@@ -1,0 +1,46 @@
+"""Test env: force JAX onto CPU with 8 virtual devices so sharding tests run
+without TPU hardware. Must run before jax is imported anywhere."""
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+xla_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in xla_flags:
+    os.environ['XLA_FLAGS'] = (
+        xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+REPO_ROOT = Path(__file__).parent.parent
+REFERENCE_ROOT = Path('/root/reference')
+
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope='session')
+def sample_video() -> str:
+    """The reference repo's sample clip (read-only)."""
+    path = REFERENCE_ROOT / 'sample' / 'v_GGSY1Qvo990.mp4'
+    if not path.exists():
+        pytest.skip('sample video unavailable')
+    return str(path)
+
+
+@pytest.fixture(scope='session')
+def sample_video_2() -> str:
+    path = REFERENCE_ROOT / 'sample' / 'v_ZNVhz7ctTq0.mp4'
+    if not path.exists():
+        pytest.skip('sample video unavailable')
+    return str(path)
+
+
+@pytest.fixture(scope='session')
+def reference_repo() -> Path:
+    """Path to the reference implementation, importable for parity tests only."""
+    if not REFERENCE_ROOT.exists():
+        pytest.skip('reference repo unavailable')
+    if str(REFERENCE_ROOT) not in sys.path:
+        sys.path.insert(0, str(REFERENCE_ROOT))
+    return REFERENCE_ROOT

@@ -1,0 +1,114 @@
+"""BaseExtractor contract: resume, fault isolation, output actions, concat."""
+import numpy as np
+import pytest
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.utils.output import load_numpy, load_pickle, make_path
+from video_features_tpu.utils.slicing import form_slices, stack_indices
+
+
+class StubExtractor(BaseExtractor):
+    output_feat_keys = ['rgb', 'flow']
+
+    def __init__(self, tmp_path, output_path, on_extraction='save_numpy',
+                 concat_rgb_flow=True, fail=False):
+        super().__init__('stub', on_extraction, str(tmp_path), str(output_path),
+                         keep_tmp_files=False, device='cpu',
+                         concat_rgb_flow=concat_rgb_flow)
+        self.fail = fail
+        self.calls = 0
+
+    def extract(self, video_path):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError('decode exploded')
+        return {'rgb': np.ones((3, 4), np.float32),
+                'flow': np.full((3, 4), 2.0, np.float32)}
+
+
+def test_concat_and_rgb_naming(tmp_path):
+    out = tmp_path / 'out'
+    ex = StubExtractor(tmp_path / 'tmp', out)
+    ex._extract('/videos/clip01.mp4')
+    # concat saved under the no-suffix 'rgb' name; no flow file
+    arr = load_numpy(str(out / 'clip01.npy'))
+    assert arr.shape == (3, 8)
+    assert (arr[:, :4] == 1).all() and (arr[:, 4:] == 2).all()
+    assert not (out / 'clip01_flow.npy').exists()
+
+
+def test_no_concat_saves_both_keys(tmp_path):
+    out = tmp_path / 'out'
+    ex = StubExtractor(tmp_path / 'tmp', out, concat_rgb_flow=False)
+    ex._extract('/videos/clip01.mp4')
+    assert load_numpy(str(out / 'clip01.npy')).shape == (3, 4)  # 'rgb' no suffix
+    assert load_numpy(str(out / 'clip01_flow.npy')).shape == (3, 4)
+
+
+def test_skip_if_exists(tmp_path):
+    out = tmp_path / 'out'
+    ex = StubExtractor(tmp_path / 'tmp', out)
+    ex._extract('/videos/clip01.mp4')
+    ex._extract('/videos/clip01.mp4')
+    assert ex.calls == 1  # second run resumed/skipped
+
+
+def test_corrupted_output_triggers_reextraction(tmp_path):
+    out = tmp_path / 'out'
+    ex = StubExtractor(tmp_path / 'tmp', out)
+    ex._extract('/videos/clip01.mp4')
+    (out / 'clip01.npy').write_bytes(b'garbage')
+    ex._extract('/videos/clip01.mp4')
+    assert ex.calls == 2
+    assert load_numpy(str(out / 'clip01.npy')).shape == (3, 8)
+
+
+def test_error_isolation(tmp_path, capsys):
+    ex = StubExtractor(tmp_path / 'tmp', tmp_path / 'out', fail=True)
+    ex._extract('/videos/bad.mp4')  # must not raise
+    captured = capsys.readouterr()
+    assert 'An error occurred' in captured.out
+    assert 'Continuing' in captured.out
+
+
+def test_keyboard_interrupt_propagates(tmp_path):
+    class KBStub(StubExtractor):
+        def extract(self, video_path):
+            raise KeyboardInterrupt
+
+    ex = KBStub(tmp_path / 'tmp', tmp_path / 'out')
+    with pytest.raises(KeyboardInterrupt):
+        ex._extract('/videos/clip01.mp4')
+
+
+def test_save_pickle_roundtrip(tmp_path):
+    out = tmp_path / 'out'
+    ex = StubExtractor(tmp_path / 'tmp', out, on_extraction='save_pickle')
+    ex._extract('/videos/clip01.mp4')
+    assert load_pickle(str(out / 'clip01.pkl')).shape == (3, 8)
+
+
+def test_print_mode_never_skips(tmp_path, capsys):
+    ex = StubExtractor(tmp_path / 'tmp', tmp_path / 'out', on_extraction='print')
+    ex._extract('/videos/clip01.mp4')
+    ex._extract('/videos/clip01.mp4')
+    assert ex.calls == 2
+    assert 'max:' in capsys.readouterr().out
+
+
+def test_make_path_naming():
+    assert make_path('/o', '/v/stem.mp4', 'rgb', '.npy') == '/o/stem.npy'
+    assert make_path('/o', '/v/stem.mp4', 'fps', '.npy') == '/o/stem_fps.npy'
+
+
+def test_form_slices():
+    assert form_slices(100, 15, 15) == [(0, 15), (15, 30), (30, 45), (45, 60),
+                                        (60, 75), (75, 90)]
+    assert form_slices(10, 16, 16) == []  # shorter than one stack → dropped
+
+
+def test_stack_indices_matches_form_slices():
+    idx = stack_indices(100, 15, 15)
+    assert idx.shape == (6, 15)
+    assert idx[0, 0] == 0 and idx[-1, -1] == 89
+    assert stack_indices(10, 16, 16).shape == (0, 16)

@@ -1,0 +1,128 @@
+"""Config system: YAML defaults, dotlist merge, sanity_check behavior parity."""
+import os
+
+import pytest
+
+from video_features_tpu.config import (
+    Config, form_list_from_user_input, load_config, parse_dotlist, sanity_check,
+)
+
+
+def _mk_video(tmp_path, name='vid.mp4'):
+    p = tmp_path / name
+    p.write_bytes(b'\x00')
+    return str(p)
+
+
+def test_parse_dotlist_yaml_typing():
+    cfg = parse_dotlist([
+        'feature_type=i3d', 'stack_size=24', 'extraction_fps=null',
+        'keep_tmp_files=true', "video_paths=['a.mp4','b.mp4']",
+    ])
+    assert cfg.feature_type == 'i3d'
+    assert cfg.stack_size == 24 and isinstance(cfg.stack_size, int)
+    assert cfg.extraction_fps is None
+    assert cfg.keep_tmp_files is True
+    assert cfg.video_paths == ['a.mp4', 'b.mp4']
+
+
+def test_load_config_defaults_and_override(tmp_path):
+    v = _mk_video(tmp_path)
+    args = load_config('i3d', overrides={'video_paths': v, 'stack_size': 24,
+                                         'device': 'cpu'})
+    assert args.feature_type == 'i3d'
+    assert args.stack_size == 24
+    assert args.step_size == 16  # YAML default survives
+    # path rewriting appends feature_type
+    assert args.output_path.endswith(os.path.join('output', 'i3d'))
+    assert args.tmp_path.endswith(os.path.join('tmp', 'i3d'))
+
+
+def test_model_name_appended_with_slash_replaced(tmp_path):
+    v = _mk_video(tmp_path)
+    args = load_config('clip', overrides={'video_paths': v, 'device': 'cpu'})
+    assert args.output_path.endswith(os.path.join('output', 'clip', 'ViT-B_32'))
+
+
+def test_unknown_feature_type():
+    with pytest.raises(NotImplementedError):
+        load_config('pwc2')
+
+
+def test_sanity_rejects_missing_paths():
+    with pytest.raises(AssertionError):
+        load_config('i3d', overrides={'device': 'cpu'})
+
+
+def test_sanity_rejects_duplicate_stems(tmp_path):
+    a = tmp_path / 'a';  a.mkdir()
+    b = tmp_path / 'b';  b.mkdir()
+    v1 = _mk_video(a, 'same.mp4')
+    v2 = _mk_video(b, 'same.mp4')
+    with pytest.raises(AssertionError):
+        load_config('resnet', overrides={'video_paths': [v1, v2], 'device': 'cpu'})
+
+
+def test_sanity_rejects_small_i3d_stack(tmp_path):
+    v = _mk_video(tmp_path)
+    with pytest.raises(AssertionError):
+        load_config('i3d', overrides={'video_paths': v, 'stack_size': 4,
+                                      'device': 'cpu'})
+
+
+def test_sanity_rejects_pwc(tmp_path):
+    v = _mk_video(tmp_path)
+    with pytest.raises(NotImplementedError):
+        load_config('i3d', overrides={'video_paths': v, 'flow_type': 'pwc',
+                                      'device': 'cpu'})
+
+
+def test_sanity_rejects_fps_and_total(tmp_path):
+    v = _mk_video(tmp_path)
+    with pytest.raises(AssertionError):
+        load_config('resnet', overrides={'video_paths': v, 'extraction_fps': 5,
+                                         'extraction_total': 10, 'device': 'cpu'})
+
+
+def test_sanity_rejects_same_out_and_tmp(tmp_path):
+    v = _mk_video(tmp_path)
+    with pytest.raises(AssertionError):
+        load_config('resnet', overrides={'video_paths': v, 'output_path': './x',
+                                         'tmp_path': './x', 'device': 'cpu'})
+
+
+def test_timm_requires_model_name(tmp_path):
+    v = _mk_video(tmp_path)
+    with pytest.raises(AssertionError):
+        load_config('timm', overrides={'video_paths': v, 'device': 'cpu'})
+
+
+def test_device_never_leaks_cuda(tmp_path):
+    # 'cuda:0' (torch-style) maps to the accelerator if present, else cpu.
+    v = _mk_video(tmp_path)
+    args = load_config('resnet', overrides={'video_paths': v, 'device': 'cuda:0'})
+    assert args.device in ('cpu', 'tpu')
+
+
+def test_device_cpu_stays_cpu(tmp_path):
+    v = _mk_video(tmp_path)
+    args = load_config('resnet', overrides={'video_paths': v, 'device': 'cpu'})
+    assert args.device == 'cpu'
+
+
+def test_form_list_from_file(tmp_path):
+    v1 = _mk_video(tmp_path, 'a.mp4')
+    v2 = _mk_video(tmp_path, 'b.mp4')
+    listfile = tmp_path / 'list.txt'
+    listfile.write_text(f'{v1}\n\n{v2}\n')
+    paths = form_list_from_user_input(None, str(listfile), to_shuffle=False)
+    assert paths == [v1, v2]
+
+
+def test_config_attr_access():
+    c = Config(a=1)
+    assert c.a == 1
+    c.b = 2
+    assert c['b'] == 2
+    with pytest.raises(AttributeError):
+        _ = c.missing

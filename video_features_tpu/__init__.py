@@ -1,0 +1,31 @@
+"""video_features_tpu — a TPU-native video feature-extraction framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capability surface of
+``video_features`` (reference: /root/reference): given video files, run frozen
+pretrained models (3D CNNs, optical flow, image backbones, an audio net) over
+frame stacks / frames / audio tracks and print or persist per-clip features.
+
+Architecture (TPU-first, not a port):
+  * all model compute is batched, fixed-shape, jit-compiled XLA (bf16/f32);
+  * host-side decode/preprocess streams fixed-shape NumPy clip tensors into HBM;
+  * scale-out = data parallelism over a ``jax.sharding.Mesh`` plus the
+    idempotent-output/skip-if-exists contract of the reference
+    (reference README.md:70-84, models/_base/base_extractor.py:100-132).
+
+Public API::
+
+    from video_features_tpu import create_extractor, load_config
+    args = load_config('i3d', overrides={'video_paths': ['a.mp4']})
+    extractor = create_extractor(args)
+    feats = extractor.extract('a.mp4')     # {'rgb': (T,1024), 'flow': (T,1024)}
+"""
+
+from video_features_tpu.config import load_config, sanity_check, Config
+from video_features_tpu.registry import EXTRACTORS, create_extractor
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'load_config', 'sanity_check', 'Config', 'EXTRACTORS', 'create_extractor',
+    '__version__',
+]

@@ -1,0 +1,3 @@
+from video_features_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,46 @@
+"""CLI entry point: ``python -m video_features_tpu feature_type=X key=val ...``
+
+Reference main.py:7-55 behavior: load per-feature YAML, merge dotlist CLI
+(CLI wins), sanity-check, build the one extractor, shuffle the video list,
+loop ``_extract`` per video with fault isolation.
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+import yaml
+
+from video_features_tpu.config import (
+    form_list_from_user_input, load_config, parse_dotlist,
+)
+from video_features_tpu.registry import create_extractor
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cli_args = parse_dotlist(argv)
+    if 'feature_type' not in cli_args:
+        print('Usage: python -m video_features_tpu feature_type=<name> [key=value ...]')
+        return 2
+    args = load_config(cli_args['feature_type'], overrides=cli_args)
+
+    print(yaml.safe_dump(dict(args), sort_keys=False, default_flow_style=False))
+    if args['on_extraction'] in ('save_numpy', 'save_pickle'):
+        print(f'Saving features to {args["output_path"]}')
+    print('Device:', args['device'])
+
+    extractor = create_extractor(args)
+
+    video_paths = form_list_from_user_input(
+        args.get('video_paths'), args.get('file_with_video_paths'), to_shuffle=True)
+    print(f'The number of specified videos: {len(video_paths)}')
+
+    for i, video_path in enumerate(video_paths):
+        print(f'[{i + 1}/{len(video_paths)}] {video_path}')
+        extractor._extract(video_path)
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

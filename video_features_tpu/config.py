@@ -1,0 +1,221 @@
+"""Config system: per-feature YAML defaults merged with dotlist CLI overrides.
+
+Behavior parity with the reference's OmegaConf pipeline (main.py:9-10,
+utils/utils.py:77-135) without the OmegaConf dependency: flat key=value YAML
+files, CLI ``key=value`` dotlist wins over YAML, then an imperative
+``sanity_check`` that validates combinations and rewrites output/tmp paths.
+"""
+from __future__ import annotations
+
+import os
+import random
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import yaml
+
+CONFIG_DIR = Path(__file__).parent / 'configs'
+
+KNOWN_FEATURE_TYPES = ('i3d', 'r21d', 's3d', 'vggish', 'resnet', 'raft', 'clip', 'timm')
+
+
+class Config(dict):
+    """A flat dict with attribute access — the shape every extractor consumes.
+
+    The reference accepts "any object with the right attributes" (its tests
+    patch OmegaConf dicts programmatically, tests/utils.py:51-56); this class
+    keeps that duck-typed contract.
+    """
+
+    def __getattr__(self, key: str) -> Any:
+        try:
+            return self[key]
+        except KeyError:
+            raise AttributeError(key)
+
+    def __setattr__(self, key: str, value: Any) -> None:
+        self[key] = value
+
+    def __delattr__(self, key: str) -> None:
+        try:
+            del self[key]
+        except KeyError:
+            raise AttributeError(key)
+
+    def copy(self) -> 'Config':
+        return Config(self)
+
+
+def build_cfg_path(feature_type: str) -> Path:
+    """Default YAML path for a feature family (reference utils/utils.py:229-240)."""
+    return CONFIG_DIR / f'{feature_type}.yml'
+
+
+def _parse_value(raw: str) -> Any:
+    """Parse one CLI value with YAML scalar/list semantics (OmegaConf-like).
+
+    ``null``→None, ``true``→bool, ``3``→int, ``'[a,b]'``→list, else str.
+    """
+    try:
+        return yaml.safe_load(raw)
+    except yaml.YAMLError:
+        return raw
+
+
+def parse_dotlist(dotlist: Iterable[str]) -> Config:
+    """Parse ``['key=value', ...]`` CLI args into a Config."""
+    cfg = Config()
+    for item in dotlist:
+        if '=' not in item:
+            raise ValueError(f'Malformed CLI argument (expected key=value): {item!r}')
+        key, _, raw = item.partition('=')
+        cfg[key.strip()] = _parse_value(raw)
+    return cfg
+
+
+def load_yaml(path: Union[str, os.PathLike]) -> Config:
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    if not isinstance(data, dict):
+        raise ValueError(f'Config file {path} must contain a flat mapping')
+    return Config(data)
+
+
+def load_config(
+    feature_type: Optional[str] = None,
+    overrides: Optional[Dict[str, Any]] = None,
+    run_sanity_check: bool = True,
+) -> Config:
+    """YAML defaults ← overrides (overrides win), then sanity_check.
+
+    Mirrors reference main.py:9-11: ``OmegaConf.merge(args_yml, args_cli)``
+    with CLI priority, followed by ``sanity_check``.
+    """
+    overrides = dict(overrides or {})
+    feature_type = feature_type or overrides.get('feature_type')
+    if feature_type is None:
+        raise ValueError('feature_type must be given (CLI: feature_type=<name>)')
+    cfg_path = build_cfg_path(feature_type)
+    if not cfg_path.exists():
+        raise NotImplementedError(
+            f'Extractor {feature_type!r} is not implemented. '
+            f'Known: {", ".join(KNOWN_FEATURE_TYPES)}')
+    args = load_yaml(cfg_path)
+    args.update(overrides)
+    if run_sanity_check:
+        sanity_check(args)
+    return args
+
+
+def resolve_device(device: str) -> str:
+    """Map a user device string onto a JAX platform.
+
+    The reference accepts torch strings ('cuda:0', 'cpu'); we keep accepting
+    them for drop-in compatibility (reference utils/utils.py:83-92 maps
+    unavailable CUDA → CPU): 'cuda*'/'tpu' → the accelerator platform if one
+    is present, else 'cpu'.
+    """
+    import jax
+
+    device = str(device).lower()
+    platforms = {d.platform for d in jax.devices()}
+    accel = next((p for p in platforms if p != 'cpu'), None)
+    if device.startswith(('cuda', 'tpu', 'gpu', 'accel')):
+        if accel is not None:
+            return accel
+        print('An accelerator was requested but the system does not have one. '
+              'Going to use CPU...')
+        return 'cpu'
+    return 'cpu'
+
+
+def sanity_check(args: Config) -> None:
+    """Validate the merged config and rewrite output/tmp paths.
+
+    Check-for-check parity with reference utils/utils.py:77-135:
+      * legacy ``device_ids`` → single-device warning (:83-89);
+      * unavailable accelerator degrades to CPU (:90-92);
+      * paths required; unique video stems (:93-95, upstream issue #54);
+      * output_path != tmp_path (:96);
+      * i3d stack_size >= 10 (:103-106); pwc removed (:107-109);
+      * timm model_name required (:113-115); batch_size not None (:116-117);
+      * extraction_fps xor extraction_total (:118-120);
+      * append ``<feature_type>[/<model_name>]`` ('/'→'_') to output/tmp
+        paths (:122-135).
+    """
+    if 'device_ids' in args:
+        print('WARNING: multi-device single-process extraction is not supported. '
+              'Scale out by sharding the video list across workers/hosts '
+              f'(device_ids={args["device_ids"]} ignored; using one accelerator).')
+        args['device'] = 'tpu'
+    args['device'] = resolve_device(args.get('device', 'cpu'))
+
+    assert args.get('file_with_video_paths') or args.get('video_paths'), \
+        '`video_paths` or `file_with_video_paths` must be specified'
+    filenames = [Path(p).stem for p in form_list_from_user_input(
+        args.get('video_paths'), args.get('file_with_video_paths'), to_shuffle=False)]
+    assert len(filenames) == len(set(filenames)), \
+        'Non-unique video filenames (stems collide in the flat output dir)'
+    assert os.path.relpath(str(args['output_path'])) != os.path.relpath(str(args['tmp_path'])), \
+        'The same path for out & tmp'
+
+    ft = args.get('feature_type')
+    if args.get('show_pred') and ft == 'vggish':
+        print('Showing class predictions is not implemented for VGGish')
+    if ft == 'i3d' and args.get('stack_size') is not None:
+        assert args['stack_size'] >= 10, (
+            f'I3D does not support inputs shorter than 10 timestamps. '
+            f'You have: {args["stack_size"]}')
+    if ft == 'pwc' or (ft == 'i3d' and args.get('flow_type') == 'pwc'):
+        raise NotImplementedError('PWC flow is not supported; use flow_type=raft')
+    if ft == 'timm':
+        assert args.get('model_name') is not None, \
+            'Please specify `model_name` for timm-style models; e.g. `efficientnet_b0`'
+    if 'batch_size' in args:
+        assert args['batch_size'] is not None, \
+            f'Please specify `batch_size`. It is {args["batch_size"]} now'
+    if 'extraction_fps' in args and 'extraction_total' in args:
+        assert not (args['extraction_fps'] is not None and args['extraction_total'] is not None), \
+            '`extraction_fps` and `extraction_total` are mutually exclusive'
+
+    # Append <feature_type>[/<model_name>] to output & tmp paths ('/' → '_').
+    subs = [ft] if ft else []
+    if args.get('model_name') is not None:
+        subs.append(str(args['model_name']))
+    out, tmp = str(args['output_path']), str(args['tmp_path'])
+    for p in subs:
+        out = os.path.join(out, p.replace('/', '_'))
+        tmp = os.path.join(tmp, p.replace('/', '_'))
+    args['output_path'] = out
+    args['tmp_path'] = tmp
+
+
+def form_list_from_user_input(
+    video_paths: Union[str, List[str], None] = None,
+    file_with_video_paths: Optional[str] = None,
+    to_shuffle: bool = True,
+) -> List[str]:
+    """Normalize user-specified paths into a list (reference utils/utils.py:138-178).
+
+    A file lists one path per line (blank lines dropped). Shuffling randomizes
+    the work order so independent shared-filesystem workers rarely collide on
+    the same video — the reference's whole multi-worker story (:151-152).
+    """
+    if file_with_video_paths is None:
+        if video_paths is None:
+            path_list: List[str] = []
+        elif isinstance(video_paths, str):
+            path_list = [video_paths]
+        else:
+            path_list = [str(p) for p in video_paths]
+    else:
+        with open(file_with_video_paths) as f:
+            path_list = [line.strip() for line in f if line.strip()]
+
+    for path in path_list:
+        if not Path(path).exists():
+            print(f'The path does not exist: {path}')
+
+    if to_shuffle:
+        random.shuffle(path_list)
+    return path_list

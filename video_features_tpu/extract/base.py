@@ -1,0 +1,143 @@
+"""BaseExtractor: per-video orchestration, fault isolation, idempotent output.
+
+Re-design of reference models/_base/base_extractor.py (132 LoC) with the same
+externally observable contract:
+  * ``_extract`` = skip-if-exists → ``extract()`` → [optional rgb||flow
+    concat] → ``action_on_extraction``; any exception is isolated per video
+    (KeyboardInterrupt re-raised) so one bad file never kills a worker
+    (reference base_extractor.py:29-58);
+  * ``action_on_extraction`` prints (with max/mean/min) or saves
+    numpy/pickle, warns on empty values, and re-checks existence right before
+    writing so concurrent shared-filesystem workers collide benignly
+    (reference base_extractor.py:60-98);
+  * ``is_already_exist`` requires ALL output files present *and loadable* —
+    the load doubles as corruption detection, and is what makes workers
+    restartable/elastic (reference base_extractor.py:100-132).
+
+Unlike the fork (which concatenates rgb||flow unconditionally and thereby
+breaks every non-I3D extractor, reference base_extractor.py:43-52), the concat
+here is opt-in via ``concat_rgb_flow`` and only applies when both streams are
+present — upstream behavior for everyone else.
+"""
+from __future__ import annotations
+
+import os
+import traceback
+from pathlib import Path
+from typing import Dict, List, Union
+
+import numpy as np
+
+from video_features_tpu.utils.output import (
+    ACTION_TO_EXT, ACTION_TO_LOAD, ACTION_TO_SAVE, make_path,
+)
+
+
+class BaseExtractor:
+    """Common per-video orchestration inherited by every extractor."""
+
+    # subclasses must set: output_feat_keys: List[str]
+    output_feat_keys: List[str] = []
+
+    def __init__(
+        self,
+        feature_type: str,
+        on_extraction: str,
+        tmp_path: str,
+        output_path: str,
+        keep_tmp_files: bool,
+        device: str,
+        concat_rgb_flow: bool = False,
+    ) -> None:
+        self.feature_type = feature_type
+        self.on_extraction = on_extraction
+        self.tmp_path = tmp_path
+        self.output_path = output_path
+        self.keep_tmp_files = keep_tmp_files
+        self.device = device
+        self.concat_rgb_flow = concat_rgb_flow
+
+    # -- per-video driver ---------------------------------------------------
+
+    def _extract(self, video_path: str) -> None:
+        """Fault-isolating wrapper around :meth:`extract` for the work loop."""
+        try:
+            if self.is_already_exist(video_path):
+                return
+            feats_dict = self.extract(video_path)
+            feats_dict = self._maybe_concat_streams(feats_dict)
+            self.action_on_extraction(feats_dict, video_path)
+        except KeyboardInterrupt:
+            raise
+        except Exception:
+            print(f'An error occurred during extraction from: {video_path}:')
+            traceback.print_exc()
+            print('Continuing...')
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def _maybe_concat_streams(self, feats_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """rgb||flow → single (T, 2C) array under 'rgb' when configured.
+
+        Preserves the fork's flagship output (reference
+        base_extractor.py:46-50) without breaking single-stream extractors.
+        """
+        if self.concat_rgb_flow and 'rgb' in feats_dict and 'flow' in feats_dict:
+            feats_dict = dict(feats_dict)
+            flow = feats_dict.pop('flow')
+            feats_dict['rgb'] = np.concatenate((feats_dict['rgb'], flow), axis=1)
+        return feats_dict
+
+    # -- output actions -----------------------------------------------------
+
+    def action_on_extraction(self, feats_dict: Dict[str, np.ndarray], video_path: str) -> None:
+        if self.on_extraction in ACTION_TO_EXT and self.is_already_exist(video_path):
+            # A concurrent worker finished this video while we extracted it.
+            print('WARNING: extraction didnt find feature files on the 1st try '
+                  'but did on the 2nd try.')
+            return
+
+        for key, value in feats_dict.items():
+            if self.on_extraction == 'print':
+                print(key)
+                print(value)
+                print(f'max: {value.max():.8f}; mean: {value.mean():.8f}; min: {value.min():.8f}')
+                print()
+            elif self.on_extraction in ACTION_TO_EXT:
+                os.makedirs(self.output_path, exist_ok=True)
+                fpath = make_path(self.output_path, video_path, key,
+                                  ACTION_TO_EXT[self.on_extraction])
+                if key != 'fps' and len(value) == 0:
+                    print(f'Warning: the value is empty for {key} @ {fpath}')
+                ACTION_TO_SAVE[self.on_extraction](fpath, value)
+            else:
+                raise NotImplementedError(
+                    f'on_extraction: {self.on_extraction} is not implemented')
+
+    def is_already_exist(self, video_path: Union[str, Path]) -> bool:
+        """True iff every output file exists and loads cleanly (resume contract)."""
+        if self.on_extraction not in ACTION_TO_EXT:
+            return False
+
+        keys = self._saved_feat_keys()
+        for key in keys:
+            fpath = make_path(self.output_path, video_path, key,
+                              ACTION_TO_EXT[self.on_extraction])
+            if not Path(fpath).exists():
+                return False
+            try:
+                ACTION_TO_LOAD[self.on_extraction](fpath)
+            except Exception:
+                # Corrupted (e.g. a worker died mid-write) → re-extract.
+                return False
+        print(f'Features for {video_path} already exist in '
+              f'{Path(self.output_path).absolute()}/ - skipping..')
+        return True
+
+    def _saved_feat_keys(self) -> List[str]:
+        """Keys that actually reach disk, accounting for the concat folding 'flow' into 'rgb'."""
+        keys = list(self.output_feat_keys)
+        if self.concat_rgb_flow and 'rgb' in keys and 'flow' in keys:
+            keys.remove('flow')
+        return keys

@@ -1,0 +1,33 @@
+"""Extractor registry with lazy imports (reference main.py:20-38 dispatch)."""
+from __future__ import annotations
+
+import importlib
+from typing import TYPE_CHECKING, Dict, Tuple
+
+if TYPE_CHECKING:
+    from video_features_tpu.config import Config
+    from video_features_tpu.extract.base import BaseExtractor
+
+# feature_type -> (module, class). Imports are deferred so a missing optional
+# dependency for one family never breaks the others.
+EXTRACTORS: Dict[str, Tuple[str, str]] = {
+    'i3d': ('video_features_tpu.extract.i3d', 'ExtractI3D'),
+    'r21d': ('video_features_tpu.extract.r21d', 'ExtractR21D'),
+    's3d': ('video_features_tpu.extract.s3d', 'ExtractS3D'),
+    'vggish': ('video_features_tpu.extract.vggish', 'ExtractVGGish'),
+    'resnet': ('video_features_tpu.extract.resnet', 'ExtractResNet'),
+    'raft': ('video_features_tpu.extract.raft', 'ExtractRAFT'),
+    'clip': ('video_features_tpu.extract.clip', 'ExtractCLIP'),
+    'timm': ('video_features_tpu.extract.timm', 'ExtractTIMM'),
+}
+
+
+def create_extractor(args: 'Config') -> 'BaseExtractor':
+    feature_type = args['feature_type']
+    try:
+        module_name, class_name = EXTRACTORS[feature_type]
+    except KeyError:
+        raise NotImplementedError(f'Extractor {feature_type!r} is not implemented. '
+                                  f'Known: {", ".join(EXTRACTORS)}')
+    module = importlib.import_module(module_name)
+    return getattr(module, class_name)(args)

@@ -1,0 +1,43 @@
+"""Output pathing & serialization (reference utils/utils.py:56-63,252-262)."""
+from __future__ import annotations
+
+import os
+import pickle
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+
+def make_path(output_root: str, video_path: str, output_key: str, ext: str) -> str:
+    """``<out>/<stem><ext>`` for key 'rgb', else ``<out>/<stem>_<key><ext>``.
+
+    The no-suffix 'rgb' special case is the fork's output contract for the
+    concatenated I3D feature (reference utils/utils.py:56-63).
+    """
+    stem = Path(video_path).stem
+    fname = f'{stem}{ext}' if output_key == 'rgb' else f'{stem}_{output_key}{ext}'
+    return os.path.join(output_root, fname)
+
+
+def load_numpy(fpath: str) -> np.ndarray:
+    return np.load(fpath)
+
+
+def write_numpy(fpath: str, value: Any) -> None:
+    np.save(fpath, value)
+
+
+def load_pickle(fpath: str) -> Any:
+    with open(fpath, 'rb') as f:
+        return pickle.load(f)
+
+
+def write_pickle(fpath: str, value: Any) -> None:
+    with open(fpath, 'wb') as f:
+        pickle.dump(value, f)
+
+
+ACTION_TO_EXT = {'save_numpy': '.npy', 'save_pickle': '.pkl'}
+ACTION_TO_SAVE = {'save_numpy': write_numpy, 'save_pickle': write_pickle}
+ACTION_TO_LOAD = {'save_numpy': load_numpy, 'save_pickle': load_pickle}

@@ -1,0 +1,30 @@
+"""Temporal stack slicing (reference utils/utils.py:65-74)."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def form_slices(size: int, stack_size: int, step_size: int) -> List[Tuple[int, int]]:
+    """Sliding-window (start, end) index pairs; full stacks only (floor).
+
+    Partial final stacks are dropped — the reference does the same for
+    i3d/r21d/s3d and parity requires reproducing it.
+    """
+    full_stack_num = (size - stack_size) // step_size + 1
+    return [(i * step_size, i * step_size + stack_size) for i in range(max(full_stack_num, 0))]
+
+
+def stack_indices(size: int, stack_size: int, step_size: int) -> np.ndarray:
+    """All stack windows as one gather-index array of shape (num_stacks, stack_size).
+
+    TPU-first counterpart of :func:`form_slices`: instead of a Python loop of
+    slices, one integer array drives a single vectorized ``frames[idx]`` gather
+    that produces the whole (num_stacks, stack_size, ...) clip batch at once.
+    """
+    slices = form_slices(size, stack_size, step_size)
+    if not slices:
+        return np.zeros((0, stack_size), dtype=np.int32)
+    starts = np.array([s for s, _ in slices], dtype=np.int32)
+    return starts[:, None] + np.arange(stack_size, dtype=np.int32)[None, :]
