@@ -1,0 +1,93 @@
+"""VideoLoader: batch/overlap/timestamp semantics on the sample videos."""
+import numpy as np
+import pytest
+
+from video_features_tpu.io.video import (
+    VideoLoader, get_video_props, resample_frame_indices,
+)
+
+
+def test_props(sample_video):
+    props = get_video_props(sample_video)
+    assert props['fps'] > 0 and props['num_frames'] > 0
+    assert props['height'] > 0 and props['width'] > 0
+
+
+def test_native_fps_batches_and_timestamps(sample_video):
+    loader = VideoLoader(sample_video, batch_size=32)
+    total = 0
+    first_times = None
+    for batch, times, indices in loader:
+        assert batch.dtype == np.uint8
+        assert batch.shape[1:] == (loader.height, loader.width, 3)
+        assert len(times) == len(indices) == batch.shape[0]
+        if first_times is None:
+            first_times = times
+        # timestamp formula: idx / fps * 1000
+        np.testing.assert_allclose(
+            times, [i / loader.fps * 1000 for i in indices])
+        total += batch.shape[0]
+    assert total == len(loader)
+    assert first_times[0] == 0.0
+
+
+def test_overlap_caching(sample_video):
+    loader = VideoLoader(sample_video, batch_size=8, overlap=1)
+    prev_last = None
+    for batch, times, indices in loader:
+        if prev_last is not None:
+            np.testing.assert_array_equal(batch[0], prev_last)
+            assert indices[0] == prev_idx
+        prev_last, prev_idx = batch[-1], indices[-1]
+    # overlap=1 means each batch after the first contributes batch-1 new frames
+
+
+def test_overlap_counts(sample_video):
+    n = len(VideoLoader(sample_video, batch_size=8))
+    loader = VideoLoader(sample_video, batch_size=8, overlap=1)
+    seen = []
+    for batch, times, indices in loader:
+        seen.extend(indices if not seen else indices[1:])
+    assert seen == list(range(n))
+
+
+def test_fps_resampling_downsample(sample_video):
+    props = get_video_props(sample_video)
+    target = props['fps'] / 2
+    loader = VideoLoader(sample_video, batch_size=16, fps=target, use_ffmpeg=False)
+    assert loader.fps == target
+    frames = sum(b.shape[0] for b, _, _ in loader)
+    expected = props['num_frames'] / 2
+    assert abs(frames - expected) <= 2
+    assert frames == len(loader)
+
+
+def test_total_mode(sample_video):
+    loader = VideoLoader(sample_video, batch_size=16, total=20, use_ffmpeg=False)
+    frames = sum(b.shape[0] for b, _, _ in loader)
+    assert abs(frames - 20) <= 1
+
+
+def test_resample_indices_identity():
+    idx = resample_frame_indices(10, 25.0, 25.0)
+    np.testing.assert_array_equal(idx, np.arange(10))
+
+
+def test_resample_indices_upsample():
+    idx = resample_frame_indices(10, 10.0, 20.0)
+    assert len(idx) == 20
+    assert idx[0] == 0 and idx[-1] == 9
+    assert (np.diff(idx) >= 0).all()
+
+
+def test_transform_applied(sample_video):
+    loader = VideoLoader(sample_video, batch_size=4,
+                         transform=lambda f: f.astype(np.float32) / 255.0)
+    batch, _, _ = next(iter(loader))
+    assert batch[0].dtype == np.float32
+    assert batch[0].max() <= 1.0
+
+
+def test_fps_and_total_mutually_exclusive(sample_video):
+    with pytest.raises(ValueError):
+        VideoLoader(sample_video, fps=10, total=10)
