@@ -37,6 +37,30 @@ def sample_video_2() -> str:
 
 
 @pytest.fixture(scope='session')
+def short_video(tmp_path_factory) -> str:
+    """A ~48-frame clip cut from the sample video (keeps CPU E2E tests fast)."""
+    import cv2
+
+    src = REFERENCE_ROOT / 'sample' / 'v_ZNVhz7ctTq0.mp4'
+    if not src.exists():
+        pytest.skip('sample video unavailable')
+    out = str(tmp_path_factory.mktemp('vids') / 'short_clip.mp4')
+    cap = cv2.VideoCapture(str(src))
+    fps = cap.get(cv2.CAP_PROP_FPS)
+    w = int(cap.get(cv2.CAP_PROP_FRAME_WIDTH))
+    h = int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
+    writer = cv2.VideoWriter(out, cv2.VideoWriter_fourcc(*'mp4v'), fps, (w, h))
+    for _ in range(48):
+        ok, frame = cap.read()
+        if not ok:
+            break
+        writer.write(frame)
+    writer.release()
+    cap.release()
+    return out
+
+
+@pytest.fixture(scope='session')
 def reference_repo() -> Path:
     """Path to the reference implementation, importable for parity tests only."""
     if not REFERENCE_ROOT.exists():

@@ -1,0 +1,67 @@
+"""Transform numerics parity vs torch (CPU reference semantics)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from video_features_tpu.ops.transforms import (
+    center_crop, flow_to_uint8_levels, normalize, resize_bilinear,
+    scale_to_pm1, to_float_zero_one,
+)
+
+
+def test_resize_matches_torch_interpolate():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 60, 80, 3).astype(np.float32)
+    ours = np.asarray(resize_bilinear(x, (128, 171)))
+    # torch works channels-first
+    xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+    ref = F.interpolate(xt, size=(128, 171), mode='bilinear',
+                        align_corners=False).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_resize_downscale_matches_torch():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 240, 320, 3).astype(np.float32)
+    ours = np.asarray(resize_bilinear(x, (128, 171)))
+    xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+    ref = F.interpolate(xt, size=(128, 171), mode='bilinear',
+                        align_corners=False).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_center_crop_matches_torch_offsets():
+    # torch center_crop on (..., H, W): top = int(round((H - th) / 2.))
+    x = np.arange(10 * 9 * 1, dtype=np.float32).reshape(1, 10, 9, 1)
+    out = np.asarray(center_crop(x, (4, 4)))
+    assert out.shape == (1, 4, 4, 1)
+    # reference models/transforms.py:14-17: i = round((h - th) / 2.)
+    i, j = int(round((10 - 4) / 2.0)), int(round((9 - 4) / 2.0))
+    np.testing.assert_array_equal(out[0, :, :, 0], x[0, i:i + 4, j:j + 4, 0])
+
+
+def test_to_float_zero_one():
+    x = np.array([0, 128, 255], np.uint8).reshape(1, 1, 3, 1)
+    out = np.asarray(to_float_zero_one(x))
+    np.testing.assert_allclose(out.ravel(), [0, 128 / 255, 1.0], atol=1e-7)
+
+
+def test_scale_to_pm1():
+    x = np.array([0.0, 127.5, 255.0], np.float32)
+    np.testing.assert_allclose(np.asarray(scale_to_pm1(x)), [-1, 0, 1], atol=1e-6)
+
+
+def test_normalize():
+    x = np.ones((1, 2, 2, 3), np.float32)
+    out = np.asarray(normalize(x, [1, 1, 1], [2, 2, 2]))
+    np.testing.assert_allclose(out, 0)
+
+
+def test_flow_uint8_quantization_matches_reference_recipe():
+    # reference transforms.py:168-176: clamp ±20, (x+20)/40*255, round
+    flow = np.array([-25.0, -20.0, 0.0, 10.0, 20.0, 30.0], np.float32)
+    out = np.asarray(flow_to_uint8_levels(flow, 20.0))
+    expected = np.round((np.clip(flow, -20, 20) + 20) / 40 * 255)
+    np.testing.assert_array_equal(out, expected)
+    assert out.min() >= 0 and out.max() <= 255

@@ -1,0 +1,60 @@
+"""Transplant layer: layout conversion, nesting, DP-prefix stripping."""
+import numpy as np
+
+from video_features_tpu.transplant.torch2jax import (
+    convert_tensor, nest, strip_dataparallel, transplant,
+)
+
+
+def test_conv2d_layout():
+    w = np.arange(2 * 3 * 5 * 7).reshape(2, 3, 5, 7).astype(np.float32)
+    out = convert_tensor('conv.weight', w)
+    assert out.shape == (5, 7, 3, 2)  # (O,I,kH,kW) -> (kH,kW,I,O)
+    assert out[1, 2, 0, 1] == w[1, 0, 1, 2]
+
+
+def test_conv3d_layout():
+    w = np.zeros((4, 3, 1, 7, 7), np.float32)
+    assert convert_tensor('stem.0.weight', w).shape == (1, 7, 7, 3, 4)
+
+
+def test_linear_layout():
+    w = np.arange(6).reshape(2, 3).astype(np.float32)
+    out = convert_tensor('fc.weight', w)
+    assert out.shape == (3, 2)
+    np.testing.assert_array_equal(out, w.T)
+
+
+def test_bias_untouched():
+    b = np.arange(4).astype(np.float32)
+    np.testing.assert_array_equal(convert_tensor('fc.bias', b), b)
+
+
+def test_bn_vectors_untouched():
+    v = np.ones(8, np.float32)
+    np.testing.assert_array_equal(convert_tensor('bn.running_mean', v), v)
+    # BN '.weight' is 1-D → not transposed
+    np.testing.assert_array_equal(convert_tensor('bn.weight', v), v)
+
+
+def test_strip_dataparallel_keeps_unprefixed():
+    sd = {'module.a.weight': 1, 'b.bias': 2}
+    out = strip_dataparallel(sd)
+    assert out == {'a.weight': 1, 'b.bias': 2}
+
+
+def test_nest():
+    tree = nest({'a.b.c': 1, 'a.b.d': 2, 'e': 3})
+    assert tree == {'a': {'b': {'c': 1, 'd': 2}}, 'e': 3}
+
+
+def test_transplant_drops_num_batches_tracked():
+    sd = {'bn.num_batches_tracked': np.int64(7), 'bn.weight': np.ones(2, np.float32)}
+    tree = transplant(sd)
+    assert 'num_batches_tracked' not in tree['bn']
+
+
+def test_transplant_dtype_cast():
+    sd = {'fc.weight': np.ones((2, 2), np.float16)}
+    tree = transplant(sd, dtype=np.float32)
+    assert tree['fc']['weight'].dtype == np.float32

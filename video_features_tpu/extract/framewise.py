@@ -1,0 +1,83 @@
+"""Frame-wise extractor base (ResNet / CLIP / timm-style backbones).
+
+Re-design of reference models/_base/base_framewise_extractor.py (90 LoC):
+the host prepares fixed-size uint8 frames (PIL short-side resize + center
+crop), batches are padded to the compiled batch size and masked, and one
+jit-compiled step does float conversion + normalization + the backbone
+forward — so every batch reuses a single XLA executable per video geometry.
+
+Returns {feature_type: (T, D), 'fps': scalar, 'timestamps_ms': (T,)} exactly
+like the reference (:75-79).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.video import VideoLoader
+
+
+class BaseFrameWiseExtractor(BaseExtractor):
+
+    def __init__(self, args, feat_dim: int) -> None:
+        super().__init__(
+            feature_type=args.feature_type,
+            on_extraction=args.on_extraction,
+            tmp_path=args.tmp_path,
+            output_path=args.output_path,
+            keep_tmp_files=args.keep_tmp_files,
+            device=args.device,
+        )
+        self.batch_size = args.batch_size
+        self.extraction_fps = args.get('extraction_fps')
+        self.extraction_total = args.get('extraction_total')
+        self.show_pred = args.show_pred
+        self.feat_dim = feat_dim
+        self.output_feat_keys = [self.feature_type, 'fps', 'timestamps_ms']
+
+    # subclasses provide:
+    def host_transform(self, frame: np.ndarray) -> np.ndarray:
+        """HWC uint8 RGB frame → fixed-size HWC uint8 (resize + crop)."""
+        raise NotImplementedError
+
+    def device_step(self, batch: np.ndarray) -> jax.Array:
+        """(B, H, W, 3) uint8 → (B, D) features. Must be jit-compiled."""
+        raise NotImplementedError
+
+    def maybe_show_pred(self, feats: np.ndarray) -> None:
+        pass
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        loader = VideoLoader(
+            video_path,
+            batch_size=self.batch_size,
+            fps=self.extraction_fps,
+            total=self.extraction_total,
+            tmp_path=self.tmp_path,
+            keep_tmp=self.keep_tmp_files,
+            transform=self.host_transform,
+        )
+        feats, timestamps = [], []
+        with jax.default_matmul_precision('highest'):
+            for batch, times, _ in loader:
+                batch = np.stack(batch)
+                valid = batch.shape[0]
+                if valid < self.batch_size:  # pad tail to the compiled shape
+                    pad = np.repeat(batch[-1:], self.batch_size - valid, axis=0)
+                    batch = np.concatenate([batch, pad], axis=0)
+                out = np.asarray(self.device_step(batch))[:valid]
+                feats.append(out)
+                timestamps.extend(times)
+                if self.show_pred:
+                    self.maybe_show_pred(out)
+
+        features = (np.concatenate(feats, axis=0) if feats
+                    else np.zeros((0, self.feat_dim), np.float32))
+        return {
+            self.feature_type: features,
+            'fps': np.array(loader.fps),
+            'timestamps_ms': np.array(timestamps),
+        }

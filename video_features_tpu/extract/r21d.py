@@ -1,0 +1,127 @@
+"""R(2+1)D extractor (reference models/r21d/extract_r21d.py behavior).
+
+TPU-first data path: the whole decoded video becomes one (T, H, W, 3) uint8
+array; sliding windows are a single vectorized gather (stack_indices), and the
+jit-compiled step transforms + runs a FIXED-shape batch of stacks per call
+(ragged tails padded and masked) so XLA compiles exactly once per video
+geometry. The reference instead loops python-side one stack at a time
+(extract_r21d.py:81-85).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.video import VideoLoader, iter_frame_batches
+from video_features_tpu.models import r21d as r21d_model
+from video_features_tpu.ops.transforms import (
+    center_crop, normalize, resize_bilinear, to_float_zero_one,
+)
+from video_features_tpu.utils.device import jax_device
+from video_features_tpu.utils.slicing import stack_indices
+
+# model_name -> (arch, native stack, native step, pred dataset)
+MODEL_CFGS = {
+    'r2plus1d_18_16_kinetics': dict(arch='r2plus1d_18', stack_size=16,
+                                    step_size=16, dataset='kinetics'),
+    'r2plus1d_34_32_ig65m_ft_kinetics': dict(arch='r2plus1d_34', stack_size=32,
+                                             step_size=32, dataset='kinetics'),
+    'r2plus1d_34_8_ig65m_ft_kinetics': dict(arch='r2plus1d_34', stack_size=8,
+                                            step_size=8, dataset='kinetics'),
+}
+
+# stacks per device step; tails are padded to this and masked out
+STACK_BATCH = 4
+
+
+class ExtractR21D(BaseExtractor):
+
+    def __init__(self, args) -> None:
+        super().__init__(
+            feature_type=args.feature_type,
+            on_extraction=args.on_extraction,
+            tmp_path=args.tmp_path,
+            output_path=args.output_path,
+            keep_tmp_files=args.keep_tmp_files,
+            device=args.device,
+        )
+        self.model_name = args.model_name
+        self.model_def = MODEL_CFGS[self.model_name]
+        self.extraction_fps = args.extraction_fps
+        self.stack_size = args.stack_size or self.model_def['stack_size']
+        self.step_size = args.step_size or self.model_def['step_size']
+        self.show_pred = args.show_pred
+        self.output_feat_keys = [self.feature_type]
+        self._device = jax_device(self.device)
+        self.params = jax.device_put(self.load_params(args), self._device)
+        self._step = jax.jit(
+            partial(self._forward_batch, arch=self.model_def['arch']))
+
+    # -- model --------------------------------------------------------------
+
+    def load_params(self, args):
+        """Transplanted torch checkpoint if provided, else documented-shape
+        random init (pretrained blobs are not bundled; see transplant/)."""
+        ckpt = args.get('checkpoint_path') if hasattr(args, 'get') else None
+        if ckpt:
+            from video_features_tpu.transplant.torch2jax import load_torch_checkpoint
+            return load_torch_checkpoint(ckpt)
+        from video_features_tpu.transplant.torch2jax import transplant
+        return transplant(r21d_model.init_state_dict(arch=self.model_def['arch']))
+
+    @staticmethod
+    def _forward_batch(params, stacks, arch):
+        """(B, stack, H, W, 3) uint8 → (B, 512) features.
+
+        Transform chain parity (reference extract_r21d.py:102-107):
+        ToFloatTensorInZeroOne → Resize(128, 171) → Normalize → CenterCrop(112).
+        """
+        x = to_float_zero_one(stacks)
+        x = resize_bilinear(x, (128, 171))
+        x = normalize(x, r21d_model.MEAN, r21d_model.STD)
+        x = center_crop(x, (112, 112))
+        return r21d_model.forward(params, x, arch=arch, features=True)
+
+    # -- extraction ---------------------------------------------------------
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        loader = VideoLoader(
+            video_path, batch_size=64,
+            fps=self.extraction_fps, tmp_path=self.tmp_path,
+            keep_tmp=self.keep_tmp_files)
+        frames = np.concatenate(
+            [b for b, _, _ in iter_frame_batches(loader)], axis=0)
+
+        idx = stack_indices(len(frames), self.stack_size, self.step_size)
+        num_stacks = idx.shape[0]
+        feats = []
+        with jax.default_matmul_precision('highest'):
+            for start in range(0, num_stacks, STACK_BATCH):
+                chunk = idx[start:start + STACK_BATCH]
+                valid = chunk.shape[0]
+                if valid < STACK_BATCH:  # pad to the compiled shape, mask later
+                    pad = np.repeat(chunk[-1:], STACK_BATCH - valid, axis=0)
+                    chunk = np.concatenate([chunk, pad], axis=0)
+                stacks = frames[chunk]  # (B, stack, H, W, 3)
+                out = np.asarray(self._step(self.params, stacks))[:valid]
+                feats.append(out)
+                if self.show_pred:
+                    for k in range(valid):
+                        s = idx[start + k]
+                        self.maybe_show_pred(out[k:k + 1], int(s[0]), int(s[-1]) + 1)
+
+        feats = np.concatenate(feats, axis=0) if feats else np.zeros((0, 512), np.float32)
+        return {self.feature_type: feats}
+
+    def maybe_show_pred(self, visual_feats: np.ndarray, start_idx: int, end_idx: int):
+        if self.show_pred:
+            from video_features_tpu.ops.nn import linear
+            from video_features_tpu.utils.preds import show_predictions_on_dataset
+            logits = np.asarray(linear(jnp.asarray(visual_feats), self.params['fc']))
+            print(f'At frames ({start_idx}, {end_idx})')
+            show_predictions_on_dataset(logits, self.model_def['dataset'])

@@ -1,0 +1,65 @@
+"""ResNet frame-wise extractor (reference models/resnet/extract_resnet.py).
+
+Transform parity with torchvision's IMAGENET1K_V1 preset (the reference takes
+transforms straight from the weights object, extract_resnet.py:41-44):
+short-side resize 256 (host, PIL bilinear/antialiased) → center crop 224 →
+scale to [0,1] → normalize — the latter two fused into the jitted step.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
+from video_features_tpu.models import resnet as resnet_model
+from video_features_tpu.ops.transforms import (
+    normalize, short_side_resize_pil, to_float_zero_one,
+)
+from video_features_tpu.utils.device import jax_device
+
+RESIZE_SIZE = 256
+CROP_SIZE = 224
+
+
+class ExtractResNet(BaseFrameWiseExtractor):
+
+    def __init__(self, args) -> None:
+        self.model_name = args.model_name
+        cfg = resnet_model.ARCHS[self.model_name]
+        super().__init__(args, feat_dim=cfg['feat_dim'])
+        self._device = jax_device(self.device)
+        self.params = jax.device_put(self.load_params(args), self._device)
+        self._step = jax.jit(partial(self._forward, arch=self.model_name))
+
+    def load_params(self, args):
+        ckpt = args.get('checkpoint_path') if hasattr(args, 'get') else None
+        if ckpt:
+            from video_features_tpu.transplant.torch2jax import load_torch_checkpoint
+            return load_torch_checkpoint(ckpt)
+        from video_features_tpu.transplant.torch2jax import transplant
+        return transplant(resnet_model.init_state_dict(arch=self.model_name))
+
+    @staticmethod
+    def _forward(params, batch, arch):
+        x = to_float_zero_one(batch)
+        x = normalize(x, resnet_model.MEAN, resnet_model.STD)
+        return resnet_model.forward(params, x, arch=arch, features=True)
+
+    def host_transform(self, frame: np.ndarray) -> np.ndarray:
+        frame = short_side_resize_pil(frame, RESIZE_SIZE)
+        h, w = frame.shape[:2]
+        i = int(round((h - CROP_SIZE) / 2.0))
+        j = int(round((w - CROP_SIZE) / 2.0))
+        return frame[i:i + CROP_SIZE, j:j + CROP_SIZE]
+
+    def device_step(self, batch: np.ndarray) -> jax.Array:
+        return self._step(self.params, batch)
+
+    def maybe_show_pred(self, feats: np.ndarray) -> None:
+        from video_features_tpu.ops.nn import linear
+        from video_features_tpu.utils.preds import show_predictions_on_dataset
+        import jax.numpy as jnp
+        logits = np.asarray(linear(jnp.asarray(feats), self.params['fc']))
+        show_predictions_on_dataset(logits, 'imagenet1k')

@@ -1,0 +1,264 @@
+"""CLIP (OpenAI) — ViT and ModifiedResNet visual towers + text transformer.
+
+Functional re-implementation of the architecture behind the reference's
+vendored CLIP (reference models/clip/clip_src/model.py, 436 LoC): QuickGELU
+MLPs (:166-168), pre-norm residual attention blocks, ViT class-token pooling
+with a final projection matrix (:213-221), ModifiedResNet with avgpool
+anti-aliased striding (:94-143) and an AttentionPool2d head (:58-91), and a
+causal text transformer pooled at the argmax (EOT) token.
+
+Params mirror the OpenAI checkpoint state_dict. Notable layout facts:
+  * ``visual.proj`` / ``text_projection`` are raw matmul params (used as
+    ``x @ W`` in torch) — the transplant leaves them untouched;
+  * ``attn.in_proj_weight`` is a fused (3d, d) F.linear weight — consumed
+    here with an explicit transpose;
+  * ``token_embedding.weight`` must NOT be transposed (gather table) — pass
+    ``no_transpose`` to the transplant.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from video_features_tpu.ops.nn import avg_pool, batch_norm, conv, relu
+
+Params = Dict[str, Any]
+
+# OpenAI CLIP preprocessing constants (reference clip_src/clip.py transform)
+MEAN = (0.48145466, 0.4578275, 0.40821073)
+STD = (0.26862954, 0.26130258, 0.27577711)
+
+# state_dict entries the generic transplant must leave un-transposed
+NO_TRANSPOSE = ('token_embedding.weight',)
+
+VISUAL_CFGS = {
+    'ViT-B/32': dict(kind='vit', width=768, layers=12, heads=12, patch=32,
+                     input_resolution=224, embed_dim=512),
+    'ViT-B/16': dict(kind='vit', width=768, layers=12, heads=12, patch=16,
+                     input_resolution=224, embed_dim=512),
+    'RN50': dict(kind='resnet', width=64, layers=(3, 4, 6, 3), heads=32,
+                 input_resolution=224, embed_dim=1024),
+    'RN101': dict(kind='resnet', width=64, layers=(3, 4, 23, 3), heads=32,
+                  input_resolution=224, embed_dim=512),
+    'RN50x4': dict(kind='resnet', width=80, layers=(4, 6, 10, 6), heads=40,
+                   input_resolution=288, embed_dim=640),
+    'RN50x16': dict(kind='resnet', width=96, layers=(6, 8, 18, 8), heads=48,
+                    input_resolution=384, embed_dim=768),
+}
+
+TEXT_CFG = dict(context_length=77, vocab_size=49408)
+
+
+def quick_gelu(x: jax.Array) -> jax.Array:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def layer_norm(x: jax.Array, p: Params, eps: float = 1e-5) -> jax.Array:
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + jnp.asarray(eps, x.dtype))
+    return out * p['weight'].astype(x.dtype) + p['bias'].astype(x.dtype)
+
+
+def multi_head_attention(p: Params, x: jax.Array, num_heads: int,
+                         mask: Optional[jax.Array] = None) -> jax.Array:
+    """torch nn.MultiheadAttention with fused in_proj, self-attention case.
+
+    x: (B, L, D). in_proj_weight (3D, D) is an F.linear weight → x @ W.T.
+    """
+    B, L, D = x.shape
+    qkv = x @ p['in_proj_weight'].astype(x.dtype).T + p['in_proj_bias'].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    head_dim = D // num_heads
+
+    def split_heads(t):
+        return t.reshape(B, L, num_heads, head_dim).transpose(0, 2, 1, 3)
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    attn = (q @ k.transpose(0, 1, 3, 2)) * (head_dim ** -0.5)
+    if mask is not None:
+        attn = attn + mask.astype(attn.dtype)
+    attn = jax.nn.softmax(attn, axis=-1)
+    out = (attn @ v).transpose(0, 2, 1, 3).reshape(B, L, D)
+    return out @ p['out_proj']['weight'].astype(x.dtype) + p['out_proj']['bias'].astype(x.dtype)
+
+
+def residual_attention_block(p: Params, x: jax.Array, num_heads: int,
+                             mask: Optional[jax.Array] = None) -> jax.Array:
+    x = x + multi_head_attention(p['attn'], layer_norm(x, p['ln_1']), num_heads, mask)
+    h = layer_norm(x, p['ln_2'])
+    h = quick_gelu(h @ p['mlp']['c_fc']['weight'].astype(x.dtype)
+                   + p['mlp']['c_fc']['bias'].astype(x.dtype))
+    h = h @ p['mlp']['c_proj']['weight'].astype(x.dtype) + p['mlp']['c_proj']['bias'].astype(x.dtype)
+    return x + h
+
+
+def transformer(p: Params, x: jax.Array, num_heads: int,
+                mask: Optional[jax.Array] = None) -> jax.Array:
+    blocks = p['resblocks']
+    for i in range(len(blocks)):
+        x = residual_attention_block(blocks[str(i)], x, num_heads, mask)
+    return x
+
+
+# -- ViT visual tower --------------------------------------------------------
+
+def encode_image_vit(params: Params, x: jax.Array, model_name: str) -> jax.Array:
+    """(B, H, W, 3) normalized → (B, embed_dim) image features."""
+    cfg = VISUAL_CFGS[model_name]
+    p = params['visual']
+    x = conv(x, p['conv1']['weight'], stride=cfg['patch'])      # (B, g, g, width)
+    B = x.shape[0]
+    x = x.reshape(B, -1, cfg['width'])
+    cls = jnp.broadcast_to(p['class_embedding'].astype(x.dtype), (B, 1, cfg['width']))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + p['positional_embedding'].astype(x.dtype)
+    x = layer_norm(x, p['ln_pre'])
+    x = transformer(p['transformer'], x, cfg['heads'] if cfg['kind'] == 'vit' else 12)
+    x = layer_norm(x[:, 0, :], p['ln_post'])
+    return x @ p['proj'].astype(x.dtype)
+
+
+# -- ModifiedResNet visual tower --------------------------------------------
+
+def _clip_bottleneck(p: Params, x: jax.Array, stride: int) -> jax.Array:
+    out = relu(batch_norm(conv(x, p['conv1']['weight']), p['bn1']))
+    out = relu(batch_norm(conv(out, p['conv2']['weight'], padding=1), p['bn2']))
+    if stride > 1:
+        out = avg_pool(out, stride)
+    out = batch_norm(conv(out, p['conv3']['weight']), p['bn3'])
+    if 'downsample' in p:
+        identity = avg_pool(x, stride) if stride > 1 else x
+        identity = batch_norm(conv(identity, p['downsample']['0']['weight']),
+                              p['downsample']['1'])
+    else:
+        identity = x
+    return relu(out + identity)
+
+
+def _attention_pool(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
+    """AttentionPool2d (reference model.py:58-91): mean-token query attention."""
+    B, H, W, C = x.shape
+    x = x.reshape(B, H * W, C)
+    x = jnp.concatenate([x.mean(axis=1, keepdims=True), x], axis=1)  # (B,HW+1,C)
+    x = x + p['positional_embedding'].astype(x.dtype)
+    L = x.shape[1]
+    q_w = p['q_proj']['weight'].astype(x.dtype)   # transplanted to (I, O)
+    k_w = p['k_proj']['weight'].astype(x.dtype)
+    v_w = p['v_proj']['weight'].astype(x.dtype)
+    q = x[:, :1] @ q_w + p['q_proj']['bias'].astype(x.dtype)
+    k = x @ k_w + p['k_proj']['bias'].astype(x.dtype)
+    v = x @ v_w + p['v_proj']['bias'].astype(x.dtype)
+    head_dim = C // num_heads
+    q = q.reshape(B, 1, num_heads, head_dim).transpose(0, 2, 1, 3)
+    k = k.reshape(B, L, num_heads, head_dim).transpose(0, 2, 1, 3)
+    v = v.reshape(B, L, num_heads, head_dim).transpose(0, 2, 1, 3)
+    attn = jax.nn.softmax((q @ k.transpose(0, 1, 3, 2)) * (head_dim ** -0.5), axis=-1)
+    out = (attn @ v).transpose(0, 2, 1, 3).reshape(B, C)
+    return out @ p['c_proj']['weight'].astype(x.dtype) + p['c_proj']['bias'].astype(x.dtype)
+
+
+def encode_image_resnet(params: Params, x: jax.Array, model_name: str) -> jax.Array:
+    cfg = VISUAL_CFGS[model_name]
+    p = params['visual']
+    # 3-conv stem, each stride-1 except conv1 (stride 2), then avgpool 2
+    x = relu(batch_norm(conv(x, p['conv1']['weight'], stride=2, padding=1), p['bn1']))
+    x = relu(batch_norm(conv(x, p['conv2']['weight'], padding=1), p['bn2']))
+    x = relu(batch_norm(conv(x, p['conv3']['weight'], padding=1), p['bn3']))
+    x = avg_pool(x, 2)
+    for li, nb in enumerate(cfg['layers'], start=1):
+        layer = p[f'layer{li}']
+        for bi in range(nb):
+            stride = 2 if (li > 1 and bi == 0) else 1
+            x = _clip_bottleneck(layer[str(bi)], x, stride)
+    return _attention_pool(p['attnpool'], x, cfg['heads'])
+
+
+def encode_image(params: Params, x: jax.Array, model_name: str) -> jax.Array:
+    if VISUAL_CFGS[model_name]['kind'] == 'vit':
+        return encode_image_vit(params, x, model_name)
+    return encode_image_resnet(params, x, model_name)
+
+
+# -- text tower --------------------------------------------------------------
+
+def encode_text(params: Params, tokens: jax.Array, model_name: str) -> jax.Array:
+    """(B, 77) int tokens → (B, embed_dim) text features."""
+    emb = params['token_embedding']['weight']
+    x = emb[tokens]                                   # (B, L, D)
+    x = x + params['positional_embedding'].astype(x.dtype)
+    L = x.shape[1]
+    mask = jnp.triu(jnp.full((L, L), -jnp.inf), k=1)
+    # text transformer head count: width // 64 per OpenAI build_model
+    heads = x.shape[-1] // 64
+    x = transformer(params['transformer'], x, heads, mask)
+    x = layer_norm(x, params['ln_final'])
+    eot = jnp.argmax(tokens, axis=-1)
+    x = x[jnp.arange(x.shape[0]), eot]
+    return x @ params['text_projection'].astype(x.dtype)
+
+
+def zero_shot_logits(params: Params, image_feats: jax.Array,
+                     text_feats: jax.Array) -> jax.Array:
+    """Cosine-similarity logits with learned temperature (reference :362-368)."""
+    img = image_feats / jnp.linalg.norm(image_feats, axis=-1, keepdims=True)
+    txt = text_feats / jnp.linalg.norm(text_feats, axis=-1, keepdims=True)
+    scale = jnp.exp(params['logit_scale'])
+    return scale * img @ txt.T
+
+
+# -- random init for tests ---------------------------------------------------
+
+def init_state_dict(seed: int = 0, model_name: str = 'ViT-B/32',
+                    text_layers: int = 2, vocab_size: int = 512,
+                    context_length: int = 77) -> Dict[str, np.ndarray]:
+    """Random OpenAI-layout state_dict (tiny text tower option for tests)."""
+    assert VISUAL_CFGS[model_name]['kind'] == 'vit', 'test init supports ViT'
+    cfg = VISUAL_CFGS[model_name]
+    rng = np.random.RandomState(seed)
+    sd: Dict[str, np.ndarray] = {}
+    w, d = cfg['width'], cfg['embed_dim']
+
+    def f32(*shape, scale=0.02):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    def block(prefix, dim):
+        sd[f'{prefix}.ln_1.weight'] = np.ones(dim, np.float32)
+        sd[f'{prefix}.ln_1.bias'] = f32(dim)
+        sd[f'{prefix}.attn.in_proj_weight'] = f32(3 * dim, dim)
+        sd[f'{prefix}.attn.in_proj_bias'] = f32(3 * dim)
+        sd[f'{prefix}.attn.out_proj.weight'] = f32(dim, dim)
+        sd[f'{prefix}.attn.out_proj.bias'] = f32(dim)
+        sd[f'{prefix}.ln_2.weight'] = np.ones(dim, np.float32)
+        sd[f'{prefix}.ln_2.bias'] = f32(dim)
+        sd[f'{prefix}.mlp.c_fc.weight'] = f32(4 * dim, dim)
+        sd[f'{prefix}.mlp.c_fc.bias'] = f32(4 * dim)
+        sd[f'{prefix}.mlp.c_proj.weight'] = f32(dim, 4 * dim)
+        sd[f'{prefix}.mlp.c_proj.bias'] = f32(dim)
+
+    grid = cfg['input_resolution'] // cfg['patch']
+    sd['visual.conv1.weight'] = f32(w, 3, cfg['patch'], cfg['patch'])
+    sd['visual.class_embedding'] = f32(w)
+    sd['visual.positional_embedding'] = f32(grid * grid + 1, w)
+    sd['visual.ln_pre.weight'] = np.ones(w, np.float32)
+    sd['visual.ln_pre.bias'] = f32(w)
+    for i in range(cfg['layers']):
+        block(f'visual.transformer.resblocks.{i}', w)
+    sd['visual.ln_post.weight'] = np.ones(w, np.float32)
+    sd['visual.ln_post.bias'] = f32(w)
+    sd['visual.proj'] = f32(w, d)
+
+    # tiny text tower
+    tw = d
+    sd['token_embedding.weight'] = f32(vocab_size, tw)
+    sd['positional_embedding'] = f32(context_length, tw)
+    for i in range(text_layers):
+        block(f'transformer.resblocks.{i}', tw)
+    sd['ln_final.weight'] = np.ones(tw, np.float32)
+    sd['ln_final.bias'] = f32(tw)
+    sd['text_projection'] = f32(tw, d)
+    sd['logit_scale'] = np.float32(np.log(1 / 0.07))
+    return sd

@@ -1,0 +1,111 @@
+"""ResNet image backbones (torchvision resnet18/34/50/101/152 layout).
+
+Functional re-implementation of the architecture behind the reference resnet
+extractor (reference models/resnet/extract_resnet.py:38-50 uses torchvision
+IMAGENET1K_V1 weights with fc → Identity). Params mirror torchvision
+state_dict names; layout NHWC.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import numpy as np
+
+from video_features_tpu.ops.nn import (
+    adaptive_avg_pool, batch_norm, conv, linear, max_pool, relu,
+)
+
+Params = Dict[str, Any]
+
+# torchvision IMAGENET1K_V1 transform constants (Resize 256 → CenterCrop 224)
+MEAN = (0.485, 0.456, 0.406)
+STD = (0.229, 0.224, 0.225)
+
+ARCHS = {
+    'resnet18': dict(block='basic', layers=[2, 2, 2, 2], feat_dim=512),
+    'resnet34': dict(block='basic', layers=[3, 4, 6, 3], feat_dim=512),
+    'resnet50': dict(block='bottleneck', layers=[3, 4, 6, 3], feat_dim=2048),
+    'resnet101': dict(block='bottleneck', layers=[3, 4, 23, 3], feat_dim=2048),
+    'resnet152': dict(block='bottleneck', layers=[3, 8, 36, 3], feat_dim=2048),
+}
+
+
+def _basic_block(p: Params, x: jax.Array, stride: int) -> jax.Array:
+    identity = x
+    out = relu(batch_norm(conv(x, p['conv1']['weight'], stride=stride, padding=1), p['bn1']))
+    out = batch_norm(conv(out, p['conv2']['weight'], stride=1, padding=1), p['bn2'])
+    if 'downsample' in p:
+        identity = batch_norm(conv(x, p['downsample']['0']['weight'], stride=stride),
+                              p['downsample']['1'])
+    return relu(out + identity)
+
+
+def _bottleneck(p: Params, x: jax.Array, stride: int) -> jax.Array:
+    identity = x
+    out = relu(batch_norm(conv(x, p['conv1']['weight']), p['bn1']))
+    out = relu(batch_norm(conv(out, p['conv2']['weight'], stride=stride, padding=1), p['bn2']))
+    out = batch_norm(conv(out, p['conv3']['weight']), p['bn3'])
+    if 'downsample' in p:
+        identity = batch_norm(conv(x, p['downsample']['0']['weight'], stride=stride),
+                              p['downsample']['1'])
+    return relu(out + identity)
+
+
+def forward(params: Params, x: jax.Array, arch: str = 'resnet50',
+            features: bool = True) -> jax.Array:
+    """(B, H, W, 3) normalized image → (B, feat_dim) features or logits."""
+    cfg = ARCHS[arch]
+    block_fn = _basic_block if cfg['block'] == 'basic' else _bottleneck
+    x = conv(x, params['conv1']['weight'], stride=2, padding=3)
+    x = relu(batch_norm(x, params['bn1']))
+    x = max_pool(x, 3, stride=2, padding=1)
+    for layer_idx, num_blocks in enumerate(cfg['layers'], start=1):
+        layer = params[f'layer{layer_idx}']
+        for block_idx in range(num_blocks):
+            stride = 2 if (layer_idx > 1 and block_idx == 0) else 1
+            x = block_fn(layer[str(block_idx)], x, stride)
+    x = adaptive_avg_pool(x)
+    if features:
+        return x
+    return linear(x, params['fc'])
+
+
+def init_state_dict(seed: int = 0, arch: str = 'resnet50',
+                    num_classes: int = 1000) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict with torchvision naming/shapes."""
+    rng = np.random.RandomState(seed)
+    cfg = ARCHS[arch]
+    sd: Dict[str, np.ndarray] = {}
+
+    def conv_w(name, o, i, k):
+        sd[name] = rng.randn(o, i, k, k).astype(np.float32) * 0.03
+
+    def bn(name, c):
+        sd[f'{name}.weight'] = rng.rand(c).astype(np.float32) + 0.5
+        sd[f'{name}.bias'] = rng.randn(c).astype(np.float32) * 0.1
+        sd[f'{name}.running_mean'] = rng.randn(c).astype(np.float32) * 0.1
+        sd[f'{name}.running_var'] = rng.rand(c).astype(np.float32) + 0.5
+
+    conv_w('conv1.weight', 64, 3, 7); bn('bn1', 64)
+    in_p = 64
+    expansion = 1 if cfg['block'] == 'basic' else 4
+    for li, (nb, planes) in enumerate(zip(cfg['layers'], [64, 128, 256, 512]), 1):
+        out_p = planes * expansion
+        for bi in range(nb):
+            base = f'layer{li}.{bi}'
+            stride = 2 if (li > 1 and bi == 0) else 1
+            if cfg['block'] == 'basic':
+                conv_w(f'{base}.conv1.weight', planes, in_p, 3); bn(f'{base}.bn1', planes)
+                conv_w(f'{base}.conv2.weight', planes, planes, 3); bn(f'{base}.bn2', planes)
+            else:
+                conv_w(f'{base}.conv1.weight', planes, in_p, 1); bn(f'{base}.bn1', planes)
+                conv_w(f'{base}.conv2.weight', planes, planes, 3); bn(f'{base}.bn2', planes)
+                conv_w(f'{base}.conv3.weight', out_p, planes, 1); bn(f'{base}.bn3', out_p)
+            if stride != 1 or in_p != out_p:
+                conv_w(f'{base}.downsample.0.weight', out_p, in_p, 1)
+                bn(f'{base}.downsample.1', out_p)
+            in_p = out_p
+    sd['fc.weight'] = rng.randn(num_classes, cfg['feat_dim']).astype(np.float32) * 0.03
+    sd['fc.bias'] = rng.randn(num_classes).astype(np.float32) * 0.03
+    return sd

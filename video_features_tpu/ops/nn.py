@@ -1,0 +1,201 @@
+"""Functional NN building blocks (channels-last, XLA/TPU-native).
+
+Every model in this framework is a pure function ``forward(params, x)`` over a
+nested params pytree whose keys mirror the source torch ``state_dict`` names
+(see video_features_tpu/transplant). Layouts are TPU-optimal channels-last:
+images are NHWC, videos are NDHWC (D = time); conv kernels are stored
+spatial-major with I/O last (HWIO / DHWIO) so XLA tiles them straight onto the
+MXU without relayout.
+
+Numerics parity notes (vs torch, for checkpoint-transplant fidelity):
+  * conv: torch symmetric int padding → explicit (lo, hi) pairs here; TF-SAME
+    asymmetric padding (I3D) is also expressible per-edge.
+  * batch norm is inference-only: y = (x - mean) / sqrt(var + eps) * γ + β
+    with running statistics — matches torch .eval() semantics.
+  * max pool with ceil_mode / TF-SAME is built from explicit -inf padding.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+IntOrTuple = Union[int, Sequence[int]]
+
+
+def _tuple(v: IntOrTuple, n: int) -> Tuple[int, ...]:
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    assert len(v) == n, f'expected {n} values, got {v}'
+    return v
+
+
+def _pad_pairs(padding: Union[IntOrTuple, Sequence[Tuple[int, int]], str], n: int):
+    """Normalize padding to lax explicit (lo, hi) pairs, or pass 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if padding and isinstance(padding[0], (tuple, list)):
+        return [tuple(p) for p in padding]
+    return [(p, p) for p in padding]
+
+
+def conv(x: Array, kernel: Array, stride: IntOrTuple = 1,
+         padding: Union[IntOrTuple, Sequence[Tuple[int, int]], str] = 0,
+         dilation: IntOrTuple = 1, groups: int = 1,
+         bias: Optional[Array] = None) -> Array:
+    """N-D convolution, channels-last. kernel: (*spatial, I/groups, O)."""
+    n = kernel.ndim - 2
+    spec = {1: ('NWC', 'WIO', 'NWC'),
+            2: ('NHWC', 'HWIO', 'NHWC'),
+            3: ('NDHWC', 'DHWIO', 'NDHWC')}[n]
+    out = lax.conv_general_dilated(
+        x, kernel.astype(x.dtype),
+        window_strides=_tuple(stride, n),
+        padding=_pad_pairs(padding, n),
+        rhs_dilation=_tuple(dilation, n),
+        dimension_numbers=spec,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+def batch_norm(x: Array, p: Dict[str, Array], eps: float = 1e-5) -> Array:
+    """Inference-mode batch norm over the trailing channel axis.
+
+    ``p`` holds torch-named entries: weight (γ), bias (β), running_mean,
+    running_var. Affine params may be absent (γ=1, β=0).
+    """
+    mean = p['running_mean'].astype(x.dtype)
+    var = p['running_var'].astype(x.dtype)
+    inv = lax.rsqrt(var + jnp.asarray(eps, x.dtype))
+    out = (x - mean) * inv
+    if 'weight' in p:
+        out = out * p['weight'].astype(x.dtype)
+    if 'bias' in p:
+        out = out + p['bias'].astype(x.dtype)
+    return out
+
+
+def instance_norm(x: Array, p: Dict[str, Array], eps: float = 1e-5) -> Array:
+    """InstanceNorm over spatial dims (channels-last), matching torch
+    InstanceNorm2d (affine optional, no running stats — RAFT's fnet)."""
+    axes = tuple(range(1, x.ndim - 1))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = x.var(axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + jnp.asarray(eps, x.dtype))
+    if 'weight' in p:
+        out = out * p['weight'].astype(x.dtype)
+    if 'bias' in p:
+        out = out + p['bias'].astype(x.dtype)
+    return out
+
+
+def group_norm(x: Array, p: Dict[str, Array], num_groups: int,
+               eps: float = 1e-5) -> Array:
+    """GroupNorm (channels-last), matching torch nn.GroupNorm."""
+    *lead, c = x.shape
+    g = num_groups
+    xg = x.reshape(*lead, g, c // g)
+    axes = tuple(range(1, x.ndim - 1)) + (x.ndim,)
+    mean = xg.mean(axis=axes, keepdims=True)
+    var = xg.var(axis=axes, keepdims=True)
+    out = ((xg - mean) * lax.rsqrt(var + jnp.asarray(eps, x.dtype))).reshape(x.shape)
+    if 'weight' in p:
+        out = out * p['weight'].astype(x.dtype)
+    if 'bias' in p:
+        out = out + p['bias'].astype(x.dtype)
+    return out
+
+
+def linear(x: Array, p: Dict[str, Array]) -> Array:
+    """Dense layer; p['weight'] is stored transplanted as (I, O)."""
+    out = x @ p['weight'].astype(x.dtype)
+    if 'bias' in p:
+        out = out + p['bias'].astype(x.dtype)
+    return out
+
+
+def relu(x: Array) -> Array:
+    return jax.nn.relu(x)
+
+
+def max_pool(x: Array, window: IntOrTuple, stride: Optional[IntOrTuple] = None,
+             padding: Union[IntOrTuple, Sequence[Tuple[int, int]], str] = 0) -> Array:
+    """Max pooling over the spatial dims of channels-last input."""
+    n = x.ndim - 2
+    window = _tuple(window, n)
+    stride = window if stride is None else _tuple(stride, n)
+    pads = _pad_pairs(padding, n)
+    if not isinstance(pads, str):
+        pads = [(0, 0)] + list(pads) + [(0, 0)]
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1,) + window + (1,),
+        window_strides=(1,) + stride + (1,),
+        padding=pads if not isinstance(pads, str) else pads,
+    )
+
+
+def avg_pool(x: Array, window: IntOrTuple, stride: Optional[IntOrTuple] = None,
+             padding: Union[IntOrTuple, Sequence[Tuple[int, int]]] = 0,
+             count_include_pad: bool = True) -> Array:
+    """Average pooling matching torch AvgPool semantics."""
+    n = x.ndim - 2
+    window = _tuple(window, n)
+    stride = window if stride is None else _tuple(stride, n)
+    pads = [(0, 0)] + list(_pad_pairs(padding, n)) + [(0, 0)]
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1,) + window + (1,),
+        window_strides=(1,) + stride + (1,),
+        padding=pads,
+    )
+    if count_include_pad:
+        return summed / np.prod(window)
+    ones = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+    counts = lax.reduce_window(
+        ones, 0.0, lax.add,
+        window_dimensions=(1,) + window + (1,),
+        window_strides=(1,) + stride + (1,),
+        padding=pads,
+    )
+    return summed / counts
+
+
+def adaptive_avg_pool(x: Array, output_size: int = 1) -> Array:
+    """AdaptiveAvgPool to (1,1,...) == global mean over spatial dims."""
+    assert output_size == 1, 'only global pooling is used by these models'
+    return x.mean(axis=tuple(range(1, x.ndim - 1)))
+
+
+def same_padding_tf(in_size: int, kernel: int, stride: int,
+                    dilation: int = 1) -> Tuple[int, int]:
+    """TF-SAME per-edge (lo, hi) padding — asymmetric, extra on the high side.
+
+    This is the semantics I3D inherited from its TF origin (reference
+    models/i3d/i3d_src/i3d_net.py:8-34 emulates it in torch with ConstantPad3d;
+    here it is just explicit lax padding).
+    """
+    eff_k = (kernel - 1) * dilation + 1
+    out = -(-in_size // stride)  # ceil
+    pad = max(0, (out - 1) * stride + eff_k - in_size)
+    return pad // 2, pad - pad // 2
+
+
+def ceil_mode_padding(in_size: int, kernel: int, stride: int) -> Tuple[int, int]:
+    """Torch ceil_mode pooling → (0, extra) high-side padding."""
+    out_ceil = -(-(in_size - kernel) // stride) + 1
+    needed = (out_ceil - 1) * stride + kernel - in_size
+    return 0, max(0, needed)

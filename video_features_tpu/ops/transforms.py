@@ -1,0 +1,98 @@
+"""Tensor transforms (device-side, jit-able) + host-side PIL helpers.
+
+Numerics-parity notes vs the reference transform chain
+(reference models/transforms.py, 307 LoC):
+  * frames here are channels-last (T, H, W, C) float32 — the reference's
+    CFHW/CHW permutes (:34-35, :152-155) are layout choices of torch and do
+    not exist in this framework;
+  * ``resize_bilinear`` matches torch ``F.interpolate(mode='bilinear',
+    align_corners=False)`` = half-pixel centers, no antialias — what the
+    reference `Resize` uses for tensors (:76-96);
+  * PIL-style short-side resize (the reference's i3d/RAFT frame prep
+    ``ResizeImproved`` :234-242) stays on the host where PIL gives exact
+    parity; it runs before frames are stacked for the device.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def to_float_zero_one(x: Array) -> Array:
+    """uint8 [0,255] → float32 [0,1] (reference transforms.py:34-35 numerics)."""
+    return jnp.asarray(x, jnp.float32) / 255.0
+
+
+def scale_to_pm1(x: Array) -> Array:
+    """[0,255] → [-1,1] via 2x/255 - 1 (reference transforms.py:146-149)."""
+    return x * (2.0 / 255.0) - 1.0
+
+
+def normalize(x: Array, mean: Sequence[float], std: Sequence[float]) -> Array:
+    """Per-channel (x - mean) / std over the trailing axis."""
+    mean = jnp.asarray(mean, x.dtype)
+    std = jnp.asarray(std, x.dtype)
+    return (x - mean) / std
+
+
+def resize_bilinear(x: Array, size: Tuple[int, int]) -> Array:
+    """Bilinear resize of (..., H, W, C) to (..., *size, C), half-pixel centers,
+    no antialias — torch ``F.interpolate(..., align_corners=False)`` parity."""
+    *lead, h, w, c = x.shape
+    out_shape = (*lead, *size, c)
+    return jax.image.resize(x, out_shape, method='bilinear', antialias=False)
+
+
+def center_crop(x: Array, size: Union[int, Tuple[int, int]]) -> Array:
+    """Center crop of (..., H, W, C); torch CenterCrop offset convention
+    (round-half-down via int division)."""
+    if isinstance(size, int):
+        size = (size, size)
+    th, tw = size
+    h, w = x.shape[-3], x.shape[-2]
+    i = int(round((h - th) / 2.0))
+    j = int(round((w - tw) / 2.0))
+    return x[..., i:i + th, j:j + tw, :]
+
+
+def clamp(x: Array, min_val: float, max_val: float) -> Array:
+    return jnp.clip(x, min_val, max_val)
+
+
+def flow_to_uint8_levels(x: Array, bound: float = 20.0) -> Array:
+    """Flow [-bound, bound] → quantized [0, 255] levels then back to float.
+
+    The kinetics-i3d flow recipe (reference transforms.py:168-176 `ToUInt8`):
+    round((x + bound) / (2 * bound) * 255), keeping float dtype so the
+    subsequent ScaleTo1_1 sees the same values torch's uint8 tensor held.
+    """
+    x = jnp.clip(x, -bound, bound)
+    return jnp.round((x + bound) * (255.0 / (2.0 * bound)))
+
+
+def short_side_resize_pil(frame: np.ndarray, size: int) -> np.ndarray:
+    """Host-side PIL bilinear resize so min(H, W) == size, aspect preserved.
+
+    Exact parity with the reference's PIL-based `ResizeImproved`
+    (reference models/transforms.py:191-242): if a side already equals the
+    target the frame is returned unchanged; the scaled side uses
+    round-to-nearest via PIL's size computation.
+    """
+    from PIL import Image
+
+    h, w = frame.shape[:2]
+    if min(h, w) == size:
+        return frame
+    if w < h:
+        ow = size
+        oh = int(size * h / w)
+    else:
+        oh = size
+        ow = int(size * w / h)
+    img = Image.fromarray(frame)
+    return np.asarray(img.resize((ow, oh), Image.BILINEAR))

@@ -1,0 +1,111 @@
+"""PyTorch checkpoint → JAX pytree transplant layer.
+
+The params pytree of every model in this framework mirrors the source torch
+``state_dict``: keys are split on '.' into a nested dict, and kernels are
+re-laid-out once at load time into TPU-native channels-last form:
+
+  * ConvNd weight (O, I, *spatial)  →  (*spatial, I, O)   (HWIO / DHWIO)
+  * Linear weight (O, I)            →  (I, O)
+  * everything else (biases, norm stats) unchanged.
+
+This makes the converter mechanical for all model families and lets parity
+tests transplant a randomly-initialized reference torch module directly
+(SURVEY.md §5.4: conv layout transpose, DataParallel prefixes, fp16 params).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+
+def to_numpy(tensor: Any) -> np.ndarray:
+    """torch.Tensor / array-like → float32-preserving numpy array."""
+    if hasattr(tensor, 'detach'):
+        tensor = tensor.detach().cpu().numpy()
+    return np.asarray(tensor)
+
+
+def strip_dataparallel(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Remove 'module.' DataParallel prefixes (reference utils/utils.py:243-249).
+
+    Unlike the reference helper, keys without the prefix are KEPT (the
+    reference silently drops them — a footgun for mixed checkpoints).
+    """
+    out = {}
+    for k, v in state_dict.items():
+        out[k[len('module.'):] if k.startswith('module.') else k] = v
+    return out
+
+
+def convert_tensor(name: str, value: Any,
+                   no_transpose: Optional[set] = None) -> np.ndarray:
+    """Apply the layout rule for one state_dict entry.
+
+    ``no_transpose`` lists names whose 2-D '.weight' is a gather table or a
+    raw matmul-right operand and must keep torch layout (e.g. CLIP's
+    ``token_embedding.weight``).
+    """
+    arr = to_numpy(value)
+    if no_transpose and name in no_transpose:
+        return arr
+    if name.endswith('.weight') or name == 'weight':
+        if arr.ndim >= 3:            # convNd (O, I, *spatial) → (*spatial, I, O)
+            axes = tuple(range(2, arr.ndim)) + (1, 0)
+            return np.ascontiguousarray(arr.transpose(axes))
+        if arr.ndim == 2:            # linear (O, I) → (I, O)
+            return np.ascontiguousarray(arr.T)
+    return arr
+
+
+def nest(flat: Mapping[str, np.ndarray]) -> Dict[str, Any]:
+    """{'a.b.c': x} → {'a': {'b': {'c': x}}}."""
+    tree: Dict[str, Any] = {}
+    for key, value in flat.items():
+        node = tree
+        parts = key.split('.')
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def transplant(state_dict: Mapping[str, Any],
+               no_transpose: Optional[set] = None,
+               dtype: Optional[np.dtype] = None) -> Dict[str, Any]:
+    """Full pipeline: strip DP prefixes, convert layouts, nest, cast.
+
+    Args:
+        state_dict: torch state_dict (or any {name: tensor} mapping).
+        no_transpose: names whose 2-D '.weight' must keep torch layout
+            (embedding tables; see :func:`convert_tensor`).
+        dtype: optional cast (e.g. np.float32 for CLIP's fp16 checkpoints).
+    """
+    no_transpose = set(no_transpose or ())
+    flat = {}
+    for name, value in strip_dataparallel(state_dict).items():
+        if name.endswith('num_batches_tracked'):
+            continue  # torch BN bookkeeping, meaningless at inference
+        arr = convert_tensor(name, value, no_transpose)
+        if dtype is not None and np.issubdtype(arr.dtype, np.floating):
+            arr = arr.astype(dtype)
+        flat[name] = arr
+    return nest(flat)
+
+
+def load_torch_checkpoint(path: str, dtype: Optional[np.dtype] = np.float32,
+                          key: Optional[str] = None,
+                          no_transpose: Optional[set] = None) -> Dict[str, Any]:
+    """Load a .pt/.pth checkpoint via torch (CPU) and transplant it.
+
+    ``key`` selects a sub-dict for checkpoints that wrap the state_dict
+    (e.g. {'state_dict': ...} or {'model': ...}).
+    """
+    import torch
+
+    ckpt = torch.load(path, map_location='cpu', weights_only=False)
+    if key is not None:
+        ckpt = ckpt[key]
+    elif isinstance(ckpt, dict) and 'state_dict' in ckpt:
+        ckpt = ckpt['state_dict']
+    return transplant(ckpt, dtype=dtype, no_transpose=no_transpose)

@@ -1,0 +1,17 @@
+"""Device resolution helpers shared by extractors."""
+from __future__ import annotations
+
+import jax
+
+
+def jax_device(device: str) -> jax.Device:
+    """Map a resolved config device string ('cpu'/'tpu') to a jax.Device.
+
+    Tests run with a TPU plugin still registered, so 'cpu' must explicitly
+    target the CPU backend rather than the default device.
+    """
+    platform = 'cpu' if str(device).lower() == 'cpu' else None
+    if platform is None:
+        platforms = {d.platform for d in jax.devices()}
+        platform = next((p for p in platforms if p != 'cpu'), 'cpu')
+    return jax.devices(platform)[0]

@@ -1,0 +1,59 @@
+"""Top-5 prediction printing (reference utils/utils.py:21-54 surface).
+
+Label maps are looked up at runtime: ``$VFT_LABEL_MAP_DIR`` first, then the
+reference checkout if present. Class names are display sugar only — when no
+map is found, indices are printed instead of failing.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import List, Optional, Union
+
+import numpy as np
+
+_DATASET_TO_FILE = {
+    'kinetics': 'K400_label_map.txt',
+    'imagenet1k': 'IN1K_label_map.txt',
+    'imagenet21k': 'IN21K_label_map.txt',
+}
+
+_SEARCH_DIRS = [
+    os.environ.get('VFT_LABEL_MAP_DIR', ''),
+    '/root/reference/utils',
+]
+
+
+def load_label_map(dataset: str) -> Optional[List[str]]:
+    fname = _DATASET_TO_FILE.get(dataset)
+    if fname is None:
+        return None
+    for d in _SEARCH_DIRS:
+        if d and (Path(d) / fname).exists():
+            with open(Path(d) / fname) as f:
+                return [line.strip() for line in f]
+    return None
+
+
+def softmax(x: np.ndarray, axis: int = -1) -> np.ndarray:
+    x = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def show_predictions_on_dataset(logits: np.ndarray,
+                                dataset: Union[str, List[str]], k: int = 5) -> None:
+    """Print a top-k table of logits/probabilities/labels per batch row."""
+    logits = np.asarray(logits)
+    if isinstance(dataset, str):
+        classes = load_label_map(dataset)
+    else:
+        classes = list(dataset)
+    probs = softmax(logits)
+    top_idx = np.argsort(-probs, axis=-1)[:, :k]
+    for b in range(logits.shape[0]):
+        print('  Logits | Prob. | Label ')
+        for idx in top_idx[b]:
+            label = classes[idx] if classes and idx < len(classes) else f'class_{idx}'
+            print(f'{logits[b, idx]:8.3f} | {probs[b, idx]:.3f} | {label}')
+        print()
