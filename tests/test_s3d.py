@@ -1,0 +1,71 @@
+"""S3D: numerical parity vs the reference torch net + E2E extraction."""
+import numpy as np
+import pytest
+import torch
+
+from video_features_tpu.config import load_config
+from video_features_tpu.models import s3d as s3d_model
+from video_features_tpu.registry import create_extractor
+from video_features_tpu.transplant.torch2jax import transplant
+
+
+@pytest.fixture(scope='module')
+def torch_s3d(reference_repo):
+    from models.s3d.s3d_src.s3d import S3D
+    torch.manual_seed(0)
+    model = S3D(num_class=400)
+    model.eval()
+    return model
+
+
+def test_parity_vs_reference_torch(torch_s3d):
+    """Random-weight transplant: our forward must match torch to float32 noise.
+
+    This is the core de-risking test for the whole torch->JAX transplant
+    approach (SURVEY.md §4c): same weights, same input => same features.
+    """
+    params = transplant(torch_s3d.state_dict())
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 16, 64, 64, 3).astype(np.float32)
+
+    with torch.no_grad():
+        # torch layout (B, C, T, H, W)
+        ref = torch_s3d(torch.from_numpy(x).permute(0, 4, 1, 2, 3),
+                        features=True).numpy()
+    import jax
+    with jax.default_matmul_precision('highest'):
+        ours = np.asarray(s3d_model.forward(params, x, features=True))
+
+    assert ours.shape == ref.shape == (1, 1024)
+    l2 = np.linalg.norm(ours - ref) / max(np.linalg.norm(ref), 1e-12)
+    assert l2 < 1e-3, f'relative L2 {l2}'
+    np.testing.assert_allclose(ours, ref, atol=5e-4)
+
+
+def test_parity_logits(torch_s3d):
+    params = transplant(torch_s3d.state_dict())
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 16, 64, 64, 3).astype(np.float32)
+    with torch.no_grad():
+        ref = torch_s3d(torch.from_numpy(x).permute(0, 4, 1, 2, 3),
+                        features=False).numpy()
+    import jax
+    with jax.default_matmul_precision('highest'):
+        ours = np.asarray(s3d_model.forward(params, x, features=False))
+    assert ours.shape == (1, 400)
+    np.testing.assert_allclose(ours, ref, atol=5e-4)
+
+
+def test_e2e_extraction(short_video, tmp_path):
+    args = load_config('s3d', overrides={
+        'video_paths': short_video,
+        'device': 'cpu',
+        'stack_size': 16, 'step_size': 16,
+        'extraction_fps': None,  # avoid re-encode in tests
+        'output_path': str(tmp_path / 'out'),
+        'tmp_path': str(tmp_path / 'tmp'),
+    })
+    ex = create_extractor(args)
+    feats = ex.extract(short_video)['s3d']
+    assert feats.shape == (3, 1024)
+    assert np.isfinite(feats).all()

@@ -1,0 +1,103 @@
+"""S3D extractor (reference models/s3d/extract_s3d.py behavior).
+
+Transform parity (reference extract_s3d.py:30-35 — kylemin/S3D convention,
+deliberately NO normalization): ToFloatTensorInZeroOne → Resize(224,
+short side, torch bilinear) → CenterCrop(224). Default extraction_fps=25,
+stack/step 64 (configs/s3d.yml). Partial final stacks are dropped.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import numpy as np
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.video import VideoLoader, iter_frame_batches
+from video_features_tpu.models import s3d as s3d_model
+from video_features_tpu.ops.transforms import (
+    center_crop, resize_bilinear, to_float_zero_one,
+)
+from video_features_tpu.utils.device import jax_device
+from video_features_tpu.utils.slicing import stack_indices
+
+STACK_BATCH = 1  # 64-frame stacks are large; one per device step
+
+
+class ExtractS3D(BaseExtractor):
+
+    def __init__(self, args) -> None:
+        super().__init__(
+            feature_type=args.feature_type,
+            on_extraction=args.on_extraction,
+            tmp_path=args.tmp_path,
+            output_path=args.output_path,
+            keep_tmp_files=args.keep_tmp_files,
+            device=args.device,
+        )
+        self.stack_size = args.stack_size
+        self.step_size = args.step_size
+        self.extraction_fps = args.extraction_fps
+        self.show_pred = args.show_pred
+        self.output_feat_keys = [self.feature_type]
+        self._device = jax_device(self.device)
+        self.params = jax.device_put(self.load_params(args), self._device)
+        self._step = jax.jit(self._forward)
+
+    def load_params(self, args):
+        ckpt = args.get('checkpoint_path') if hasattr(args, 'get') else None
+        if ckpt:
+            from video_features_tpu.transplant.torch2jax import load_torch_checkpoint
+            return load_torch_checkpoint(ckpt)
+        from video_features_tpu.transplant.torch2jax import transplant
+        return transplant(s3d_model.init_state_dict())
+
+    @staticmethod
+    def _forward(params, stacks, resize_hw):
+        x = to_float_zero_one(stacks)
+        x = resize_bilinear(x, resize_hw)
+        x = center_crop(x, (224, 224))
+        return s3d_model.forward(params, x, features=True)
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        loader = VideoLoader(
+            video_path, batch_size=64,
+            fps=self.extraction_fps, tmp_path=self.tmp_path,
+            keep_tmp=self.keep_tmp_files)
+        frames = np.concatenate(
+            [b for b, _, _ in iter_frame_batches(loader)], axis=0)
+
+        # short-side 224, torch F.interpolate semantics, static per video
+        h, w = frames.shape[1:3]
+        if h < w:
+            resize_hw = (224, int(224 * w / h))
+        else:
+            resize_hw = (int(224 * h / w), 224)
+        step = jax.jit(partial(self._forward, resize_hw=resize_hw))
+
+        idx = stack_indices(len(frames), self.stack_size, self.step_size)
+        feats = []
+        with jax.default_matmul_precision('highest'):
+            for start in range(0, idx.shape[0], STACK_BATCH):
+                chunk = idx[start:start + STACK_BATCH]
+                out = np.asarray(step(self.params, frames[chunk]))
+                feats.append(out)
+                if self.show_pred:
+                    self.maybe_show_pred(frames[chunk], int(chunk[0][0]),
+                                         int(chunk[-1][-1]) + 1, resize_hw)
+
+        feats = (np.concatenate(feats, axis=0) if feats
+                 else np.zeros((0, s3d_model.FEAT_DIM), np.float32))
+        return {self.feature_type: feats}
+
+    def maybe_show_pred(self, stacks, start_idx, end_idx, resize_hw):
+        import jax.numpy as jnp
+        from video_features_tpu.ops.transforms import normalize  # noqa: F401
+        from video_features_tpu.utils.preds import show_predictions_on_dataset
+        x = to_float_zero_one(jnp.asarray(stacks))
+        x = resize_bilinear(x, resize_hw)
+        x = center_crop(x, (224, 224))
+        logits = np.asarray(s3d_model.forward(self.params, x, features=False))
+        print(f'At frames ({start_idx}, {end_idx})')
+        show_predictions_on_dataset(logits, 'kinetics')
