@@ -1,0 +1,60 @@
+"""I3D net: numerical parity vs the reference torch net (TF-SAME padding)."""
+import numpy as np
+import pytest
+import torch
+
+from video_features_tpu.models import i3d as i3d_model
+from video_features_tpu.transplant.torch2jax import transplant
+
+
+def _torch_i3d(reference_repo, modality):
+    from models.i3d.i3d_src.i3d_net import I3D
+    torch.manual_seed(0)
+    model = I3D(num_classes=400, modality=modality)
+    model.eval()
+    return model
+
+
+@pytest.mark.parametrize('modality,channels', [('rgb', 3), ('flow', 2)])
+def test_parity_features(reference_repo, modality, channels):
+    model = _torch_i3d(reference_repo, modality)
+    params = transplant(model.state_dict())
+    rng = np.random.RandomState(0)
+    # T=16 (fork default stack), 224 spatial is required by the fixed (2,7,7) avg-pool head and
+    # still exercises every asymmetric-padding branch (stride-2 convs/pools)
+    x = (rng.rand(1, 16, 224, 224, channels).astype(np.float32) * 2) - 1
+
+    with torch.no_grad():
+        ref = model(torch.from_numpy(x).permute(0, 4, 1, 2, 3),
+                    features=True).numpy()
+    import jax
+    with jax.default_matmul_precision('highest'):
+        ours = np.asarray(i3d_model.forward(params, x, features=True))
+
+    assert ours.shape == ref.shape == (1, 1024)
+    l2 = np.linalg.norm(ours - ref) / max(np.linalg.norm(ref), 1e-12)
+    assert l2 < 1e-3, f'relative L2 {l2}'
+    np.testing.assert_allclose(ours, ref, atol=5e-4)
+
+
+def test_parity_logits(reference_repo):
+    model = _torch_i3d(reference_repo, 'rgb')
+    params = transplant(model.state_dict())
+    rng = np.random.RandomState(1)
+    x = (rng.rand(1, 16, 224, 224, 3).astype(np.float32) * 2) - 1
+    with torch.no_grad():
+        ref_sm, ref_logits = model(torch.from_numpy(x).permute(0, 4, 1, 2, 3),
+                                   features=False)
+    import jax
+    with jax.default_matmul_precision('highest'):
+        sm, logits = i3d_model.forward(params, x, features=False)
+    np.testing.assert_allclose(np.asarray(logits), ref_logits.numpy(), atol=5e-4)
+    np.testing.assert_allclose(np.asarray(sm), ref_sm.numpy(), atol=1e-5)
+
+
+def test_tf_same_pads_rule():
+    # k=7 s=2 -> pad 5 -> (2,3); k=3 s=1 -> (1,1); k=2 s=2 -> (0,0)
+    assert i3d_model.tf_same_pads((7, 7, 7), (2, 2, 2)) == [(2, 3)] * 3
+    assert i3d_model.tf_same_pads((3, 3, 3), (1, 1, 1)) == [(1, 1)] * 3
+    assert i3d_model.tf_same_pads((2, 2, 2), (2, 2, 2)) == [(0, 0)] * 3
+    assert i3d_model.tf_same_pads((1, 3, 3), (1, 2, 2)) == [(0, 0), (0, 1), (0, 1)]
