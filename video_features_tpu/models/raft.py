@@ -1,0 +1,331 @@
+"""RAFT optical flow (princeton-vl architecture, 'basic' variant).
+
+Functional re-implementation of the architecture behind the reference raft
+extractor (reference models/raft/raft_src/ — raft.py, extractor.py, update.py,
+corr.py). TPU-native design choices:
+
+  * the 20 recurrent GRU iterations are a single ``lax.scan`` body compiled
+    once (reference loops in python, raft.py:153-171);
+  * the all-pairs correlation volume is one batched matmul
+    (B, H·W, H·W)/√dim (corr.py:53-60) and its 4-level pyramid lives as four
+    arrays closed over by the scan;
+  * the (2r+1)² window lookup (corr.py:29-50) is a vectorized gather-based
+    bilinear sample with ``align_corners=True`` / zeros-padding semantics
+    (utils/utils.py:58-72 wraps grid_sample the same way);
+  * convex 8× upsampling (raft.py:103-115) is a softmax-weighted sum over
+    3×3 flow patches, channels-last.
+
+Params mirror the torch state_dict (fnet./cnet./update_block. prefixes).
+Instance norms are affine-less (torch default) and carry no params.
+Input: two (B, H, W, 3) uint8/float RGB frames, H and W divisible by 8
+(use :func:`pad_to_multiple`); output (B, H, W, 2) flow in pixels (x, y).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from video_features_tpu.ops.nn import avg_pool, batch_norm, conv, instance_norm, relu
+
+Params = Dict[str, Any]
+
+CORR_LEVELS = 4
+CORR_RADIUS = 4
+HIDDEN_DIM = 128
+CONTEXT_DIM = 128
+ITERS = 20
+
+
+# -- encoders ----------------------------------------------------------------
+
+def _residual_block(p: Params, x: jax.Array, norm_fn: str, stride: int) -> jax.Array:
+    def norm(name, t):
+        if norm_fn == 'batch':
+            return batch_norm(t, p[name])
+        if norm_fn == 'instance':
+            return instance_norm(t, p.get(name, {}))
+        return t
+
+    y = relu(norm('norm1', conv(x, p['conv1']['weight'], stride=stride,
+                                padding=1, bias=p['conv1']['bias'])))
+    y = relu(norm('norm2', conv(y, p['conv2']['weight'], padding=1,
+                                bias=p['conv2']['bias'])))
+    if 'downsample' in p:
+        x = conv(x, p['downsample']['0']['weight'], stride=stride,
+                 bias=p['downsample']['0']['bias'])
+        x = norm('norm3', x)
+    return relu(x + y)
+
+
+def basic_encoder(p: Params, x: jax.Array, norm_fn: str) -> jax.Array:
+    """(B, H, W, 3) in [-1,1] → (B, H/8, W/8, out_dim)."""
+    x = conv(x, p['conv1']['weight'], stride=2, padding=3, bias=p['conv1']['bias'])
+    if norm_fn == 'batch':
+        x = batch_norm(x, p['norm1'])
+    elif norm_fn == 'instance':
+        x = instance_norm(x, p.get('norm1', {}))
+    x = relu(x)
+    for layer in ('layer1', 'layer2', 'layer3'):
+        stride = 1 if layer == 'layer1' else 2
+        x = _residual_block(p[layer]['0'], x, norm_fn, stride)
+        x = _residual_block(p[layer]['1'], x, norm_fn, 1)
+    return conv(x, p['conv2']['weight'], bias=p['conv2']['bias'])
+
+
+# -- correlation pyramid -----------------------------------------------------
+
+def build_corr_pyramid(fmap1: jax.Array, fmap2: jax.Array) -> List[jax.Array]:
+    """All-pairs correlation pyramid.
+
+    fmap: (B, H, W, D). Level i: (B·H·W, H/2^i, W/2^i, 1).
+    """
+    B, H, W, D = fmap1.shape
+    f1 = fmap1.reshape(B, H * W, D)
+    f2 = fmap2.reshape(B, H * W, D)
+    corr = jnp.einsum('bnd,bmd->bnm', f1, f2) / jnp.sqrt(jnp.asarray(D, f1.dtype))
+    corr = corr.reshape(B * H * W, H, W, 1)
+    pyramid = [corr]
+    for _ in range(CORR_LEVELS - 1):
+        corr = avg_pool(corr, 2, stride=2)
+        pyramid.append(corr)
+    return pyramid
+
+
+def bilinear_sample(img: jax.Array, coords: jax.Array) -> jax.Array:
+    """grid_sample(align_corners=True, padding_mode='zeros') in pixel coords.
+
+    img: (N, h, w, C); coords: (N, P, 2) as (x, y) pixel positions.
+    Returns (N, P, C).
+    """
+    N, h, w, C = img.shape
+    x, y = coords[..., 0], coords[..., 1]
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    flat = img.reshape(N, h * w, C)
+    batch_idx = jnp.arange(N)[:, None]
+
+    def corner(xi, yi, weight):
+        valid = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        vals = flat[batch_idx, yi_c * w + xi_c]              # (N, P, C)
+        return vals * (weight * valid)[..., None].astype(img.dtype)
+
+    return (corner(x0, y0, (1 - wx) * (1 - wy))
+            + corner(x0 + 1, y0, wx * (1 - wy))
+            + corner(x0, y0 + 1, (1 - wx) * wy)
+            + corner(x0 + 1, y0 + 1, wx * wy))
+
+
+def lookup_corr(pyramid: List[jax.Array], coords: jax.Array,
+                radius: int = CORR_RADIUS) -> jax.Array:
+    """Sample a (2r+1)² window at every level around ``coords``.
+
+    coords: (B, H, W, 2) in level-0 pixel units → (B, H, W, levels·(2r+1)²).
+    """
+    B, H, W, _ = coords.shape
+    r = radius
+    d = jnp.arange(-r, r + 1, dtype=coords.dtype)
+    # torch meshgrid(dy, dx) stacked as (dy, dx) then added to (x, y) coords
+    # via broadcasting of (..., 2) — delta ordering is (y, x) in the
+    # reference (corr.py:38-40), but it is added to centroids whose last dim
+    # is (x, y); grid points form the same set either way because the window
+    # is square and symmetric, yet the *ordering* of the 81 outputs matters
+    # for weight parity: reference orders dy-major with (dy,dx) added as-is.
+    dy, dx = jnp.meshgrid(d, d, indexing='ij')
+    delta = jnp.stack([dy, dx], axis=-1).reshape(-1, 2)      # (81, 2) (dy,dx)
+
+    out = []
+    for i, corr in enumerate(pyramid):
+        centroid = coords.reshape(B * H * W, 1, 2) / (2 ** i)  # (N,1,2) (x,y)
+        # reference adds delta (dy,dx) directly onto (x,y) centroids
+        pts = centroid + delta[None, :, :]
+        sampled = bilinear_sample(corr, pts)                  # (N, 81, 1)
+        out.append(sampled.reshape(B, H, W, -1))
+    return jnp.concatenate(out, axis=-1)
+
+
+# -- update block ------------------------------------------------------------
+
+def _conv_b(p: Params, x: jax.Array, padding=0) -> jax.Array:
+    return conv(x, p['weight'], padding=padding, bias=p['bias'])
+
+
+def motion_encoder(p: Params, flow: jax.Array, corr: jax.Array) -> jax.Array:
+    cor = relu(_conv_b(p['convc1'], corr))
+    cor = relu(_conv_b(p['convc2'], cor, padding=1))
+    flo = relu(_conv_b(p['convf1'], flow, padding=3))
+    flo = relu(_conv_b(p['convf2'], flo, padding=1))
+    out = relu(_conv_b(p['conv'], jnp.concatenate([cor, flo], -1), padding=1))
+    return jnp.concatenate([out, flow], -1)
+
+
+def sep_conv_gru(p: Params, h: jax.Array, x: jax.Array) -> jax.Array:
+    for suffix, pad in (('1', [(0, 0), (2, 2)]), ('2', [(2, 2), (0, 0)])):
+        hx = jnp.concatenate([h, x], -1)
+        z = jax.nn.sigmoid(_conv_b(p[f'convz{suffix}'], hx, padding=pad))
+        r = jax.nn.sigmoid(_conv_b(p[f'convr{suffix}'], hx, padding=pad))
+        q = jnp.tanh(_conv_b(p[f'convq{suffix}'],
+                             jnp.concatenate([r * h, x], -1), padding=pad))
+        h = (1 - z) * h + z * q
+    return h
+
+
+def flow_head(p: Params, x: jax.Array) -> jax.Array:
+    return _conv_b(p['conv2'], relu(_conv_b(p['conv1'], x, padding=1)), padding=1)
+
+
+def upsample_flow(flow: jax.Array, mask: jax.Array) -> jax.Array:
+    """Convex-combination 8× upsample (reference raft.py:103-115).
+
+    flow: (B, H, W, 2); mask: (B, H, W, 576=9·8·8) → (B, 8H, 8W, 2).
+    """
+    B, H, W, _ = flow.shape
+    mask = mask.reshape(B, H, W, 9, 8, 8)
+    mask = jax.nn.softmax(mask, axis=3)
+
+    fp = jnp.pad(8.0 * flow, [(0, 0), (1, 1), (1, 1), (0, 0)])
+    # 3×3 patches, row-major to match F.unfold ordering
+    patches = jnp.stack([fp[:, i:i + H, j:j + W, :]
+                         for i in range(3) for j in range(3)], axis=3)  # (B,H,W,9,2)
+    up = jnp.einsum('bhwkij,bhwkc->bhwijc', mask, patches)  # (B,H,W,8,8,2)
+    return up.transpose(0, 1, 3, 2, 4, 5).reshape(B, 8 * H, 8 * W, 2)
+
+
+# -- full model --------------------------------------------------------------
+
+def coords_grid(B: int, H: int, W: int, dtype=jnp.float32) -> jax.Array:
+    """(B, H, W, 2) grid of (x, y) pixel coordinates."""
+    y, x = jnp.meshgrid(jnp.arange(H, dtype=dtype), jnp.arange(W, dtype=dtype),
+                        indexing='ij')
+    return jnp.broadcast_to(jnp.stack([x, y], -1), (B, H, W, 2))
+
+
+def forward(params: Params, image1: jax.Array, image2: jax.Array,
+            iters: int = ITERS) -> jax.Array:
+    """Two (B, H, W, 3) frames (values 0..255) → (B, H, W, 2) flow.
+
+    H, W must be divisible by 8 (reference pads with InputPadder, raft.py:30-48
+    — see :func:`pad_to_multiple` / :func:`unpad`).
+    """
+    image1 = 2.0 * (jnp.asarray(image1, jnp.float32) / 255.0) - 1.0
+    image2 = 2.0 * (jnp.asarray(image2, jnp.float32) / 255.0) - 1.0
+
+    fmap1 = basic_encoder(params['fnet'], image1, 'instance')
+    fmap2 = basic_encoder(params['fnet'], image2, 'instance')
+    pyramid = build_corr_pyramid(fmap1, fmap2)
+
+    cnet = basic_encoder(params['cnet'], image1, 'batch')
+    net, inp = jnp.split(cnet, [HIDDEN_DIM], axis=-1)
+    net = jnp.tanh(net)
+    inp = relu(inp)
+
+    B, H8, W8, _ = fmap1.shape
+    coords0 = coords_grid(B, H8, W8)
+    up = params['update_block']
+
+    def step(carry, _):
+        net, coords1, _ = carry
+        corr = lookup_corr(pyramid, coords1)
+        flow = coords1 - coords0
+        motion = motion_encoder(up['encoder'], flow, corr)
+        net_new = sep_conv_gru(up['gru'], net, jnp.concatenate([inp, motion], -1))
+        delta = flow_head(up['flow_head'], net_new)
+        coords1_new = coords1 + delta
+        mask = 0.25 * _conv_b(up['mask']['2'],
+                              relu(_conv_b(up['mask']['0'], net_new, padding=1)))
+        return (net_new, coords1_new, mask), None
+
+    mask0 = jnp.zeros((B, H8, W8, 576), net.dtype)
+    (net, coords1, mask), _ = lax.scan(step, (net, coords0, mask0), None,
+                                       length=iters)
+    return upsample_flow(coords1 - coords0, mask)
+
+
+def pad_to_multiple(x: jax.Array, mode: str = 'sintel',
+                    multiple: int = 8) -> Tuple[jax.Array, Tuple[int, int, int, int]]:
+    """Replicate-pad (B, H, W, C) so H, W divide ``multiple``.
+
+    Reference InputPadder (raft.py:30-48): sintel centers the pad; kitti pads
+    bottom-only in height. Returns (padded, (top, bottom, left, right)).
+    """
+    H, W = x.shape[1], x.shape[2]
+    pad_h = (((H // multiple) + 1) * multiple - H) % multiple
+    pad_w = (((W // multiple) + 1) * multiple - W) % multiple
+    if mode == 'sintel':
+        pads = (pad_h // 2, pad_h - pad_h // 2, pad_w // 2, pad_w - pad_w // 2)
+    else:
+        pads = (0, pad_h, pad_w // 2, pad_w - pad_w // 2)
+    t, b, l, r = pads
+    x = jnp.pad(x, [(0, 0), (t, b), (l, r), (0, 0)], mode='edge')
+    return x, pads
+
+
+def unpad(x: jax.Array, pads: Tuple[int, int, int, int]) -> jax.Array:
+    t, b, l, r = pads
+    H, W = x.shape[1], x.shape[2]
+    return x[:, t:H - b, l:W - r, :]
+
+
+# -- random init for tests ---------------------------------------------------
+
+def init_state_dict(seed: int = 0) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict with princeton-vl RAFT naming/shapes."""
+    rng = np.random.RandomState(seed)
+    sd: Dict[str, np.ndarray] = {}
+
+    def conv_w(name, o, i, kh, kw, scale=0.05):
+        sd[f'{name}.weight'] = rng.randn(o, i, kh, kw).astype(np.float32) * scale
+        sd[f'{name}.bias'] = rng.randn(o).astype(np.float32) * 0.05
+
+    def bn(name, c):
+        sd[f'{name}.weight'] = rng.rand(c).astype(np.float32) + 0.5
+        sd[f'{name}.bias'] = rng.randn(c).astype(np.float32) * 0.1
+        sd[f'{name}.running_mean'] = rng.randn(c).astype(np.float32) * 0.1
+        sd[f'{name}.running_var'] = rng.rand(c).astype(np.float32) + 0.5
+
+    def encoder(prefix, out_dim, norm_fn):
+        conv_w(f'{prefix}.conv1', 64, 3, 7, 7)
+        if norm_fn == 'batch':
+            bn(f'{prefix}.norm1', 64)
+        dims = [(64, 64, 1), (64, 96, 2), (96, 128, 2)]
+        for li, (i_p, o_p, stride) in enumerate(dims, start=1):
+            for bi in range(2):
+                base = f'{prefix}.layer{li}.{bi}'
+                cin = i_p if bi == 0 else o_p
+                s = stride if bi == 0 else 1
+                conv_w(f'{base}.conv1', o_p, cin, 3, 3)
+                conv_w(f'{base}.conv2', o_p, o_p, 3, 3)
+                if norm_fn == 'batch':
+                    bn(f'{base}.norm1', o_p)
+                    bn(f'{base}.norm2', o_p)
+                if s != 1 or cin != o_p:
+                    conv_w(f'{base}.downsample.0', o_p, cin, 1, 1)
+                    if norm_fn == 'batch':
+                        bn(f'{base}.norm3', o_p)
+        conv_w(f'{prefix}.conv2', out_dim, 128, 1, 1)
+
+    encoder('fnet', 256, 'instance')
+    encoder('cnet', HIDDEN_DIM + CONTEXT_DIM, 'batch')
+
+    cor_planes = CORR_LEVELS * (2 * CORR_RADIUS + 1) ** 2
+    conv_w('update_block.encoder.convc1', 256, cor_planes, 1, 1)
+    conv_w('update_block.encoder.convc2', 192, 256, 3, 3)
+    conv_w('update_block.encoder.convf1', 128, 2, 7, 7)
+    conv_w('update_block.encoder.convf2', 64, 128, 3, 3)
+    conv_w('update_block.encoder.conv', 126, 256, 3, 3)
+    for g in ('z', 'r', 'q'):
+        conv_w(f'update_block.gru.conv{g}1', 128, 256 + 128, 1, 5)
+        conv_w(f'update_block.gru.conv{g}2', 128, 256 + 128, 5, 1)
+    conv_w('update_block.flow_head.conv1', 256, 128, 3, 3)
+    conv_w('update_block.flow_head.conv2', 2, 256, 3, 3)
+    conv_w('update_block.mask.0', 256, 128, 3, 3)
+    conv_w('update_block.mask.2', 64 * 9, 256, 1, 1)
+    return sd
