@@ -1,0 +1,58 @@
+"""I3D extractor: rgb-only E2E + the fused two-stream device step."""
+import numpy as np
+import pytest
+
+from video_features_tpu.config import load_config
+from video_features_tpu.registry import create_extractor
+
+
+def test_e2e_rgb_only(short_video, tmp_path):
+    args = load_config('i3d', overrides={
+        'video_paths': short_video,
+        'device': 'cpu',
+        'streams': 'rgb',
+        'stack_size': 16, 'step_size': 16,
+        'on_extraction': 'save_numpy',
+        'output_path': str(tmp_path / 'out'),
+        'tmp_path': str(tmp_path / 'tmp'),
+    })
+    ex = create_extractor(args)
+    feats = ex.extract(short_video)
+    # 48 frames -> windows of 17: (48-17)//16+1 = 2 stacks
+    assert feats['rgb'].shape == (2, 1024)
+    assert np.isfinite(feats['rgb']).all()
+
+    # single-stream extraction must not attempt the concat (fork bug fixed)
+    ex._extract(short_video)
+    from pathlib import Path
+    stem = Path(short_video).stem
+    assert (tmp_path / 'out' / 'i3d' / f'{stem}.npy').exists()
+
+
+def test_fused_two_stream_step():
+    """The flagship fused graph: stacks → RAFT flow → both I3D towers."""
+    args = load_config('i3d', overrides={
+        'video_paths': ['/dev/null'], 'device': 'cpu',
+        'stack_size': 10, 'step_size': 10,
+    }, run_sanity_check=False)
+    args['output_path'] = '/tmp/i3d_out'
+    args['tmp_path'] = '/tmp/i3d_tmp'
+    args['device'] = 'cpu'
+    ex = create_extractor(args)
+
+    rng = np.random.RandomState(0)
+    stacks = rng.randint(0, 256, (1, 11, 224, 224, 3)).astype(np.float32)
+    import jax
+    with jax.default_matmul_precision('highest'):
+        out = ex._step(ex.params, stacks, pads=(0, 0, 0, 0),
+                       streams=('rgb', 'flow'))
+    assert np.asarray(out['rgb']).shape == (1, 1024)
+    assert np.asarray(out['flow']).shape == (1, 1024)
+    assert np.isfinite(np.asarray(out['rgb'])).all()
+    assert np.isfinite(np.asarray(out['flow'])).all()
+
+    # concat contract: rgb||flow under 'rgb'
+    merged = ex._maybe_concat_streams(
+        {k: np.asarray(v) for k, v in out.items()})
+    assert merged['rgb'].shape == (1, 2048)
+    assert 'flow' not in merged

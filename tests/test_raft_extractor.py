@@ -1,0 +1,49 @@
+"""RAFT extractor: E2E flow extraction with pair batching + flow_viz."""
+import numpy as np
+
+from video_features_tpu.config import load_config
+from video_features_tpu.io.video import get_video_props
+from video_features_tpu.registry import create_extractor
+from video_features_tpu.utils.flow_viz import flow_to_image, make_colorwheel
+
+
+def test_e2e_flow(short_video, tmp_path):
+    args = load_config('raft', overrides={
+        'video_paths': short_video,
+        'device': 'cpu',
+        'batch_size': 16,
+        'side_size': 128,        # small frames keep CPU runtime sane
+        'output_path': str(tmp_path / 'out'),
+        'tmp_path': str(tmp_path / 'tmp'),
+    })
+    ex = create_extractor(args)
+    feats = ex.extract(short_video)
+
+    n = get_video_props(short_video)['num_frames']
+    flow = feats['raft']
+    # reference contract: (T-1, 2, H, W) channels-first on disk
+    assert flow.shape[0] == n - 1
+    assert flow.shape[1] == 2
+    # side_size=128 on a 320x240 video -> 128 is the smaller (height) edge
+    assert min(flow.shape[2], flow.shape[3]) == 128
+    assert np.isfinite(flow).all()
+    # timestamps cover every decoded frame (one more than flows)
+    assert len(feats['timestamps_ms']) == n
+    assert feats['fps'] > 0
+
+
+def test_colorwheel():
+    wheel = make_colorwheel()
+    assert wheel.shape == (55, 3)
+    assert wheel.max() == 255 and wheel.min() == 0
+
+
+def test_flow_to_image():
+    rng = np.random.RandomState(0)
+    flow = rng.randn(16, 24, 2).astype(np.float32) * 3
+    img = flow_to_image(flow)
+    assert img.shape == (16, 24, 3)
+    assert img.dtype == np.uint8
+    # zero flow maps to (near-)white center of the wheel
+    white = flow_to_image(np.zeros((4, 4, 2), np.float32))
+    assert (white > 250).all()

@@ -1,0 +1,168 @@
+"""I3D two-stream extractor — the flagship fused RAFT→I3D pipeline.
+
+Behavior parity with reference models/i3d/extract_i3d.py:
+  * frames host-resized to short side 256 (PIL, ResizeImproved numerics,
+    :43-48) and accumulated into stacks of ``stack_size + 1`` frames — B+1
+    frames give B flow pairs, and the rgb stream uses the first B frames so
+    both streams have equal length (:115-123, :150-160);
+  * flow stream: RAFT on /8-padded consecutive pairs; the center crop is
+    taken from the PADDED flow exactly like the reference (which never
+    unpads before TensorCenterCrop, :156-164);
+  * transforms: rgb = crop224 → 2x/255-1; flow = crop224 → clamp(±20) →
+    uint8 quantize → 2x/255-1 (:49-62);
+  * ``step_size`` < ``stack_size`` overlaps windows; partial final stacks
+    are dropped (:126-129); streams configurable ('rgb'/'flow'/both).
+
+TPU-first: the whole stack→flow→transform→two-I3D graph is ONE jit-compiled
+function; stacks are gathered with a vectorized index array and batched
+``batch_size`` windows per device step (padded + masked at the tail). The
+reference instead runs a python frame loop with per-stack device round trips.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from video_features_tpu.extract.base import BaseExtractor
+from video_features_tpu.io.video import VideoLoader
+from video_features_tpu.models import i3d as i3d_model
+from video_features_tpu.models import raft as raft_model
+from video_features_tpu.ops.transforms import (
+    center_crop, flow_to_uint8_levels, resize_pil, scale_to_pm1,
+)
+from video_features_tpu.utils.device import jax_device
+from video_features_tpu.utils.slicing import form_slices
+
+MIN_SIDE_SIZE = 256
+CROP_SIZE = 224
+
+
+class ExtractI3D(BaseExtractor):
+
+    def __init__(self, args) -> None:
+        super().__init__(
+            feature_type=args.feature_type,
+            on_extraction=args.on_extraction,
+            tmp_path=args.tmp_path,
+            output_path=args.output_path,
+            keep_tmp_files=args.keep_tmp_files,
+            device=args.device,
+            concat_rgb_flow=args.get('concat_rgb_flow', False),
+        )
+        self.streams: List[str] = (['rgb', 'flow'] if args.streams is None
+                                   else [args.streams])
+        for s in self.streams:
+            assert s in ('rgb', 'flow'), f'unknown stream {s}'
+        if args.flow_type != 'raft':
+            raise NotImplementedError('only flow_type=raft is supported')
+        self.stack_size = 64 if args.stack_size is None else args.stack_size
+        self.step_size = 64 if args.step_size is None else args.step_size
+        self.extraction_fps = args.extraction_fps
+        self.batch_size = args.get('batch_size', 1)
+        self.show_pred = args.show_pred
+        self.output_feat_keys = list(self.streams)
+        self._device = jax_device(self.device)
+        self.params = jax.device_put(self.load_params(args), self._device)
+        # pads/streams are static so one executable serves each video geometry
+        self._step = jax.jit(self._stack_batch,
+                             static_argnames=('pads', 'streams'))
+
+    def load_params(self, args):
+        """{'rgb': i3d params, 'flow': i3d params, 'raft': raft params}."""
+        from video_features_tpu.transplant.torch2jax import (
+            load_torch_checkpoint, transplant,
+        )
+        params = {}
+        get = args.get if hasattr(args, 'get') else lambda k: None
+        if 'rgb' in self.streams:
+            ckpt = get('i3d_rgb_checkpoint_path')
+            params['rgb'] = (load_torch_checkpoint(ckpt) if ckpt
+                             else transplant(i3d_model.init_state_dict(modality='rgb')))
+        if 'flow' in self.streams:
+            ckpt = get('i3d_flow_checkpoint_path')
+            params['flow'] = (load_torch_checkpoint(ckpt) if ckpt
+                              else transplant(i3d_model.init_state_dict(modality='flow')))
+            raft_ckpt = get('raft_checkpoint_path')
+            params['raft'] = (load_torch_checkpoint(raft_ckpt) if raft_ckpt
+                              else transplant(raft_model.init_state_dict()))
+        return params
+
+    # -- the fused device step ----------------------------------------------
+
+    @staticmethod
+    def _stack_batch(params, stacks, pads, streams):
+        """(B, stack+1, H, W, 3) float frames → {stream: (B, 1024)}.
+
+        The full two-stream graph — RAFT flow, quantization, both I3D
+        towers — compiles into a single XLA executable.
+        """
+        B, S1, H, W, _ = stacks.shape
+        stack = S1 - 1
+        out = {}
+        if 'rgb' in streams:
+            rgb = center_crop(stacks[:, :-1], CROP_SIZE)
+            rgb = scale_to_pm1(rgb)
+            out['rgb'] = i3d_model.forward(params['rgb'], rgb, features=True)
+        if 'flow' in streams:
+            t, b, l, r = pads
+            padded = jnp.pad(stacks, [(0, 0), (0, 0), (t, b), (l, r), (0, 0)],
+                             mode='edge')
+            f1 = padded[:, :-1].reshape(B * stack, H + t + b, W + l + r, 3)
+            f2 = padded[:, 1:].reshape(B * stack, H + t + b, W + l + r, 3)
+            flow = raft_model.forward(params['raft'], f1, f2)
+            flow = flow.reshape(B, stack, H + t + b, W + l + r, 2)
+            # reference crops the PADDED flow (never unpads, extract_i3d.py:156-164)
+            flow = center_crop(flow, CROP_SIZE)
+            flow = scale_to_pm1(flow_to_uint8_levels(flow, 20.0))
+            out['flow'] = i3d_model.forward(params['flow'], flow, features=True)
+        return out
+
+    # -- extraction ---------------------------------------------------------
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        loader = VideoLoader(
+            video_path, batch_size=64,
+            fps=self.extraction_fps, tmp_path=self.tmp_path,
+            keep_tmp=self.keep_tmp_files,
+            transform=lambda f: resize_pil(f, MIN_SIDE_SIZE).astype(np.float32))
+        frames = np.stack(
+            [f for batch, _, _ in loader for f in batch])     # (T, H, W, 3)
+
+        # stack windows of stack_size+1 frames (B+1 frames → B flow pairs)
+        slices = form_slices(len(frames), self.stack_size + 1, self.step_size)
+        H, W = frames.shape[1:3]
+        pads = raft_model.pad_to_multiple(
+            np.zeros((1, H, W, 1), np.float32))[1]
+
+        feats: Dict[str, list] = {s: [] for s in self.streams}
+        with jax.default_matmul_precision('highest'):
+            for start in range(0, len(slices), self.batch_size):
+                window = slices[start:start + self.batch_size]
+                valid = len(window)
+                while len(window) < self.batch_size:  # pad tail, mask below
+                    window = window + [window[-1]]
+                stacks = np.stack([frames[s:e] for s, e in window])
+                out = self._step(self.params, stacks, pads=tuple(pads),
+                                 streams=tuple(self.streams))
+                for s in self.streams:
+                    feats[s].append(np.asarray(out[s])[:valid])
+                if self.show_pred:
+                    self.maybe_show_pred(stacks[:valid], pads, start)
+
+        return {
+            s: (np.concatenate(v, axis=0) if v
+                else np.zeros((0, i3d_model.FEAT_DIM), np.float32))
+            for s, v in feats.items()
+        }
+
+    def maybe_show_pred(self, stacks, pads, stack_counter):
+        if 'rgb' not in self.streams:
+            return
+        from video_features_tpu.utils.preds import show_predictions_on_dataset
+        rgb = scale_to_pm1(center_crop(jnp.asarray(stacks[:, :-1]), CROP_SIZE))
+        _, logits = i3d_model.forward(self.params['rgb'], rgb, features=False)
+        print(f'At stack {stack_counter} (rgb stream)')
+        show_predictions_on_dataset(np.asarray(logits), 'kinetics')

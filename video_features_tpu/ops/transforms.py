@@ -75,20 +75,21 @@ def flow_to_uint8_levels(x: Array, bound: float = 20.0) -> Array:
     return jnp.round((x + bound) * (255.0 / (2.0 * bound)))
 
 
-def short_side_resize_pil(frame: np.ndarray, size: int) -> np.ndarray:
-    """Host-side PIL bilinear resize so min(H, W) == size, aspect preserved.
+def resize_pil(frame: np.ndarray, size: int,
+               to_smaller_edge: bool = True) -> np.ndarray:
+    """Host-side PIL bilinear edge resize, aspect preserved.
 
     Exact parity with the reference's PIL-based `ResizeImproved`
-    (reference models/transforms.py:191-242): if a side already equals the
-    target the frame is returned unchanged; the scaled side uses
-    round-to-nearest via PIL's size computation.
+    (reference models/transforms.py:191-242): no-op when the matched edge
+    already equals ``size``; the scaled side uses ``int(size * other/edge)``
+    (truncation, PIL convention).
     """
     from PIL import Image
 
     h, w = frame.shape[:2]
-    if min(h, w) == size:
+    if (w <= h and w == size) or (h <= w and h == size):
         return frame
-    if w < h:
+    if (w < h) == to_smaller_edge:
         ow = size
         oh = int(size * h / w)
     else:
@@ -96,3 +97,8 @@ def short_side_resize_pil(frame: np.ndarray, size: int) -> np.ndarray:
         ow = int(size * w / h)
     img = Image.fromarray(frame)
     return np.asarray(img.resize((ow, oh), Image.BILINEAR))
+
+
+def short_side_resize_pil(frame: np.ndarray, size: int) -> np.ndarray:
+    """min(H, W) → ``size`` via PIL bilinear (see :func:`resize_pil`)."""
+    return resize_pil(frame, size, to_smaller_edge=True)
