@@ -1,0 +1,83 @@
+"""Flagship benchmark: fused I3D two-stream (RAFT-backed) clips/sec/chip.
+
+One stack window (stack_size consecutive frames → RAFT flow → I3D rgb ∥
+I3D flow → (2048,) feature) is one "clip" — the unit of the north-star
+metric (BASELINE.md: Kinetics-400 val clips/sec/chip). The reference fork's
+only timing datapoint is ~4 s/video at stack 16 / step 16 @ 25 fps
+(reference Test3.ipynb cells 0,2) ≈ 3.75 clips/s on its unspecified GPU;
+``vs_baseline`` is measured against that.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "clips/sec/chip", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+# Reference anecdote: ~4 s/video, ~15 stacks/video at stack 16 step 16 @25fps
+BASELINE_CLIPS_PER_SEC = 3.75
+
+
+def main() -> None:
+    import jax
+
+    # Local smoke runs: BENCH_PLATFORM=cpu avoids dialing remote hardware.
+    if os.environ.get('BENCH_PLATFORM'):
+        jax.config.update('jax_platforms', os.environ['BENCH_PLATFORM'])
+
+    from video_features_tpu.extract.i3d import fused_two_stream_step
+    from video_features_tpu.models import i3d as i3d_model
+    from video_features_tpu.models import raft as raft_model
+    from video_features_tpu.transplant.torch2jax import transplant
+    from video_features_tpu.utils.device import jax_device
+
+    platform = jax.devices()[0].platform
+    on_accel = platform != 'cpu'
+    # Reference-parity geometry on an accelerator; a small smoke shape on
+    # CPU so the bench stays runnable anywhere.
+    stack = int(os.environ.get('BENCH_STACK', 16))
+    size = int(os.environ.get('BENCH_SIZE', 224 if on_accel else 64))
+    batch = int(os.environ.get('BENCH_BATCH', 4 if on_accel else 1))
+    iters = int(os.environ.get('BENCH_ITERS', 5 if on_accel else 2))
+
+    device = jax_device(platform)
+    params = jax.device_put({
+        'rgb': transplant(i3d_model.init_state_dict(modality='rgb')),
+        'flow': transplant(i3d_model.init_state_dict(modality='flow')),
+        'raft': transplant(raft_model.init_state_dict()),
+    }, device)
+    rng = np.random.RandomState(0)
+    stacks = jax.device_put(
+        rng.randint(0, 255, size=(batch, stack + 1, size, size, 3))
+        .astype(np.float32), device)
+
+    step = jax.jit(fused_two_stream_step,
+                   static_argnames=('pads', 'streams', 'crop_size'))
+    kwargs = dict(pads=(0, 0, 0, 0), streams=('rgb', 'flow'),
+                  crop_size=min(224, size))
+
+    out = step(params, stacks, **kwargs)           # compile + warmup
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(params, stacks, **kwargs)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+
+    clips_per_sec = batch * iters / elapsed
+    print(json.dumps({
+        'metric': f'i3d_two_stream_clips_per_sec_{platform}'
+                  f'_stack{stack}_{size}px',
+        'value': round(clips_per_sec, 3),
+        'unit': 'clips/sec/chip',
+        'vs_baseline': round(clips_per_sec / BASELINE_CLIPS_PER_SEC, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

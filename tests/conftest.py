@@ -4,11 +4,19 @@ import os
 import sys
 from pathlib import Path
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['JAX_PLATFORMS'] = 'cpu'
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (
         xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+# A site hook may have pre-imported jax with JAX_PLATFORMS pointed at a
+# remote TPU backend; the env var above is then too late (the config read
+# it at import). Force the runtime config before any backend initializes
+# so tests never try to dial real hardware.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 REPO_ROOT = Path(__file__).parent.parent
 REFERENCE_ROOT = Path('/root/reference')

@@ -1,0 +1,136 @@
+"""CLIP: numerical parity vs the reference torch model + tokenizer + E2E."""
+import numpy as np
+import pytest
+import torch
+
+from video_features_tpu.config import load_config
+from video_features_tpu.models import clip as clip_model
+from video_features_tpu.registry import create_extractor
+from video_features_tpu.transplant.torch2jax import transplant
+
+
+def _load_reference_module(reference_repo, relpath, name):
+    """Import a reference source file directly, bypassing package __init__s
+    (models/clip/__init__.py pulls in omegaconf, absent here)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(name, reference_repo / relpath)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture(scope='module')
+def torch_clip(reference_repo):
+    """A small ViT-B/32-shaped torch CLIP built from the reference's vendored
+    model code (reference models/clip/clip_src/model.py:399-436) with a tiny
+    text tower so CPU parity tests stay fast."""
+    CLIP = _load_reference_module(
+        reference_repo, 'models/clip/clip_src/model.py', 'ref_clip_model').CLIP
+    torch.manual_seed(0)
+    model = CLIP(embed_dim=512, image_resolution=224, vision_layers=12,
+                 vision_width=768, vision_patch_size=32, context_length=77,
+                 vocab_size=512, transformer_width=512, transformer_heads=8,
+                 transformer_layers=2)
+    model.eval()
+    return model
+
+
+def test_image_parity_vs_reference_torch(torch_clip):
+    params = transplant(torch_clip.state_dict(),
+                        no_transpose=set(clip_model.NO_TRANSPOSE))
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 224, 224, 3).astype(np.float32)
+
+    with torch.no_grad():
+        ref = torch_clip.encode_image(
+            torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    import jax
+    with jax.default_matmul_precision('highest'):
+        ours = np.asarray(clip_model.encode_image(params, x, 'ViT-B/32'))
+
+    assert ours.shape == ref.shape == (2, 512)
+    l2 = np.linalg.norm(ours - ref) / max(np.linalg.norm(ref), 1e-12)
+    assert l2 < 1e-3, f'relative L2 {l2}'
+
+
+def test_text_parity_vs_reference_torch(torch_clip):
+    params = transplant(torch_clip.state_dict(),
+                        no_transpose=set(clip_model.NO_TRANSPOSE))
+    rng = np.random.RandomState(1)
+    tokens = np.zeros((3, 77), np.int64)
+    for i in range(3):
+        n = rng.randint(3, 20)
+        tokens[i, :n] = rng.randint(1, 500, size=n)
+        tokens[i, n - 1] = 511  # highest id = argmax pooling token (EOT)
+
+    with torch.no_grad():
+        ref = torch_clip.encode_text(torch.from_numpy(tokens)).numpy()
+    import jax
+    with jax.default_matmul_precision('highest'):
+        ours = np.asarray(clip_model.encode_text(params, tokens, 'ViT-B/32'))
+
+    assert ours.shape == ref.shape == (3, 512)
+    l2 = np.linalg.norm(ours - ref) / max(np.linalg.norm(ref), 1e-12)
+    assert l2 < 1e-3, f'relative L2 {l2}'
+
+
+def test_tokenizer_parity(reference_repo):
+    """Our BPE must produce the same ids as the reference's vendored
+    tokenizer for representative zero-shot prompts."""
+    pytest.importorskip('regex')
+
+    # The reference tokenizer imports ftfy (absent here); both tokenizers
+    # then see identical un-fixed text, so parity still holds with a stub.
+    import sys
+    import types
+    if 'ftfy' not in sys.modules:
+        stub = types.ModuleType('ftfy')
+        stub.fix_text = lambda s: s
+        sys.modules['ftfy'] = stub
+
+    RefTok = _load_reference_module(
+        reference_repo, 'models/clip/clip_src/simple_tokenizer.py',
+        'ref_clip_tokenizer').SimpleTokenizer
+
+    from video_features_tpu.utils.clip_tokenizer import (
+        SimpleTokenizer, find_bpe_vocab, tokenize,
+    )
+    if find_bpe_vocab() is None:
+        pytest.skip('BPE vocab unavailable')
+
+    ref = RefTok()
+    ours = SimpleTokenizer()
+    prompts = [
+        'a photo of riding a bike',
+        'Hello, World! 123',
+        "it's the tokenizer's edge-cases: don't fail",
+        'playing    ukulele',
+    ]
+    for p in prompts:
+        assert ours.encode(p) == ref.encode(p), p
+
+    mat = tokenize(prompts, tokenizer=ours)
+    assert mat.shape == (4, 77)
+    sot, eot = ours.encoder['<|startoftext|>'], ours.encoder['<|endoftext|>']
+    assert (mat[:, 0] == sot).all()
+    assert all(eot in row for row in mat)
+
+
+def test_infer_model_name(torch_clip):
+    assert clip_model.infer_model_name(torch_clip.state_dict()) == 'ViT-B/32'
+
+
+def test_e2e_extraction(short_video, tmp_path):
+    args = load_config('clip', overrides={
+        'video_paths': short_video,
+        'device': 'cpu',
+        'batch_size': 16,
+        'extraction_fps': None,
+        'output_path': str(tmp_path / 'out'),
+        'tmp_path': str(tmp_path / 'tmp'),
+    })
+    ex = create_extractor(args)
+    out = ex.extract(short_video)
+    assert out['clip'].shape == (48, 512)
+    assert np.isfinite(out['clip']).all()
+    assert out['timestamps_ms'].shape == (48,)

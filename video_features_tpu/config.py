@@ -117,7 +117,15 @@ def resolve_device(device: str) -> str:
     """
     import jax
 
+    from video_features_tpu.utils.device import pin_cpu_platform
+
     device = str(device).lower()
+    if device == 'cpu':
+        # Pin before backends initialize: probing for accelerators here
+        # would spin up every registered plugin (a remote-TPU tunnel can
+        # block a pure-CPU run for minutes).
+        pin_cpu_platform()
+        return 'cpu'
     platforms = {d.platform for d in jax.devices()}
     accel = next((p for p in platforms if p != 'cpu'), None)
     if device.startswith(('cuda', 'tpu', 'gpu', 'accel')):

@@ -1,0 +1,123 @@
+"""CLIP frame-wise extractor (reference models/clip/extract_clip.py).
+
+Transform parity with the reference's `_transform` (reference
+clip_src/clip.py: Resize(n_px, BICUBIC) → CenterCrop(n_px) → ToTensor →
+Normalize(CLIP mean/std)): the resize+crop runs on the host (PIL bicubic),
+scale+normalize are fused into the jitted encode_image step.
+
+``show_pred`` is zero-shot classification: cosine-similarity logits against
+Kinetics-400 ``"a photo of {label}"`` prompts or user ``pred_texts``
+(reference extract_clip.py:32-40,86-108). Text features are encoded once
+per run and cached.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
+from video_features_tpu.models import clip as clip_model
+from video_features_tpu.ops.transforms import (
+    normalize, resize_pil, to_float_zero_one,
+)
+from video_features_tpu.utils.device import jax_device
+
+
+class ExtractCLIP(BaseFrameWiseExtractor):
+
+    def __init__(self, args) -> None:
+        self.model_name = args.model_name
+        state_dict = self._load_state_dict(args)
+        if self.model_name == 'custom':
+            self.arch = clip_model.infer_model_name(state_dict)
+        else:
+            if self.model_name not in clip_model.VISUAL_CFGS:
+                raise NotImplementedError(
+                    f'model_name {self.model_name!r}; known: '
+                    f'{", ".join(clip_model.VISUAL_CFGS)} or "custom"')
+            self.arch = self.model_name
+        cfg = clip_model.VISUAL_CFGS[self.arch]
+        super().__init__(args, feat_dim=cfg['embed_dim'])
+        self.input_resolution = cfg['input_resolution']
+        self.pred_texts: Optional[List[str]] = (
+            list(args.pred_texts) if args.get('pred_texts') else None)
+        self._device = jax_device(self.device)
+        from video_features_tpu.transplant.torch2jax import transplant
+        self.params = jax.device_put(
+            transplant(state_dict, no_transpose=set(clip_model.NO_TRANSPOSE),
+                       dtype=np.float32),
+            self._device)
+        self._step = jax.jit(partial(self._forward, arch=self.arch))
+        self._text_feats: Optional[np.ndarray] = None
+
+    def _load_state_dict(self, args):
+        """Checkpoint sources: explicit path, or 'custom' → CLIP-custom.pth
+        (reference extract_clip.py:55-61). OpenAI URL download needs network
+        — a local path must be provided in this environment."""
+        ckpt = args.get('checkpoint_path')
+        if self.model_name == 'custom' and not ckpt:
+            ckpt = './checkpoints/CLIP-custom.pth'
+        if ckpt:
+            import torch
+            sd = torch.load(ckpt, map_location='cpu', weights_only=False)
+            if hasattr(sd, 'state_dict'):  # jit-archived OpenAI models
+                sd = sd.state_dict()
+            if isinstance(sd, dict) and 'state_dict' in sd:
+                sd = sd['state_dict']
+            return sd
+        return clip_model.init_state_dict(model_name=args.model_name)
+
+    @staticmethod
+    def _forward(params, batch, arch):
+        x = to_float_zero_one(batch)
+        x = normalize(x, clip_model.MEAN, clip_model.STD)
+        return clip_model.encode_image(params, x, arch)
+
+    def host_transform(self, frame: np.ndarray) -> np.ndarray:
+        n_px = self.input_resolution
+        frame = resize_pil(frame, n_px, interpolation='bicubic')
+        h, w = frame.shape[:2]
+        i = int(round((h - n_px) / 2.0))
+        j = int(round((w - n_px) / 2.0))
+        return frame[i:i + n_px, j:j + n_px]
+
+    def device_step(self, batch: np.ndarray) -> jax.Array:
+        return self._step(self.params, batch)
+
+    # -- zero-shot show_pred -------------------------------------------------
+
+    def _get_text_feats(self):
+        if self._text_feats is not None:
+            return self._text_feats, self._classes
+        from video_features_tpu.utils.clip_tokenizer import tokenize
+        from video_features_tpu.utils.preds import load_label_map
+        if self.pred_texts is not None:
+            self._classes = self.pred_texts
+        else:
+            labels = load_label_map('kinetics')
+            if labels is None:
+                print('show_pred: no Kinetics label map available — skipping')
+                self._classes = None
+                return None, None
+            self._classes = [f'a photo of {label}' for label in labels]
+        tokens = tokenize(self._classes)
+        feats = jax.jit(partial(clip_model.encode_text, model_name=self.arch))(
+            self.params, tokens)
+        self._text_feats = feats
+        return self._text_feats, self._classes
+
+    def maybe_show_pred(self, feats: np.ndarray) -> None:
+        from video_features_tpu.utils.preds import show_predictions_on_dataset
+        try:
+            text_feats, classes = self._get_text_feats()
+        except FileNotFoundError as e:
+            print(f'show_pred unavailable: {e}')
+            return
+        if text_feats is None:
+            return
+        logits = clip_model.zero_shot_logits(
+            self.params, jax.numpy.asarray(feats), text_feats)
+        show_predictions_on_dataset(np.asarray(logits), classes)

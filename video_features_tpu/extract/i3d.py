@@ -40,6 +40,40 @@ MIN_SIDE_SIZE = 256
 CROP_SIZE = 224
 
 
+def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
+                          crop_size=CROP_SIZE):
+    """(B, stack+1, H, W, 3) float frames → {stream: (B, 1024)}.
+
+    The full two-stream graph — RAFT flow, quantization, both I3D towers —
+    compiles into a single XLA executable. ``constrain_pairs`` optionally
+    applies a sharding constraint to the (B·stack, h, w, C) flow-pair
+    tensors so the RAFT sub-graph spreads over a (data, time) mesh
+    (sequence parallelism over temporal pairs — see parallel.mesh).
+    """
+    B, S1, H, W, _ = stacks.shape
+    stack = S1 - 1
+    out = {}
+    if 'rgb' in streams:
+        rgb = center_crop(stacks[:, :-1], crop_size)
+        rgb = scale_to_pm1(rgb)
+        out['rgb'] = i3d_model.forward(params['rgb'], rgb, features=True)
+    if 'flow' in streams:
+        t, b, l, r = pads
+        padded = jnp.pad(stacks, [(0, 0), (0, 0), (t, b), (l, r), (0, 0)],
+                         mode='edge')
+        f1 = padded[:, :-1].reshape(B * stack, H + t + b, W + l + r, 3)
+        f2 = padded[:, 1:].reshape(B * stack, H + t + b, W + l + r, 3)
+        if constrain_pairs is not None:
+            f1, f2 = constrain_pairs(f1), constrain_pairs(f2)
+        flow = raft_model.forward(params['raft'], f1, f2)
+        flow = flow.reshape(B, stack, H + t + b, W + l + r, 2)
+        # reference crops the PADDED flow (never unpads, extract_i3d.py:156-164)
+        flow = center_crop(flow, crop_size)
+        flow = scale_to_pm1(flow_to_uint8_levels(flow, 20.0))
+        out['flow'] = i3d_model.forward(params['flow'], flow, features=True)
+    return out
+
+
 class ExtractI3D(BaseExtractor):
 
     def __init__(self, args) -> None:
@@ -92,33 +126,7 @@ class ExtractI3D(BaseExtractor):
 
     # -- the fused device step ----------------------------------------------
 
-    @staticmethod
-    def _stack_batch(params, stacks, pads, streams):
-        """(B, stack+1, H, W, 3) float frames → {stream: (B, 1024)}.
-
-        The full two-stream graph — RAFT flow, quantization, both I3D
-        towers — compiles into a single XLA executable.
-        """
-        B, S1, H, W, _ = stacks.shape
-        stack = S1 - 1
-        out = {}
-        if 'rgb' in streams:
-            rgb = center_crop(stacks[:, :-1], CROP_SIZE)
-            rgb = scale_to_pm1(rgb)
-            out['rgb'] = i3d_model.forward(params['rgb'], rgb, features=True)
-        if 'flow' in streams:
-            t, b, l, r = pads
-            padded = jnp.pad(stacks, [(0, 0), (0, 0), (t, b), (l, r), (0, 0)],
-                             mode='edge')
-            f1 = padded[:, :-1].reshape(B * stack, H + t + b, W + l + r, 3)
-            f2 = padded[:, 1:].reshape(B * stack, H + t + b, W + l + r, 3)
-            flow = raft_model.forward(params['raft'], f1, f2)
-            flow = flow.reshape(B, stack, H + t + b, W + l + r, 2)
-            # reference crops the PADDED flow (never unpads, extract_i3d.py:156-164)
-            flow = center_crop(flow, CROP_SIZE)
-            flow = scale_to_pm1(flow_to_uint8_levels(flow, 20.0))
-            out['flow'] = i3d_model.forward(params['flow'], flow, features=True)
-        return out
+    _stack_batch = staticmethod(fused_two_stream_step)
 
     # -- extraction ---------------------------------------------------------
 

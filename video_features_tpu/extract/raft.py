@@ -113,7 +113,11 @@ class ExtractRAFT(BaseExtractor):
         if flows:
             features = np.concatenate(flows, axis=0).transpose(0, 3, 1, 2)
         else:
-            features = np.zeros((0, 2, loader.height, loader.width), np.float32)
+            # Empty fallback must match the geometry normal outputs would
+            # have — i.e. AFTER the host resize, not the raw video dims.
+            h, w = self.host_transform(
+                np.zeros((loader.height, loader.width, 3), np.uint8)).shape[:2]
+            features = np.zeros((0, 2, h, w), np.float32)
         return {
             self.feature_type: features,
             'fps': np.array(loader.fps),

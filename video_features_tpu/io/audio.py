@@ -1,0 +1,65 @@
+"""Audio demux + wav reading for the VGGish path.
+
+Re-design of reference utils/utils.py:197-226 (`extract_wav_from_mp4`):
+the same two-stage mp4 → .aac (stream copy) → .wav contract and tmp-file
+naming, but with list-argv subprocess calls (no shell-split breakage on
+paths with spaces) and a stdlib `wave` reader instead of the soundfile
+dependency (ffmpeg's wav output is PCM16, which `wave` handles exactly).
+"""
+from __future__ import annotations
+
+import subprocess
+import wave
+from pathlib import Path
+from typing import Tuple
+
+import numpy as np
+
+from video_features_tpu.io.video import which_ffmpeg
+
+
+def extract_wav_from_mp4(video_path: str, tmp_path: str) -> Tuple[str, str]:
+    """mp4 → aac (codec copy) → wav; returns (wav_path, aac_path)."""
+    ffmpeg = which_ffmpeg()
+    assert ffmpeg != '', 'ffmpeg is not installed'
+    assert video_path.endswith('.mp4'), 'expected an .mp4 file'
+    Path(tmp_path).mkdir(parents=True, exist_ok=True)
+
+    stem = Path(video_path).stem
+    aac_path = str(Path(tmp_path) / f'{stem}.aac')
+    wav_path = str(Path(tmp_path) / f'{stem}.wav')
+
+    for cmd in ([ffmpeg, '-hide_banner', '-loglevel', 'error', '-y',
+                 '-i', video_path, '-acodec', 'copy', aac_path],
+                [ffmpeg, '-hide_banner', '-loglevel', 'error', '-y',
+                 '-i', aac_path, wav_path]):
+        result = subprocess.run(cmd, stderr=subprocess.PIPE, text=True)
+        if result.returncode != 0:
+            raise RuntimeError(
+                f'audio demux failed (no/unsupported audio track in '
+                f'{video_path}?): {" ".join(cmd)}\n{result.stderr.strip()}')
+    return wav_path, aac_path
+
+
+def read_wav(wav_path: str) -> Tuple[np.ndarray, int]:
+    """PCM wav → (float waveform in [-1, 1] shaped (T,) or (T, C), rate).
+
+    Matches the reference's int16 read + /32768 scaling
+    (reference vggish_src/vggish_input.py:84-88).
+    """
+    with wave.open(wav_path, 'rb') as f:
+        rate = f.getframerate()
+        n_channels = f.getnchannels()
+        width = f.getsampwidth()
+        raw = f.readframes(f.getnframes())
+    if width == 2:
+        data = np.frombuffer(raw, dtype='<i2').astype(np.float64) / 32768.0
+    elif width == 4:
+        data = np.frombuffer(raw, dtype='<i4').astype(np.float64) / 2147483648.0
+    elif width == 1:  # unsigned 8-bit
+        data = (np.frombuffer(raw, dtype=np.uint8).astype(np.float64) - 128.0) / 128.0
+    else:
+        raise NotImplementedError(f'unsupported wav sample width: {width}')
+    if n_channels > 1:
+        data = data.reshape(-1, n_channels)
+    return data, rate

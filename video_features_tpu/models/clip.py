@@ -210,6 +210,36 @@ def zero_shot_logits(params: Params, image_feats: jax.Array,
     return scale * img @ txt.T
 
 
+def infer_model_name(state_dict) -> str:
+    """Detect the architecture from a raw torch state_dict, the way the
+    reference's build_model does (reference clip_src/model.py:399-417), and
+    map it onto a known VISUAL_CFGS key (for ``model_name: custom``)."""
+    def shape(k):
+        return tuple(state_dict[k].shape)
+
+    if 'visual.proj' in state_dict:
+        width = shape('visual.conv1.weight')[0]
+        patch = shape('visual.conv1.weight')[-1]
+        layers = len({k.split('.')[3] for k in state_dict
+                      if k.startswith('visual.transformer.resblocks.')})
+        for name, cfg in VISUAL_CFGS.items():
+            if (cfg['kind'] == 'vit' and cfg['width'] == width
+                    and cfg['patch'] == patch and cfg['layers'] == layers):
+                return name
+        raise NotImplementedError(
+            f'unrecognized ViT: width={width} patch={patch} layers={layers}')
+    width = shape('visual.layer1.0.conv1.weight')[0]
+    layers = tuple(
+        len({k.split('.')[2] for k in state_dict
+             if k.startswith(f'visual.layer{li}.')}) for li in (1, 2, 3, 4))
+    for name, cfg in VISUAL_CFGS.items():
+        if (cfg['kind'] == 'resnet' and cfg['width'] == width
+                and tuple(cfg['layers']) == layers):
+            return name
+    raise NotImplementedError(
+        f'unrecognized ModifiedResNet: width={width} layers={layers}')
+
+
 # -- random init for tests ---------------------------------------------------
 
 def init_state_dict(seed: int = 0, model_name: str = 'ViT-B/32',

@@ -1,0 +1,77 @@
+"""VGGish audio embedding net (AudioSet VGG, harritaylor/torchvggish port).
+
+Functional re-implementation of the architecture behind the reference's
+vendored net (reference models/vggish/vggish_src/vggish_slim.py:15-37,
+100-111): four conv stages [64, M, 128, M, 256×2, M, 512×2, M] of 3×3/pad-1
+convs + ReLU with 2×2 max pools, then FC 12288→4096→4096→128, ReLU after
+EVERY linear including the last.
+
+Layout note: the torch net flattens its (B, 512, 6, 4) feature map
+channels-LAST via two transposes before the FC stack (vggish_slim.py:28-35)
+— in NHWC that flatten is just reshape, one more place the TPU layout is
+the natural one.
+
+The AudioSet release's PCA-whiten + 8-bit quantize postprocessor
+(vggish_slim.py:40-99) is :func:`postprocess`; the reference's default
+path bypasses it (forward(post_process=False)), and so does ours.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from video_features_tpu.ops.nn import conv, linear, max_pool, relu
+
+Params = Dict[str, Any]
+
+FEAT_DIM = 128
+# Sequential indices of the conv layers in torch's make_layers()
+# ([64, M, 128, M, 256, 256, M, 512, 512, M] → convs at 0,3,6,8,11,13)
+CONV_LAYERS = ((0, 64), (3, 128), (6, 256), (8, 256), (11, 512), (13, 512))
+POOL_AFTER = {0, 3, 8, 13}  # pool follows these convs
+
+
+def forward(params: Params, x: jax.Array) -> jax.Array:
+    """(B, 96, 64, 1) log-mel examples → (B, 128) embeddings."""
+    feats = params['features']
+    for idx, _ in CONV_LAYERS:
+        p = feats[str(idx)]
+        x = relu(conv(x, p['weight'], padding=1, bias=p['bias']))
+        if idx in POOL_AFTER:
+            x = max_pool(x, (2, 2), stride=(2, 2))
+    B = x.shape[0]
+    x = x.reshape(B, -1)            # NHWC flatten == torch's transposed flatten
+    emb = params['embeddings']
+    for i in ('0', '2', '4'):
+        x = relu(linear(x, emb[i]))
+    return x
+
+
+def postprocess(pca_eigen_vectors: jax.Array, pca_means: jax.Array,
+                embeddings: jax.Array,
+                quant_min: float = -2.0, quant_max: float = 2.0) -> jax.Array:
+    """AudioSet PCA-whiten + 8-bit quantization (vggish_slim.py:63-96)."""
+    x = (embeddings - pca_means.reshape(1, -1)) @ pca_eigen_vectors.T
+    x = jnp.clip(x, quant_min, quant_max)
+    return jnp.round((x - quant_min) * (255.0 / (quant_max - quant_min)))
+
+
+def init_state_dict(seed: int = 0) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict with torchvggish naming/shapes."""
+    rng = np.random.RandomState(seed)
+    sd: Dict[str, np.ndarray] = {}
+    in_ch = 1
+    for idx, out_ch in CONV_LAYERS:
+        sd[f'features.{idx}.weight'] = (
+            rng.randn(out_ch, in_ch, 3, 3).astype(np.float32) * 0.05)
+        sd[f'features.{idx}.bias'] = rng.randn(out_ch).astype(np.float32) * 0.05
+        in_ch = out_ch
+    dims = [(512 * 4 * 6, 4096), (4096, 4096), (4096, 128)]
+    for i, (fan_in, fan_out) in zip(('0', '2', '4'), dims):
+        sd[f'embeddings.{i}.weight'] = (
+            rng.randn(fan_out, fan_in).astype(np.float32) * 0.01)
+        sd[f'embeddings.{i}.bias'] = rng.randn(fan_out).astype(np.float32) * 0.01
+    return sd

@@ -1,0 +1,116 @@
+"""Audio DSP: waveform → log-mel examples for VGGish.
+
+Numerics re-implementation of the chain behind the reference's
+preprocessing (reference models/vggish/vggish_src/mel_features.py 223 LoC,
+vggish_input.py 89 LoC): strided framing with floor-truncated tails,
+periodic Hann window, magnitude rFFT at the next power of two, an HTK
+triangular mel filterbank with a zeroed DC bin, log with offset 0.01, and
+0.96 s non-overlapping 96×64 examples.
+
+This runs on the host (float64, exactly like the reference's numpy) — the
+DSP is microseconds per clip; the VGG net is the device work. One
+divergence: the reference resamples with ``resampy`` (Kaiser polyphase);
+here non-16 kHz input is resampled with scipy's polyphase resampler
+(`scipy.signal.resample_poly`) — same class of filter, not bit-identical.
+Feeding 16 kHz wavs (e.g. asking ffmpeg for ``-ar 16000``) avoids any
+resampling difference entirely.
+"""
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Optional
+
+import numpy as np
+
+SAMPLE_RATE = 16000
+STFT_WINDOW_SECS = 0.025
+STFT_HOP_SECS = 0.010
+NUM_MEL_BINS = 64
+MEL_MIN_HZ = 125.0
+MEL_MAX_HZ = 7500.0
+LOG_OFFSET = 0.01
+EXAMPLE_WINDOW_SECS = 0.96
+EXAMPLE_HOP_SECS = 0.96
+
+_MEL_BREAK_HZ = 700.0
+_MEL_HIGH_Q = 1127.0
+
+
+def frame(data: np.ndarray, window_length: int, hop_length: int) -> np.ndarray:
+    """(T, ...) → (num_frames, window_length, ...); incomplete tails dropped."""
+    num_frames = 1 + int(np.floor((data.shape[0] - window_length) / hop_length))
+    shape = (num_frames, window_length) + data.shape[1:]
+    strides = (data.strides[0] * hop_length,) + data.strides
+    return np.lib.stride_tricks.as_strided(data, shape=shape, strides=strides)
+
+
+def periodic_hann(window_length: int) -> np.ndarray:
+    """Full-cycle (period-N) raised cosine — NOT numpy's symmetric hanning."""
+    return 0.5 - 0.5 * np.cos(2 * np.pi / window_length
+                              * np.arange(window_length))
+
+
+def stft_magnitude(signal: np.ndarray, fft_length: int, hop_length: int,
+                   window_length: int) -> np.ndarray:
+    frames = frame(signal, window_length, hop_length)
+    return np.abs(np.fft.rfft(frames * periodic_hann(window_length),
+                              int(fft_length)))
+
+
+def hertz_to_mel(frequencies_hertz):
+    return _MEL_HIGH_Q * np.log(1.0 + np.asarray(frequencies_hertz)
+                                / _MEL_BREAK_HZ)
+
+
+def mel_matrix(num_mel_bins: int = NUM_MEL_BINS,
+               num_spectrogram_bins: int = 257,
+               audio_sample_rate: float = SAMPLE_RATE,
+               lower_edge_hertz: float = MEL_MIN_HZ,
+               upper_edge_hertz: float = MEL_MAX_HZ) -> np.ndarray:
+    """(num_spectrogram_bins, num_mel_bins) triangular HTK filterbank,
+    linear in mel space, DC bin zeroed."""
+    nyquist = audio_sample_rate / 2.0
+    if not 0.0 <= lower_edge_hertz < upper_edge_hertz <= nyquist:
+        raise ValueError('bad mel band edges')
+    spec_mel = hertz_to_mel(np.linspace(0.0, nyquist, num_spectrogram_bins))
+    edges = np.linspace(hertz_to_mel(lower_edge_hertz),
+                        hertz_to_mel(upper_edge_hertz), num_mel_bins + 2)
+    lower = (spec_mel[:, None] - edges[None, :-2]) / (edges[1:-1] - edges[:-2])
+    upper = (edges[None, 2:] - spec_mel[:, None]) / (edges[2:] - edges[1:-1])
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    weights[0, :] = 0.0
+    return weights
+
+
+def log_mel_spectrogram(data: np.ndarray,
+                        audio_sample_rate: float = SAMPLE_RATE) -> np.ndarray:
+    window_length = int(round(audio_sample_rate * STFT_WINDOW_SECS))
+    hop_length = int(round(audio_sample_rate * STFT_HOP_SECS))
+    fft_length = 2 ** int(np.ceil(np.log(window_length) / np.log(2.0)))
+    spec = stft_magnitude(data, fft_length, hop_length, window_length)
+    mel = spec @ mel_matrix(num_spectrogram_bins=spec.shape[1],
+                            audio_sample_rate=audio_sample_rate)
+    return np.log(mel + LOG_OFFSET)
+
+
+def resample(data: np.ndarray, sr: int, target_sr: int = SAMPLE_RATE) -> np.ndarray:
+    from scipy.signal import resample_poly
+    ratio = Fraction(target_sr, sr)
+    return resample_poly(data, ratio.numerator, ratio.denominator)
+
+
+def waveform_to_examples(data: np.ndarray, sample_rate: int,
+                         target_sr: Optional[int] = None) -> np.ndarray:
+    """Waveform → (num_examples, 96, 64) float32 log-mel patches
+    (reference vggish_input.py:26-74 semantics: mono-mean, resample to
+    16 kHz, 0.96 s non-overlapping windows, tails dropped)."""
+    if data.ndim > 1:
+        data = data.mean(axis=1)
+    target_sr = target_sr or SAMPLE_RATE
+    if sample_rate != target_sr:
+        data = resample(data, sample_rate, target_sr)
+    log_mel = log_mel_spectrogram(data, target_sr)
+    feats_rate = 1.0 / STFT_HOP_SECS
+    window = int(round(EXAMPLE_WINDOW_SECS * feats_rate))
+    hop = int(round(EXAMPLE_HOP_SECS * feats_rate))
+    return frame(log_mel, window, hop).astype(np.float32)

@@ -76,16 +76,20 @@ def flow_to_uint8_levels(x: Array, bound: float = 20.0) -> Array:
 
 
 def resize_pil(frame: np.ndarray, size: int,
-               to_smaller_edge: bool = True) -> np.ndarray:
-    """Host-side PIL bilinear edge resize, aspect preserved.
+               to_smaller_edge: bool = True,
+               interpolation: str = 'bilinear') -> np.ndarray:
+    """Host-side PIL edge resize, aspect preserved.
 
     Exact parity with the reference's PIL-based `ResizeImproved`
     (reference models/transforms.py:191-242): no-op when the matched edge
     already equals ``size``; the scaled side uses ``int(size * other/edge)``
-    (truncation, PIL convention).
+    (truncation, PIL convention). ``interpolation='bicubic'`` gives the
+    torchvision Resize(BICUBIC) used by CLIP (reference clip_src/clip.py
+    transform).
     """
     from PIL import Image
 
+    modes = {'bilinear': Image.BILINEAR, 'bicubic': Image.BICUBIC}
     h, w = frame.shape[:2]
     if (w <= h and w == size) or (h <= w and h == size):
         return frame
@@ -96,7 +100,7 @@ def resize_pil(frame: np.ndarray, size: int,
         oh = size
         ow = int(size * w / h)
     img = Image.fromarray(frame)
-    return np.asarray(img.resize((ow, oh), Image.BILINEAR))
+    return np.asarray(img.resize((ow, oh), modes[interpolation]))
 
 
 def short_side_resize_pil(frame: np.ndarray, size: int) -> np.ndarray:

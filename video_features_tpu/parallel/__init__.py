@@ -1,0 +1,17 @@
+"""Parallelism layer: device meshes, sharded pipelines, multi-host worklists.
+
+See SURVEY.md §2.3 for the accounting of what the reference does (shared-
+nothing multi-process data parallelism only) and what this layer adds
+(in-graph DP over stacks + sequence parallelism over temporal flow pairs,
+with XLA collectives over ICI).
+"""
+from video_features_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS, TIME_AXIS, batch_sharding, factor_mesh_shape, make_mesh,
+    pair_sharding, replicated,
+)
+from video_features_tpu.parallel.pipeline import (  # noqa: F401
+    build_sharded_two_stream_step, put_batch, put_replicated,
+)
+from video_features_tpu.parallel.worklist import (  # noqa: F401
+    shard_worklist, shuffled,
+)

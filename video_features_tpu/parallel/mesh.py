@@ -1,0 +1,76 @@
+"""Device-mesh construction for sharded extraction.
+
+The reference scales by launching N independent single-GPU processes over a
+shared filesystem (reference README.md:70-84, utils/utils.py:151-176 — the
+shuffled work list IS its distribution layer). The TPU-native design keeps
+that shared-nothing elasticity contract *across hosts* (see
+:mod:`.worklist`) and adds *in-graph* parallelism within a slice:
+
+  * ``data`` axis — data parallelism over stack windows / frame batches
+    (the reference's per-process parallelism, moved inside one XLA program);
+  * ``time`` axis — sequence parallelism over temporal flow pairs: a stack
+    of S+1 frames yields S independent RAFT pairs, and long videos yield
+    many stacks, so the temporal dimension shards cleanly with no halo
+    (SURVEY.md §5.7: temporal tiling is the long-context analog here).
+
+Collectives ride ICI inside the mesh; DCN/filesystem only carries the
+work-list and the output files.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = 'data'
+TIME_AXIS = 'time'
+
+
+def factor_mesh_shape(n: int, time_parallel: Optional[int] = None) -> Tuple[int, int]:
+    """Split ``n`` devices into (data, time) axis sizes.
+
+    Defaults to the largest power-of-two time axis ≤ 2 — flow pairs within a
+    stack are plentiful (stack_size ≥ 10), but data parallelism over stacks
+    has better arithmetic intensity per shard, so it gets the larger axis.
+    """
+    if time_parallel is None:
+        time_parallel = 2 if n % 2 == 0 and n > 1 else 1
+    if n % time_parallel != 0:
+        raise ValueError(f'{n} devices do not factor into time={time_parallel}')
+    return n // time_parallel, time_parallel
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              time_parallel: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 2-D (data, time) mesh over the available (or given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f'requested {n_devices} devices, have {len(devices)}')
+        devices = devices[:n_devices]
+    shape = factor_mesh_shape(len(devices), time_parallel)
+    grid = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(grid, (DATA_AXIS, TIME_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for params: one full copy per device (models are ≤100s MB —
+    SURVEY.md §2.3: tensor parallelism is not needed, replicate per chip)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding over the data axis (stack windows / frames)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def pair_sharding(mesh: Mesh) -> NamedSharding:
+    """(B·S, h, w, C) flow-pair sharding over BOTH axes — each device gets a
+    contiguous run of temporal pairs; no halo exchange is needed because
+    all-pairs correlation is local to a pair."""
+    return NamedSharding(mesh, P((DATA_AXIS, TIME_AXIS)))

@@ -1,0 +1,70 @@
+"""Sharded extraction pipeline: the fused two-stream step over a device mesh.
+
+Where the reference runs one python loop per GPU process (reference
+main.py:47-48) and scales by launching more processes, this module compiles
+ONE program over a (data, time) mesh:
+
+  * stack windows shard over ``data`` (in-graph data parallelism);
+  * RAFT flow pairs additionally spread over ``time`` (sequence parallelism
+    over the temporal axis — the pairs are independent, so XLA inserts only
+    the reshard collectives at the sub-graph boundary, and they ride ICI);
+  * params are replicated (SURVEY.md §2.3 — nets are small; TP buys nothing).
+
+Outputs land fully replicated so the host can write `.npy` files under the
+same idempotent-output contract the reference uses for elasticity.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+from jax.sharding import Mesh
+
+from video_features_tpu.extract.i3d import fused_two_stream_step
+from video_features_tpu.parallel.mesh import (
+    batch_sharding, pair_sharding, replicated,
+)
+
+
+def build_sharded_two_stream_step(mesh: Mesh,
+                                  streams: Tuple[str, ...] = ('rgb', 'flow'),
+                                  donate_stacks: bool = False):
+    """jit-compiled ``step(params, stacks, pads, crop_size=…)`` over ``mesh``.
+
+    ``stacks`` is (B, stack+1, H, W, 3) with B divisible by the data-axis
+    size; ``pads`` is the static (top, bottom, left, right) /8 padding tuple
+    from raft.pad_to_multiple. Returns {stream: (B, 1024)} replicated.
+
+    pjit rejects kwargs when in_shardings is given, so the static args are
+    positional here (argnums 2/3) and ``streams`` is baked per-build.
+    """
+    def constrain_pairs(t: jax.Array) -> jax.Array:
+        return jax.lax.with_sharding_constraint(t, pair_sharding(mesh))
+
+    def step(params, stacks, pads, crop_size):
+        return fused_two_stream_step(params, stacks, pads, streams,
+                                     constrain_pairs=constrain_pairs,
+                                     crop_size=crop_size)
+
+    jitted = jax.jit(
+        step,
+        static_argnums=(2, 3),
+        in_shardings=(replicated(mesh), batch_sharding(mesh)),
+        out_shardings=replicated(mesh),
+        donate_argnums=(1,) if donate_stacks else (),
+    )
+
+    def call(params, stacks, pads, crop_size=224):
+        return jitted(params, stacks, pads, crop_size)
+
+    return call
+
+
+def put_replicated(mesh: Mesh, params):
+    """Place a params pytree on every device of the mesh."""
+    return jax.device_put(params, replicated(mesh))
+
+
+def put_batch(mesh: Mesh, batch):
+    """Shard a host batch over the data axis of the mesh."""
+    return jax.device_put(batch, batch_sharding(mesh))

@@ -1,0 +1,48 @@
+"""Multi-host work distribution: the shared-nothing video-list contract.
+
+The reference distributes work by (a) shuffling the path list per process so
+concurrent workers rarely collide and (b) relying on idempotent output files
+plus is_already_exist re-checks to make collisions benign (reference
+utils/utils.py:151-176, models/_base/base_extractor.py:77-81,100-132).
+
+The TPU build keeps that contract — it is what makes workers elastic and
+restartable — but replaces the probabilistic shuffle with a deterministic
+interleaved shard per host, so N healthy hosts do zero duplicate work while
+a dead host's videos are still picked up by any worker re-run with the full
+list (the skip-if-exists check makes re-processing free).
+"""
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Sequence
+
+import jax
+
+
+def shard_worklist(paths: Sequence[str],
+                   shard_id: Optional[int] = None,
+                   num_shards: Optional[int] = None) -> List[str]:
+    """Deterministic interleaved shard of the video list for this host.
+
+    Defaults to jax's multi-host identity (process_index/process_count), so
+    the same launch command works on every host of a pod — the reference
+    needs a manually varied ``device=`` per terminal instead
+    (README.md:70-78).
+    """
+    if num_shards is None:
+        num_shards = jax.process_count()
+    if shard_id is None:
+        shard_id = jax.process_index()
+    if not 0 <= shard_id < num_shards:
+        raise ValueError(f'shard_id {shard_id} out of range [0, {num_shards})')
+    # Interleaved (round-robin) keeps per-shard work balanced even when the
+    # list is sorted by size/class, unlike contiguous block splits.
+    return list(paths[shard_id::num_shards])
+
+
+def shuffled(paths: Sequence[str], seed: Optional[int] = None) -> List[str]:
+    """Opt-in shuffle for heterogeneous-worker runs (the reference's default
+    collision-avoidance strategy, utils/utils.py:175-176)."""
+    out = list(paths)
+    random.Random(seed).shuffle(out)
+    return out

@@ -4,13 +4,32 @@ from __future__ import annotations
 import jax
 
 
+def pin_cpu_platform() -> None:
+    """Restrict jax to the CPU platform BEFORE backends initialize.
+
+    Without this, jax initializes every registered plugin on first device
+    access, and a remote-accelerator plugin (e.g. a TPU tunnel) can block a
+    pure-CPU run for minutes dialing hardware it will never use. A shell
+    ``JAX_PLATFORMS=cpu`` is not enough when a site hook pre-imports jax
+    with its own value — the runtime config is the authoritative knob.
+    No-op if backends are already up (the update then fails harmlessly).
+    """
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+
+
 def jax_device(device: str) -> jax.Device:
     """Map a resolved config device string ('cpu'/'tpu') to a jax.Device.
 
     Tests run with a TPU plugin still registered, so 'cpu' must explicitly
-    target the CPU backend rather than the default device.
+    target the CPU backend rather than the default device (and pin the
+    platform first — see :func:`pin_cpu_platform`).
     """
     platform = 'cpu' if str(device).lower() == 'cpu' else None
+    if platform == 'cpu':
+        pin_cpu_platform()
     if platform is None:
         platforms = {d.platform for d in jax.devices()}
         platform = next((p for p in platforms if p != 'cpu'), 'cpu')
