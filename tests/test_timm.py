@@ -1,0 +1,146 @@
+"""timm family: ViT parity vs a torch mirror of timm's VisionTransformer
+(qkv-fused pre-norm blocks, exact-erf GELU, eps=1e-6 LayerNorm, cls-token
+pooling — the math behind reference models/timm/extract_timm.py's
+`timm.create_model` + `reset_classifier(0)`), plus registry/E2E coverage."""
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+from video_features_tpu.config import load_config
+from video_features_tpu.models import vit as vit_model
+from video_features_tpu.registry import create_extractor
+from video_features_tpu.transplant.torch2jax import transplant
+
+
+class _Block(nn.Module):
+    def __init__(self, width, heads):
+        super().__init__()
+        self.heads = heads
+        self.norm1 = nn.LayerNorm(width, eps=1e-6)
+        self.attn = nn.Module()
+        self.attn.qkv = nn.Linear(width, 3 * width)
+        self.attn.proj = nn.Linear(width, width)
+        self.norm2 = nn.LayerNorm(width, eps=1e-6)
+        self.mlp = nn.Module()
+        self.mlp.fc1 = nn.Linear(width, 4 * width)
+        self.mlp.fc2 = nn.Linear(4 * width, width)
+
+    def forward(self, x):
+        B, N, D = x.shape
+        hd = D // self.heads
+        h = self.norm1(x)
+        qkv = self.attn.qkv(h).reshape(B, N, 3, self.heads, hd)
+        q, k, v = qkv.permute(2, 0, 3, 1, 4).unbind(0)
+        attn = (q @ k.transpose(-2, -1) * hd ** -0.5).softmax(dim=-1)
+        h = (attn @ v).transpose(1, 2).reshape(B, N, D)
+        x = x + self.attn.proj(h)
+        h = self.norm2(x)
+        return x + self.mlp.fc2(F.gelu(self.mlp.fc1(h)))
+
+
+class _TorchViT(nn.Module):
+    """State-dict-compatible mirror of timm VisionTransformer (features)."""
+
+    def __init__(self, width, layers, heads, patch, img=224):
+        super().__init__()
+        self.cls_token = nn.Parameter(torch.randn(1, 1, width) * 0.02)
+        self.pos_embed = nn.Parameter(
+            torch.randn(1, 1 + (img // patch) ** 2, width) * 0.02)
+        self.patch_embed = nn.Module()
+        self.patch_embed.proj = nn.Conv2d(3, width, patch, patch)
+        self.blocks = nn.ModuleList(_Block(width, heads) for _ in range(layers))
+        self.norm = nn.LayerNorm(width, eps=1e-6)
+        self.head = nn.Linear(width, 1000)
+
+    def forward(self, x, features=True):
+        B = x.shape[0]
+        x = self.patch_embed.proj(x).flatten(2).transpose(1, 2)
+        x = torch.cat([self.cls_token.expand(B, -1, -1), x], 1) + self.pos_embed
+        for blk in self.blocks:
+            x = blk(x)
+        feats = self.norm(x)[:, 0]
+        return feats if features else self.head(feats)
+
+
+@pytest.mark.parametrize('arch', ['vit_tiny_patch16_224'])
+def test_vit_parity_vs_torch_mirror(arch):
+    cfg = vit_model.ARCHS[arch]
+    torch.manual_seed(0)
+    ref_model = _TorchViT(cfg['width'], cfg['layers'], cfg['heads'],
+                          cfg['patch']).eval()
+    params = transplant(ref_model.state_dict())
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        ref = ref_model(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+        ref_logits = ref_model(
+            torch.from_numpy(x).permute(0, 3, 1, 2), features=False).numpy()
+
+    import jax
+    with jax.default_matmul_precision('highest'):
+        ours = np.asarray(vit_model.forward(params, x, arch=arch))
+        ours_logits = np.asarray(
+            vit_model.forward(params, x, arch=arch, features=False))
+
+    assert ours.shape == ref.shape == (2, cfg['width'])
+    l2 = np.linalg.norm(ours - ref) / max(np.linalg.norm(ref), 1e-12)
+    assert l2 < 1e-3, f'relative L2 {l2}'
+    l2 = np.linalg.norm(ours_logits - ref_logits) / \
+        max(np.linalg.norm(ref_logits), 1e-12)
+    assert l2 < 1e-3, f'head relative L2 {l2}'
+
+
+def test_state_dict_shapes_roundtrip():
+    """init_state_dict must transplant into shapes forward() accepts."""
+    sd = vit_model.init_state_dict(arch='vit_tiny_patch16_224')
+    params = transplant(sd)
+    assert params['patch_embed']['proj']['weight'].shape == (16, 16, 3, 192)
+    assert params['blocks']['0']['attn']['qkv']['weight'].shape == (192, 576)
+    x = np.zeros((1, 224, 224, 3), np.float32)
+    out = np.asarray(vit_model.forward(params, x, 'vit_tiny_patch16_224'))
+    assert out.shape == (1, 192)
+
+
+def test_registry_resolution():
+    from video_features_tpu.extract.timm import REGISTRY
+    assert 'vit_base_patch16_224' in REGISTRY
+    assert 'resnet50' in REGISTRY
+    assert REGISTRY['resnet50']['family'] == 'resnet'
+
+
+@pytest.mark.parametrize('model_name,family', [
+    ('vit_tiny_patch16_224', 'vit'),
+    ('hf_hub:timm/vit_tiny_patch16_224.augreg_in21k', 'vit'),
+    ('resnet18', 'resnet'),
+])
+def test_e2e_extraction(short_video, tmp_path, model_name, family):
+    args = load_config('timm', overrides={
+        'model_name': model_name,
+        'video_paths': short_video,
+        'device': 'cpu',
+        'batch_size': 16,
+        'output_path': str(tmp_path / 'out'),
+        'tmp_path': str(tmp_path / 'tmp'),
+    })
+    ex = create_extractor(args)
+    assert ex.family == family
+    out = ex.extract(short_video)
+    T, D = out['timm'].shape
+    assert T == 48 and D == ex.feat_dim
+    assert np.isfinite(out['timm']).all()
+    assert out['timestamps_ms'].shape == (T,)
+
+
+def test_unknown_model_rejected(tmp_path):
+    args = load_config('timm', overrides={
+        'model_name': 'efficientnet_b0',
+        'video_paths': '/dev/null',
+        'device': 'cpu',
+        'output_path': str(tmp_path / 'out'),
+        'tmp_path': str(tmp_path / 'tmp'),
+    })
+    with pytest.raises(NotImplementedError):
+        create_extractor(args)

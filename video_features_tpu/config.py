@@ -178,7 +178,7 @@ def sanity_check(args: Config) -> None:
         raise NotImplementedError('PWC flow is not supported; use flow_type=raft')
     if ft == 'timm':
         assert args.get('model_name') is not None, \
-            'Please specify `model_name` for timm-style models; e.g. `efficientnet_b0`'
+            'Please specify `model_name` for timm-style models; e.g. `vit_base_patch16_224`'
     if 'batch_size' in args:
         assert args['batch_size'] is not None, \
             f'Please specify `batch_size`. It is {args["batch_size"]} now'

@@ -21,7 +21,7 @@ import numpy as np
 from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
 from video_features_tpu.models import clip as clip_model
 from video_features_tpu.ops.transforms import (
-    normalize, resize_pil, to_float_zero_one,
+    center_crop_host, normalize, resize_pil, to_float_zero_one,
 )
 from video_features_tpu.utils.device import jax_device
 
@@ -30,14 +30,15 @@ class ExtractCLIP(BaseFrameWiseExtractor):
 
     def __init__(self, args) -> None:
         self.model_name = args.model_name
+        if (self.model_name != 'custom'
+                and self.model_name not in clip_model.VISUAL_CFGS):
+            raise NotImplementedError(
+                f'model_name {self.model_name!r}; known: '
+                f'{", ".join(clip_model.VISUAL_CFGS)} or "custom"')
         state_dict = self._load_state_dict(args)
         if self.model_name == 'custom':
             self.arch = clip_model.infer_model_name(state_dict)
         else:
-            if self.model_name not in clip_model.VISUAL_CFGS:
-                raise NotImplementedError(
-                    f'model_name {self.model_name!r}; known: '
-                    f'{", ".join(clip_model.VISUAL_CFGS)} or "custom"')
             self.arch = self.model_name
         cfg = clip_model.VISUAL_CFGS[self.arch]
         super().__init__(args, feat_dim=cfg['embed_dim'])
@@ -79,10 +80,7 @@ class ExtractCLIP(BaseFrameWiseExtractor):
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
         n_px = self.input_resolution
         frame = resize_pil(frame, n_px, interpolation='bicubic')
-        h, w = frame.shape[:2]
-        i = int(round((h - n_px) / 2.0))
-        j = int(round((w - n_px) / 2.0))
-        return frame[i:i + n_px, j:j + n_px]
+        return center_crop_host(frame, n_px)
 
     def device_step(self, batch: np.ndarray) -> jax.Array:
         return self._step(self.params, batch)
@@ -90,8 +88,9 @@ class ExtractCLIP(BaseFrameWiseExtractor):
     # -- zero-shot show_pred -------------------------------------------------
 
     def _get_text_feats(self):
-        if self._text_feats is not None:
+        if getattr(self, '_text_feats_resolved', False):
             return self._text_feats, self._classes
+        self._text_feats_resolved = True
         from video_features_tpu.utils.clip_tokenizer import tokenize
         from video_features_tpu.utils.preds import load_label_map
         if self.pred_texts is not None:

@@ -15,7 +15,7 @@ import numpy as np
 from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
 from video_features_tpu.models import resnet as resnet_model
 from video_features_tpu.ops.transforms import (
-    normalize, short_side_resize_pil, to_float_zero_one,
+    center_crop_host, normalize, short_side_resize_pil, to_float_zero_one,
 )
 from video_features_tpu.utils.device import jax_device
 
@@ -49,10 +49,7 @@ class ExtractResNet(BaseFrameWiseExtractor):
 
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
         frame = short_side_resize_pil(frame, RESIZE_SIZE)
-        h, w = frame.shape[:2]
-        i = int(round((h - CROP_SIZE) / 2.0))
-        j = int(round((w - CROP_SIZE) / 2.0))
-        return frame[i:i + CROP_SIZE, j:j + CROP_SIZE]
+        return center_crop_host(frame, CROP_SIZE)
 
     def device_step(self, batch: np.ndarray) -> jax.Array:
         return self._step(self.params, batch)

@@ -1,0 +1,130 @@
+"""timm-style pluggable image-backbone extractor (reference models/timm/).
+
+The reference creates any pip-timm model, resolves its data config, and
+strips the classifier (reference models/timm/extract_timm.py:48-60). Here
+the backbone registry is native-JAX — the ViT family (models/vit.py) and the
+ResNet family (models/resnet.py) cover the curated model space — and a real
+``timm`` install (optional) extends it: if timm is importable and
+``pretrained=true``, the torch model's state_dict and resolved data config
+are transplanted mechanically.
+
+Output parity: {feature_type: (T, D), 'fps', 'timestamps_ms'} and
+``show_pred`` top-5 against the ImageNet-1k label map when a classifier head
+exists (reference extract_timm.py:63-91 infers the dataset from the hf tag;
+our native registry is in1k-headed).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
+from video_features_tpu.models import resnet as resnet_model
+from video_features_tpu.models import vit as vit_model
+from video_features_tpu.ops.transforms import (
+    center_crop_host, normalize, resize_pil, to_float_zero_one,
+)
+from video_features_tpu.utils.device import jax_device
+
+
+def _data_cfg(family: str) -> Dict[str, Any]:
+    """timm resolve_data_config equivalents for the native families:
+    resize = floor(input_size / crop_pct), family-default interpolation."""
+    if family == 'vit':
+        return dict(resize=248, crop=224, interpolation='bicubic',
+                    mean=vit_model.MEAN, std=vit_model.STD)
+    return dict(resize=256, crop=224, interpolation='bilinear',
+                mean=resnet_model.MEAN, std=resnet_model.STD)
+
+
+def _registry() -> Dict[str, Dict[str, Any]]:
+    reg = {}
+    for name, cfg in vit_model.ARCHS.items():
+        reg[name] = dict(family='vit', arch=name, feat_dim=cfg['width'])
+    for name, cfg in resnet_model.ARCHS.items():
+        reg[name] = dict(family='resnet', arch=name, feat_dim=cfg['feat_dim'])
+    return reg
+
+
+REGISTRY = _registry()
+
+
+class ExtractTIMM(BaseFrameWiseExtractor):
+
+    def __init__(self, args) -> None:
+        self.model_name = args.model_name
+        # hf-hub ids (reference tests/timm/test_timm.py:24) resolve by tail:
+        # 'hf_hub:timm/vit_base_patch16_224.augreg_in21k' → vit_base_patch16_224
+        name = self.model_name.split(':')[-1].split('/')[-1].split('.')[0]
+        if name not in REGISTRY:
+            raise NotImplementedError(
+                f'model_name {self.model_name!r} is not in the native '
+                f'backbone registry: {", ".join(sorted(REGISTRY))}. '
+                f'(With pip timm installed, timm checkpoints for these '
+                f'architectures transplant via checkpoint_path.)')
+        spec = REGISTRY[name]
+        self.family, self.arch = spec['family'], spec['arch']
+        super().__init__(args, feat_dim=spec['feat_dim'])
+        self.data_cfg = _data_cfg(self.family)
+        self._device = jax_device(self.device)
+        self.params = jax.device_put(self._load_params(args), self._device)
+        self._step = jax.jit(partial(
+            self._forward, family=self.family, arch=self.arch,
+            mean=self.data_cfg['mean'], std=self.data_cfg['std']))
+
+    def _load_params(self, args):
+        from video_features_tpu.transplant.torch2jax import (
+            load_torch_checkpoint, transplant,
+        )
+        ckpt = args.get('checkpoint_path')
+        if ckpt:
+            return load_torch_checkpoint(ckpt)
+        try:  # optional pip timm: pull pretrained weights + data config
+            import timm
+        except ImportError:
+            timm = None
+        if timm is not None:
+            # failures past the import (missing checkpoint dep, bad hf id)
+            # must propagate — silently falling back to random weights would
+            # masquerade as a successful pretrained load
+            model = timm.create_model(self.model_name, pretrained=True)
+            data = timm.data.resolve_data_config({}, model=model)
+            self.data_cfg.update(
+                resize=data['input_size'][-1] if data.get('crop_pct') is None
+                else int(data['input_size'][-1] / data['crop_pct']),
+                crop=data['input_size'][-1],
+                interpolation=data.get('interpolation', 'bilinear'),
+                mean=tuple(data['mean']), std=tuple(data['std']))
+            return transplant(model.state_dict())
+        init = (vit_model if self.family == 'vit' else resnet_model)
+        return transplant(init.init_state_dict(arch=self.arch))
+
+    @staticmethod
+    def _forward(params, batch, family, arch, mean, std):
+        x = to_float_zero_one(batch)
+        x = normalize(x, mean, std)
+        if family == 'vit':
+            return vit_model.forward(params, x, arch=arch, features=True)
+        return resnet_model.forward(params, x, arch=arch, features=True)
+
+    def host_transform(self, frame: np.ndarray) -> np.ndarray:
+        frame = resize_pil(frame, self.data_cfg['resize'],
+                           interpolation=self.data_cfg['interpolation'])
+        return center_crop_host(frame, self.data_cfg['crop'])
+
+    def device_step(self, batch: np.ndarray) -> jax.Array:
+        return self._step(self.params, batch)
+
+    def maybe_show_pred(self, feats: np.ndarray) -> None:
+        head = self.params.get('head') if self.family == 'vit' else \
+            self.params.get('fc')
+        if not head:
+            return
+        import jax.numpy as jnp
+        from video_features_tpu.ops.nn import linear
+        from video_features_tpu.utils.preds import show_predictions_on_dataset
+        logits = np.asarray(linear(jnp.asarray(feats), head))
+        show_predictions_on_dataset(logits, 'imagenet1k')

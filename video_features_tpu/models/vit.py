@@ -1,0 +1,137 @@
+"""Vision Transformer image backbones (timm `vit_*` state_dict layout).
+
+The reference's timm extractor accepts any pip-timm model
+(reference models/timm/extract_timm.py:48 `timm.create_model`). timm is an
+optional dependency here; this module natively implements the ViT family —
+the workhorse of that model space — against the exact timm
+``VisionTransformer`` state_dict naming (``cls_token``, ``pos_embed``,
+``patch_embed.proj``, ``blocks.N.{norm1,attn.qkv,attn.proj,norm2,mlp}``,
+``norm``) so real timm checkpoints transplant mechanically, and parity can
+be tested against a torch mirror without timm installed.
+
+Feature semantics match `reset_classifier(0)` + `forward(x)`
+(reference models/timm/extract_timm.py:59-60): class-token pooling after the
+final norm, no head.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+# timm default_cfg constants for the supported family: inputs are 224px,
+# bicubic, crop_pct 0.9 → resize short side 248; "inception" 0.5 mean/std.
+MEAN = (0.5, 0.5, 0.5)
+STD = (0.5, 0.5, 0.5)
+
+ARCHS = {
+    'vit_tiny_patch16_224': dict(width=192, layers=12, heads=3, patch=16),
+    'vit_small_patch16_224': dict(width=384, layers=12, heads=6, patch=16),
+    'vit_small_patch32_224': dict(width=384, layers=12, heads=6, patch=32),
+    'vit_base_patch16_224': dict(width=768, layers=12, heads=12, patch=16),
+    'vit_base_patch32_224': dict(width=768, layers=12, heads=12, patch=32),
+    'vit_large_patch16_224': dict(width=1024, layers=24, heads=16, patch=16),
+}
+INPUT_RESOLUTION = 224
+
+
+def layer_norm(x: jax.Array, p: Params, eps: float = 1e-6) -> jax.Array:
+    mean = x.mean(axis=-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * p['weight'] + p['bias']
+
+
+def _attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
+    """timm `Attention`: fused qkv linear, per-head scaled dot product."""
+    B, N, D = x.shape
+    head_dim = D // num_heads
+    qkv = x @ p['qkv']['weight'] + p['qkv']['bias']          # (B, N, 3D)
+    qkv = qkv.reshape(B, N, 3, num_heads, head_dim)
+    q, k, v = jnp.moveaxis(qkv, 2, 0)                        # (B, N, H, hd)
+    q = jnp.moveaxis(q, 1, 2)                                # (B, H, N, hd)
+    k = jnp.moveaxis(k, 1, 2)
+    v = jnp.moveaxis(v, 1, 2)
+    attn = jax.nn.softmax(q @ jnp.swapaxes(k, -1, -2) / jnp.sqrt(head_dim),
+                          axis=-1)
+    out = jnp.moveaxis(attn @ v, 1, 2).reshape(B, N, D)
+    return out @ p['proj']['weight'] + p['proj']['bias']
+
+
+def _block(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
+    """Pre-norm transformer block with exact-erf GELU (torch nn.GELU)."""
+    x = x + _attention(p['attn'], layer_norm(x, p['norm1']), num_heads)
+    h = layer_norm(x, p['norm2'])
+    h = h @ p['mlp']['fc1']['weight'] + p['mlp']['fc1']['bias']
+    h = jax.nn.gelu(h, approximate=False)
+    h = h @ p['mlp']['fc2']['weight'] + p['mlp']['fc2']['bias']
+    return x + h
+
+
+def forward(params: Params, x: jax.Array, arch: str = 'vit_base_patch16_224',
+            features: bool = True) -> jax.Array:
+    """(B, H, W, 3) float in model space → (B, width) cls-token features.
+
+    With ``features=False`` and a transplanted ``head``, returns (B, 1000)
+    logits (the reference's show_pred path, extract_timm.py:63-91).
+    """
+    cfg = ARCHS[arch]
+    width, num_heads, patch = cfg['width'], cfg['heads'], cfg['patch']
+    B = x.shape[0]
+    # patch embed: conv stride=patch, then row-major flatten (timm flattens
+    # NCHW as (B, D, H', W') → (B, H'·W', D); NHWC flatten matches directly)
+    k = params['patch_embed']['proj']
+    x = jax.lax.conv_general_dilated(
+        x, k['weight'], window_strides=(patch, patch), padding='VALID',
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC')) + k['bias']
+    x = x.reshape(B, -1, width)
+    cls = jnp.broadcast_to(params['cls_token'], (B, 1, width))
+    x = jnp.concatenate([cls, x], axis=1) + params['pos_embed']
+    for i in range(cfg['layers']):
+        x = _block(params['blocks'][str(i)], x, num_heads)
+    x = layer_norm(x, params['norm'])
+    feats = x[:, 0]
+    if features:
+        return feats
+    return feats @ params['head']['weight'] + params['head']['bias']
+
+
+def init_state_dict(seed: int = 0, arch: str = 'vit_base_patch16_224',
+                    num_classes: int = 1000) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict (keys/shapes as timm saves them)."""
+    cfg = ARCHS[arch]
+    width, patch, layers = cfg['width'], cfg['patch'], cfg['layers']
+    n_tokens = 1 + (INPUT_RESOLUTION // patch) ** 2
+    rng = np.random.RandomState(seed)
+
+    def f32(*shape, scale=0.02):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    sd = {
+        'cls_token': f32(1, 1, width),
+        'pos_embed': f32(1, n_tokens, width),
+        'patch_embed.proj.weight': f32(width, 3, patch, patch),
+        'patch_embed.proj.bias': f32(width),
+        'norm.weight': np.ones(width, np.float32),
+        'norm.bias': np.zeros(width, np.float32),
+        'head.weight': f32(num_classes, width),
+        'head.bias': np.zeros(num_classes, np.float32),
+    }
+    for i in range(layers):
+        b = f'blocks.{i}.'
+        sd[b + 'norm1.weight'] = np.ones(width, np.float32)
+        sd[b + 'norm1.bias'] = np.zeros(width, np.float32)
+        sd[b + 'attn.qkv.weight'] = f32(3 * width, width)
+        sd[b + 'attn.qkv.bias'] = np.zeros(3 * width, np.float32)
+        sd[b + 'attn.proj.weight'] = f32(width, width)
+        sd[b + 'attn.proj.bias'] = np.zeros(width, np.float32)
+        sd[b + 'norm2.weight'] = np.ones(width, np.float32)
+        sd[b + 'norm2.bias'] = np.zeros(width, np.float32)
+        sd[b + 'mlp.fc1.weight'] = f32(4 * width, width)
+        sd[b + 'mlp.fc1.bias'] = np.zeros(4 * width, np.float32)
+        sd[b + 'mlp.fc2.weight'] = f32(width, 4 * width)
+        sd[b + 'mlp.fc2.bias'] = np.zeros(width, np.float32)
+    return sd

@@ -106,3 +106,12 @@ def resize_pil(frame: np.ndarray, size: int,
 def short_side_resize_pil(frame: np.ndarray, size: int) -> np.ndarray:
     """min(H, W) → ``size`` via PIL bilinear (see :func:`resize_pil`)."""
     return resize_pil(frame, size, to_smaller_edge=True)
+
+
+def center_crop_host(frame: np.ndarray, size: int) -> np.ndarray:
+    """Host-side HWC center crop with torchvision's round-to-even offsets
+    (the reference's CenterCrop behavior across all frame-wise extractors)."""
+    h, w = frame.shape[:2]
+    i = int(round((h - size) / 2.0))
+    j = int(round((w - size) / 2.0))
+    return frame[i:i + size, j:j + size]
