@@ -1,0 +1,177 @@
+// vfdecode — native video decode service for video_features_tpu.
+//
+// TPU-native replacement for the reference's native decode path (the
+// reference shells out to ffmpeg binaries and decodes through OpenCV's
+// VideoCapture — reference utils/io.py:96-154, utils/utils.py:181-226).
+// Here the FFmpeg C libraries (libavformat/libavcodec/libswscale) feed
+// host-side RGB24 buffers directly: frames land in caller-provided numpy
+// memory in batches, ready for one host→HBM transfer, with no per-frame
+// Python or subprocess overhead.
+//
+// C ABI (consumed via ctypes from video_features_tpu/io/native.py):
+//   vf_open(path)                  -> opaque handle (NULL on failure)
+//   vf_props(h, &fps,&n,&w,&h)     -> stream properties (n may be estimated)
+//   vf_read(h, out, max_frames)    -> decode ≤max_frames RGB24 frames into
+//                                     out (HWC, w*h*3 bytes each); returns
+//                                     #frames, 0 at EOF, <0 on error
+//   vf_close(h)
+//   vf_last_error()                -> static string for the last vf_open error
+
+extern "C" {
+#include <libavcodec/avcodec.h>
+#include <libavformat/avformat.h>
+#include <libavutil/imgutils.h>
+#include <libswscale/swscale.h>
+}
+
+#include <cstring>
+#include <string>
+
+namespace {
+thread_local std::string g_last_error;
+
+struct Decoder {
+  AVFormatContext* fmt = nullptr;
+  AVCodecContext* codec = nullptr;
+  SwsContext* sws = nullptr;
+  AVPacket* pkt = nullptr;
+  AVFrame* frame = nullptr;
+  int stream_index = -1;
+  int width = 0;
+  int height = 0;
+  double fps = 0.0;
+  long num_frames = 0;
+  bool draining = false;
+  bool done = false;
+};
+
+void destroy(Decoder* d) {
+  if (!d) return;
+  if (d->sws) sws_freeContext(d->sws);
+  if (d->frame) av_frame_free(&d->frame);
+  if (d->pkt) av_packet_free(&d->pkt);
+  if (d->codec) avcodec_free_context(&d->codec);
+  if (d->fmt) avformat_close_input(&d->fmt);
+  delete d;
+}
+
+bool fail(const std::string& msg) {
+  g_last_error = msg;
+  return false;
+}
+
+bool open_impl(Decoder* d, const char* path) {
+  if (avformat_open_input(&d->fmt, path, nullptr, nullptr) < 0)
+    return fail(std::string("cannot open ") + path);
+  if (avformat_find_stream_info(d->fmt, nullptr) < 0)
+    return fail("no stream info");
+  const AVCodec* dec = nullptr;
+  d->stream_index =
+      av_find_best_stream(d->fmt, AVMEDIA_TYPE_VIDEO, -1, -1, &dec, 0);
+  if (d->stream_index < 0 || !dec) return fail("no video stream");
+  AVStream* st = d->fmt->streams[d->stream_index];
+
+  d->codec = avcodec_alloc_context3(dec);
+  if (!d->codec ||
+      avcodec_parameters_to_context(d->codec, st->codecpar) < 0)
+    return fail("codec context setup failed");
+  d->codec->thread_count = 0;  // auto
+  if (avcodec_open2(d->codec, dec, nullptr) < 0)
+    return fail("cannot open codec");
+
+  d->width = d->codec->width;
+  d->height = d->codec->height;
+  AVRational r = st->avg_frame_rate.num ? st->avg_frame_rate : st->r_frame_rate;
+  d->fps = r.den ? av_q2d(r) : 0.0;
+  d->num_frames = st->nb_frames;
+  if (d->num_frames <= 0 && d->fmt->duration > 0 && d->fps > 0)
+    d->num_frames =
+        (long)(d->fmt->duration / (double)AV_TIME_BASE * d->fps + 0.5);
+
+  d->pkt = av_packet_alloc();
+  d->frame = av_frame_alloc();
+  if (!d->pkt || !d->frame) return fail("alloc failed");
+  return true;
+}
+
+// Lazily (re)build the RGB24 converter — pixel format can change mid-stream.
+bool ensure_sws(Decoder* d, AVPixelFormat src_fmt) {
+  d->sws = sws_getCachedContext(d->sws, d->width, d->height, src_fmt,
+                                d->width, d->height, AV_PIX_FMT_RGB24,
+                                SWS_BILINEAR, nullptr, nullptr, nullptr);
+  return d->sws != nullptr;
+}
+
+void emit_rgb(Decoder* d, unsigned char* out) {
+  uint8_t* dst[1] = {out};
+  int dst_linesize[1] = {3 * d->width};
+  sws_scale(d->sws, d->frame->data, d->frame->linesize, 0, d->height, dst,
+            dst_linesize);
+}
+}  // namespace
+
+extern "C" {
+
+void* vf_open(const char* path) {
+  Decoder* d = new Decoder();
+  if (!open_impl(d, path)) {
+    destroy(d);
+    return nullptr;
+  }
+  return d;
+}
+
+const char* vf_last_error() { return g_last_error.c_str(); }
+
+void vf_props(void* handle, double* fps, long* num_frames, int* width,
+              int* height) {
+  Decoder* d = (Decoder*)handle;
+  if (fps) *fps = d->fps;
+  if (num_frames) *num_frames = d->num_frames;
+  if (width) *width = d->width;
+  if (height) *height = d->height;
+}
+
+long vf_read(void* handle, unsigned char* out, long max_frames) {
+  Decoder* d = (Decoder*)handle;
+  if (d->done || max_frames <= 0) return 0;
+  const long frame_bytes = 3L * d->width * d->height;
+  long produced = 0;
+
+  while (produced < max_frames) {
+    int ret = avcodec_receive_frame(d->codec, d->frame);
+    if (ret == 0) {
+      // A mid-stream resolution change would make sws_scale read past the
+      // frame's planes (and the caller's buffer geometry stale): hard error.
+      if (d->frame->width != d->width || d->frame->height != d->height)
+        return -3;
+      if (!ensure_sws(d, (AVPixelFormat)d->frame->format)) return -1;
+      emit_rgb(d, out + produced * frame_bytes);
+      av_frame_unref(d->frame);
+      ++produced;
+      continue;
+    }
+    if (ret == AVERROR_EOF) {
+      d->done = true;
+      break;
+    }
+    if (ret != AVERROR(EAGAIN)) return -2;
+
+    // decoder wants input
+    if (d->draining) continue;
+    ret = av_read_frame(d->fmt, d->pkt);
+    if (ret < 0) {
+      avcodec_send_packet(d->codec, nullptr);  // start flush
+      d->draining = true;
+      continue;
+    }
+    if (d->pkt->stream_index == d->stream_index)
+      avcodec_send_packet(d->codec, d->pkt);
+    av_packet_unref(d->pkt);
+  }
+  return produced;
+}
+
+void vf_close(void* handle) { destroy((Decoder*)handle); }
+
+}  // extern "C"

@@ -1,0 +1,93 @@
+"""Native C++ decode service: frame parity vs cv2, props, VideoLoader
+backend integration, and the prefetch pipelining wrapper."""
+import numpy as np
+import pytest
+
+from video_features_tpu.io import native
+from video_features_tpu.io.video import Cv2FrameDecoder, VideoLoader, prefetch
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason='libvfdecode.so unavailable')
+
+
+@needs_native
+def test_frame_parity_vs_cv2(sample_video_2):
+    nat = list(native.NativeFrameDecoder(sample_video_2))
+    cv = list(Cv2FrameDecoder(sample_video_2))
+    assert len(nat) == len(cv) > 0
+    for (i, a), (j, b) in zip(nat[:64], cv[:64]):
+        assert i == j
+        np.testing.assert_array_equal(a, b)
+
+
+@needs_native
+def test_props(sample_video_2):
+    import cv2
+    dec = native.NativeFrameDecoder(sample_video_2).open()
+    cap = cv2.VideoCapture(sample_video_2)
+    assert dec.fps == pytest.approx(cap.get(cv2.CAP_PROP_FPS), rel=1e-3)
+    assert dec.width == int(cap.get(cv2.CAP_PROP_FRAME_WIDTH))
+    assert dec.height == int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
+    assert dec.num_frames == int(cap.get(cv2.CAP_PROP_FRAME_COUNT))
+    cap.release()
+    dec.release()
+
+
+@needs_native
+def test_open_error():
+    with pytest.raises(IOError):
+        native.NativeFrameDecoder('/nonexistent/clip.mp4').open()
+
+
+@needs_native
+def test_videoloader_backend_equivalence(short_video):
+    def batches(backend):
+        loader = VideoLoader(short_video, batch_size=16, overlap=1,
+                             backend=backend)
+        return [(b, t, i) for b, t, i in loader]
+
+    nat, cv = batches('native'), batches('cv2')
+    assert len(nat) == len(cv)
+    for (nb, nt, ni), (cb, ct, ci) in zip(nat, cv):
+        np.testing.assert_array_equal(nb, cb)
+        assert nt == ct and ni == ci
+
+
+@needs_native
+def test_videoloader_native_with_fps_resample(short_video):
+    """Index-map fps retiming must work over the native decoder too."""
+    loader = VideoLoader(short_video, batch_size=8, fps=10,
+                         use_ffmpeg=False, backend='native')
+    frames = [f for b, _, _ in loader for f in b]
+    ref = VideoLoader(short_video, batch_size=8, fps=10,
+                      use_ffmpeg=False, backend='cv2')
+    ref_frames = [f for b, _, _ in ref for f in b]
+    assert len(frames) == len(ref_frames) > 0
+    np.testing.assert_array_equal(np.stack(frames), np.stack(ref_frames))
+
+
+def test_prefetch_order_and_completeness():
+    items = list(range(100))
+    assert list(prefetch(iter(items), depth=3)) == items
+
+
+def test_prefetch_propagates_exception():
+    def gen():
+        yield 1
+        raise ValueError('decode failed')
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match='decode failed'):
+        list(it)
+
+
+def test_prefetch_early_close():
+    """Abandoning the consumer must not deadlock the producer thread."""
+    def gen():
+        for i in range(10_000):
+            yield i
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 0
+    it.close()

@@ -17,7 +17,7 @@ import jax
 import numpy as np
 
 from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.io.video import VideoLoader
+from video_features_tpu.io.video import VideoLoader, prefetch
 
 
 class BaseFrameWiseExtractor(BaseExtractor):
@@ -62,7 +62,8 @@ class BaseFrameWiseExtractor(BaseExtractor):
         )
         feats, timestamps = [], []
         with jax.default_matmul_precision('highest'):
-            for batch, times, _ in loader:
+            # decode thread fills batch k+1 while the device runs batch k
+            for batch, times, _ in prefetch(loader, depth=2):
                 batch = np.stack(batch)
                 valid = batch.shape[0]
                 if valid < self.batch_size:  # pad tail to the compiled shape

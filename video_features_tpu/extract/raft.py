@@ -26,7 +26,7 @@ import jax
 import numpy as np
 
 from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.io.video import VideoLoader
+from video_features_tpu.io.video import VideoLoader, prefetch
 from video_features_tpu.models import raft as raft_model
 from video_features_tpu.ops.transforms import resize_pil
 from video_features_tpu.utils.device import jax_device
@@ -93,7 +93,7 @@ class ExtractRAFT(BaseExtractor):
         flows, timestamps = [], []
         first = True
         with jax.default_matmul_precision('highest'):
-            for batch, times, _ in loader:
+            for batch, times, _ in prefetch(loader, depth=2):
                 batch = np.stack(batch)                      # (n, H, W, 3)
                 timestamps.extend(times if first else times[1:])
                 first = False

@@ -82,9 +82,12 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         ckpt = args.get('checkpoint_path')
         if ckpt:
             return load_torch_checkpoint(ckpt)
-        try:  # optional pip timm: pull pretrained weights + data config
-            import timm
-        except ImportError:
+        if args.get('pretrained', True):  # opt-out for offline runs
+            try:  # optional pip timm: pull pretrained weights + data config
+                import timm
+            except ImportError:
+                timm = None
+        else:
             timm = None
         if timm is not None:
             # failures past the import (missing checkpoint dep, bad hf id)

@@ -1,0 +1,155 @@
+"""ctypes binding for the native C++ decode service (native/vfdecode.cc).
+
+The reference's decode path crosses a process boundary per re-encode and a
+Python call per frame (reference utils/io.py:96-154 via cv2, utils/
+utils.py:181-226 via ffmpeg subprocesses). The native service decodes
+through the FFmpeg C libraries directly into preallocated numpy chunks —
+one C call per ``CHUNK`` frames — and is the default ``VideoLoader``
+backend when buildable; cv2 remains the fallback.
+
+The shared library is compiled on first use (g++ + pkg-config, cached next
+to the source); environments without a toolchain or libav dev packages
+transparently fall back.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+NATIVE_DIR = Path(__file__).resolve().parents[2] / 'native'
+LIB_PATH = NATIVE_DIR / 'libvfdecode.so'
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+# frames decoded per C call: amortizes FFI overhead, bounds memory
+# (CHUNK × H × W × 3 bytes; 32 × 1080p ≈ 200 MB worst case, typical ≪)
+CHUNK = 32
+
+
+def _build() -> bool:
+    try:
+        proc = subprocess.run(['make', '-C', str(NATIVE_DIR)],
+                              capture_output=True, timeout=120)
+        return proc.returncode == 0 and LIB_PATH.exists()
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The bound library, building it if needed; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not LIB_PATH.exists() and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(str(LIB_PATH))
+        except OSError:
+            _build_failed = True
+            return None
+        lib.vf_open.restype = ctypes.c_void_p
+        lib.vf_open.argtypes = [ctypes.c_char_p]
+        lib.vf_last_error.restype = ctypes.c_char_p
+        lib.vf_props.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.vf_read.restype = ctypes.c_long
+        lib.vf_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_long]
+        lib.vf_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+class NativeFrameDecoder:
+    """Sequential RGB frame decoder over the C++ service.
+
+    Same protocol as io.video.Cv2FrameDecoder: iterating yields
+    ``(source_index, frame HWC uint8 RGB)``. Frames are decoded in CHUNK-
+    sized batches into a fresh numpy array per chunk; yielded frames are
+    views into it, safe for callers that hold references.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._handle: Optional[int] = None
+
+    def open(self) -> 'NativeFrameDecoder':
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError('native decode service unavailable')
+        handle = lib.vf_open(os.fsencode(self.path))
+        if not handle:
+            raise IOError(
+                f'vfdecode: {lib.vf_last_error().decode()} ({self.path})')
+        self._handle = handle
+        fps = ctypes.c_double()
+        n = ctypes.c_long()
+        w = ctypes.c_int()
+        h = ctypes.c_int()
+        lib.vf_props(handle, ctypes.byref(fps), ctypes.byref(n),
+                     ctypes.byref(w), ctypes.byref(h))
+        self.fps = fps.value
+        self.num_frames = n.value
+        self.width = w.value
+        self.height = h.value
+        return self
+
+    def __iter__(self) -> Iterator[Tuple[int, np.ndarray]]:
+        if self._handle is None:
+            self.open()
+        lib = load_library()
+        idx = 0
+        try:
+            while True:
+                chunk = np.empty((CHUNK, self.height, self.width, 3), np.uint8)
+                got = lib.vf_read(self._handle, chunk.ctypes.data, CHUNK)
+                if got < 0:
+                    raise IOError(f'vfdecode: decode error {got} ({self.path})')
+                for i in range(got):
+                    yield idx, chunk[i]
+                    idx += 1
+                if got < CHUNK:
+                    break
+        finally:
+            self.release()
+
+    def release(self) -> None:
+        if self._handle is not None:
+            load_library().vf_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        self.release()
+
+
+def get_video_props_native(path: str) -> Optional[dict]:
+    """fps/num_frames/height/width via the C++ service; None if unavailable."""
+    if not available():
+        return None
+    dec = NativeFrameDecoder(str(path))
+    try:
+        dec.open()
+    except (IOError, RuntimeError):
+        return None
+    props = dict(fps=dec.fps, num_frames=dec.num_frames,
+                 height=dec.height, width=dec.width)
+    dec.release()
+    return props

@@ -138,6 +138,8 @@ class VideoLoader:
         use_ffmpeg: force/forbid the ffmpeg re-encode backend; default: use
             it iff a binary is present (exact reference parity), else the
             index-resampling backend.
+        backend: frame decode backend — 'native' (C++ libav service),
+            'cv2', or 'auto' (native when buildable, else cv2).
     """
 
     def __init__(
@@ -151,20 +153,23 @@ class VideoLoader:
         transform: Optional[Callable] = None,
         overlap: int = 0,
         use_ffmpeg: Optional[bool] = None,
+        backend: str = 'auto',
     ):
         assert isinstance(batch_size, int) and batch_size > 0
         assert isinstance(overlap, int) and 0 <= overlap < batch_size
         if fps is not None and total is not None:
             raise ValueError("'fps' and 'total' are mutually exclusive")
 
+        assert backend in ('auto', 'native', 'cv2'), backend
         self.batch_size = batch_size
         self.transform = transform
         self.overlap = overlap
         self.keep_tmp = keep_tmp
+        self.backend = backend
         self._tmp_file: Optional[str] = None
 
         path = str(path)
-        props = get_video_props(path)
+        props = self._probe_props(path)
         self.height, self.width = props['height'], props['width']
         src_fps, src_frames = props['fps'], props['num_frames']
 
@@ -203,9 +208,40 @@ class VideoLoader:
         self._exhausted = False
         return self
 
+    def _probe_props(self, path: str) -> Dict[str, float]:
+        """Stream properties from whichever probe understands the file:
+        the native service first (when selected), cv2 otherwise — each can
+        demux containers the other's build may lack."""
+        if self.backend != 'cv2':
+            from video_features_tpu.io import native
+            props = native.get_video_props_native(path)
+            if props is not None and props['num_frames'] > 0:
+                return props
+            if self.backend == 'native' and props is None and \
+                    not native.available():
+                raise RuntimeError('native decode backend unavailable '
+                                   '(libvfdecode.so failed to build/load)')
+        return get_video_props(path)
+
+    def _make_decoder(self):
+        if self.backend != 'cv2':
+            from video_features_tpu.io import native
+            if native.available():
+                decoder = native.NativeFrameDecoder(self.path)
+                if self.backend == 'native':
+                    return decoder
+                try:  # auto: per-file fallback — libav may lack a demuxer
+                    return decoder.open()
+                except IOError:
+                    pass
+            elif self.backend == 'native':
+                raise RuntimeError('native decode backend unavailable '
+                                   '(libvfdecode.so failed to build/load)')
+        return Cv2FrameDecoder(self.path)
+
     def _retimed_frames(self) -> Iterator[np.ndarray]:
         """Decoded frames in output order, honoring the index map (dup/drop)."""
-        decoder = Cv2FrameDecoder(self.path)
+        decoder = self._make_decoder()
         if self._index_map is None:
             for _, frame in decoder:
                 yield frame
@@ -273,3 +309,52 @@ def iter_frame_batches(loader: VideoLoader) -> Iterator[Tuple[np.ndarray, List[f
         if isinstance(batch, list):
             batch = np.stack(batch)
         yield batch, times, indices
+
+
+def prefetch(iterable, depth: int = 2):
+    """Run ``iterable`` on a background thread, buffering ``depth`` items.
+
+    Host-side software pipelining (SURVEY.md §7 design stance 2): while the
+    device computes on batch k, the decode thread fills batch k+1 — the
+    single-host analog of a double-buffered infeed. Exceptions from the
+    producer re-raise at the consuming site; the thread shuts down with the
+    iterator (``close()`` or garbage collection of the generator).
+    """
+    import queue
+    import threading
+
+    q: 'queue.Queue' = queue.Queue(maxsize=max(depth, 1))
+    _END = object()
+    stop = threading.Event()
+
+    def put_or_abort(item) -> bool:
+        """Blocking put that gives up once the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in iterable:
+                if not put_or_abort(item):
+                    return
+            put_or_abort(_END)
+        except BaseException as e:  # re-raised by the consumer
+            put_or_abort(e)
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
