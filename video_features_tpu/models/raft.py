@@ -22,6 +22,7 @@ Input: two (B, H, W, 3) uint8/float RGB frames, H and W divisible by 8
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, List, Tuple
 
 import jax
@@ -208,6 +209,21 @@ def coords_grid(B: int, H: int, W: int, dtype=jnp.float32) -> jax.Array:
     return jnp.broadcast_to(jnp.stack([x, y], -1), (B, H, W, 2))
 
 
+def _use_pallas_lookup() -> bool:
+    """Pallas corr lookup: on for TPU backends, overridable via env.
+
+    ``VFT_RAFT_PALLAS=1`` forces it on (interpret mode off-TPU), ``=0`` forces
+    the XLA gather path, unset → auto (TPU only).
+    """
+    import os
+    flag = os.environ.get('VFT_RAFT_PALLAS', 'auto')
+    if flag == '1':
+        return True
+    if flag == '0':
+        return False
+    return jax.default_backend() == 'tpu'
+
+
 def forward(params: Params, image1: jax.Array, image2: jax.Array,
             iters: int = ITERS) -> jax.Array:
     """Two (B, H, W, 3) frames (values 0..255) → (B, H, W, 2) flow.
@@ -231,9 +247,18 @@ def forward(params: Params, image1: jax.Array, image2: jax.Array,
     coords0 = coords_grid(B, H8, W8)
     up = params['update_block']
 
+    if _use_pallas_lookup():
+        from video_features_tpu.ops import pallas_corr
+        prepped = pallas_corr.prep_pyramid(pyramid, CORR_RADIUS)
+        interp = jax.default_backend() != 'tpu'
+        lookup = partial(pallas_corr.lookup_corr, prepped,
+                         radius=CORR_RADIUS, interpret=interp)
+    else:
+        lookup = partial(lookup_corr, pyramid)
+
     def step(carry, _):
         net, coords1, _ = carry
-        corr = lookup_corr(pyramid, coords1)
+        corr = lookup(coords1)
         flow = coords1 - coords0
         motion = motion_encoder(up['encoder'], flow, corr)
         net_new = sep_conv_gru(up['gru'], net, jnp.concatenate([inp, motion], -1))
