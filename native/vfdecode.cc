@@ -20,12 +20,15 @@
 extern "C" {
 #include <libavcodec/avcodec.h>
 #include <libavformat/avformat.h>
+#include <libavutil/display.h>
 #include <libavutil/imgutils.h>
 #include <libswscale/swscale.h>
 }
 
+#include <cmath>
 #include <cstring>
 #include <string>
+#include <vector>
 
 namespace {
 thread_local std::string g_last_error;
@@ -37,12 +40,18 @@ struct Decoder {
   AVPacket* pkt = nullptr;
   AVFrame* frame = nullptr;
   int stream_index = -1;
-  int width = 0;
+  int width = 0;    // coded geometry (sws output)
   int height = 0;
+  int rotation = 0;  // clockwise degrees to apply for display (0/90/180/270)
+  std::vector<unsigned char> rot_buf;  // staging buffer when rotation != 0
   double fps = 0.0;
   long num_frames = 0;
   bool draining = false;
   bool done = false;
+
+  // geometry after rotation — what the caller sees
+  int out_width() const { return rotation % 180 ? height : width; }
+  int out_height() const { return rotation % 180 ? width : height; }
 };
 
 void destroy(Decoder* d) {
@@ -81,6 +90,21 @@ bool open_impl(Decoder* d, const char* path) {
 
   d->width = d->codec->width;
   d->height = d->codec->height;
+
+  // Display-matrix rotation (portrait phone videos etc.). cv2 auto-rotates
+  // since OpenCV 4.5; matching it keeps the native and cv2 backends
+  // interchangeable. Same convention as ffmpeg's autorotate: theta is the
+  // clockwise rotation to apply for correct display.
+  const uint8_t* sd =
+      av_stream_get_side_data(st, AV_PKT_DATA_DISPLAYMATRIX, nullptr);
+  if (sd) {
+    double theta = -av_display_rotation_get((const int32_t*)sd);
+    theta -= 360.0 * std::floor(theta / 360.0 + 0.9 / 360.0);
+    d->rotation = ((int)(theta / 90.0 + 0.5) % 4) * 90;
+    if (d->rotation)
+      d->rot_buf.resize((size_t)3 * d->width * d->height);
+  }
+
   AVRational r = st->avg_frame_rate.num ? st->avg_frame_rate : st->r_frame_rate;
   d->fps = r.den ? av_q2d(r) : 0.0;
   d->num_frames = st->nb_frames;
@@ -102,11 +126,34 @@ bool ensure_sws(Decoder* d, AVPixelFormat src_fmt) {
   return d->sws != nullptr;
 }
 
+// Rotate an RGB24 image by d->rotation degrees clockwise: src is coded
+// H×W, dst is the display geometry. Plain pixel loops; memory-bound, cheap
+// relative to decode.
+void rotate_rgb(const Decoder* d, const unsigned char* src,
+                unsigned char* dst) {
+  const int h = d->height, w = d->width;
+  auto px = [&](int r, int c) { return src + 3 * ((size_t)r * w + c); };
+  unsigned char* o = dst;
+  if (d->rotation == 90) {  // dst (w × h): dst(r,c) = src(h-1-c, r)
+    for (int r = 0; r < w; ++r)
+      for (int c = 0; c < h; ++c, o += 3) std::memcpy(o, px(h - 1 - c, r), 3);
+  } else if (d->rotation == 180) {
+    for (int r = 0; r < h; ++r)
+      for (int c = 0; c < w; ++c, o += 3)
+        std::memcpy(o, px(h - 1 - r, w - 1 - c), 3);
+  } else {  // 270: dst (w × h): dst(r,c) = src(c, w-1-r)
+    for (int r = 0; r < w; ++r)
+      for (int c = 0; c < h; ++c, o += 3) std::memcpy(o, px(c, w - 1 - r), 3);
+  }
+}
+
 void emit_rgb(Decoder* d, unsigned char* out) {
-  uint8_t* dst[1] = {out};
+  unsigned char* target = d->rotation ? d->rot_buf.data() : out;
+  uint8_t* dst[1] = {target};
   int dst_linesize[1] = {3 * d->width};
   sws_scale(d->sws, d->frame->data, d->frame->linesize, 0, d->height, dst,
             dst_linesize);
+  if (d->rotation) rotate_rgb(d, d->rot_buf.data(), out);
 }
 }  // namespace
 
@@ -128,9 +175,12 @@ void vf_props(void* handle, double* fps, long* num_frames, int* width,
   Decoder* d = (Decoder*)handle;
   if (fps) *fps = d->fps;
   if (num_frames) *num_frames = d->num_frames;
-  if (width) *width = d->width;
-  if (height) *height = d->height;
+  if (width) *width = d->out_width();
+  if (height) *height = d->out_height();
 }
+
+// Clockwise display rotation applied to emitted frames (0/90/180/270).
+int vf_rotation(void* handle) { return ((Decoder*)handle)->rotation; }
 
 long vf_read(void* handle, unsigned char* out, long max_frames) {
   Decoder* d = (Decoder*)handle;

@@ -91,3 +91,40 @@ def test_prefetch_early_close():
     it = prefetch(gen(), depth=2)
     assert next(it) == 0
     it.close()
+
+
+def _patch_tkhd_rotation(src: str, dst: str) -> None:
+    """Binary-patch the mp4 tkhd display matrix to a 90° cw rotation."""
+    import struct
+
+    data = bytearray(open(src, 'rb').read())
+    i = data.find(b'tkhd')
+    assert i > 0, 'no tkhd box in test clip'
+    m = i + 4 + 1 + 3 + 20 + 16  # v0 tkhd: matrix is 44 bytes after fourcc
+    data[m:m + 36] = struct.pack(
+        '>9i', 0, 0x00010000, 0, -0x00010000, 0, 0, 0, 0, 0x40000000)
+    open(dst, 'wb').write(data)
+
+
+def test_rotation_metadata(short_video, tmp_path):
+    """Display-matrix rotation is applied like cv2's auto-rotate.
+
+    Phone portrait videos carry a rotate-90 display matrix; the native
+    backend must yield the same upright frames and swapped dims as cv2, or
+    backend='auto' silently changes orientation semantics.
+    """
+    rot = str(tmp_path / 'rot90.mp4')
+    _patch_tkhd_rotation(short_video, rot)
+
+    dec = native.NativeFrameDecoder(rot).open()
+    assert dec.rotation == 90
+    plain = native.NativeFrameDecoder(short_video).open()
+    assert plain.rotation == 0
+    assert (dec.width, dec.height) == (plain.height, plain.width)
+    plain.release()
+
+    nat = [f.copy() for _, f in zip(range(4), (fr for _, fr in dec))]
+    cv = [f for _, f in zip(range(4), (fr for _, fr in Cv2FrameDecoder(rot)))]
+    if cv[0].shape != nat[0].shape:
+        pytest.skip('this cv2 build does not auto-rotate')
+    np.testing.assert_array_equal(np.stack(nat), np.stack(cv))

@@ -69,6 +69,8 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.vf_read.restype = ctypes.c_long
         lib.vf_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                 ctypes.c_long]
+        lib.vf_rotation.restype = ctypes.c_int
+        lib.vf_rotation.argtypes = [ctypes.c_void_p]
         lib.vf_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
@@ -108,8 +110,11 @@ class NativeFrameDecoder:
                      ctypes.byref(w), ctypes.byref(h))
         self.fps = fps.value
         self.num_frames = n.value
+        # display geometry: vfdecode applies display-matrix rotation (like
+        # cv2's auto-rotate), so width/height already reflect it
         self.width = w.value
         self.height = h.value
+        self.rotation = lib.vf_rotation(handle)
         return self
 
     def __iter__(self) -> Iterator[Tuple[int, np.ndarray]]:
