@@ -95,8 +95,16 @@ bool open_impl(Decoder* d, const char* path) {
   // since OpenCV 4.5; matching it keeps the native and cv2 backends
   // interchangeable. Same convention as ffmpeg's autorotate: theta is the
   // clockwise rotation to apply for correct display.
+#if LIBAVFORMAT_VERSION_MAJOR >= 61
+  // FFmpeg 7+: stream side data moved to codecpar->coded_side_data
+  const AVPacketSideData* psd = av_packet_side_data_get(
+      st->codecpar->coded_side_data, st->codecpar->nb_coded_side_data,
+      AV_PKT_DATA_DISPLAYMATRIX);
+  const uint8_t* sd = psd ? psd->data : nullptr;
+#else
   const uint8_t* sd =
       av_stream_get_side_data(st, AV_PKT_DATA_DISPLAYMATRIX, nullptr);
+#endif
   if (sd) {
     double theta = -av_display_rotation_get((const int32_t*)sd);
     theta -= 360.0 * std::floor(theta / 360.0 + 0.9 / 360.0);

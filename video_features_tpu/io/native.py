@@ -51,7 +51,11 @@ def load_library() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None or _build_failed:
             return _lib
-        if not LIB_PATH.exists() and not _build():
+        # Always run make: a no-op when the cached .so is fresh, a rebuild
+        # when vfdecode.cc is newer (stale libs would otherwise miss newer
+        # symbols). If make is unavailable but a prebuilt .so exists, still
+        # try it.
+        if not _build() and not LIB_PATH.exists():
             _build_failed = True
             return None
         try:
@@ -59,21 +63,31 @@ def load_library() -> Optional[ctypes.CDLL]:
         except OSError:
             _build_failed = True
             return None
-        lib.vf_open.restype = ctypes.c_void_p
-        lib.vf_open.argtypes = [ctypes.c_char_p]
-        lib.vf_last_error.restype = ctypes.c_char_p
-        lib.vf_props.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_int)]
-        lib.vf_read.restype = ctypes.c_long
-        lib.vf_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                ctypes.c_long]
-        lib.vf_rotation.restype = ctypes.c_int
-        lib.vf_rotation.argtypes = [ctypes.c_void_p]
-        lib.vf_close.argtypes = [ctypes.c_void_p]
+        try:
+            _bind(lib)
+        except AttributeError:
+            # missing symbol: a stale prebuilt .so that make couldn't
+            # refresh — treat as unavailable rather than crash callers
+            _build_failed = True
+            return None
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.vf_open.restype = ctypes.c_void_p
+    lib.vf_open.argtypes = [ctypes.c_char_p]
+    lib.vf_last_error.restype = ctypes.c_char_p
+    lib.vf_props.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.vf_read.restype = ctypes.c_long
+    lib.vf_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                            ctypes.c_long]
+    lib.vf_rotation.restype = ctypes.c_int
+    lib.vf_rotation.argtypes = [ctypes.c_void_p]
+    lib.vf_close.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:

@@ -45,19 +45,29 @@ def layer_norm(x: jax.Array, p: Params, eps: float = 1e-6) -> jax.Array:
     return (x - mean) / jnp.sqrt(var + eps) * p['weight'] + p['bias']
 
 
+# Above this token count, attention switches to the blockwise online-softmax
+# path (O(N·block) score memory instead of O(N²)) — irrelevant for 224px
+# frames (~197 tokens) but load-bearing when a long video's temporal tokens
+# are attended as one sequence.
+BLOCKWISE_THRESHOLD = 2048
+_BLOCK = 512
+
+
 def _attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
     """timm `Attention`: fused qkv linear, per-head scaled dot product."""
+    from video_features_tpu.ops.attention import (
+        blockwise_attention, dense_attention,
+    )
     B, N, D = x.shape
     head_dim = D // num_heads
     qkv = x @ p['qkv']['weight'] + p['qkv']['bias']          # (B, N, 3D)
     qkv = qkv.reshape(B, N, 3, num_heads, head_dim)
     q, k, v = jnp.moveaxis(qkv, 2, 0)                        # (B, N, H, hd)
-    q = jnp.moveaxis(q, 1, 2)                                # (B, H, N, hd)
-    k = jnp.moveaxis(k, 1, 2)
-    v = jnp.moveaxis(v, 1, 2)
-    attn = jax.nn.softmax(q @ jnp.swapaxes(k, -1, -2) / jnp.sqrt(head_dim),
-                          axis=-1)
-    out = jnp.moveaxis(attn @ v, 1, 2).reshape(B, N, D)
+    if N >= BLOCKWISE_THRESHOLD and N % _BLOCK == 0:
+        out = blockwise_attention(q, k, v, block_size=_BLOCK)
+    else:
+        out = dense_attention(q, k, v)
+    out = out.reshape(B, N, D)
     return out @ p['proj']['weight'] + p['proj']['bias']
 
 

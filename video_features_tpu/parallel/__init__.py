@@ -12,6 +12,9 @@ from video_features_tpu.parallel.mesh import (  # noqa: F401
 from video_features_tpu.parallel.pipeline import (  # noqa: F401
     build_sharded_two_stream_step, put_batch, put_replicated,
 )
+from video_features_tpu.parallel.ring import (  # noqa: F401
+    sequence_sharded_attention, sequence_sharding,
+)
 from video_features_tpu.parallel.worklist import (  # noqa: F401
     shard_worklist, shuffled,
 )
