@@ -1,0 +1,90 @@
+"""Unit tests for the per-stage tracing subsystem (utils/tracing.py)."""
+import threading
+import time
+
+from video_features_tpu.utils.tracing import NULL_TRACER, Tracer, jax_profiler_trace
+
+
+def test_stage_accumulates():
+    t = Tracer()
+    for _ in range(3):
+        with t.stage('work'):
+            time.sleep(0.001)
+    rep = t.report()
+    assert rep['work']['count'] == 3
+    assert rep['work']['total_s'] >= 0.003
+    assert rep['work']['max_s'] <= rep['work']['total_s']
+
+
+def test_stage_records_on_exception():
+    t = Tracer()
+    try:
+        with t.stage('boom'):
+            raise ValueError
+    except ValueError:
+        pass
+    assert t.report()['boom']['count'] == 1
+
+
+def test_wrap_iter_times_each_next():
+    t = Tracer()
+
+    def gen():
+        for i in range(4):
+            time.sleep(0.001)
+            yield i
+
+    assert list(t.wrap_iter('decode', gen())) == [0, 1, 2, 3]
+    rep = t.report()
+    # 4 yields + the final StopIteration probe
+    assert rep['decode']['count'] == 5
+    assert rep['decode']['total_s'] >= 0.004
+
+
+def test_null_tracer_is_noop():
+    with NULL_TRACER.stage('x'):
+        pass
+    assert list(NULL_TRACER.wrap_iter('y', iter([1, 2]))) == [1, 2]
+    assert NULL_TRACER.report() == {}
+
+
+def test_thread_safety():
+    t = Tracer()
+
+    def worker():
+        for _ in range(200):
+            with t.stage('shared'):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert t.report()['shared']['count'] == 800
+
+
+def test_summary_and_reset():
+    t = Tracer()
+    with t.stage('a'):
+        pass
+    with t.stage('b'):
+        pass
+    s = t.summary()
+    assert 'a' in s and 'b' in s and 'share' in s
+    t.reset()
+    assert t.report() == {}
+    assert t.summary() == '(no stages recorded)'
+
+
+def test_jax_profiler_trace_none_is_noop():
+    with jax_profiler_trace(None):
+        pass
+
+
+def test_jax_profiler_trace_writes(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    with jax_profiler_trace(str(tmp_path)):
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    assert any(tmp_path.rglob('*')), 'profiler wrote nothing'

@@ -36,9 +36,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.get('video_paths'), args.get('file_with_video_paths'), to_shuffle=True)
     print(f'The number of specified videos: {len(video_paths)}')
 
-    for i, video_path in enumerate(video_paths):
-        print(f'[{i + 1}/{len(video_paths)}] {video_path}')
-        extractor._extract(video_path)
+    # profile=true prints per-stage timing tables after each video;
+    # profile_dir=<path> additionally captures a jax/XLA device trace.
+    from video_features_tpu.utils.tracing import jax_profiler_trace
+    with jax_profiler_trace(args.get('profile_dir')):
+        for i, video_path in enumerate(video_paths):
+            print(f'[{i + 1}/{len(video_paths)}] {video_path}')
+            extractor._extract(video_path)
     return 0
 
 

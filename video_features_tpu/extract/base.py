@@ -31,6 +31,7 @@ import numpy as np
 from video_features_tpu.utils.output import (
     ACTION_TO_EXT, ACTION_TO_LOAD, ACTION_TO_SAVE, make_path,
 )
+from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
 
 
 class BaseExtractor:
@@ -48,6 +49,7 @@ class BaseExtractor:
         keep_tmp_files: bool,
         device: str,
         concat_rgb_flow: bool = False,
+        profile: bool = False,
     ) -> None:
         self.feature_type = feature_type
         self.on_extraction = on_extraction
@@ -56,6 +58,7 @@ class BaseExtractor:
         self.keep_tmp_files = keep_tmp_files
         self.device = device
         self.concat_rgb_flow = concat_rgb_flow
+        self.tracer = Tracer(enabled=True) if profile else NULL_TRACER
 
     # -- per-video driver ---------------------------------------------------
 
@@ -66,13 +69,21 @@ class BaseExtractor:
                 return
             feats_dict = self.extract(video_path)
             feats_dict = self._maybe_concat_streams(feats_dict)
-            self.action_on_extraction(feats_dict, video_path)
+            with self.tracer.stage('save'):
+                self.action_on_extraction(feats_dict, video_path)
         except KeyboardInterrupt:
             raise
         except Exception:
             print(f'An error occurred during extraction from: {video_path}:')
             traceback.print_exc()
             print('Continuing...')
+        finally:
+            # report+reset even on failure so one bad video's timings never
+            # leak into the next video's table
+            if self.tracer.enabled and self.tracer.report():
+                print(f'--- stage timing: {video_path}')
+                print(self.tracer.summary())
+                self.tracer.reset()
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         raise NotImplementedError

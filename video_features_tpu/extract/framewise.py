@@ -30,6 +30,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
             output_path=args.output_path,
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
+            profile=args.get('profile', False),
         )
         self.batch_size = args.batch_size
         self.extraction_fps = args.get('extraction_fps')
@@ -61,15 +62,19 @@ class BaseFrameWiseExtractor(BaseExtractor):
             transform=self.host_transform,
         )
         feats, timestamps = [], []
+        # wrap_iter times decode+preprocess on the prefetch producer thread
+        batches = prefetch(
+            self.tracer.wrap_iter('decode+preprocess', loader), depth=2)
         with jax.default_matmul_precision('highest'):
             # decode thread fills batch k+1 while the device runs batch k
-            for batch, times, _ in prefetch(loader, depth=2):
+            for batch, times, _ in batches:
                 batch = np.stack(batch)
                 valid = batch.shape[0]
                 if valid < self.batch_size:  # pad tail to the compiled shape
                     pad = np.repeat(batch[-1:], self.batch_size - valid, axis=0)
                     batch = np.concatenate([batch, pad], axis=0)
-                out = np.asarray(self.device_step(batch))[:valid]
+                with self.tracer.stage('model'):
+                    out = np.asarray(self.device_step(batch))[:valid]
                 feats.append(out)
                 timestamps.extend(times)
                 if self.show_pred:

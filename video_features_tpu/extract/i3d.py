@@ -85,6 +85,7 @@ class ExtractI3D(BaseExtractor):
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
             concat_rgb_flow=args.get('concat_rgb_flow', False),
+            profile=args.get('profile', False),
         )
         self.streams: List[str] = (['rgb', 'flow'] if args.streams is None
                                    else [args.streams])
@@ -136,8 +137,9 @@ class ExtractI3D(BaseExtractor):
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files,
             transform=lambda f: resize_pil(f, MIN_SIDE_SIZE).astype(np.float32))
-        frames = np.stack(
-            [f for batch, _, _ in loader for f in batch])     # (T, H, W, 3)
+        with self.tracer.stage('decode+preprocess'):
+            frames = np.stack(
+                [f for batch, _, _ in loader for f in batch])  # (T, H, W, 3)
 
         # stack windows of stack_size+1 frames (B+1 frames → B flow pairs)
         slices = form_slices(len(frames), self.stack_size + 1, self.step_size)
@@ -153,10 +155,11 @@ class ExtractI3D(BaseExtractor):
                 while len(window) < self.batch_size:  # pad tail, mask below
                     window = window + [window[-1]]
                 stacks = np.stack([frames[s:e] for s, e in window])
-                out = self._step(self.params, stacks, pads=tuple(pads),
-                                 streams=tuple(self.streams))
-                for s in self.streams:
-                    feats[s].append(np.asarray(out[s])[:valid])
+                with self.tracer.stage('model'):
+                    out = self._step(self.params, stacks, pads=tuple(pads),
+                                     streams=tuple(self.streams))
+                    for s in self.streams:
+                        feats[s].append(np.asarray(out[s])[:valid])
                 if self.show_pred:
                     self.maybe_show_pred(stacks[:valid], pads, start)
 

@@ -49,6 +49,7 @@ class ExtractR21D(BaseExtractor):
             output_path=args.output_path,
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
+            profile=args.get('profile', False),
         )
         self.model_name = args.model_name
         self.model_def = MODEL_CFGS[self.model_name]
@@ -94,8 +95,9 @@ class ExtractR21D(BaseExtractor):
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files)
-        frames = np.concatenate(
-            [b for b, _, _ in iter_frame_batches(loader)], axis=0)
+        with self.tracer.stage('decode'):
+            frames = np.concatenate(
+                [b for b, _, _ in iter_frame_batches(loader)], axis=0)
 
         idx = stack_indices(len(frames), self.stack_size, self.step_size)
         num_stacks = idx.shape[0]
@@ -108,7 +110,8 @@ class ExtractR21D(BaseExtractor):
                     pad = np.repeat(chunk[-1:], STACK_BATCH - valid, axis=0)
                     chunk = np.concatenate([chunk, pad], axis=0)
                 stacks = frames[chunk]  # (B, stack, H, W, 3)
-                out = np.asarray(self._step(self.params, stacks))[:valid]
+                with self.tracer.stage('model'):
+                    out = np.asarray(self._step(self.params, stacks))[:valid]
                 feats.append(out)
                 if self.show_pred:
                     for k in range(valid):

@@ -44,6 +44,7 @@ class ExtractRAFT(BaseExtractor):
             output_path=args.output_path,
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
+            profile=args.get('profile', False),
         )
         self.batch_size = args.batch_size
         self.side_size = args.get('side_size')
@@ -92,8 +93,10 @@ class ExtractRAFT(BaseExtractor):
         )
         flows, timestamps = [], []
         first = True
+        batches = prefetch(
+            self.tracer.wrap_iter('decode+preprocess', loader), depth=2)
         with jax.default_matmul_precision('highest'):
-            for batch, times, _ in prefetch(loader, depth=2):
+            for batch, times, _ in batches:
                 batch = np.stack(batch)                      # (n, H, W, 3)
                 timestamps.extend(times if first else times[1:])
                 first = False
@@ -103,9 +106,14 @@ class ExtractRAFT(BaseExtractor):
                 if batch.shape[0] < self.batch_size + 1:
                     pad = np.repeat(batch[-1:], self.batch_size + 1 - batch.shape[0], axis=0)
                     batch = np.concatenate([batch, pad], axis=0)
-                padded, pads = raft_model.pad_to_multiple(batch, mode=self.finetuned_on)
-                flow = self._step(self.params, np.asarray(padded))
-                flow = np.asarray(raft_model.unpad(flow, pads))[:valid]
+                # host-side padding stays outside 'model' so the stage table
+                # attributes host vs device time consistently across extractors
+                padded, pads = raft_model.pad_to_multiple(
+                    batch, mode=self.finetuned_on)
+                padded = np.asarray(padded)
+                with self.tracer.stage('model'):
+                    flow = self._step(self.params, padded)
+                    flow = np.asarray(raft_model.unpad(flow, pads))[:valid]
                 flows.append(flow)
                 if self.show_pred:
                     self.maybe_show_pred(flow, batch[:valid])

@@ -35,6 +35,7 @@ class ExtractS3D(BaseExtractor):
             output_path=args.output_path,
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
+            profile=args.get('profile', False),
         )
         self.stack_size = args.stack_size
         self.step_size = args.step_size
@@ -65,8 +66,9 @@ class ExtractS3D(BaseExtractor):
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files)
-        frames = np.concatenate(
-            [b for b, _, _ in iter_frame_batches(loader)], axis=0)
+        with self.tracer.stage('decode'):
+            frames = np.concatenate(
+                [b for b, _, _ in iter_frame_batches(loader)], axis=0)
 
         # short-side 224, torch F.interpolate semantics, static per video
         h, w = frames.shape[1:3]
@@ -81,7 +83,8 @@ class ExtractS3D(BaseExtractor):
         with jax.default_matmul_precision('highest'):
             for start in range(0, idx.shape[0], STACK_BATCH):
                 chunk = idx[start:start + STACK_BATCH]
-                out = np.asarray(step(self.params, frames[chunk]))
+                with self.tracer.stage('model'):
+                    out = np.asarray(step(self.params, frames[chunk]))
                 feats.append(out)
                 if self.show_pred:
                     self.maybe_show_pred(frames[chunk], int(chunk[0][0]),

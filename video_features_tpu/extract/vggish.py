@@ -36,6 +36,7 @@ class ExtractVGGish(BaseExtractor):
             output_path=args.output_path,
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
+            profile=args.get('profile', False),
         )
         if args.show_pred:
             raise NotImplementedError('vggish has no show_pred (reference '
@@ -68,9 +69,11 @@ class ExtractVGGish(BaseExtractor):
             raise NotImplementedError(f'unsupported extension {ext}')
 
         try:
-            data, sr = read_wav(wav_path)
-            examples = waveform_to_examples(data, sr)      # (N, 96, 64)
-            feats = self._run_batched(examples[..., None])  # NHWC
+            with self.tracer.stage('audio_dsp'):
+                data, sr = read_wav(wav_path)
+                examples = waveform_to_examples(data, sr)  # (N, 96, 64)
+            with self.tracer.stage('model'):
+                feats = self._run_batched(examples[..., None])  # NHWC
         finally:
             if not self.keep_tmp_files and ext == '.mp4':
                 for p in (wav_path, aac_path):

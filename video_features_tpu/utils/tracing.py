@@ -1,0 +1,156 @@
+"""Per-stage timing and JAX profiler hooks.
+
+The reference has no tracing/profiling surface at all — only tqdm progress
+and prints (SURVEY.md §5.1; reference main.py:2,47). On TPU the pipeline is
+host-decode-bound long before it is FLOPs-bound, so knowing how wall time
+splits across decode / preprocess / host→device+model / save is the first
+profiling question. This module provides:
+
+  * ``Tracer`` — a thread-safe accumulator of named stage timings. Stages
+    are timed with ``with tracer.stage('decode'): ...`` or by wrapping an
+    iterator (``tracer.wrap_iter('decode', loader)`` times each ``next()``
+    call, which is where streaming decode work actually happens — including
+    on the prefetch producer thread).
+  * ``NULL_TRACER`` — a disabled singleton; instrumentation sites cost two
+    attribute loads and a truthiness check when profiling is off.
+  * ``jax_profiler_trace(dir)`` — context manager around
+    ``jax.profiler.trace`` for XLA/TPU-level traces viewable in
+    TensorBoard/Perfetto, gated so importing this module never imports jax.
+
+Enable per-run with the ``profile: true`` config key (any extractor); each
+video then prints a stage table after extraction. ``profile_dir`` addition-
+ally captures a jax profiler trace of the whole run.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterable, Iterator, List, Optional
+
+
+class _StageStat:
+    __slots__ = ('count', 'total_s', 'max_s')
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        if dt > self.max_s:
+            self.max_s = dt
+
+
+class Tracer:
+    """Thread-safe named-stage wall-time accumulator."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._stats: Dict[str, _StageStat] = {}
+        self._order: List[str] = []
+
+    # -- recording -----------------------------------------------------------
+
+    def add(self, name: str, dt: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            stat = self._stats.get(name)
+            if stat is None:
+                stat = self._stats[name] = _StageStat()
+                self._order.append(name)
+            stat.add(dt)
+
+    @contextmanager
+    def stage(self, name: str):
+        """Time a block under ``name`` (no-op when disabled)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def wrap_iter(self, name: str, iterable: Iterable) -> Iterator:
+        """Yield from ``iterable``, timing each ``next()`` under ``name``.
+
+        Streaming decoders do their work inside ``next()``; wrapping the
+        iterator (before any prefetch thread) therefore times decode on the
+        thread that actually runs it.
+        """
+        if not self.enabled:
+            yield from iterable
+            return
+        it = iter(iterable)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            finally:
+                self.add(name, time.perf_counter() - t0)
+            yield item
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {'count': s.count, 'total_s': s.total_s,
+                       'mean_s': s.total_s / max(s.count, 1), 'max_s': s.max_s}
+                for name, s in self._stats.items()
+            }
+
+    def summary(self) -> str:
+        """Human-readable stage table, ordered by first occurrence."""
+        # one lock acquisition for both stats and order: a concurrent add()
+        # (e.g. a lingering prefetch thread) must not desync them
+        with self._lock:
+            order = list(self._order)
+            rep = {
+                name: {'count': s.count, 'total_s': s.total_s,
+                       'mean_s': s.total_s / max(s.count, 1), 'max_s': s.max_s}
+                for name, s in self._stats.items()
+            }
+        if not rep:
+            return '(no stages recorded)'
+        total = sum(r['total_s'] for r in rep.values())
+        width = max(len(n) for n in order)
+        lines = [f'{"stage".ljust(width)} | count |  total s |   mean ms | share']
+        for name in order:
+            r = rep[name]
+            share = r['total_s'] / total * 100 if total else 0.0
+            lines.append(
+                f'{name.ljust(width)} | {r["count"]:5d} | {r["total_s"]:8.3f} '
+                f'| {r["mean_s"] * 1e3:9.2f} | {share:4.1f}%')
+        return '\n'.join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+            self._order.clear()
+
+
+NULL_TRACER = Tracer(enabled=False)
+
+
+@contextmanager
+def jax_profiler_trace(log_dir: Optional[str]):
+    """Capture a jax/XLA profiler trace to ``log_dir`` (None → no-op).
+
+    The trace includes device-side timelines (TPU step traces, XLA op
+    breakdowns) viewable with TensorBoard's profile plugin or Perfetto.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(str(log_dir)):
+        yield
