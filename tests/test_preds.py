@@ -1,0 +1,64 @@
+"""Label-map lookup + fetch tool + top-k printing (utils/preds.py)."""
+import importlib.util
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.utils.preds import (
+    load_label_map, show_predictions_on_dataset, softmax,
+)
+
+REPO_ROOT = Path(__file__).parent.parent
+
+
+def _run_fetch_tool(out_dir, checkout):
+    spec = importlib.util.spec_from_file_location(
+        'fetch_label_maps', REPO_ROOT / 'tools' / 'fetch_label_maps.py')
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    argv = sys.argv
+    sys.argv = ['fetch_label_maps', '--out', str(out_dir),
+                '--from-checkout', str(checkout)]
+    try:
+        return mod.main()
+    finally:
+        sys.argv = argv
+
+
+def test_fetch_tool_and_env_lookup(tmp_path, reference_repo, monkeypatch):
+    rc = _run_fetch_tool(tmp_path, reference_repo)
+    assert rc == 0
+    assert (tmp_path / 'K400_label_map.txt').exists()
+
+    monkeypatch.setenv('VFT_LABEL_MAP_DIR', str(tmp_path))
+    classes = load_label_map('kinetics')
+    assert classes is not None and len(classes) == 400
+
+
+def test_load_label_map_unknown_dataset():
+    assert load_label_map('nonsense') is None
+
+
+def test_softmax_rows_sum_to_one():
+    x = np.random.RandomState(0).randn(3, 10)
+    p = softmax(x)
+    np.testing.assert_allclose(p.sum(-1), np.ones(3), atol=1e-6)
+
+
+def test_show_predictions_falls_back_to_indices(capsys, monkeypatch):
+    # point the search path somewhere empty: indices must print, not raise
+    monkeypatch.setenv('VFT_LABEL_MAP_DIR', '/nonexistent')
+    logits = np.random.RandomState(0).randn(2, 40).astype(np.float32)
+    show_predictions_on_dataset(logits, 'nonsense', k=3)
+    out = capsys.readouterr().out
+    assert 'class_' in out and out.count('Logits') == 2
+
+
+def test_show_predictions_with_custom_class_list(capsys):
+    logits = np.array([[0.1, 5.0, -1.0]], np.float32)
+    show_predictions_on_dataset(logits, ['cat', 'dog', 'fish'], k=2)
+    out = capsys.readouterr().out
+    assert 'dog' in out

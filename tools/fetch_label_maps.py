@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Write K400/IN1K/IN21K label-map files for ``show_pred`` class names.
+
+Class-name files are display sugar for top-5 prediction tables
+(`video_features_tpu/utils/preds.py`); without them indices are printed.
+This tool materializes them from whatever source is available, in priority
+order:
+
+  1. torchvision weight metadata (Kinetics-400 from the r2plus1d weights,
+     ImageNet-1k from the resnet50 weights) — requires `torchvision`;
+  2. timm's dataset info (`imagenet-21k`) — requires `timm`;
+  3. an existing `video_features` checkout (``--from-checkout PATH``), whose
+     `utils/*_label_map.txt` files are copied as-is.
+
+Usage:
+    python tools/fetch_label_maps.py --out ./label_maps \
+        [--from-checkout /path/to/video_features]
+    export VFT_LABEL_MAP_DIR=./label_maps
+"""
+from __future__ import annotations
+
+import argparse
+import shutil
+import sys
+from pathlib import Path
+
+FILES = {
+    'kinetics': 'K400_label_map.txt',
+    'imagenet1k': 'IN1K_label_map.txt',
+    'imagenet21k': 'IN21K_label_map.txt',
+}
+
+
+def from_torchvision(out: Path) -> list:
+    written = []
+    try:
+        from torchvision.models import ResNet50_Weights
+        from torchvision.models.video import R2Plus1D_18_Weights
+    except ImportError:
+        return written
+    for weights, key in ((R2Plus1D_18_Weights.DEFAULT, 'kinetics'),
+                         (ResNet50_Weights.IMAGENET1K_V1, 'imagenet1k')):
+        cats = weights.meta.get('categories')
+        if cats:
+            (out / FILES[key]).write_text('\n'.join(cats) + '\n')
+            written.append(key)
+    return written
+
+
+def from_timm(out: Path) -> list:
+    try:
+        from timm.data import ImageNetInfo
+    except ImportError:
+        return []
+    try:
+        info = ImageNetInfo('imagenet-21k')
+        names = [info.index_to_description(i)
+                 for i in range(info.num_classes())]
+    except Exception:
+        return []
+    (out / FILES['imagenet21k']).write_text('\n'.join(names) + '\n')
+    return ['imagenet21k']
+
+
+def from_checkout(out: Path, checkout: Path) -> list:
+    written = []
+    for key, fname in FILES.items():
+        src = checkout / 'utils' / fname
+        if src.exists():
+            shutil.copy(src, out / fname)
+            written.append(key)
+    return written
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', required=True, help='output directory')
+    ap.add_argument('--from-checkout', default=None,
+                    help='path to a video_features checkout to copy from')
+    ns = ap.parse_args()
+
+    out = Path(ns.out)
+    out.mkdir(parents=True, exist_ok=True)
+    done: set = set()
+    done.update(from_torchvision(out))
+    if 'imagenet21k' not in done:
+        done.update(from_timm(out))
+    missing = set(FILES) - done
+    if missing and ns.from_checkout:
+        done.update(from_checkout(out, Path(ns.from_checkout)))
+        missing = set(FILES) - done
+
+    for key in sorted(done):
+        print(f'wrote {out / FILES[key]}')
+    for key in sorted(missing):
+        print(f'MISSING {key} ({FILES[key]}): no source available '
+              '(install torchvision/timm or pass --from-checkout)',
+              file=sys.stderr)
+    return 0 if done else 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -42,9 +42,25 @@ class ExtractVGGish(BaseExtractor):
             raise NotImplementedError('vggish has no show_pred (reference '
                                       'extract_vggish.py:25-26)')
         self.output_feat_keys = [self.feature_type]
+        # AudioSet-compatible PCA-whiten + uint8 quantization: off by default
+        # (the reference's forward(post_process=False) bypasses its vendored
+        # Postprocessor, vggish_slim.py:150-156) but available for users who
+        # need YouTube-8M/AudioSet-format embeddings. Validate before the
+        # (expensive) checkpoint load so misconfiguration fails fast.
+        self.post_process = args.get('post_process', False)
+        pca_path = args.get('pca_params_path')
+        if self.post_process and not pca_path:
+            raise ValueError(
+                'post_process=true needs pca_params_path=<vggish_pca_params.npz>')
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
         self._step = jax.jit(vggish_model.forward)
+        if self.post_process:
+            pca = np.load(pca_path)
+            self._pca_eig = jax.device_put(
+                pca['pca_eigen_vectors'].astype(np.float32), self._device)
+            self._pca_means = jax.device_put(
+                pca['pca_means'].astype(np.float32).reshape(-1), self._device)
 
     def load_params(self, args):
         ckpt = args.get('checkpoint_path')
@@ -74,6 +90,9 @@ class ExtractVGGish(BaseExtractor):
                 examples = waveform_to_examples(data, sr)  # (N, 96, 64)
             with self.tracer.stage('model'):
                 feats = self._run_batched(examples[..., None])  # NHWC
+            if self.post_process:
+                feats = np.asarray(vggish_model.postprocess(
+                    self._pca_eig, self._pca_means, feats)).astype(np.uint8)
         finally:
             if not self.keep_tmp_files and ext == '.mp4':
                 for p in (wav_path, aac_path):

@@ -18,17 +18,20 @@ _DATASET_TO_FILE = {
     'imagenet21k': 'IN21K_label_map.txt',
 }
 
-_SEARCH_DIRS = [
-    os.environ.get('VFT_LABEL_MAP_DIR', ''),
-    '/root/reference/utils',
-]
+def _search_dirs() -> List[str]:
+    # read the env var per call so `os.environ['VFT_LABEL_MAP_DIR'] = ...`
+    # after import still takes effect
+    return [
+        os.environ.get('VFT_LABEL_MAP_DIR', ''),
+        '/root/reference/utils',
+    ]
 
 
 def load_label_map(dataset: str) -> Optional[List[str]]:
     fname = _DATASET_TO_FILE.get(dataset)
     if fname is None:
         return None
-    for d in _SEARCH_DIRS:
+    for d in _search_dirs():
         if d and (Path(d) / fname).exists():
             with open(Path(d) / fname) as f:
                 return [line.strip() for line in f]
