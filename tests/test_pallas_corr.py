@@ -61,8 +61,8 @@ def test_lookup_integer_coords_exact():
     np.testing.assert_allclose(center, want, rtol=1e-6, atol=1e-6)
 
 
-def test_forward_with_pallas_lookup(monkeypatch):
-    """Full RAFT forward: pallas lookup == XLA lookup end-to-end."""
+def test_forward_with_all_lookup_impls(monkeypatch):
+    """Full RAFT forward: gather oracle == dense == pallas end-to-end."""
     sd = raft.init_state_dict(seed=0)
     from video_features_tpu.transplant.torch2jax import transplant
     params = transplant(sd)
@@ -71,8 +71,11 @@ def test_forward_with_pallas_lookup(monkeypatch):
     img1 = jnp.asarray(rng.randint(0, 255, (1, 64, 80, 3)).astype(np.float32))
     img2 = jnp.asarray(rng.randint(0, 255, (1, 64, 80, 3)).astype(np.float32))
 
-    monkeypatch.setenv('VFT_RAFT_PALLAS', '0')
+    monkeypatch.delenv('VFT_RAFT_PALLAS', raising=False)
+    monkeypatch.setenv('VFT_RAFT_LOOKUP', 'gather')
     ref = np.asarray(raft.forward(params, img1, img2, iters=3))
-    monkeypatch.setenv('VFT_RAFT_PALLAS', '1')
-    got = np.asarray(raft.forward(params, img1, img2, iters=3))
-    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    for impl in ('dense', 'pallas'):
+        monkeypatch.setenv('VFT_RAFT_LOOKUP', impl)
+        got = np.asarray(raft.forward(params, img1, img2, iters=3))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4,
+                                   err_msg=impl)

@@ -1,5 +1,10 @@
 """Pallas TPU kernel for RAFT's correlation-pyramid window lookup.
 
+NOTE: the production default is the gather-free dense-matmul formulation in
+models/raft.py::lookup_corr_dense (measured faster on both TPU and CPU);
+this kernel is the ``VFT_RAFT_LOOKUP=pallas`` alternate, kept as the
+window-slice formulation of the same op.
+
 The reference implements the lookup (reference models/raft/raft_src/corr.py:29-50)
 as 81 independent bilinear samples per pixel per pyramid level — a gather of
 ``N·(2r+1)²·4corners·levels`` scattered elements from HBM on every one of the
@@ -71,13 +76,26 @@ def _level_kernel(p1: int):
     p2 = p1 + 1
 
     def kernel(xs_ref, ys_ref, wx_ref, wy_ref, corr_ref, out_ref):
+        hp = corr_ref.shape[2]
+
         def body(k, _):
             xs = xs_ref[k, 0]
             ys = ys_ref[k, 0]
             wx = wx_ref[k, 0]
             wy = wy_ref[k, 0]
             # corr is transposed: leading spatial dim is x, trailing is y.
-            patch = corr_ref[k, pl.ds(xs, p2), pl.ds(ys, p2)]
+            # Mosaic allows a dynamic-start slice on the sublane dim (xs) but
+            # the lane dim demands 128-aligned starts — so read the full lane
+            # extent and select the p2 columns at dynamic ys with a one-hot
+            # matmul (iota-compare builds the selector; the MXU does the
+            # "slice").
+            rows = corr_ref[k, pl.ds(xs, p2), :]                  # (p2, hp)
+            col = jax.lax.broadcasted_iota(jnp.int32, (hp, p2), 0)
+            j = jax.lax.broadcasted_iota(jnp.int32, (hp, p2), 1)
+            sel = (col == ys + j).astype(rows.dtype)              # (hp, p2)
+            patch = jax.lax.dot_general(
+                rows, sel, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)               # (p2, p2)
             out_ref[k, :, :] = (
                 (1 - wx) * (1 - wy) * patch[0:p1, 0:p1]
                 + wx * (1 - wy) * patch[1:p2, 0:p1]
