@@ -56,3 +56,37 @@ def test_fused_two_stream_step():
         {k: np.asarray(v) for k, v in out.items()})
     assert merged['rgb'].shape == (1, 2048)
     assert 'flow' not in merged
+
+
+@pytest.mark.parametrize('stack,step,total', [
+    (16, 16, 48),   # contiguous windows
+    (16, 8, 50),    # overlapping windows
+    (10, 24, 100),  # gaps between windows (step > stack+1)
+    (16, 16, 10),   # too short: zero windows
+])
+def test_stream_windows_matches_form_slices(stack, step, total):
+    """Streaming windower == form_slices over the fully-decoded video."""
+    import numpy as np
+
+    from video_features_tpu.extract.i3d import ExtractI3D
+    from video_features_tpu.utils.slicing import form_slices
+    from video_features_tpu.utils.tracing import NULL_TRACER
+
+    ex = ExtractI3D.__new__(ExtractI3D)
+    ex.stack_size, ex.step_size, ex.tracer = stack, step, NULL_TRACER
+
+    frames = [np.full((2, 2, 3), i, np.float32) for i in range(total)]
+    # decoder yields ragged batches to exercise buffer bookkeeping
+    batches, i = [], 0
+    for n in ([7, 13, 1, 64] * 10):
+        if i >= total:
+            break
+        batches.append((frames[i:i + n], None, None))
+        i += n
+
+    got = list(ex._stream_windows(batches))
+    want = [np.stack(frames[s:e])
+            for s, e in form_slices(total, stack + 1, step)]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)

@@ -82,3 +82,30 @@ def test_sharded_two_stream_step_matches_single_device():
     for key in ('rgb', 'flow'):
         np.testing.assert_allclose(np.asarray(out[key]), np.asarray(ref[key]),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_extractor_data_parallel_e2e(short_video, tmp_path):
+    """ExtractI3D(data_parallel=true) runs the mesh-sharded step from the
+    normal extract() path and matches the single-device extractor."""
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+
+    common = {
+        'video_paths': short_video, 'device': 'cpu',
+        'streams': 'rgb',                       # rgb-only keeps CPU cost low
+        'stack_size': 16, 'step_size': 16,
+        'concat_rgb_flow': False,
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    }
+    dp = create_extractor(load_config('i3d', overrides={
+        **common, 'data_parallel': True, 'batch_size': 1}))
+    assert dp.mesh.shape['data'] == 4
+    assert dp.batch_size == 4        # global batch rounded up to the data axis
+
+    single = create_extractor(load_config('i3d', overrides=common))
+
+    feats_dp = dp.extract(short_video)
+    feats_single = single.extract(short_video)
+    assert feats_dp['rgb'].shape == feats_single['rgb'].shape
+    np.testing.assert_allclose(feats_dp['rgb'], feats_single['rgb'],
+                               atol=2e-5, rtol=1e-5)

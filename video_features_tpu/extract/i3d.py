@@ -34,7 +34,6 @@ from video_features_tpu.ops.transforms import (
     center_crop, flow_to_uint8_levels, resize_pil, scale_to_pm1,
 )
 from video_features_tpu.utils.device import jax_device
-from video_features_tpu.utils.slicing import form_slices
 
 MIN_SIDE_SIZE = 256
 CROP_SIZE = 224
@@ -100,10 +99,33 @@ class ExtractI3D(BaseExtractor):
         self.show_pred = args.show_pred
         self.output_feat_keys = list(self.streams)
         self._device = jax_device(self.device)
-        self.params = jax.device_put(self.load_params(args), self._device)
-        # pads/streams are static so one executable serves each video geometry
-        self._step = jax.jit(self._stack_batch,
-                             static_argnames=('pads', 'streams'))
+        # data_parallel=true shards stack batches over ALL local devices with
+        # one pjit program (params replicated, RAFT pairs spread over the
+        # time axis) — the reference's only scale-out is launching one
+        # process per GPU (reference README.md:70-84)
+        self.data_parallel = args.get('data_parallel', False)
+        if self.data_parallel:
+            from video_features_tpu.parallel import (
+                build_sharded_two_stream_step, make_mesh, put_replicated,
+            )
+            from video_features_tpu.utils.device import jax_devices_all
+            self.mesh = make_mesh(devices=jax_devices_all(self.device))
+            data_size = self.mesh.shape['data']
+            # batch_size is the global batch; round up to fill the data axis
+            self.batch_size = -(-self.batch_size // data_size) * data_size
+            self.params = put_replicated(self.mesh, self.load_params(args))
+            sharded = build_sharded_two_stream_step(
+                self.mesh, streams=tuple(self.streams))
+
+            def _step(params, stacks, pads, streams):
+                return sharded(params, stacks, pads)
+
+            self._step = _step
+        else:
+            self.params = jax.device_put(self.load_params(args), self._device)
+            # pads/streams are static so one executable serves each geometry
+            self._step = jax.jit(self._stack_batch,
+                                 static_argnames=('pads', 'streams'))
 
     def load_params(self, args):
         """{'rgb': i3d params, 'flow': i3d params, 'raft': raft params}."""
@@ -131,37 +153,75 @@ class ExtractI3D(BaseExtractor):
 
     # -- extraction ---------------------------------------------------------
 
+    def _stream_windows(self, loader) -> 'np.ndarray':
+        """Yield (stack_size+1)-frame windows as frames stream off the
+        decoder — a bounded ring buffer instead of whole-video RAM, and the
+        producer side of the decode/compute overlap (same windowing as
+        form_slices: start = k·step, full windows only — partial final
+        stacks are dropped exactly like the reference, extract_i3d.py:126-129).
+        """
+        win = self.stack_size + 1
+        buf: List[np.ndarray] = []
+        offset = 0          # absolute frame index of buf[0]
+        next_start = 0      # absolute start of the next window
+        for batch, _, _ in self.tracer.wrap_iter('decode+preprocess', loader):
+            buf.extend(batch)
+            # drop frames the next window can no longer touch
+            d = min(next_start - offset, len(buf))
+            if d > 0:
+                del buf[:d]
+                offset += d
+            while next_start + win <= offset + len(buf):
+                s = next_start - offset
+                yield np.stack(buf[s:s + win])
+                next_start += self.step_size
+                d = min(next_start - offset, len(buf))
+                if d > 0:
+                    del buf[:d]
+                    offset += d
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        from video_features_tpu.io.video import prefetch
+
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files,
             transform=lambda f: resize_pil(f, MIN_SIDE_SIZE).astype(np.float32))
-        with self.tracer.stage('decode+preprocess'):
-            frames = np.stack(
-                [f for batch, _, _ in loader for f in batch])  # (T, H, W, 3)
-
-        # stack windows of stack_size+1 frames (B+1 frames → B flow pairs)
-        slices = form_slices(len(frames), self.stack_size + 1, self.step_size)
-        H, W = frames.shape[1:3]
-        pads = raft_model.pad_to_multiple(
-            np.zeros((1, H, W, 1), np.float32))[1]
 
         feats: Dict[str, list] = {s: [] for s in self.streams}
+        pads = None
+        pending: List[np.ndarray] = []
+        window_count = 0
+
+        def flush():
+            nonlocal window_count
+            valid = len(pending)
+            while len(pending) < self.batch_size:  # pad tail, masked below
+                pending.append(pending[-1])
+            stacks = np.stack(pending)
+            pending.clear()
+            with self.tracer.stage('model'):
+                out = self._step(self.params, stacks, pads=tuple(pads),
+                                 streams=tuple(self.streams))
+                for s in self.streams:
+                    feats[s].append(np.asarray(out[s])[:valid])
+            if self.show_pred:
+                self.maybe_show_pred(stacks[:valid], pads, window_count)
+            window_count += valid
+
         with jax.default_matmul_precision('highest'):
-            for start in range(0, len(slices), self.batch_size):
-                window = slices[start:start + self.batch_size]
-                valid = len(window)
-                while len(window) < self.batch_size:  # pad tail, mask below
-                    window = window + [window[-1]]
-                stacks = np.stack([frames[s:e] for s, e in window])
-                with self.tracer.stage('model'):
-                    out = self._step(self.params, stacks, pads=tuple(pads),
-                                     streams=tuple(self.streams))
-                    for s in self.streams:
-                        feats[s].append(np.asarray(out[s])[:valid])
-                if self.show_pred:
-                    self.maybe_show_pred(stacks[:valid], pads, start)
+            # decode thread assembles window k+1 while the device runs k
+            for window in prefetch(self._stream_windows(loader), depth=2):
+                if pads is None:
+                    H, W = window.shape[1:3]
+                    pads = raft_model.pad_to_multiple(
+                        np.zeros((1, H, W, 1), np.float32))[1]
+                pending.append(window)
+                if len(pending) == self.batch_size:
+                    flush()
+            if pending:
+                flush()
 
         return {
             s: (np.concatenate(v, axis=0) if v

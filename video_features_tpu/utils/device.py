@@ -34,3 +34,10 @@ def jax_device(device: str) -> jax.Device:
         platforms = {d.platform for d in jax.devices()}
         platform = next((p for p in platforms if p != 'cpu'), 'cpu')
     return jax.devices(platform)[0]
+
+
+def jax_devices_all(device: str) -> list:
+    """All devices of the platform :func:`jax_device` would resolve to —
+    the device set an in-process data-parallel mesh spans."""
+    first = jax_device(device)
+    return jax.devices(first.platform)
