@@ -1,0 +1,24 @@
+# Runtime image for video_features_tpu.
+#
+# On a Cloud TPU VM the host libtpu is injected by the TPU runtime; for CPU
+# (tests/CI) this image is self-contained. The reference ships a conda/cuda
+# image (reference Dockerfile); here plain pip + the jax TPU wheel is enough.
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        ffmpeg build-essential \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY video_features_tpu ./video_features_tpu
+COPY native ./native
+COPY tools ./tools
+
+# TPU: pip install 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+RUN pip install --no-cache-dir -e .[torch]
+
+# optional native libav decoder (falls back to cv2 when the build is skipped)
+RUN make -C native 2>/dev/null || true
+
+ENTRYPOINT ["python", "-m", "video_features_tpu"]
