@@ -1,0 +1,66 @@
+"""Dual-API equivalence: CLI (save_numpy / save_pickle) vs the import API.
+
+The reference's entire test harness is built on this triangle (reference
+tests/utils.py:107-135): run the CLI twice (numpy + pickle actions), load
+the files back, run ``extractor.extract`` directly, and require all three
+to agree. The CLI here runs in-process through ``cli.main(argv)`` — the
+same code path as ``python -m video_features_tpu`` — which also keeps the
+jit cache warm across the three runs.
+"""
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu import cli
+from video_features_tpu.config import load_config
+from video_features_tpu.registry import create_extractor
+from video_features_tpu.utils.output import load_numpy, load_pickle
+
+KEYS = ('resnet', 'fps', 'timestamps_ms')
+
+
+def _run_cli(video, out, tmp, action):
+    rc = cli.main([
+        'feature_type=resnet', 'model_name=resnet18', 'device=cpu',
+        'batch_size=16', f'video_paths={video}',
+        f'on_extraction={action}', f'output_path={out}', f'tmp_path={tmp}',
+    ])
+    assert rc == 0
+
+
+def _load(out_dir, stem, ext, loader):
+    # make_path: non-'rgb' keys get a _<key> suffix (reference utils/utils.py:56-63)
+    d = Path(out_dir) / 'resnet' / 'resnet18'
+    return {k: loader(str(d / f'{stem}_{k}{ext}')) for k in KEYS}
+
+
+def test_cli_numpy_pickle_import_agree(short_video, tmp_path):
+    stem = Path(short_video).stem
+
+    _run_cli(short_video, tmp_path / 'np_out', tmp_path / 'tmp', 'save_numpy')
+    _run_cli(short_video, tmp_path / 'pk_out', tmp_path / 'tmp', 'save_pickle')
+
+    from_numpy = _load(tmp_path / 'np_out', stem, '.npy', load_numpy)
+    from_pickle = _load(tmp_path / 'pk_out', stem, '.pkl', load_pickle)
+
+    args = load_config('resnet', overrides={
+        'model_name': 'resnet18', 'device': 'cpu', 'batch_size': 16,
+        'video_paths': short_video,
+        'output_path': str(tmp_path / 'im_out'), 'tmp_path': str(tmp_path / 'tmp'),
+    })
+    from_import = create_extractor(args).extract(short_video)
+
+    assert from_numpy['resnet'].shape == from_import['resnet'].shape
+    for k in KEYS:
+        np.testing.assert_allclose(np.asarray(from_numpy[k]),
+                                   np.asarray(from_pickle[k]), atol=0,
+                                   err_msg=f'numpy vs pickle: {k}')
+        np.testing.assert_allclose(np.asarray(from_numpy[k]),
+                                   np.asarray(from_import[k]), atol=1e-6,
+                                   err_msg=f'cli vs import: {k}')
+
+
+def test_cli_unknown_feature_type_lists_known(capsys):
+    with pytest.raises(NotImplementedError, match='i3d'):
+        cli.main(['feature_type=nonsense', 'video_paths=/dev/null'])

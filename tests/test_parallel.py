@@ -109,3 +109,46 @@ def test_extractor_data_parallel_e2e(short_video, tmp_path):
     assert feats_dp['rgb'].shape == feats_single['rgb'].shape
     np.testing.assert_allclose(feats_dp['rgb'], feats_single['rgb'],
                                atol=2e-5, rtol=1e-5)
+
+
+def test_initialize_passthrough_and_already_init(monkeypatch):
+    from video_features_tpu.parallel import distributed
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, 'initialize',
+                        lambda **kw: calls.append(kw))
+    distributed.initialize('host:1234', 4, 2)
+    assert calls == [{'coordinator_address': 'host:1234',
+                      'num_processes': 4, 'process_id': 2}]
+
+    def boom(**kw):
+        raise RuntimeError('backend already initialized')
+    monkeypatch.setattr(jax.distributed, 'initialize', boom)
+    distributed.initialize()  # swallowed
+
+    def other(**kw):
+        raise RuntimeError('connection refused')
+    monkeypatch.setattr(jax.distributed, 'initialize', other)
+    with pytest.raises(RuntimeError, match='connection refused'):
+        distributed.initialize()
+
+
+def test_cli_multihost_shards_worklist(short_video, tmp_path, monkeypatch, capsys):
+    """multihost=true initializes the runtime and takes this host's shard
+    (process 0 of 1 == the full list) without shuffling."""
+    from video_features_tpu import cli
+    from video_features_tpu.parallel import distributed
+
+    inited = []
+    monkeypatch.setattr(distributed, 'initialize',
+                        lambda *a, **k: inited.append(1))
+    rc = cli.main([
+        'feature_type=resnet', 'model_name=resnet18', 'device=cpu',
+        'batch_size=16', f'video_paths={short_video}', 'multihost=true',
+        'on_extraction=save_numpy',
+        f'output_path={tmp_path / "out"}', f'tmp_path={tmp_path / "tmp"}',
+    ])
+    assert rc == 0
+    assert inited == [1]
+    stem = short_video.rsplit('/', 1)[-1].rsplit('.', 1)[0]
+    assert (tmp_path / 'out' / 'resnet' / 'resnet18' / f'{stem}_resnet.npy').exists()

@@ -23,6 +23,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if 'feature_type' not in cli_args:
         print('Usage: python -m video_features_tpu feature_type=<name> [key=value ...]')
         return 2
+    if cli_args.get('multihost'):
+        # must run before anything probes jax devices (sanity_check does)
+        from video_features_tpu.parallel.distributed import initialize
+        initialize()
     args = load_config(cli_args['feature_type'], overrides=cli_args)
 
     print(yaml.safe_dump(dict(args), sort_keys=False, default_flow_style=False))
@@ -32,8 +36,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     extractor = create_extractor(args)
 
+    # multihost: every host runs this same command; each takes a
+    # deterministic interleaved shard of the list (no duplicate work across
+    # healthy hosts) instead of the single-host collision-avoidance shuffle.
+    multihost = bool(args.get('multihost'))
     video_paths = form_list_from_user_input(
-        args.get('video_paths'), args.get('file_with_video_paths'), to_shuffle=True)
+        args.get('video_paths'), args.get('file_with_video_paths'),
+        to_shuffle=not multihost)
+    if multihost:
+        from video_features_tpu.parallel import shard_worklist
+        video_paths = shard_worklist(video_paths)
     print(f'The number of specified videos: {len(video_paths)}')
 
     # profile=true prints per-stage timing tables after each video;
@@ -43,6 +55,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         for i, video_path in enumerate(video_paths):
             print(f'[{i + 1}/{len(video_paths)}] {video_path}')
             extractor._extract(video_path)
+
+    if multihost:
+        # process 0 hosts the coordinator service: hold every process at a
+        # final barrier so a host that drew short videos can't exit and tear
+        # the coordinator down under hosts still extracting
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices('extraction_done')
     return 0
 
 

@@ -5,6 +5,9 @@ nothing multi-process data parallelism only) and what this layer adds
 (in-graph DP over stacks + sequence parallelism over temporal flow pairs,
 with XLA collectives over ICI).
 """
+from video_features_tpu.parallel.distributed import (  # noqa: F401
+    initialize,
+)
 from video_features_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS, TIME_AXIS, batch_sharding, factor_mesh_shape, make_mesh,
     pair_sharding, replicated,

@@ -1,0 +1,60 @@
+"""Multi-host runtime initialization for TPU pods.
+
+The reference has no collective backend at all — its multi-node story is
+"run another process with another device flag over a shared filesystem"
+(reference README.md:70-84). Here multi-host runs are first-class:
+
+  * :func:`initialize` brings up jax's distributed runtime (coordinator
+    discovery, ICI/DCN mesh wiring) — on Cloud TPU pods
+    ``jax.distributed.initialize()`` autodetects everything from the
+    environment, and each host then sees its local chips in
+    ``jax.local_devices()`` and the full slice in ``jax.devices()``;
+  * combined with :func:`~video_features_tpu.parallel.worklist.shard_worklist`
+    (deterministic per-host shard of the video list) and the idempotent
+    output contract, the same launch command works on every host of a pod:
+
+        # on every host of a v5e-64 slice
+        python -m video_features_tpu feature_type=i3d multihost=true \\
+            file_with_video_paths=paths.txt output_path=gs://bucket/feats
+
+    In-graph collectives (the data/time mesh of parallel.mesh) ride ICI
+    within the slice; nothing but the work list and output files crosses
+    DCN — the sharding layout that keeps collectives off the slow network.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up the jax distributed runtime (no-op if already initialized).
+
+    With no arguments, autodetects from the TPU-pod / cluster environment
+    (the common case). Arguments are for manual clusters: a
+    ``host:port`` coordinator, world size, and this host's rank.
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs['coordinator_address'] = coordinator_address
+    if num_processes is not None:
+        kwargs['num_processes'] = num_processes
+    if process_id is not None:
+        kwargs['process_id'] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if 'already initialized' in str(e).lower():
+            return
+        raise
+    except ValueError:
+        if kwargs:
+            raise
+        # Not on a pod/cluster (autodetection found no coordinator). A
+        # single-process run needs no distributed runtime: process_count()
+        # is 1 and the worklist shard is the whole list.
+        print('multihost: no cluster environment detected — '
+              'continuing as a single-process run')

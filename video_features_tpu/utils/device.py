@@ -37,7 +37,13 @@ def jax_device(device: str) -> jax.Device:
 
 
 def jax_devices_all(device: str) -> list:
-    """All devices of the platform :func:`jax_device` would resolve to —
-    the device set an in-process data-parallel mesh spans."""
+    """All LOCAL devices of the platform :func:`jax_device` resolves to —
+    the device set an in-process data-parallel mesh spans.
+
+    Local, not global: under the multi-host runtime each host runs its own
+    video shard (shared-nothing contract), so the in-graph mesh must stay on
+    this host's addressable chips — a pod-global mesh would have every host
+    deadlocking in collectives over different data.
+    """
     first = jax_device(device)
-    return jax.devices(first.platform)
+    return [d for d in jax.local_devices() if d.platform == first.platform]
