@@ -22,6 +22,8 @@ extern "C" {
 #include <libavformat/avformat.h>
 #include <libavutil/display.h>
 #include <libavutil/imgutils.h>
+#include <libavutil/opt.h>
+#include <libswresample/swresample.h>
 #include <libswscale/swscale.h>
 }
 
@@ -231,5 +233,169 @@ long vf_read(void* handle, unsigned char* out, long max_frames) {
 }
 
 void vf_close(void* handle) { destroy((Decoder*)handle); }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Audio: demux + decode + resample to mono float32 at a target rate.
+//
+// Replaces the reference's two-stage ffmpeg subprocess pipeline
+// (mp4 → aac → wav, reference utils/utils.py:197-226) for hosts without an
+// ffmpeg binary: the same libav libraries demux and decode in-process, and
+// libswresample converts straight to the VGGish input format (mono float,
+// 16 kHz) — no temp files, no int16 round-trip.
+
+namespace {
+
+struct AudioDecoder {
+  AVFormatContext* fmt = nullptr;
+  AVCodecContext* codec = nullptr;
+  SwrContext* swr = nullptr;
+  AVPacket* pkt = nullptr;
+  AVFrame* frame = nullptr;
+  int stream_index = -1;
+  int out_rate = 0;
+  std::vector<float> carry;  // resampled samples not yet taken by the caller
+  size_t carry_pos = 0;
+  bool draining = false;
+  bool done = false;
+};
+
+void destroy_audio(AudioDecoder* d) {
+  if (!d) return;
+  if (d->swr) swr_free(&d->swr);
+  if (d->frame) av_frame_free(&d->frame);
+  if (d->pkt) av_packet_free(&d->pkt);
+  if (d->codec) avcodec_free_context(&d->codec);
+  if (d->fmt) avformat_close_input(&d->fmt);
+  delete d;
+}
+
+bool open_audio_impl(AudioDecoder* d, const char* path, int target_rate) {
+  if (avformat_open_input(&d->fmt, path, nullptr, nullptr) < 0)
+    return fail(std::string("cannot open ") + path);
+  if (avformat_find_stream_info(d->fmt, nullptr) < 0)
+    return fail("no stream info");
+  const AVCodec* dec = nullptr;
+  d->stream_index =
+      av_find_best_stream(d->fmt, AVMEDIA_TYPE_AUDIO, -1, -1, &dec, 0);
+  if (d->stream_index < 0 || !dec) return fail("no audio stream");
+  AVStream* st = d->fmt->streams[d->stream_index];
+
+  d->codec = avcodec_alloc_context3(dec);
+  if (!d->codec ||
+      avcodec_parameters_to_context(d->codec, st->codecpar) < 0)
+    return fail("audio codec context setup failed");
+  if (avcodec_open2(d->codec, dec, nullptr) < 0)
+    return fail("cannot open audio codec");
+
+  d->out_rate = target_rate > 0 ? target_rate : d->codec->sample_rate;
+  AVChannelLayout mono = AV_CHANNEL_LAYOUT_MONO;
+  // must be zero-initialized: av_channel_layout_copy() uninit()s dst first,
+  // and stack garbage that looks like AV_CHANNEL_ORDER_CUSTOM would free a
+  // wild u.map pointer
+  AVChannelLayout in_layout = {};
+  if (d->codec->ch_layout.nb_channels > 0)
+    av_channel_layout_copy(&in_layout, &d->codec->ch_layout);
+  else
+    av_channel_layout_default(&in_layout, 1);
+  int ret = swr_alloc_set_opts2(&d->swr, &mono, AV_SAMPLE_FMT_FLT,
+                                d->out_rate, &in_layout,
+                                d->codec->sample_fmt, d->codec->sample_rate,
+                                0, nullptr);
+  av_channel_layout_uninit(&in_layout);
+  if (ret < 0 || !d->swr || swr_init(d->swr) < 0)
+    return fail("resampler setup failed");
+
+  d->pkt = av_packet_alloc();
+  d->frame = av_frame_alloc();
+  if (!d->pkt || !d->frame) return fail("alloc failed");
+  return true;
+}
+
+// Convert one decoded frame (or flush with null) through swr into carry.
+bool push_resampled(AudioDecoder* d, const AVFrame* in) {
+  const uint8_t** src = in ? (const uint8_t**)in->extended_data : nullptr;
+  int in_count = in ? in->nb_samples : 0;
+  int64_t delay = swr_get_delay(d->swr, d->codec->sample_rate) + in_count;
+  int max_out = (int)av_rescale_rnd(delay, d->out_rate,
+                                    d->codec->sample_rate, AV_ROUND_UP) + 32;
+  size_t old = d->carry.size();
+  d->carry.resize(old + max_out);
+  uint8_t* dst[1] = {(uint8_t*)(d->carry.data() + old)};
+  int got = swr_convert(d->swr, dst, max_out, src, in_count);
+  if (got < 0) return false;
+  d->carry.resize(old + got);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* vf_audio_open(const char* path, int target_rate) {
+  AudioDecoder* d = new AudioDecoder();
+  if (!open_audio_impl(d, path, target_rate)) {
+    destroy_audio(d);
+    return nullptr;
+  }
+  return d;
+}
+
+int vf_audio_rate(void* handle) { return ((AudioDecoder*)handle)->out_rate; }
+
+// Decode ≤ max_samples mono float32 samples into out. Returns the number
+// produced, 0 at EOF, <0 on error.
+long vf_audio_read(void* handle, float* out, long max_samples) {
+  AudioDecoder* d = (AudioDecoder*)handle;
+  if (max_samples <= 0) return 0;
+  long produced = 0;
+
+  while (produced < max_samples) {
+    // serve buffered samples first
+    size_t avail = d->carry.size() - d->carry_pos;
+    if (avail > 0) {
+      size_t take = std::min<size_t>(avail, max_samples - produced);
+      std::memcpy(out + produced, d->carry.data() + d->carry_pos,
+                  take * sizeof(float));
+      d->carry_pos += take;
+      produced += (long)take;
+      if (d->carry_pos == d->carry.size()) {
+        d->carry.clear();
+        d->carry_pos = 0;
+      }
+      continue;
+    }
+    if (d->done) break;
+
+    int ret = avcodec_receive_frame(d->codec, d->frame);
+    if (ret == 0) {
+      bool ok = push_resampled(d, d->frame);
+      av_frame_unref(d->frame);
+      if (!ok) return -1;
+      continue;
+    }
+    if (ret == AVERROR_EOF) {
+      if (!push_resampled(d, nullptr)) return -1;  // flush the resampler
+      d->done = true;
+      continue;
+    }
+    if (ret != AVERROR(EAGAIN)) return -2;
+
+    if (d->draining) continue;
+    ret = av_read_frame(d->fmt, d->pkt);
+    if (ret < 0) {
+      avcodec_send_packet(d->codec, nullptr);
+      d->draining = true;
+      continue;
+    }
+    if (d->pkt->stream_index == d->stream_index)
+      avcodec_send_packet(d->codec, d->pkt);
+    av_packet_unref(d->pkt);
+  }
+  return produced;
+}
+
+void vf_audio_close(void* handle) { destroy_audio((AudioDecoder*)handle); }
 
 }  // extern "C"

@@ -128,3 +128,62 @@ def test_rotation_metadata(short_video, tmp_path):
     if cv[0].shape != nat[0].shape:
         pytest.skip('this cv2 build does not auto-rotate')
     np.testing.assert_array_equal(np.stack(nat), np.stack(cv))
+
+
+def test_native_audio_tone_roundtrip(tmp_path):
+    """libswresample path: decode + resample a tone wav to mono 16 kHz."""
+    import wave
+
+    from video_features_tpu.io import native
+
+    if not native.available():
+        pytest.skip('native service unavailable')
+
+    sr_in = 44100
+    t = np.arange(int(sr_in * 1.5)) / sr_in
+    samples = (np.sin(2 * np.pi * 440 * t) * 0.5 * 32767).astype('<i2')
+    path = str(tmp_path / 'tone44k.wav')
+    with wave.open(path, 'wb') as f:
+        f.setnchannels(1)
+        f.setsampwidth(2)
+        f.setframerate(sr_in)
+        f.writeframes(samples.tobytes())
+
+    data, sr = native.read_audio_native(path, 16000)
+    assert sr == 16000
+    assert abs(len(data) - 24000) < 50        # 1.5 s at 16 kHz
+    spec = np.abs(np.fft.rfft(data[:16000]))
+    assert abs(int(np.argmax(spec)) - 440) <= 1   # tone survives resample
+
+
+def test_native_audio_no_track_raises(tmp_path):
+    from video_features_tpu.io import native
+
+    if not native.available():
+        pytest.skip('native service unavailable')
+    bad = tmp_path / 'not_media.mp4'
+    bad.write_bytes(b'\x00' * 128)
+    with pytest.raises(IOError):
+        native.read_audio_native(str(bad), 16000)
+
+
+def test_vggish_native_backend_e2e(sample_video, tmp_path):
+    """mp4 → features with audio_backend=native (no ffmpeg binary needed)."""
+    from video_features_tpu.config import load_config
+    from video_features_tpu.io import native
+    from video_features_tpu.registry import create_extractor
+
+    if not native.available():
+        pytest.skip('native service unavailable')
+
+    args = load_config('vggish', overrides={
+        'video_paths': sample_video, 'device': 'cpu',
+        'audio_backend': 'native',
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    })
+    ex = create_extractor(args)
+    out = ex.extract(sample_video)
+    feats = out['vggish']
+    # the sample clip is ~18 s → 18 examples of 0.96 s
+    assert feats.shape[1] == 128 and feats.shape[0] >= 15
+    assert np.isfinite(feats).all()

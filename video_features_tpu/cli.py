@@ -23,11 +23,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     if 'feature_type' not in cli_args:
         print('Usage: python -m video_features_tpu feature_type=<name> [key=value ...]')
         return 2
-    if cli_args.get('multihost'):
-        # must run before anything probes jax devices (sanity_check does)
+    # single source of truth: multihost must come from the CLI because the
+    # runtime must initialize before anything probes jax devices
+    # (sanity_check inside load_config does) — a config-file value would be
+    # seen too late and silently skip initialization
+    multihost = bool(cli_args.get('multihost'))
+    if multihost:
         from video_features_tpu.parallel.distributed import initialize
         initialize()
     args = load_config(cli_args['feature_type'], overrides=cli_args)
+    if args.get('multihost') and not multihost:
+        raise ValueError(
+            'multihost must be passed on the command line (multihost=true), '
+            'not via a config file: the distributed runtime must initialize '
+            'before device probing')
 
     print(yaml.safe_dump(dict(args), sort_keys=False, default_flow_style=False))
     if args['on_extraction'] in ('save_numpy', 'save_pickle'):
@@ -39,7 +48,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     # multihost: every host runs this same command; each takes a
     # deterministic interleaved shard of the list (no duplicate work across
     # healthy hosts) instead of the single-host collision-avoidance shuffle.
-    multihost = bool(args.get('multihost'))
     video_paths = form_list_from_user_input(
         args.get('video_paths'), args.get('file_with_video_paths'),
         to_shuffle=not multihost)

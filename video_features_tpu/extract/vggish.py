@@ -42,6 +42,13 @@ class ExtractVGGish(BaseExtractor):
             raise NotImplementedError('vggish has no show_pred (reference '
                                       'extract_vggish.py:25-26)')
         self.output_feat_keys = [self.feature_type]
+        # mp4 audio backend: 'ffmpeg' = the reference's mp4→aac→wav
+        # subprocess chain (exact parity, needs an ffmpeg binary); 'native'
+        # = in-process libav demux+decode+resample straight to mono 16 kHz
+        # float (no temp files, no binary); 'auto' = ffmpeg when present.
+        self.audio_backend = args.get('audio_backend', 'auto')
+        assert self.audio_backend in ('auto', 'ffmpeg', 'native'), \
+            self.audio_backend
         # AudioSet-compatible PCA-whiten + uint8 quantization: off by default
         # (the reference's forward(post_process=False) bypasses its vendored
         # Postprocessor, vggish_slim.py:150-156) but available for users who
@@ -72,21 +79,54 @@ class ExtractVGGish(BaseExtractor):
         from video_features_tpu.transplant.torch2jax import transplant
         return transplant(vggish_model.init_state_dict())
 
-    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+    def _read_audio(self, video_path: str):
+        """(waveform, sr, tmp_files_to_clean) for any supported input."""
         from video_features_tpu.io.audio import extract_wav_from_mp4, read_wav
+        from video_features_tpu.io.video import which_ffmpeg
 
         ext = Path(video_path).suffix
-        aac_path = None
-        if ext == '.mp4':
-            wav_path, aac_path = extract_wav_from_mp4(video_path, self.tmp_path)
-        elif ext == '.wav':
-            wav_path = video_path
-        else:
+        if ext == '.wav':
+            data, sr = read_wav(video_path)
+            return data, sr, ()
+        if ext != '.mp4':
             raise NotImplementedError(f'unsupported extension {ext}')
 
+        backend = self.audio_backend
+        if backend == 'auto':
+            if which_ffmpeg():
+                backend = 'ffmpeg'
+            else:
+                from video_features_tpu.io import native
+                if not native.available():
+                    raise RuntimeError(
+                        'no mp4 audio backend available: install an ffmpeg '
+                        'binary (audio_backend=ffmpeg) or a C++ toolchain + '
+                        'libav dev packages for the in-process decoder '
+                        '(audio_backend=native)')
+                backend = 'native'
+        if backend == 'native':
+            from video_features_tpu.io.native import read_audio_native
+            from video_features_tpu.ops.audio import SAMPLE_RATE
+            data, sr = read_audio_native(video_path, SAMPLE_RATE)
+            return data.astype(np.float64), sr, ()
+        wav_path, aac_path = extract_wav_from_mp4(video_path, self.tmp_path)
+        try:
+            data, sr = read_wav(wav_path)
+        except Exception:
+            # the temp files are bound here, not yet at the caller: clean up
+            # so a malformed wav can't leak them
+            if not self.keep_tmp_files:
+                for p in (wav_path, aac_path):
+                    if p and os.path.exists(p):
+                        os.remove(p)
+            raise
+        return data, sr, (wav_path, aac_path)
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        tmp_files = ()
         try:
             with self.tracer.stage('audio_dsp'):
-                data, sr = read_wav(wav_path)
+                data, sr, tmp_files = self._read_audio(video_path)
                 examples = waveform_to_examples(data, sr)  # (N, 96, 64)
             with self.tracer.stage('model'):
                 feats = self._run_batched(examples[..., None])  # NHWC
@@ -94,8 +134,8 @@ class ExtractVGGish(BaseExtractor):
                 feats = np.asarray(vggish_model.postprocess(
                     self._pca_eig, self._pca_means, feats)).astype(np.uint8)
         finally:
-            if not self.keep_tmp_files and ext == '.mp4':
-                for p in (wav_path, aac_path):
+            if not self.keep_tmp_files:
+                for p in tmp_files:
                     if p and os.path.exists(p):
                         os.remove(p)
         return {self.feature_type: feats}

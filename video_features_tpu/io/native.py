@@ -88,6 +88,14 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.vf_rotation.restype = ctypes.c_int
     lib.vf_rotation.argtypes = [ctypes.c_void_p]
     lib.vf_close.argtypes = [ctypes.c_void_p]
+    lib.vf_audio_open.restype = ctypes.c_void_p
+    lib.vf_audio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.vf_audio_rate.restype = ctypes.c_int
+    lib.vf_audio_rate.argtypes = [ctypes.c_void_p]
+    lib.vf_audio_read.restype = ctypes.c_long
+    lib.vf_audio_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_long]
+    lib.vf_audio_close.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -172,3 +180,38 @@ def get_video_props_native(path: str) -> Optional[dict]:
                  height=dec.height, width=dec.width)
     dec.release()
     return props
+
+
+def read_audio_native(path: str, target_sr: int = 0) -> 'tuple':
+    """Decode a file's audio track to mono float32 via the C++ service.
+
+    Returns ``(waveform (T,) float32 in [-1, 1], sample_rate)``. With
+    ``target_sr`` > 0 libswresample converts to that rate in-process —
+    replacing the reference's mp4 → aac → wav ffmpeg-subprocess chain
+    (reference utils/utils.py:197-226) with zero temp files. Raises IOError
+    when the file has no audio track (matching the ffmpeg path's behavior)
+    or RuntimeError when the native service is unavailable.
+    """
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError('native decode service unavailable')
+    handle = lib.vf_audio_open(os.fsencode(str(path)), int(target_sr))
+    if not handle:
+        raise IOError(f'vfdecode audio: {lib.vf_last_error().decode()} ({path})')
+    try:
+        rate = lib.vf_audio_rate(handle)
+        chunk = 1 << 18
+        buf = np.empty(chunk, np.float32)
+        parts = []
+        while True:
+            n = lib.vf_audio_read(handle, buf.ctypes.data, chunk)
+            if n < 0:
+                raise IOError(f'vfdecode audio: decode error {n} ({path})')
+            if n == 0:
+                break
+            parts.append(buf[:n].copy())
+        data = (np.concatenate(parts) if parts
+                else np.zeros((0,), np.float32))
+        return data, rate
+    finally:
+        lib.vf_audio_close(handle)
