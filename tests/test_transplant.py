@@ -58,3 +58,51 @@ def test_transplant_dtype_cast():
     sd = {'fc.weight': np.ones((2, 2), np.float16)}
     tree = transplant(sd, dtype=np.float32)
     assert tree['fc']['weight'].dtype == np.float32
+
+
+def test_npz_roundtrip_and_torchfree_load(tmp_path):
+    """save_transplanted → load via load_torch_checkpoint('.npz') preserves
+    the exact pytree (torch-free deployment path)."""
+    from video_features_tpu.models import r21d as r21d_model
+    from video_features_tpu.transplant.torch2jax import (
+        load_torch_checkpoint, save_transplanted, transplant,
+    )
+
+    params = transplant(r21d_model.init_state_dict(seed=3))
+    path = str(tmp_path / 'ckpt.npz')
+    save_transplanted(params, path)
+    loaded = load_torch_checkpoint(path)
+
+    def flatten(t, p=''):
+        for k, v in t.items():
+            if isinstance(v, dict):
+                yield from flatten(v, f'{p}{k}.')
+            else:
+                yield f'{p}{k}', v
+
+    a, b = dict(flatten(params)), dict(flatten(loaded))
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_npz_end_to_end_in_extractor(tmp_path, short_video):
+    """An extractor consumes a .npz checkpoint_path with no torch import."""
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    from video_features_tpu.models import resnet as resnet_model
+    from video_features_tpu.transplant.torch2jax import (
+        save_transplanted, transplant,
+    )
+
+    params = transplant(resnet_model.init_state_dict(arch='resnet18'))
+    ckpt = str(tmp_path / 'resnet18.npz')
+    save_transplanted(params, ckpt)
+
+    args = load_config('resnet', overrides={
+        'model_name': 'resnet18', 'device': 'cpu', 'batch_size': 16,
+        'video_paths': short_video, 'checkpoint_path': ckpt,
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    })
+    out = create_extractor(args).extract(short_video)
+    assert out['resnet'].shape[1] == 512

@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""Convert a PyTorch checkpoint to a torch-free .npz the extractors load.
+
+Run once on any machine with torch installed; the output .npz contains the
+fully transplanted JAX pytree (layout transposes, DataParallel-prefix
+stripping, fp16 upcast all already applied), so production TPU hosts need
+no torch:
+
+    python tools/convert_checkpoint.py raft-sintel.pth raft-sintel.npz
+    python -m video_features_tpu feature_type=raft \
+        checkpoint_path=raft-sintel.npz ...
+
+``--key`` selects a sub-dict for wrapped checkpoints; ``--no-transpose``
+names 2-D weights that must keep torch layout (embedding tables).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+# runnable as a repo script without installation: python tools/convert_checkpoint.py
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('src', help='input .pt/.pth torch checkpoint')
+    ap.add_argument('dst', help='output .npz path')
+    ap.add_argument('--key', default=None,
+                    help="sub-dict key (e.g. 'state_dict') for wrapped ckpts")
+    ap.add_argument('--no-transpose', nargs='*', default=None,
+                    help='weight names to keep in torch layout')
+    ns = ap.parse_args()
+
+    from video_features_tpu.transplant.torch2jax import (
+        _flatten, load_torch_checkpoint, save_transplanted,
+    )
+
+    params = load_torch_checkpoint(
+        ns.src, key=ns.key,
+        no_transpose=set(ns.no_transpose) if ns.no_transpose else None)
+    flat = _flatten(params)
+    if not flat:
+        raise SystemExit(f'no arrays found in {ns.src} (wrong --key?)')
+    save_transplanted(params, ns.dst)
+
+    arrays = list(flat.values())
+    print(f'wrote {ns.dst}: {len(arrays)} arrays, '
+          f'{sum(a.nbytes for a in arrays) / 1e6:.1f} MB '
+          f'(dtype {arrays[0].dtype})')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -96,11 +96,18 @@ def transplant(state_dict: Mapping[str, Any],
 def load_torch_checkpoint(path: str, dtype: Optional[np.dtype] = np.float32,
                           key: Optional[str] = None,
                           no_transpose: Optional[set] = None) -> Dict[str, Any]:
-    """Load a .pt/.pth checkpoint via torch (CPU) and transplant it.
+    """Load a checkpoint and transplant it to a JAX pytree.
 
-    ``key`` selects a sub-dict for checkpoints that wrap the state_dict
-    (e.g. {'state_dict': ...} or {'model': ...}).
+    ``.pt``/``.pth`` files are read via torch (CPU build is enough).
+    ``.npz`` files are pre-transplanted archives written by
+    :func:`save_transplanted` (or tools/convert_checkpoint.py) — loading
+    them needs NO torch at all, which is how production TPU hosts deploy.
+    ``key`` selects a sub-dict for torch checkpoints that wrap the
+    state_dict (e.g. {'state_dict': ...} or {'model': ...}).
     """
+    if str(path).endswith('.npz'):
+        return load_transplanted(path)
+
     import torch
 
     ckpt = torch.load(path, map_location='cpu', weights_only=False)
@@ -109,3 +116,29 @@ def load_torch_checkpoint(path: str, dtype: Optional[np.dtype] = np.float32,
     elif isinstance(ckpt, dict) and 'state_dict' in ckpt:
         ckpt = ckpt['state_dict']
     return transplant(ckpt, dtype=dtype, no_transpose=no_transpose)
+
+
+def _flatten(tree: Mapping[str, Any], prefix: str = '') -> Dict[str, np.ndarray]:
+    flat: Dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        name = f'{prefix}{k}'
+        if isinstance(v, Mapping):
+            flat.update(_flatten(v, f'{name}.'))
+        else:
+            flat[name] = np.asarray(v)
+    return flat
+
+
+def save_transplanted(params: Mapping[str, Any], path: str) -> None:
+    """Write a transplanted pytree as a flat .npz (dot-joined keys).
+
+    The inverse of :func:`load_transplanted`; lets a torch-equipped machine
+    convert checkpoints once so TPU hosts run torch-free.
+    """
+    np.savez(path, **_flatten(params))
+
+
+def load_transplanted(path: str) -> Dict[str, Any]:
+    """Read a :func:`save_transplanted` .npz back into the nested pytree."""
+    with np.load(path) as data:
+        return nest({k: data[k] for k in data.files})
