@@ -153,32 +153,12 @@ class ExtractI3D(BaseExtractor):
 
     # -- extraction ---------------------------------------------------------
 
-    def _stream_windows(self, loader) -> 'np.ndarray':
-        """Yield (stack_size+1)-frame windows as frames stream off the
-        decoder — a bounded ring buffer instead of whole-video RAM, and the
-        producer side of the decode/compute overlap (same windowing as
-        form_slices: start = k·step, full windows only — partial final
-        stacks are dropped exactly like the reference, extract_i3d.py:126-129).
-        """
-        win = self.stack_size + 1
-        buf: List[np.ndarray] = []
-        offset = 0          # absolute frame index of buf[0]
-        next_start = 0      # absolute start of the next window
-        for batch, _, _ in self.tracer.wrap_iter('decode+preprocess', loader):
-            buf.extend(batch)
-            # drop frames the next window can no longer touch
-            d = min(next_start - offset, len(buf))
-            if d > 0:
-                del buf[:d]
-                offset += d
-            while next_start + win <= offset + len(buf):
-                s = next_start - offset
-                yield np.stack(buf[s:s + win])
-                next_start += self.step_size
-                d = min(next_start - offset, len(buf))
-                if d > 0:
-                    del buf[:d]
-                    offset += d
+    def _stream_windows(self, loader):
+        """(stack_size+1)-frame windows (B+1 frames → B flow pairs) streamed
+        off the decoder; see extract.streaming for the semantics."""
+        from video_features_tpu.extract.streaming import stream_windows
+        return stream_windows(loader, self.stack_size + 1, self.step_size,
+                              self.tracer, 'decode+preprocess')
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         from video_features_tpu.io.video import prefetch

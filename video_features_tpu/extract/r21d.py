@@ -1,11 +1,12 @@
 """R(2+1)D extractor (reference models/r21d/extract_r21d.py behavior).
 
-TPU-first data path: the whole decoded video becomes one (T, H, W, 3) uint8
-array; sliding windows are a single vectorized gather (stack_indices), and the
-jit-compiled step transforms + runs a FIXED-shape batch of stacks per call
-(ragged tails padded and masked) so XLA compiles exactly once per video
-geometry. The reference instead loops python-side one stack at a time
-(extract_r21d.py:81-85).
+TPU-first data path: frames stream off the decoder into stack windows
+(extract.streaming — bounded memory, decode overlapped with compute via a
+prefetch thread), and the jit-compiled step transforms + runs a FIXED-shape
+batch of stacks per call (ragged tails padded and masked) so XLA compiles
+exactly once per video geometry. The reference instead loads the ENTIRE
+video into RAM (extract_r21d.py:72-74) and loops python-side one stack at a
+time (extract_r21d.py:81-85).
 """
 from __future__ import annotations
 
@@ -17,13 +18,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.io.video import VideoLoader, iter_frame_batches
+from video_features_tpu.io.video import VideoLoader
 from video_features_tpu.models import r21d as r21d_model
 from video_features_tpu.ops.transforms import (
     center_crop, normalize, resize_bilinear, to_float_zero_one,
 )
 from video_features_tpu.utils.device import jax_device
-from video_features_tpu.utils.slicing import stack_indices
 
 # model_name -> (arch, native stack, native step, pred dataset)
 MODEL_CFGS = {
@@ -91,34 +91,48 @@ class ExtractR21D(BaseExtractor):
     # -- extraction ---------------------------------------------------------
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        from video_features_tpu.extract.streaming import stream_windows
+        from video_features_tpu.io.video import prefetch
+
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files)
-        with self.tracer.stage('decode'):
-            frames = np.concatenate(
-                [b for b, _, _ in iter_frame_batches(loader)], axis=0)
+        windows = stream_windows(loader, self.stack_size, self.step_size,
+                                 self.tracer, 'decode')
 
-        idx = stack_indices(len(frames), self.stack_size, self.step_size)
-        num_stacks = idx.shape[0]
-        feats = []
+        feats: list = []
+        pending: list = []
+        window_idx = 0
+
+        def flush():
+            nonlocal window_idx
+            valid = len(pending)
+            while len(pending) < STACK_BATCH:  # pad tail, masked below
+                pending.append(pending[-1])
+            stacks = np.stack(pending)
+            pending.clear()
+            with self.tracer.stage('model'):
+                out = np.asarray(self._step(self.params, stacks))[:valid]
+            feats.append(out)
+            if self.show_pred:
+                for k in range(valid):
+                    start = (window_idx + k) * self.step_size
+                    self.maybe_show_pred(out[k:k + 1], start,
+                                         start + self.stack_size)
+            window_idx += valid
+
         with jax.default_matmul_precision('highest'):
-            for start in range(0, num_stacks, STACK_BATCH):
-                chunk = idx[start:start + STACK_BATCH]
-                valid = chunk.shape[0]
-                if valid < STACK_BATCH:  # pad to the compiled shape, mask later
-                    pad = np.repeat(chunk[-1:], STACK_BATCH - valid, axis=0)
-                    chunk = np.concatenate([chunk, pad], axis=0)
-                stacks = frames[chunk]  # (B, stack, H, W, 3)
-                with self.tracer.stage('model'):
-                    out = np.asarray(self._step(self.params, stacks))[:valid]
-                feats.append(out)
-                if self.show_pred:
-                    for k in range(valid):
-                        s = idx[start + k]
-                        self.maybe_show_pred(out[k:k + 1], int(s[0]), int(s[-1]) + 1)
+            # decode thread assembles stack k+1 while the device runs k
+            for window in prefetch(windows, depth=2):
+                pending.append(window)
+                if len(pending) == STACK_BATCH:
+                    flush()
+            if pending:
+                flush()
 
-        feats = np.concatenate(feats, axis=0) if feats else np.zeros((0, 512), np.float32)
+        feats = (np.concatenate(feats, axis=0) if feats
+                 else np.zeros((0, 512), np.float32))
         return {self.feature_type: feats}
 
     def maybe_show_pred(self, visual_feats: np.ndarray, start_idx: int, end_idx: int):

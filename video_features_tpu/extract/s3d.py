@@ -14,13 +14,12 @@ import jax
 import numpy as np
 
 from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.io.video import VideoLoader, iter_frame_batches
+from video_features_tpu.io.video import VideoLoader
 from video_features_tpu.models import s3d as s3d_model
 from video_features_tpu.ops.transforms import (
     center_crop, resize_bilinear, to_float_zero_one,
 )
 from video_features_tpu.utils.device import jax_device
-from video_features_tpu.utils.slicing import stack_indices
 
 STACK_BATCH = 1  # 64-frame stacks are large; one per device step
 
@@ -44,7 +43,8 @@ class ExtractS3D(BaseExtractor):
         self.output_feat_keys = [self.feature_type]
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
-        self._step = jax.jit(self._forward)
+        # the jit step is built per video: the resize geometry is static
+        # per aspect ratio (see extract())
 
     def load_params(self, args):
         ckpt = args.get('checkpoint_path') if hasattr(args, 'get') else None
@@ -62,33 +62,40 @@ class ExtractS3D(BaseExtractor):
         return s3d_model.forward(params, x, features=True)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        from video_features_tpu.extract.streaming import stream_windows
+        from video_features_tpu.io.video import prefetch
+
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files)
-        with self.tracer.stage('decode'):
-            frames = np.concatenate(
-                [b for b, _, _ in iter_frame_batches(loader)], axis=0)
+        windows = stream_windows(loader, self.stack_size, self.step_size,
+                                 self.tracer, 'decode')
 
-        # short-side 224, torch F.interpolate semantics, static per video
-        h, w = frames.shape[1:3]
-        if h < w:
-            resize_hw = (224, int(224 * w / h))
-        else:
-            resize_hw = (int(224 * h / w), 224)
-        step = jax.jit(partial(self._forward, resize_hw=resize_hw))
-
-        idx = stack_indices(len(frames), self.stack_size, self.step_size)
+        step = None
         feats = []
+        window_idx = 0
         with jax.default_matmul_precision('highest'):
-            for start in range(0, idx.shape[0], STACK_BATCH):
-                chunk = idx[start:start + STACK_BATCH]
+            # decode thread assembles stack k+1 while the device runs k
+            for window in prefetch(windows, depth=2):
+                if step is None:
+                    # short-side 224, torch F.interpolate semantics,
+                    # static per video geometry
+                    h, w = window.shape[1:3]
+                    if h < w:
+                        resize_hw = (224, int(224 * w / h))
+                    else:
+                        resize_hw = (int(224 * h / w), 224)
+                    step = jax.jit(partial(self._forward, resize_hw=resize_hw))
+                stacks = window[None]            # STACK_BATCH == 1
                 with self.tracer.stage('model'):
-                    out = np.asarray(step(self.params, frames[chunk]))
+                    out = np.asarray(step(self.params, stacks))
                 feats.append(out)
                 if self.show_pred:
-                    self.maybe_show_pred(frames[chunk], int(chunk[0][0]),
-                                         int(chunk[-1][-1]) + 1, resize_hw)
+                    start = window_idx * self.step_size
+                    self.maybe_show_pred(stacks, start,
+                                         start + self.stack_size, resize_hw)
+                window_idx += 1
 
         feats = (np.concatenate(feats, axis=0) if feats
                  else np.zeros((0, s3d_model.FEAT_DIM), np.float32))

@@ -1,0 +1,48 @@
+"""Streaming stack-window assembly shared by the stack-based extractors.
+
+The reference loads entire videos into RAM before slicing stacks
+(reference extract_r21d.py:72-74 — "could run out of memory"; the i3d loop
+holds every decoded frame too). Here frames stream off the decoder through
+a bounded ring buffer and windows are emitted as soon as they complete, so
+memory is O(window) and — wrapped in ``io.video.prefetch`` — decode overlaps
+device compute.
+
+Windowing semantics are exactly ``utils.slicing.form_slices``: window k
+starts at ``k·step``; only full windows are emitted (partial final stacks
+are dropped, like the reference, extract_i3d.py:126-129).
+"""
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List
+
+import numpy as np
+
+from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
+
+
+def stream_windows(batches: Iterable, win: int, step: int,
+                   tracer: Tracer = NULL_TRACER,
+                   stage: str = 'decode') -> Iterator[np.ndarray]:
+    """Yield (win, ...)-shaped frame windows from a loader's batch stream.
+
+    ``batches`` iterates ``(batch, times, indices)`` tuples (the VideoLoader
+    protocol); decode work inside ``next()`` is timed under ``stage``.
+    """
+    buf: List[np.ndarray] = []
+    offset = 0          # absolute frame index of buf[0]
+    next_start = 0      # absolute start of the next window
+    for batch, _, _ in tracer.wrap_iter(stage, batches):
+        buf.extend(batch)
+        # drop frames the next window can no longer touch
+        d = min(next_start - offset, len(buf))
+        if d > 0:
+            del buf[:d]
+            offset += d
+        while next_start + win <= offset + len(buf):
+            s = next_start - offset
+            yield np.stack(buf[s:s + win])
+            next_start += step
+            d = min(next_start - offset, len(buf))
+            if d > 0:
+                del buf[:d]
+                offset += d
