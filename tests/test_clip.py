@@ -134,3 +134,72 @@ def test_e2e_extraction(short_video, tmp_path):
     assert out['clip'].shape == (48, 512)
     assert np.isfinite(out['clip']).all()
     assert out['timestamps_ms'].shape == (48,)
+
+
+def test_rn50_image_parity_vs_reference_torch(reference_repo):
+    """ModifiedResNet visual tower parity (reference model.py:94-241)."""
+    CLIP = _load_reference_module(
+        reference_repo, 'models/clip/clip_src/model.py', 'ref_clip_model').CLIP
+    torch.manual_seed(1)
+    model = CLIP(embed_dim=1024, image_resolution=224,
+                 vision_layers=(3, 4, 6, 3), vision_width=64,
+                 vision_patch_size=None, context_length=77, vocab_size=128,
+                 transformer_width=512, transformer_heads=8,
+                 transformer_layers=1)
+    model.eval()
+
+    params = transplant(model.state_dict(),
+                        no_transpose=set(clip_model.NO_TRANSPOSE))
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 224, 224, 3).astype(np.float32)
+
+    with torch.no_grad():
+        ref = model.encode_image(
+            torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    import jax
+    with jax.default_matmul_precision('highest'):
+        ours = np.asarray(clip_model.encode_image(params, x, 'RN50'))
+
+    assert ours.shape == ref.shape == (2, 1024)
+    l2 = np.linalg.norm(ours - ref) / max(np.linalg.norm(ref), 1e-12)
+    assert l2 < 1e-3, f'relative L2 {l2}'
+
+
+def test_npz_checkpoint_with_custom_arch(tmp_path, short_video):
+    """model_name=custom + a pre-transplanted .npz: arch inferred from the
+    pytree, no torch needed at load time (docs/checkpoints.md contract)."""
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    from video_features_tpu.transplant.torch2jax import (
+        save_transplanted, transplant,
+    )
+
+    params = transplant(clip_model.init_state_dict(model_name='ViT-B/32'),
+                        no_transpose=set(clip_model.NO_TRANSPOSE),
+                        dtype=np.float32)
+    ckpt = str(tmp_path / 'clip.npz')
+    save_transplanted(params, ckpt)
+
+    args = load_config('clip', overrides={
+        'model_name': 'custom', 'checkpoint_path': ckpt,
+        'device': 'cpu', 'batch_size': 16, 'video_paths': short_video,
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    })
+    ex = create_extractor(args)
+    assert ex.arch == 'ViT-B/32'
+    out = ex.extract(short_video)
+    assert out['clip'].shape[1] == 512
+
+
+def test_infer_model_name_from_params_rn50(reference_repo):
+    CLIP = _load_reference_module(
+        reference_repo, 'models/clip/clip_src/model.py', 'ref_clip_model').CLIP
+    torch.manual_seed(0)
+    model = CLIP(embed_dim=1024, image_resolution=224,
+                 vision_layers=(3, 4, 6, 3), vision_width=64,
+                 vision_patch_size=None, context_length=77, vocab_size=128,
+                 transformer_width=512, transformer_heads=8,
+                 transformer_layers=1)
+    params = transplant(model.state_dict(),
+                        no_transpose=set(clip_model.NO_TRANSPOSE))
+    assert clip_model.infer_model_name_from_params(params) == 'RN50'

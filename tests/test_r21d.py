@@ -45,3 +45,15 @@ def test_e2e_extraction(short_video, tmp_path):
     saved = np.load(tmp_path / 'out' / 'r21d' / 'r2plus1d_18_16_kinetics'
                     / f'{stem}_r21d.npy')
     np.testing.assert_allclose(saved, f, atol=1e-6)
+
+
+def test_forward_shapes_r34_variants():
+    """The ig65m R(2+1)D-34 registry entries (reference extract_r21d.py:30-43):
+    deeper blocks, 8- and 32-frame stacks, same 512-d features."""
+    params = transplant(r21d_model.init_state_dict(arch='r2plus1d_34'))
+    rng = np.random.RandomState(0)
+    for stack in (8, 32):
+        x = rng.rand(1, stack, 112, 112, 3).astype(np.float32)
+        feats = np.asarray(r21d_model.forward(params, x, arch='r2plus1d_34'))
+        assert feats.shape == (1, 512), stack
+        assert np.isfinite(feats).all()

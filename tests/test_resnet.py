@@ -1,0 +1,16 @@
+"""ResNet backbones: every registry arch builds and produces its feat_dim."""
+import numpy as np
+import pytest
+
+from video_features_tpu.models import resnet as resnet_model
+from video_features_tpu.transplant.torch2jax import transplant
+
+
+@pytest.mark.parametrize('arch', list(resnet_model.ARCHS))
+def test_forward_shapes_all_archs(arch):
+    cfg = resnet_model.ARCHS[arch]
+    params = transplant(resnet_model.init_state_dict(arch=arch))
+    x = np.random.RandomState(0).rand(1, 64, 64, 3).astype(np.float32)
+    feats = np.asarray(resnet_model.forward(params, x, arch=arch))
+    assert feats.shape == (1, cfg['feat_dim']), arch
+    assert np.isfinite(feats).all()

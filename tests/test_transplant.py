@@ -106,3 +106,22 @@ def test_npz_end_to_end_in_extractor(tmp_path, short_video):
     })
     out = create_extractor(args).extract(short_video)
     assert out['resnet'].shape[1] == 512
+
+
+def test_npz_load_applies_dtype_and_rejects_key():
+    import pytest
+
+    from video_features_tpu.transplant.torch2jax import (
+        load_torch_checkpoint, save_transplanted,
+    )
+    import tempfile, os
+    tree = {'a': {'w': np.ones((2, 2), np.float16)},
+            'idx': np.arange(3, dtype=np.int64)}
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, 'x.npz')
+        save_transplanted(tree, path)
+        out = load_torch_checkpoint(path)            # default dtype=float32
+        assert out['a']['w'].dtype == np.float32     # fp16 upcast honored
+        assert out['idx'].dtype == np.int64          # ints untouched
+        with pytest.raises(ValueError, match='already transplanted'):
+            load_torch_checkpoint(path, key='state_dict')

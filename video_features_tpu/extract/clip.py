@@ -35,32 +35,43 @@ class ExtractCLIP(BaseFrameWiseExtractor):
             raise NotImplementedError(
                 f'model_name {self.model_name!r}; known: '
                 f'{", ".join(clip_model.VISUAL_CFGS)} or "custom"')
-        state_dict = self._load_state_dict(args)
-        if self.model_name == 'custom':
-            self.arch = clip_model.infer_model_name(state_dict)
-        else:
+        state_dict, params = self._load_state_dict(args)
+        if self.model_name != 'custom':
             self.arch = self.model_name
+        elif params is not None:  # pre-transplanted .npz: infer from pytree
+            self.arch = clip_model.infer_model_name_from_params(params)
+        else:
+            self.arch = clip_model.infer_model_name(state_dict)
         cfg = clip_model.VISUAL_CFGS[self.arch]
         super().__init__(args, feat_dim=cfg['embed_dim'])
         self.input_resolution = cfg['input_resolution']
         self.pred_texts: Optional[List[str]] = (
             list(args.pred_texts) if args.get('pred_texts') else None)
         self._device = jax_device(self.device)
-        from video_features_tpu.transplant.torch2jax import transplant
-        self.params = jax.device_put(
-            transplant(state_dict, no_transpose=set(clip_model.NO_TRANSPOSE),
-                       dtype=np.float32),
-            self._device)
+        if params is None:
+            from video_features_tpu.transplant.torch2jax import transplant
+            params = transplant(state_dict,
+                                no_transpose=set(clip_model.NO_TRANSPOSE),
+                                dtype=np.float32)
+        self.params = jax.device_put(params, self._device)
         self._step = jax.jit(partial(self._forward, arch=self.arch))
         self._text_feats: Optional[np.ndarray] = None
 
     def _load_state_dict(self, args):
-        """Checkpoint sources: explicit path, or 'custom' → CLIP-custom.pth
-        (reference extract_clip.py:55-61). OpenAI URL download needs network
-        — a local path must be provided in this environment."""
+        """Checkpoint sources → (torch_state_dict, transplanted_params);
+        exactly one is non-None. Sources: explicit path (a torch .pt/.pth,
+        or a pre-transplanted .npz for torch-free hosts — see
+        docs/checkpoints.md), or 'custom' → CLIP-custom.pth (reference
+        extract_clip.py:55-61). OpenAI URL download needs network — a local
+        path must be provided in this environment."""
         ckpt = args.get('checkpoint_path')
         if self.model_name == 'custom' and not ckpt:
             ckpt = './checkpoints/CLIP-custom.pth'
+        if ckpt and str(ckpt).endswith('.npz'):
+            from video_features_tpu.transplant.torch2jax import (
+                load_transplanted,
+            )
+            return None, load_transplanted(ckpt)
         if ckpt:
             import torch
             sd = torch.load(ckpt, map_location='cpu', weights_only=False)
@@ -68,8 +79,8 @@ class ExtractCLIP(BaseFrameWiseExtractor):
                 sd = sd.state_dict()
             if isinstance(sd, dict) and 'state_dict' in sd:
                 sd = sd['state_dict']
-            return sd
-        return clip_model.init_state_dict(model_name=args.model_name)
+            return sd, None
+        return clip_model.init_state_dict(model_name=args.model_name), None
 
     @staticmethod
     def _forward(params, batch, arch):

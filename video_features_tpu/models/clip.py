@@ -240,6 +240,30 @@ def infer_model_name(state_dict) -> str:
         f'unrecognized ModifiedResNet: width={width} layers={layers}')
 
 
+def infer_model_name_from_params(params) -> str:
+    """:func:`infer_model_name` for an already-transplanted pytree (the
+    .npz checkpoint path): same detection on HWIO conv layouts."""
+    visual = params['visual']
+    if 'proj' in visual:  # ViT tower
+        w = visual['conv1']['weight'].shape        # (patch, patch, 3, width)
+        width, patch = w[-1], w[0]
+        layers = len(visual['transformer']['resblocks'])
+        for name, cfg in VISUAL_CFGS.items():
+            if (cfg['kind'] == 'vit' and cfg['width'] == width
+                    and cfg['patch'] == patch and cfg['layers'] == layers):
+                return name
+        raise NotImplementedError(
+            f'unrecognized ViT: width={width} patch={patch} layers={layers}')
+    width = visual['layer1']['0']['conv1']['weight'].shape[-1]
+    layers = tuple(len(visual[f'layer{li}']) for li in (1, 2, 3, 4))
+    for name, cfg in VISUAL_CFGS.items():
+        if (cfg['kind'] == 'resnet' and cfg['width'] == width
+                and tuple(cfg['layers']) == layers):
+            return name
+    raise NotImplementedError(
+        f'unrecognized ModifiedResNet: width={width} layers={layers}')
+
+
 # -- random init for tests ---------------------------------------------------
 
 def init_state_dict(seed: int = 0, model_name: str = 'ViT-B/32',

@@ -106,7 +106,19 @@ def load_torch_checkpoint(path: str, dtype: Optional[np.dtype] = np.float32,
     state_dict (e.g. {'state_dict': ...} or {'model': ...}).
     """
     if str(path).endswith('.npz'):
-        return load_transplanted(path)
+        if key is not None or no_transpose is not None:
+            raise ValueError(
+                '.npz archives are already transplanted: key/no_transpose '
+                'were applied at conversion time and cannot be re-applied')
+        params = load_transplanted(path)
+        if dtype is not None:
+            def cast(tree):
+                return {k: (cast(v) if isinstance(v, dict) else
+                            (v.astype(dtype)
+                             if np.issubdtype(v.dtype, np.floating) else v))
+                        for k, v in tree.items()}
+            params = cast(params)
+        return params
 
     import torch
 
