@@ -152,3 +152,26 @@ def test_cli_multihost_shards_worklist(short_video, tmp_path, monkeypatch, capsy
     assert inited == [1]
     stem = short_video.rsplit('/', 1)[-1].rsplit('.', 1)[0]
     assert (tmp_path / 'out' / 'resnet' / 'resnet18' / f'{stem}_resnet.npy').exists()
+
+
+def test_framewise_data_parallel_matches_single_device(short_video, tmp_path):
+    """ResNet with data_parallel=true: mesh-sharded batches == single-device."""
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+
+    common = {
+        'model_name': 'resnet18', 'device': 'cpu', 'batch_size': 16,
+        'video_paths': short_video,
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    }
+    dp = create_extractor(load_config('resnet', overrides={
+        **common, 'data_parallel': True}))
+    single = create_extractor(load_config('resnet', overrides=common))
+
+    feats_dp = dp.extract(short_video)
+    assert dp._mesh is not None and dp.batch_size % dp._mesh.shape['data'] == 0
+    feats_single = single.extract(short_video)
+    np.testing.assert_allclose(feats_dp['resnet'], feats_single['resnet'],
+                               atol=2e-5, rtol=1e-5)
+    np.testing.assert_array_equal(feats_dp['timestamps_ms'],
+                                  feats_single['timestamps_ms'])

@@ -91,3 +91,30 @@ def test_transform_applied(sample_video):
 def test_fps_and_total_mutually_exclusive(sample_video):
     with pytest.raises(ValueError):
         VideoLoader(sample_video, fps=10, total=10)
+
+
+def test_transform_workers_preserve_order_and_values(short_video):
+    """Threaded host transforms must equal the serial path exactly,
+    including frame order and timestamps."""
+    def tf(frame):
+        return frame[:8, :8].astype(np.float32) / 255.0
+
+    serial = VideoLoader(short_video, batch_size=7, transform=tf)
+    threaded = VideoLoader(short_video, batch_size=7, transform=tf,
+                           transform_workers=4)
+    out_s = [(np.stack(b), t, i) for b, t, i in serial]
+    out_t = [(np.stack(b), t, i) for b, t, i in threaded]
+    assert len(out_s) == len(out_t) > 0
+    for (bs, ts, idx_s), (bt, tt, idx_t) in zip(out_s, out_t):
+        np.testing.assert_array_equal(bs, bt)
+        assert ts == tt and idx_s == idx_t
+
+
+def test_transform_worker_exception_propagates(short_video):
+    def bad(frame):
+        raise ValueError('boom')
+
+    loader = VideoLoader(short_video, batch_size=4, transform=bad,
+                         transform_workers=2)
+    with pytest.raises(ValueError, match='boom'):
+        next(iter(loader))

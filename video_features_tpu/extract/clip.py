@@ -68,10 +68,12 @@ class ExtractCLIP(BaseFrameWiseExtractor):
         if self.model_name == 'custom' and not ckpt:
             ckpt = './checkpoints/CLIP-custom.pth'
         if ckpt and str(ckpt).endswith('.npz'):
+            # via load_torch_checkpoint for the same float32 upcast the
+            # .pt path (and every other extractor) applies
             from video_features_tpu.transplant.torch2jax import (
-                load_transplanted,
+                load_torch_checkpoint,
             )
-            return None, load_transplanted(ckpt)
+            return None, load_torch_checkpoint(ckpt)
         if ckpt:
             import torch
             sd = torch.load(ckpt, map_location='cpu', weights_only=False)

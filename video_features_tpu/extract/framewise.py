@@ -33,6 +33,14 @@ class BaseFrameWiseExtractor(BaseExtractor):
             profile=args.get('profile', False),
         )
         self.batch_size = args.batch_size
+        self.decode_workers = int(args.get('decode_workers', 1))
+        # data_parallel=true shards frame batches over ALL local devices:
+        # params are re-placed replicated and batches arrive with a
+        # data-axis sharding, so the subclass's jitted step compiles into
+        # one pjit program with XLA-inserted collectives (reference
+        # scale-out is one process per GPU, README.md:70-84)
+        self.data_parallel = args.get('data_parallel', False)
+        self._mesh = None
         self.extraction_fps = args.get('extraction_fps')
         self.extraction_total = args.get('extraction_total')
         self.show_pred = args.show_pred
@@ -51,7 +59,27 @@ class BaseFrameWiseExtractor(BaseExtractor):
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         pass
 
+    def _ensure_mesh(self) -> None:
+        """Lazy: subclasses set self.params after super().__init__."""
+        if self._mesh is not None:
+            return
+        import jax as _jax
+
+        from video_features_tpu.parallel import (
+            batch_sharding, make_mesh, replicated,
+        )
+        from video_features_tpu.utils.device import jax_devices_all
+        self._mesh = make_mesh(devices=jax_devices_all(self.device),
+                               time_parallel=1)
+        data_size = self._mesh.shape['data']
+        # batch_size becomes the global batch; round up to fill the mesh
+        self.batch_size = -(-self.batch_size // data_size) * data_size
+        self.params = _jax.device_put(self.params, replicated(self._mesh))
+        self._batch_sharding = batch_sharding(self._mesh)
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        if self.data_parallel:
+            self._ensure_mesh()
         loader = VideoLoader(
             video_path,
             batch_size=self.batch_size,
@@ -60,6 +88,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
             tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files,
             transform=self.host_transform,
+            transform_workers=self.decode_workers,
         )
         feats, timestamps = [], []
         # wrap_iter times decode+preprocess on the prefetch producer thread
@@ -73,6 +102,8 @@ class BaseFrameWiseExtractor(BaseExtractor):
                 if valid < self.batch_size:  # pad tail to the compiled shape
                     pad = np.repeat(batch[-1:], self.batch_size - valid, axis=0)
                     batch = np.concatenate([batch, pad], axis=0)
+                if self._mesh is not None:
+                    batch = jax.device_put(batch, self._batch_sharding)
                 with self.tracer.stage('model'):
                     out = np.asarray(self.device_step(batch))[:valid]
                 feats.append(out)

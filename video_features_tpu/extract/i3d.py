@@ -96,6 +96,7 @@ class ExtractI3D(BaseExtractor):
         self.step_size = 64 if args.step_size is None else args.step_size
         self.extraction_fps = args.extraction_fps
         self.batch_size = args.get('batch_size', 1)
+        self.decode_workers = int(args.get('decode_workers', 1))
         self.show_pred = args.show_pred
         self.output_feat_keys = list(self.streams)
         self._device = jax_device(self.device)
@@ -167,7 +168,8 @@ class ExtractI3D(BaseExtractor):
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files,
-            transform=lambda f: resize_pil(f, MIN_SIDE_SIZE).astype(np.float32))
+            transform=lambda f: resize_pil(f, MIN_SIDE_SIZE).astype(np.float32),
+            transform_workers=self.decode_workers)
 
         feats: Dict[str, list] = {s: [] for s in self.streams}
         pads = None

@@ -47,6 +47,7 @@ class ExtractRAFT(BaseExtractor):
             profile=args.get('profile', False),
         )
         self.batch_size = args.batch_size
+        self.decode_workers = int(args.get('decode_workers', 1))
         self.side_size = args.get('side_size')
         self.resize_to_smaller_edge = args.get('resize_to_smaller_edge', True)
         self.extraction_fps = args.get('extraction_fps')
@@ -89,6 +90,7 @@ class ExtractRAFT(BaseExtractor):
             tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files,
             transform=self.host_transform,
+            transform_workers=self.decode_workers,
             overlap=1,
         )
         flows, timestamps = [], []

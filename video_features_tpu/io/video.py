@@ -134,6 +134,10 @@ class VideoLoader:
         transform: per-frame callable (HWC uint8 RGB → anything). When None,
             raw frames are returned and batches arrive stacked as one
             (B, H, W, 3) uint8 array.
+        transform_workers: >1 runs the transform over a thread pool,
+            pipelined ahead of the consumer (PIL/cv2 release the GIL in
+            their core loops, so host preprocessing scales with threads —
+            it is the usual bottleneck once the device is fast).
         overlap: frames shared between consecutive batches (flow pairing).
         use_ffmpeg: force/forbid the ffmpeg re-encode backend; default: use
             it iff a binary is present (exact reference parity), else the
@@ -151,18 +155,21 @@ class VideoLoader:
         tmp_path: Union[str, os.PathLike] = 'tmp',
         keep_tmp: bool = False,
         transform: Optional[Callable] = None,
+        transform_workers: int = 1,
         overlap: int = 0,
         use_ffmpeg: Optional[bool] = None,
         backend: str = 'auto',
     ):
         assert isinstance(batch_size, int) and batch_size > 0
         assert isinstance(overlap, int) and 0 <= overlap < batch_size
+        assert isinstance(transform_workers, int) and transform_workers >= 1
         if fps is not None and total is not None:
             raise ValueError("'fps' and 'total' are mutually exclusive")
 
         assert backend in ('auto', 'native', 'cv2'), backend
         self.batch_size = batch_size
         self.transform = transform
+        self.transform_workers = transform_workers if transform else 1
         self.overlap = overlap
         self.keep_tmp = keep_tmp
         self.backend = backend
@@ -201,6 +208,11 @@ class VideoLoader:
 
     def __iter__(self):
         self._frames = self._retimed_frames()
+        self._pre_transformed = False
+        if self.transform_workers > 1:
+            self._frames = _parallel_map(self.transform, self._frames,
+                                         self.transform_workers)
+            self._pre_transformed = True
         self._cache: List = []
         self._cache_times: List[float] = []
         self._cache_indices: List[int] = []
@@ -276,7 +288,9 @@ class VideoLoader:
             self._out_idx += 1
             times.append(idx / self.fps * 1000)
             indices.append(idx)
-            batch.append(self.transform(frame) if self.transform is not None else frame)
+            if self.transform is not None and not self._pre_transformed:
+                frame = self.transform(frame)
+            batch.append(frame)
             new_frames += 1
 
         # a batch of only cached overlap frames carries no new information
@@ -309,6 +323,25 @@ def iter_frame_batches(loader: VideoLoader) -> Iterator[Tuple[np.ndarray, List[f
         if isinstance(batch, list):
             batch = np.stack(batch)
         yield batch, times, indices
+
+
+def _parallel_map(fn, iterable, workers: int):
+    """Ordered parallel map with bounded lookahead (host preprocessing).
+
+    Keeps ``2·workers`` frames in flight on a thread pool; PIL/cv2 release
+    the GIL in their core loops so per-frame transforms scale with threads.
+    """
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        pending = deque()
+        for item in iterable:
+            pending.append(pool.submit(fn, item))
+            if len(pending) > 2 * workers:
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
 
 
 def prefetch(iterable, depth: int = 2):

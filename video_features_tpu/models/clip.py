@@ -210,6 +210,19 @@ def zero_shot_logits(params: Params, image_feats: jax.Array,
     return scale * img @ txt.T
 
 
+def _match_visual_cfg(kind: str, width: int, layers, patch=None) -> str:
+    """Map extracted tower dimensions onto a VISUAL_CFGS key."""
+    for name, cfg in VISUAL_CFGS.items():
+        if cfg['kind'] != kind or cfg['width'] != width:
+            continue
+        if kind == 'vit' and cfg['patch'] == patch and cfg['layers'] == layers:
+            return name
+        if kind == 'resnet' and tuple(cfg['layers']) == tuple(layers):
+            return name
+    raise NotImplementedError(
+        f'unrecognized {kind}: width={width} patch={patch} layers={layers}')
+
+
 def infer_model_name(state_dict) -> str:
     """Detect the architecture from a raw torch state_dict, the way the
     reference's build_model does (reference clip_src/model.py:399-417), and
@@ -222,22 +235,12 @@ def infer_model_name(state_dict) -> str:
         patch = shape('visual.conv1.weight')[-1]
         layers = len({k.split('.')[3] for k in state_dict
                       if k.startswith('visual.transformer.resblocks.')})
-        for name, cfg in VISUAL_CFGS.items():
-            if (cfg['kind'] == 'vit' and cfg['width'] == width
-                    and cfg['patch'] == patch and cfg['layers'] == layers):
-                return name
-        raise NotImplementedError(
-            f'unrecognized ViT: width={width} patch={patch} layers={layers}')
+        return _match_visual_cfg('vit', width, layers, patch)
     width = shape('visual.layer1.0.conv1.weight')[0]
     layers = tuple(
         len({k.split('.')[2] for k in state_dict
              if k.startswith(f'visual.layer{li}.')}) for li in (1, 2, 3, 4))
-    for name, cfg in VISUAL_CFGS.items():
-        if (cfg['kind'] == 'resnet' and cfg['width'] == width
-                and tuple(cfg['layers']) == layers):
-            return name
-    raise NotImplementedError(
-        f'unrecognized ModifiedResNet: width={width} layers={layers}')
+    return _match_visual_cfg('resnet', width, layers)
 
 
 def infer_model_name_from_params(params) -> str:
@@ -246,22 +249,11 @@ def infer_model_name_from_params(params) -> str:
     visual = params['visual']
     if 'proj' in visual:  # ViT tower
         w = visual['conv1']['weight'].shape        # (patch, patch, 3, width)
-        width, patch = w[-1], w[0]
         layers = len(visual['transformer']['resblocks'])
-        for name, cfg in VISUAL_CFGS.items():
-            if (cfg['kind'] == 'vit' and cfg['width'] == width
-                    and cfg['patch'] == patch and cfg['layers'] == layers):
-                return name
-        raise NotImplementedError(
-            f'unrecognized ViT: width={width} patch={patch} layers={layers}')
+        return _match_visual_cfg('vit', w[-1], layers, w[0])
     width = visual['layer1']['0']['conv1']['weight'].shape[-1]
     layers = tuple(len(visual[f'layer{li}']) for li in (1, 2, 3, 4))
-    for name, cfg in VISUAL_CFGS.items():
-        if (cfg['kind'] == 'resnet' and cfg['width'] == width
-                and tuple(cfg['layers']) == layers):
-            return name
-    raise NotImplementedError(
-        f'unrecognized ModifiedResNet: width={width} layers={layers}')
+    return _match_visual_cfg('resnet', width, layers)
 
 
 # -- random init for tests ---------------------------------------------------
