@@ -90,3 +90,32 @@ def test_stream_windows_matches_form_slices(stack, step, total):
     assert len(got) == len(want)
     for g, w in zip(got, want):
         np.testing.assert_array_equal(g, w)
+
+
+def test_show_pred_covers_both_streams(capsys):
+    """Reference parity: the classifier head prints top-5 for EVERY stream
+    (reference extract_i3d.py:212-216), flow included."""
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import numpy as np
+
+    from video_features_tpu.extract.i3d import ExtractI3D
+    from video_features_tpu.models import i3d as i3d_model
+    from video_features_tpu.models import raft as raft_model
+    from video_features_tpu.transplant.torch2jax import transplant
+
+    ex = ExtractI3D.__new__(ExtractI3D)
+    ex.streams = ['rgb', 'flow']
+    ex.params = {
+        'rgb': transplant(i3d_model.init_state_dict(modality='rgb')),
+        'flow': transplant(i3d_model.init_state_dict(modality='flow')),
+        'raft': transplant(raft_model.init_state_dict()),
+    }
+    stacks = np.random.RandomState(0).randint(
+        0, 255, (1, 11, 64, 64, 3)).astype(np.float32)
+    with jax.default_matmul_precision('highest'):
+        ex.maybe_show_pred(stacks, (0, 0, 0, 0), stack_counter=0)
+    out = capsys.readouterr().out
+    assert 'At stack 0 (rgb stream)' in out
+    assert 'At stack 0 (flow stream)' in out
+    assert out.count('Logits') == 2

@@ -63,19 +63,18 @@ class BaseFrameWiseExtractor(BaseExtractor):
         """Lazy: subclasses set self.params after super().__init__."""
         if self._mesh is not None:
             return
-        import jax as _jax
+        from functools import partial
 
         from video_features_tpu.parallel import (
-            batch_sharding, make_mesh, replicated,
+            make_mesh, put_batch, put_replicated, round_batch_to_data_axis,
         )
         from video_features_tpu.utils.device import jax_devices_all
         self._mesh = make_mesh(devices=jax_devices_all(self.device),
                                time_parallel=1)
-        data_size = self._mesh.shape['data']
         # batch_size becomes the global batch; round up to fill the mesh
-        self.batch_size = -(-self.batch_size // data_size) * data_size
-        self.params = _jax.device_put(self.params, replicated(self._mesh))
-        self._batch_sharding = batch_sharding(self._mesh)
+        self.batch_size = round_batch_to_data_axis(self.batch_size, self._mesh)
+        self.params = put_replicated(self._mesh, self.params)
+        self._put_batch = partial(put_batch, self._mesh)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         if self.data_parallel:
@@ -103,7 +102,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
                     pad = np.repeat(batch[-1:], self.batch_size - valid, axis=0)
                     batch = np.concatenate([batch, pad], axis=0)
                 if self._mesh is not None:
-                    batch = jax.device_put(batch, self._batch_sharding)
+                    batch = self._put_batch(batch)
                 with self.tracer.stage('model'):
                     out = np.asarray(self.device_step(batch))[:valid]
                 feats.append(out)

@@ -39,6 +39,29 @@ MIN_SIDE_SIZE = 256
 CROP_SIZE = 224
 
 
+def flow_stream_input(raft_params, stacks, pads, crop_size,
+                      constrain_pairs=None):
+    """(B, S+1, H, W, 3) frames → quantized flow I3D input (B, S, c, c, 2).
+
+    RAFT on /8-padded consecutive pairs, then the kinetics-i3d flow recipe:
+    crop the PADDED flow (the reference never unpads before TensorCenterCrop,
+    extract_i3d.py:156-164) → clamp ±20 → uint8 levels → ±1 rescale.
+    """
+    B, S1, H, W, _ = stacks.shape
+    stack = S1 - 1
+    t, b, l, r = pads
+    padded = jnp.pad(stacks, [(0, 0), (0, 0), (t, b), (l, r), (0, 0)],
+                     mode='edge')
+    f1 = padded[:, :-1].reshape(B * stack, H + t + b, W + l + r, 3)
+    f2 = padded[:, 1:].reshape(B * stack, H + t + b, W + l + r, 3)
+    if constrain_pairs is not None:
+        f1, f2 = constrain_pairs(f1), constrain_pairs(f2)
+    flow = raft_model.forward(raft_params, f1, f2)
+    flow = flow.reshape(B, stack, H + t + b, W + l + r, 2)
+    flow = center_crop(flow, crop_size)
+    return scale_to_pm1(flow_to_uint8_levels(flow, 20.0))
+
+
 def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
                           crop_size=CROP_SIZE):
     """(B, stack+1, H, W, 3) float frames → {stream: (B, 1024)}.
@@ -49,26 +72,14 @@ def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
     tensors so the RAFT sub-graph spreads over a (data, time) mesh
     (sequence parallelism over temporal pairs — see parallel.mesh).
     """
-    B, S1, H, W, _ = stacks.shape
-    stack = S1 - 1
     out = {}
     if 'rgb' in streams:
         rgb = center_crop(stacks[:, :-1], crop_size)
         rgb = scale_to_pm1(rgb)
         out['rgb'] = i3d_model.forward(params['rgb'], rgb, features=True)
     if 'flow' in streams:
-        t, b, l, r = pads
-        padded = jnp.pad(stacks, [(0, 0), (0, 0), (t, b), (l, r), (0, 0)],
-                         mode='edge')
-        f1 = padded[:, :-1].reshape(B * stack, H + t + b, W + l + r, 3)
-        f2 = padded[:, 1:].reshape(B * stack, H + t + b, W + l + r, 3)
-        if constrain_pairs is not None:
-            f1, f2 = constrain_pairs(f1), constrain_pairs(f2)
-        flow = raft_model.forward(params['raft'], f1, f2)
-        flow = flow.reshape(B, stack, H + t + b, W + l + r, 2)
-        # reference crops the PADDED flow (never unpads, extract_i3d.py:156-164)
-        flow = center_crop(flow, crop_size)
-        flow = scale_to_pm1(flow_to_uint8_levels(flow, 20.0))
+        flow = flow_stream_input(params['raft'], stacks, pads, crop_size,
+                                 constrain_pairs)
         out['flow'] = i3d_model.forward(params['flow'], flow, features=True)
     return out
 
@@ -108,12 +119,13 @@ class ExtractI3D(BaseExtractor):
         if self.data_parallel:
             from video_features_tpu.parallel import (
                 build_sharded_two_stream_step, make_mesh, put_replicated,
+                round_batch_to_data_axis,
             )
             from video_features_tpu.utils.device import jax_devices_all
             self.mesh = make_mesh(devices=jax_devices_all(self.device))
-            data_size = self.mesh.shape['data']
             # batch_size is the global batch; round up to fill the data axis
-            self.batch_size = -(-self.batch_size // data_size) * data_size
+            self.batch_size = round_batch_to_data_axis(self.batch_size,
+                                                       self.mesh)
             self.params = put_replicated(self.mesh, self.load_params(args))
             sharded = build_sharded_two_stream_step(
                 self.mesh, streams=tuple(self.streams))
@@ -212,10 +224,18 @@ class ExtractI3D(BaseExtractor):
         }
 
     def maybe_show_pred(self, stacks, pads, stack_counter):
-        if 'rgb' not in self.streams:
-            return
+        """Kinetics top-5 per STREAM, like the reference (extract_i3d.py:
+        212-216 runs the classifier head on each stream's transformed
+        slice). Debug surface only — the flow recompute happens outside the
+        fused hot path."""
         from video_features_tpu.utils.preds import show_predictions_on_dataset
-        rgb = scale_to_pm1(center_crop(jnp.asarray(stacks[:, :-1]), CROP_SIZE))
-        _, logits = i3d_model.forward(self.params['rgb'], rgb, features=False)
-        print(f'At stack {stack_counter} (rgb stream)')
-        show_predictions_on_dataset(np.asarray(logits), 'kinetics')
+        crop = min(CROP_SIZE, stacks.shape[2], stacks.shape[3])
+        for stream in self.streams:
+            if stream == 'rgb':
+                x = scale_to_pm1(center_crop(jnp.asarray(stacks[:, :-1]), crop))
+            else:
+                x = flow_stream_input(self.params['raft'],
+                                      jnp.asarray(stacks), pads, crop)
+            _, logits = i3d_model.forward(self.params[stream], x, features=False)
+            print(f'At stack {stack_counter} ({stream} stream)')
+            show_predictions_on_dataset(np.asarray(logits), 'kinetics')

@@ -10,7 +10,7 @@ from video_features_tpu.parallel.distributed import (  # noqa: F401
 )
 from video_features_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS, TIME_AXIS, batch_sharding, factor_mesh_shape, make_mesh,
-    pair_sharding, replicated,
+    pair_sharding, replicated, round_batch_to_data_axis,
 )
 from video_features_tpu.parallel.pipeline import (  # noqa: F401
     build_sharded_two_stream_step, put_batch, put_replicated,

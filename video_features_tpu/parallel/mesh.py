@@ -58,6 +58,13 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(grid, (DATA_AXIS, TIME_AXIS))
 
 
+def round_batch_to_data_axis(batch_size: int, mesh: Mesh) -> int:
+    """Smallest multiple of the mesh's data-axis size ≥ ``batch_size`` —
+    the global batch an in-graph data-parallel extractor compiles for."""
+    d = mesh.shape[DATA_AXIS]
+    return -(-batch_size // d) * d
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Sharding for params: one full copy per device (models are ≤100s MB —
     SURVEY.md §2.3: tensor parallelism is not needed, replicate per chip)."""
