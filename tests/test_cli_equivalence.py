@@ -64,3 +64,29 @@ def test_cli_numpy_pickle_import_agree(short_video, tmp_path):
 def test_cli_unknown_feature_type_lists_known(capsys):
     with pytest.raises(NotImplementedError, match='i3d'):
         cli.main(['feature_type=nonsense', 'video_paths=/dev/null'])
+
+
+def test_file_list_run_and_resume(short_video, tmp_path, capsys):
+    """file_with_video_paths drives multiple videos; a second run skips
+    everything via the idempotent-output contract."""
+    import shutil
+
+    second = str(tmp_path / 'second_clip.mp4')
+    shutil.copy(short_video, second)
+    listfile = tmp_path / 'paths.txt'
+    listfile.write_text(f'{short_video}\n{second}\n')
+
+    argv = [
+        'feature_type=resnet', 'model_name=resnet18', 'device=cpu',
+        'batch_size=16', f'file_with_video_paths={listfile}',
+        'on_extraction=save_numpy',
+        f'output_path={tmp_path / "out"}', f'tmp_path={tmp_path / "tmp"}',
+    ]
+    assert cli.main(list(argv)) == 0
+    out_dir = tmp_path / 'out' / 'resnet' / 'resnet18'
+    assert len(list(out_dir.glob('*_resnet.npy'))) == 2
+
+    capsys.readouterr()
+    assert cli.main(list(argv)) == 0
+    resumed = capsys.readouterr().out
+    assert resumed.count('already exist') == 2

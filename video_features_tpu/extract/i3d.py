@@ -20,6 +20,7 @@ reference instead runs a python frame loop with per-stack device round trips.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, List
 
 import jax
@@ -37,6 +38,12 @@ from video_features_tpu.utils.device import jax_device
 
 MIN_SIDE_SIZE = 256
 CROP_SIZE = 224
+
+
+def rgb_stream_input(stacks, crop_size):
+    """(B, S+1, H, W, 3) frames → rgb I3D input: first S frames, center
+    crop, 2x/255-1 rescale (reference extract_i3d.py:49-55)."""
+    return scale_to_pm1(center_crop(stacks[:, :-1], crop_size))
 
 
 def flow_stream_input(raft_params, stacks, pads, crop_size,
@@ -74,14 +81,24 @@ def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
     """
     out = {}
     if 'rgb' in streams:
-        rgb = center_crop(stacks[:, :-1], crop_size)
-        rgb = scale_to_pm1(rgb)
+        rgb = rgb_stream_input(stacks, crop_size)
         out['rgb'] = i3d_model.forward(params['rgb'], rgb, features=True)
     if 'flow' in streams:
         flow = flow_stream_input(params['raft'], stacks, pads, crop_size,
                                  constrain_pairs)
         out['flow'] = i3d_model.forward(params['flow'], flow, features=True)
     return out
+
+
+@partial(jax.jit, static_argnames=('stream', 'pads', 'crop_size'))
+def _pred_logits(params, stacks, stream, pads, crop_size):
+    """Classifier logits for one stream — the show_pred debug surface,
+    compiled so it doesn't pay eager dispatch per displayed batch."""
+    if stream == 'rgb':
+        x = rgb_stream_input(stacks, crop_size)
+    else:
+        x = flow_stream_input(params['raft'], stacks, pads, crop_size)
+    return i3d_model.forward(params[stream], x, features=False)[1]
 
 
 class ExtractI3D(BaseExtractor):
@@ -231,11 +248,8 @@ class ExtractI3D(BaseExtractor):
         from video_features_tpu.utils.preds import show_predictions_on_dataset
         crop = min(CROP_SIZE, stacks.shape[2], stacks.shape[3])
         for stream in self.streams:
-            if stream == 'rgb':
-                x = scale_to_pm1(center_crop(jnp.asarray(stacks[:, :-1]), crop))
-            else:
-                x = flow_stream_input(self.params['raft'],
-                                      jnp.asarray(stacks), pads, crop)
-            _, logits = i3d_model.forward(self.params[stream], x, features=False)
+            logits = _pred_logits(self.params, jnp.asarray(stacks),
+                                  stream=stream, pads=tuple(pads),
+                                  crop_size=crop)
             print(f'At stack {stack_counter} ({stream} stream)')
             show_predictions_on_dataset(np.asarray(logits), 'kinetics')
