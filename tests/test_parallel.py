@@ -175,3 +175,34 @@ def test_framewise_data_parallel_matches_single_device(short_video, tmp_path):
                                atol=2e-5, rtol=1e-5)
     np.testing.assert_array_equal(feats_dp['timestamps_ms'],
                                   feats_single['timestamps_ms'])
+
+
+def test_r21d_data_parallel_matches_single_device(short_video, tmp_path):
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+
+    common = {
+        'video_paths': short_video, 'device': 'cpu',
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    }
+    dp = create_extractor(load_config('r21d', overrides={
+        **common, 'data_parallel': True}))
+    single = create_extractor(load_config('r21d', overrides=common))
+
+    feats_dp = dp.extract(short_video)
+    assert dp._mesh is not None
+    assert dp.stack_batch % dp._mesh.shape['data'] == 0
+    feats_single = single.extract(short_video)
+    np.testing.assert_allclose(feats_dp['r21d'], feats_single['r21d'],
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_data_parallel_warns_for_unsupported(tmp_path, capsys, short_video):
+    from video_features_tpu.config import load_config
+
+    args = load_config('s3d', overrides={
+        'video_paths': short_video, 'device': 'cpu', 'data_parallel': True,
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    })
+    assert args['data_parallel'] is False
+    assert 'not implemented for s3d' in capsys.readouterr().out

@@ -170,6 +170,13 @@ def sanity_check(args: Config) -> None:
     ft = args.get('feature_type')
     if args.get('show_pred') and ft == 'vggish':
         print('Showing class predictions is not implemented for VGGish')
+    if args.get('data_parallel'):
+        from video_features_tpu.registry import DATA_PARALLEL_FEATURES
+        if ft not in DATA_PARALLEL_FEATURES:
+            print(f'WARNING: data_parallel is not implemented for {ft} — '
+                  'running single-device (scale out with multihost=true / '
+                  'sharded worklists instead)')
+            args['data_parallel'] = False
     if ft == 'i3d' and args.get('stack_size') is not None:
         assert args['stack_size'] >= 10, (
             f'I3D does not support inputs shorter than 10 timestamps. '

@@ -63,18 +63,11 @@ class BaseFrameWiseExtractor(BaseExtractor):
         """Lazy: subclasses set self.params after super().__init__."""
         if self._mesh is not None:
             return
-        from functools import partial
-
-        from video_features_tpu.parallel import (
-            make_mesh, put_batch, put_replicated, round_batch_to_data_axis,
-        )
-        from video_features_tpu.utils.device import jax_devices_all
-        self._mesh = make_mesh(devices=jax_devices_all(self.device),
-                               time_parallel=1)
-        # batch_size becomes the global batch; round up to fill the mesh
-        self.batch_size = round_batch_to_data_axis(self.batch_size, self._mesh)
-        self.params = put_replicated(self._mesh, self.params)
-        self._put_batch = partial(put_batch, self._mesh)
+        from video_features_tpu.parallel import setup_data_parallel
+        # batch_size becomes the global batch; rounded up to fill the mesh
+        (self._mesh, self.batch_size,
+         self.params, self._put_batch) = setup_data_parallel(
+            self.device, self.batch_size, self.params)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         if self.data_parallel:

@@ -58,10 +58,25 @@ class ExtractR21D(BaseExtractor):
         self.step_size = args.step_size or self.model_def['step_size']
         self.show_pred = args.show_pred
         self.output_feat_keys = [self.feature_type]
+        # stacks per device step (the reference runs one at a time,
+        # extract_r21d.py:81-85); with data_parallel this is the global batch
+        self.stack_batch = args.get('batch_size') or STACK_BATCH
+        # data_parallel=true shards stack batches over all local devices
+        # (params replicated, batch data-sharded — same scheme as framewise)
+        self.data_parallel = args.get('data_parallel', False)
+        self._mesh = None
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
         self._step = jax.jit(
             partial(self._forward_batch, arch=self.model_def['arch']))
+
+    def _ensure_mesh(self) -> None:
+        if self._mesh is not None:
+            return
+        from video_features_tpu.parallel import setup_data_parallel
+        (self._mesh, self.stack_batch,
+         self.params, self._put_batch) = setup_data_parallel(
+            self.device, self.stack_batch, self.params)
 
     # -- model --------------------------------------------------------------
 
@@ -94,6 +109,8 @@ class ExtractR21D(BaseExtractor):
         from video_features_tpu.extract.streaming import stream_windows
         from video_features_tpu.io.video import prefetch
 
+        if self.data_parallel:
+            self._ensure_mesh()
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
@@ -108,10 +125,12 @@ class ExtractR21D(BaseExtractor):
         def flush():
             nonlocal window_idx
             valid = len(pending)
-            while len(pending) < STACK_BATCH:  # pad tail, masked below
+            while len(pending) < self.stack_batch:  # pad tail, masked below
                 pending.append(pending[-1])
             stacks = np.stack(pending)
             pending.clear()
+            if self._mesh is not None:
+                stacks = self._put_batch(stacks)
             with self.tracer.stage('model'):
                 out = np.asarray(self._step(self.params, stacks))[:valid]
             feats.append(out)
@@ -126,7 +145,7 @@ class ExtractR21D(BaseExtractor):
             # decode thread assembles stack k+1 while the device runs k
             for window in prefetch(windows, depth=2):
                 pending.append(window)
-                if len(pending) == STACK_BATCH:
+                if len(pending) == self.stack_batch:
                     flush()
             if pending:
                 flush()

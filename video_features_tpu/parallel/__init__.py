@@ -14,6 +14,7 @@ from video_features_tpu.parallel.mesh import (  # noqa: F401
 )
 from video_features_tpu.parallel.pipeline import (  # noqa: F401
     build_sharded_two_stream_step, put_batch, put_replicated,
+    setup_data_parallel,
 )
 from video_features_tpu.parallel.ring import (  # noqa: F401
     sequence_sharded_attention, sequence_sharding,

@@ -68,3 +68,25 @@ def put_replicated(mesh: Mesh, params):
 def put_batch(mesh: Mesh, batch):
     """Shard a host batch over the data axis of the mesh."""
     return jax.device_put(batch, batch_sharding(mesh))
+
+
+def setup_data_parallel(device: str, batch_size: int, params):
+    """One-stop in-graph DP setup for a batch-sharding extractor.
+
+    Returns ``(mesh, global_batch, replicated_params, put_batch_fn)``: a
+    data-only mesh over this host's local devices of ``device``'s platform,
+    the batch size rounded up to fill the data axis, the params placed on
+    every device, and a batch-placement callable. Feeding jit functions
+    these shardings makes XLA compile one pjit program — no per-extractor
+    sharding code needed.
+    """
+    from functools import partial
+
+    from video_features_tpu.parallel.mesh import (
+        make_mesh, round_batch_to_data_axis,
+    )
+    from video_features_tpu.utils.device import jax_devices_all
+
+    mesh = make_mesh(devices=jax_devices_all(device), time_parallel=1)
+    return (mesh, round_batch_to_data_axis(batch_size, mesh),
+            put_replicated(mesh, params), partial(put_batch, mesh))

@@ -21,6 +21,11 @@ EXTRACTORS: Dict[str, Tuple[str, str]] = {
     'timm': ('video_features_tpu.extract.timm', 'ExtractTIMM'),
 }
 
+# feature types whose extractor implements in-graph data parallelism
+# (data_parallel=true). The single authoritative set — sanity_check
+# consults it; keep in sync with the extractor implementations.
+DATA_PARALLEL_FEATURES = frozenset({'i3d', 'r21d', 'resnet', 'clip', 'timm'})
+
 
 def create_extractor(args: 'Config') -> 'BaseExtractor':
     feature_type = args['feature_type']
