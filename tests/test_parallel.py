@@ -200,9 +200,60 @@ def test_r21d_data_parallel_matches_single_device(short_video, tmp_path):
 def test_data_parallel_warns_for_unsupported(tmp_path, capsys, short_video):
     from video_features_tpu.config import load_config
 
-    args = load_config('s3d', overrides={
+    args = load_config('raft', overrides={
         'video_paths': short_video, 'device': 'cpu', 'data_parallel': True,
         'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
     })
     assert args['data_parallel'] is False
-    assert 'not implemented for s3d' in capsys.readouterr().out
+    assert 'not implemented for raft' in capsys.readouterr().out
+
+
+def test_s3d_data_parallel_matches_single_device(short_video, tmp_path):
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+
+    common = {
+        'video_paths': short_video, 'device': 'cpu',
+        'stack_size': 16, 'step_size': 16, 'extraction_fps': None,
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    }
+    dp = create_extractor(load_config('s3d', overrides={
+        **common, 'data_parallel': True}))
+    single = create_extractor(load_config('s3d', overrides=common))
+
+    feats_dp = dp.extract(short_video)
+    assert dp._mesh is not None
+    feats_single = single.extract(short_video)
+    np.testing.assert_allclose(feats_dp['s3d'], feats_single['s3d'],
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_vggish_data_parallel_matches_single_device(tmp_path):
+    import wave
+
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+
+    sr = 16000
+    t = np.arange(int(sr * 3.5)) / sr
+    samples = (np.sin(2 * np.pi * 330 * t) * 0.4 * 32767).astype('<i2')
+    wav = str(tmp_path / 'tone.wav')
+    with wave.open(wav, 'wb') as f:
+        f.setnchannels(1)
+        f.setsampwidth(2)
+        f.setframerate(sr)
+        f.writeframes(samples.tobytes())
+
+    common = {
+        'video_paths': wav, 'device': 'cpu',
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    }
+    dp = create_extractor(load_config('vggish', overrides={
+        **common, 'data_parallel': True, 'batch_size': 8}))
+    single = create_extractor(load_config('vggish', overrides=common))
+
+    feats_dp = dp.extract(wav)
+    assert dp._mesh is not None and dp.example_batch % dp._mesh.shape['data'] == 0
+    feats_single = single.extract(wav)
+    np.testing.assert_allclose(feats_dp['vggish'], feats_single['vggish'],
+                               atol=2e-5, rtol=1e-5)

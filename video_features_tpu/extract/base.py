@@ -59,6 +59,23 @@ class BaseExtractor:
         self.device = device
         self.concat_rgb_flow = concat_rgb_flow
         self.tracer = Tracer(enabled=True) if profile else NULL_TRACER
+        self._mesh = None  # set by _ensure_mesh for data_parallel extractors
+
+    def _ensure_mesh(self, batch_attr: str) -> None:
+        """Lazy in-graph data-parallel setup shared by every DP extractor.
+
+        Builds the local-device mesh, rounds the batch attribute named
+        ``batch_attr`` up to the global batch, replicates ``self.params``,
+        and installs ``self._put_batch``. Lazy because subclasses set
+        ``self.params`` after ``super().__init__``.
+        """
+        if self._mesh is not None:
+            return
+        from video_features_tpu.parallel import setup_data_parallel
+        mesh, global_batch, params, put = setup_data_parallel(
+            self.device, getattr(self, batch_attr), self.params)
+        self._mesh, self.params, self._put_batch = mesh, params, put
+        setattr(self, batch_attr, global_batch)
 
     # -- per-video driver ---------------------------------------------------
 

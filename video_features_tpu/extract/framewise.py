@@ -40,7 +40,6 @@ class BaseFrameWiseExtractor(BaseExtractor):
         # one pjit program with XLA-inserted collectives (reference
         # scale-out is one process per GPU, README.md:70-84)
         self.data_parallel = args.get('data_parallel', False)
-        self._mesh = None
         self.extraction_fps = args.get('extraction_fps')
         self.extraction_total = args.get('extraction_total')
         self.show_pred = args.show_pred
@@ -59,19 +58,9 @@ class BaseFrameWiseExtractor(BaseExtractor):
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         pass
 
-    def _ensure_mesh(self) -> None:
-        """Lazy: subclasses set self.params after super().__init__."""
-        if self._mesh is not None:
-            return
-        from video_features_tpu.parallel import setup_data_parallel
-        # batch_size becomes the global batch; rounded up to fill the mesh
-        (self._mesh, self.batch_size,
-         self.params, self._put_batch) = setup_data_parallel(
-            self.device, self.batch_size, self.params)
-
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         if self.data_parallel:
-            self._ensure_mesh()
+            self._ensure_mesh('batch_size')
         loader = VideoLoader(
             video_path,
             batch_size=self.batch_size,

@@ -64,19 +64,10 @@ class ExtractR21D(BaseExtractor):
         # data_parallel=true shards stack batches over all local devices
         # (params replicated, batch data-sharded — same scheme as framewise)
         self.data_parallel = args.get('data_parallel', False)
-        self._mesh = None
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
         self._step = jax.jit(
             partial(self._forward_batch, arch=self.model_def['arch']))
-
-    def _ensure_mesh(self) -> None:
-        if self._mesh is not None:
-            return
-        from video_features_tpu.parallel import setup_data_parallel
-        (self._mesh, self.stack_batch,
-         self.params, self._put_batch) = setup_data_parallel(
-            self.device, self.stack_batch, self.params)
 
     # -- model --------------------------------------------------------------
 
@@ -110,7 +101,7 @@ class ExtractR21D(BaseExtractor):
         from video_features_tpu.io.video import prefetch
 
         if self.data_parallel:
-            self._ensure_mesh()
+            self._ensure_mesh('stack_batch')
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,

@@ -41,6 +41,9 @@ class ExtractS3D(BaseExtractor):
         self.extraction_fps = args.extraction_fps
         self.show_pred = args.show_pred
         self.output_feat_keys = [self.feature_type]
+        # stacks per device step; 64-frame stacks are large, so default 1
+        self.stack_batch = args.get('batch_size') or STACK_BATCH
+        self.data_parallel = args.get('data_parallel', False)
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
         # the jit step is built per video: the resize geometry is static
@@ -65,6 +68,8 @@ class ExtractS3D(BaseExtractor):
         from video_features_tpu.extract.streaming import stream_windows
         from video_features_tpu.io.video import prefetch
 
+        if self.data_parallel:
+            self._ensure_mesh('stack_batch')
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
@@ -73,8 +78,32 @@ class ExtractS3D(BaseExtractor):
                                  self.tracer, 'decode')
 
         step = None
-        feats = []
+        resize_hw = None
+        feats: list = []
+        pending: list = []
         window_idx = 0
+
+        def flush():
+            nonlocal window_idx
+            valid = len(pending)
+            while len(pending) < self.stack_batch:  # pad tail, masked below
+                pending.append(pending[-1])
+            stacks = np.stack(pending)
+            pending.clear()
+            if self._mesh is not None:
+                stacks = self._put_batch(stacks)
+            with self.tracer.stage('model'):
+                out = np.asarray(step(self.params, stacks))[:valid]
+            feats.append(out)
+            if self.show_pred:
+                # one D2H transfer for the whole (possibly sharded) batch
+                stacks_np = np.asarray(stacks)
+                for k in range(valid):
+                    start = (window_idx + k) * self.step_size
+                    self.maybe_show_pred(stacks_np[k:k + 1], start,
+                                         start + self.stack_size, resize_hw)
+            window_idx += valid
+
         with jax.default_matmul_precision('highest'):
             # decode thread assembles stack k+1 while the device runs k
             for window in prefetch(windows, depth=2):
@@ -87,15 +116,11 @@ class ExtractS3D(BaseExtractor):
                     else:
                         resize_hw = (int(224 * h / w), 224)
                     step = jax.jit(partial(self._forward, resize_hw=resize_hw))
-                stacks = window[None]            # STACK_BATCH == 1
-                with self.tracer.stage('model'):
-                    out = np.asarray(step(self.params, stacks))
-                feats.append(out)
-                if self.show_pred:
-                    start = window_idx * self.step_size
-                    self.maybe_show_pred(stacks, start,
-                                         start + self.stack_size, resize_hw)
-                window_idx += 1
+                pending.append(window)
+                if len(pending) == self.stack_batch:
+                    flush()
+            if pending:
+                flush()
 
         feats = (np.concatenate(feats, axis=0) if feats
                  else np.zeros((0, s3d_model.FEAT_DIM), np.float32))

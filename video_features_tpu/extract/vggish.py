@@ -42,6 +42,9 @@ class ExtractVGGish(BaseExtractor):
             raise NotImplementedError('vggish has no show_pred (reference '
                                       'extract_vggish.py:25-26)')
         self.output_feat_keys = [self.feature_type]
+        # 0.96 s examples per device step; global batch under data_parallel
+        self.example_batch = args.get('batch_size') or BATCH
+        self.data_parallel = args.get('data_parallel', False)
         # mp4 audio backend: 'ffmpeg' = the reference's mp4→aac→wav
         # subprocess chain (exact parity, needs an ffmpeg binary); 'native'
         # = in-process libav demux+decode+resample straight to mono 16 kHz
@@ -141,16 +144,21 @@ class ExtractVGGish(BaseExtractor):
         return {self.feature_type: feats}
 
     def _run_batched(self, examples: np.ndarray) -> np.ndarray:
+        if self.data_parallel:
+            self._ensure_mesh('example_batch')
         n = examples.shape[0]
         if n == 0:
             return np.zeros((0, vggish_model.FEAT_DIM), np.float32)
+        B = self.example_batch
         out = []
         with jax.default_matmul_precision('highest'):
-            for start in range(0, n, BATCH):
-                chunk = examples[start:start + BATCH]
+            for start in range(0, n, B):
+                chunk = examples[start:start + B]
                 valid = chunk.shape[0]
-                if valid < BATCH:
-                    pad = np.repeat(chunk[-1:], BATCH - valid, axis=0)
+                if valid < B:
+                    pad = np.repeat(chunk[-1:], B - valid, axis=0)
                     chunk = np.concatenate([chunk, pad], axis=0)
+                if self._mesh is not None:
+                    chunk = self._put_batch(chunk)
                 out.append(np.asarray(self._step(self.params, chunk))[:valid])
         return np.concatenate(out, axis=0)

@@ -24,7 +24,8 @@ EXTRACTORS: Dict[str, Tuple[str, str]] = {
 # feature types whose extractor implements in-graph data parallelism
 # (data_parallel=true). The single authoritative set — sanity_check
 # consults it; keep in sync with the extractor implementations.
-DATA_PARALLEL_FEATURES = frozenset({'i3d', 'r21d', 'resnet', 'clip', 'timm'})
+DATA_PARALLEL_FEATURES = frozenset(
+    {'i3d', 'r21d', 's3d', 'vggish', 'resnet', 'clip', 'timm'})
 
 
 def create_extractor(args: 'Config') -> 'BaseExtractor':
