@@ -139,7 +139,10 @@ class ExtractI3D(BaseExtractor):
                 round_batch_to_data_axis,
             )
             from video_features_tpu.utils.device import jax_devices_all
-            self.mesh = make_mesh(devices=jax_devices_all(self.device))
+            # self._mesh keeps the one-flag-per-extractor invariant from
+            # BaseExtractor; self.mesh stays the public name
+            self.mesh = self._mesh = make_mesh(
+                devices=jax_devices_all(self.device))
             # batch_size is the global batch; round up to fill the data axis
             self.batch_size = round_batch_to_data_axis(self.batch_size,
                                                        self.mesh)
@@ -191,6 +194,7 @@ class ExtractI3D(BaseExtractor):
                               self.tracer, 'decode+preprocess')
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        from video_features_tpu.extract.streaming import run_batched_windows
         from video_features_tpu.io.video import prefetch
 
         loader = VideoLoader(
@@ -201,38 +205,26 @@ class ExtractI3D(BaseExtractor):
             transform_workers=self.decode_workers)
 
         feats: Dict[str, list] = {s: [] for s in self.streams}
-        pads = None
-        pending: List[np.ndarray] = []
-        window_count = 0
+        state = {'pads': None}
 
-        def flush():
-            nonlocal window_count
-            valid = len(pending)
-            while len(pending) < self.batch_size:  # pad tail, masked below
-                pending.append(pending[-1])
-            stacks = np.stack(pending)
-            pending.clear()
+        def run(stacks, valid, window_idx):
+            if state['pads'] is None:
+                H, W = stacks.shape[2:4]
+                state['pads'] = tuple(raft_model.pad_to_multiple(
+                    np.zeros((1, H, W, 1), np.float32))[1])
             with self.tracer.stage('model'):
-                out = self._step(self.params, stacks, pads=tuple(pads),
+                out = self._step(self.params, stacks, pads=state['pads'],
                                  streams=tuple(self.streams))
                 for s in self.streams:
                     feats[s].append(np.asarray(out[s])[:valid])
             if self.show_pred:
-                self.maybe_show_pred(stacks[:valid], pads, window_count)
-            window_count += valid
+                self.maybe_show_pred(stacks[:valid], state['pads'], window_idx)
 
         with jax.default_matmul_precision('highest'):
             # decode thread assembles window k+1 while the device runs k
-            for window in prefetch(self._stream_windows(loader), depth=2):
-                if pads is None:
-                    H, W = window.shape[1:3]
-                    pads = raft_model.pad_to_multiple(
-                        np.zeros((1, H, W, 1), np.float32))[1]
-                pending.append(window)
-                if len(pending) == self.batch_size:
-                    flush()
-            if pending:
-                flush()
+            run_batched_windows(
+                prefetch(self._stream_windows(loader), depth=2),
+                self.batch_size, run)
 
         return {
             s: (np.concatenate(v, axis=0) if v

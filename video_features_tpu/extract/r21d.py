@@ -109,17 +109,11 @@ class ExtractR21D(BaseExtractor):
         windows = stream_windows(loader, self.stack_size, self.step_size,
                                  self.tracer, 'decode')
 
-        feats: list = []
-        pending: list = []
-        window_idx = 0
+        from video_features_tpu.extract.streaming import run_batched_windows
 
-        def flush():
-            nonlocal window_idx
-            valid = len(pending)
-            while len(pending) < self.stack_batch:  # pad tail, masked below
-                pending.append(pending[-1])
-            stacks = np.stack(pending)
-            pending.clear()
+        feats: list = []
+
+        def run(stacks, valid, window_idx):
             if self._mesh is not None:
                 stacks = self._put_batch(stacks)
             with self.tracer.stage('model'):
@@ -130,16 +124,11 @@ class ExtractR21D(BaseExtractor):
                     start = (window_idx + k) * self.step_size
                     self.maybe_show_pred(out[k:k + 1], start,
                                          start + self.stack_size)
-            window_idx += valid
 
         with jax.default_matmul_precision('highest'):
             # decode thread assembles stack k+1 while the device runs k
-            for window in prefetch(windows, depth=2):
-                pending.append(window)
-                if len(pending) == self.stack_batch:
-                    flush()
-            if pending:
-                flush()
+            run_batched_windows(prefetch(windows, depth=2),
+                                self.stack_batch, run)
 
         feats = (np.concatenate(feats, axis=0) if feats
                  else np.zeros((0, 512), np.float32))

@@ -57,6 +57,13 @@ class ExtractRAFT(BaseExtractor):
             f'finetuned_on must be one of {FINETUNED_CKPTS}'
         self.show_pred = args.show_pred
         self.output_feat_keys = [self.feature_type, 'fps', 'timestamps_ms']
+        # data_parallel=true spreads the B consecutive-pair flows over all
+        # local devices: the pair tensors f1=frames[:-1], f2=frames[1:] are
+        # materialized on the host (the one-frame halo is paid once there)
+        # and fed with a data-axis sharding, so each device receives only
+        # its own pairs — no replication of the frame batch, no in-graph
+        # halo exchange.
+        self.data_parallel = args.get('data_parallel', False)
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
         self._step = jax.jit(self._flow_batch)
@@ -76,12 +83,20 @@ class ExtractRAFT(BaseExtractor):
         """(B+1, Hp, Wp, 3) padded frames → (B, Hp, Wp, 2) flows."""
         return raft_model.forward(params, frames[:-1], frames[1:])
 
+    @staticmethod
+    def _flow_pairs(params, f1, f2):
+        """Pair-tensor form for data_parallel: inputs arrive data-sharded."""
+        return raft_model.forward(params, f1, f2)
+
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
         if self.side_size is not None:
             frame = resize_pil(frame, self.side_size, self.resize_to_smaller_edge)
         return frame.astype(np.float32)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        if self.data_parallel and self._mesh is None:
+            self._ensure_mesh('batch_size')
+            self._dp_step = jax.jit(self._flow_pairs)
         loader = VideoLoader(
             video_path,
             batch_size=self.batch_size + 1,
@@ -114,7 +129,12 @@ class ExtractRAFT(BaseExtractor):
                     batch, mode=self.finetuned_on)
                 padded = np.asarray(padded)
                 with self.tracer.stage('model'):
-                    flow = self._step(self.params, padded)
+                    if self._mesh is not None:
+                        flow = self._dp_step(self.params,
+                                             self._put_batch(padded[:-1]),
+                                             self._put_batch(padded[1:]))
+                    else:
+                        flow = self._step(self.params, padded)
                     flow = np.asarray(raft_model.unpad(flow, pads))[:valid]
                 flows.append(flow)
                 if self.show_pred:

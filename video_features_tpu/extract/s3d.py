@@ -77,23 +77,24 @@ class ExtractS3D(BaseExtractor):
         windows = stream_windows(loader, self.stack_size, self.step_size,
                                  self.tracer, 'decode')
 
-        step = None
-        resize_hw = None
-        feats: list = []
-        pending: list = []
-        window_idx = 0
+        from video_features_tpu.extract.streaming import run_batched_windows
 
-        def flush():
-            nonlocal window_idx
-            valid = len(pending)
-            while len(pending) < self.stack_batch:  # pad tail, masked below
-                pending.append(pending[-1])
-            stacks = np.stack(pending)
-            pending.clear()
+        state = {'step': None, 'resize_hw': None}
+        feats: list = []
+
+        def run(stacks, valid, window_idx):
+            if state['step'] is None:
+                # short-side 224, torch F.interpolate semantics, static per
+                # video geometry
+                h, w = stacks.shape[2:4]
+                state['resize_hw'] = ((224, int(224 * w / h)) if h < w
+                                      else (int(224 * h / w), 224))
+                state['step'] = jax.jit(
+                    partial(self._forward, resize_hw=state['resize_hw']))
             if self._mesh is not None:
                 stacks = self._put_batch(stacks)
             with self.tracer.stage('model'):
-                out = np.asarray(step(self.params, stacks))[:valid]
+                out = np.asarray(state['step'](self.params, stacks))[:valid]
             feats.append(out)
             if self.show_pred:
                 # one D2H transfer for the whole (possibly sharded) batch
@@ -101,26 +102,13 @@ class ExtractS3D(BaseExtractor):
                 for k in range(valid):
                     start = (window_idx + k) * self.step_size
                     self.maybe_show_pred(stacks_np[k:k + 1], start,
-                                         start + self.stack_size, resize_hw)
-            window_idx += valid
+                                         start + self.stack_size,
+                                         state['resize_hw'])
 
         with jax.default_matmul_precision('highest'):
             # decode thread assembles stack k+1 while the device runs k
-            for window in prefetch(windows, depth=2):
-                if step is None:
-                    # short-side 224, torch F.interpolate semantics,
-                    # static per video geometry
-                    h, w = window.shape[1:3]
-                    if h < w:
-                        resize_hw = (224, int(224 * w / h))
-                    else:
-                        resize_hw = (int(224 * h / w), 224)
-                    step = jax.jit(partial(self._forward, resize_hw=resize_hw))
-                pending.append(window)
-                if len(pending) == self.stack_batch:
-                    flush()
-            if pending:
-                flush()
+            run_batched_windows(prefetch(windows, depth=2),
+                                self.stack_batch, run)
 
         feats = (np.concatenate(feats, axis=0) if feats
                  else np.zeros((0, s3d_model.FEAT_DIM), np.float32))

@@ -13,11 +13,41 @@ are dropped, like the reference, extract_i3d.py:126-129).
 """
 from __future__ import annotations
 
-from typing import Iterable, Iterator, List
+from typing import Callable, Iterable, Iterator, List
 
 import numpy as np
 
 from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
+
+
+def run_batched_windows(windows: Iterable[np.ndarray], batch: int,
+                        run: Callable[[np.ndarray, int, int], None]) -> None:
+    """Group streamed windows into fixed-size batches and call ``run``.
+
+    ``run(stacks, valid, window_idx)`` receives a (batch, ...) array whose
+    tail is padded by repeating the last window (mask with ``[:valid]``)
+    and the absolute index of the first window in the batch. Shared by the
+    stack-based extractors so the pad/mask/flush bookkeeping exists once.
+    """
+    pending: List[np.ndarray] = []
+    window_idx = 0
+
+    def flush() -> None:
+        nonlocal window_idx
+        valid = len(pending)
+        while len(pending) < batch:
+            pending.append(pending[-1])
+        stacks = np.stack(pending)
+        pending.clear()
+        run(stacks, valid, window_idx)
+        window_idx += valid
+
+    for window in windows:
+        pending.append(window)
+        if len(pending) == batch:
+            flush()
+    if pending:
+        flush()
 
 
 def stream_windows(batches: Iterable, win: int, step: int,

@@ -23,9 +23,11 @@ EXTRACTORS: Dict[str, Tuple[str, str]] = {
 
 # feature types whose extractor implements in-graph data parallelism
 # (data_parallel=true). The single authoritative set — sanity_check
-# consults it; keep in sync with the extractor implementations.
+# consults it; deliberately an explicit literal (NOT frozenset(EXTRACTORS))
+# so a future extractor without DP support trips the warn-and-disable path
+# instead of silently claiming capability.
 DATA_PARALLEL_FEATURES = frozenset(
-    {'i3d', 'r21d', 's3d', 'vggish', 'resnet', 'clip', 'timm'})
+    {'i3d', 'r21d', 's3d', 'vggish', 'resnet', 'raft', 'clip', 'timm'})
 
 
 def create_extractor(args: 'Config') -> 'BaseExtractor':
