@@ -90,3 +90,50 @@ def test_file_list_run_and_resume(short_video, tmp_path, capsys):
     assert cli.main(list(argv)) == 0
     resumed = capsys.readouterr().out
     assert resumed.count('already exist') == 2
+
+
+def test_video_shorter_than_stack_saves_empty(tmp_path, capsys):
+    """A clip shorter than one stack yields (0, D) — saved with the empty-
+    value warning, then skipped on resume (reference drops partial stacks)."""
+    import cv2
+
+    short5 = str(tmp_path / 'five_frames.mp4')
+    w = cv2.VideoWriter(short5, cv2.VideoWriter_fourcc(*'mp4v'), 25, (64, 64))
+    for i in range(5):
+        w.write(np.full((64, 64, 3), i * 40, np.uint8))
+    w.release()
+
+    argv = [
+        'feature_type=r21d', 'device=cpu', f'video_paths={short5}',
+        'on_extraction=save_numpy',
+        f'output_path={tmp_path / "out"}', f'tmp_path={tmp_path / "tmp"}',
+    ]
+    assert cli.main(list(argv)) == 0
+    saved = np.load(
+        tmp_path / 'out' / 'r21d' / 'r2plus1d_18_16_kinetics'
+        / 'five_frames_r21d.npy')
+    assert saved.shape == (0, 512)
+    capsys.readouterr()
+    assert cli.main(list(argv)) == 0   # resume loads the empty file cleanly
+    assert 'already exist' in capsys.readouterr().out
+
+
+def test_extraction_total_retimes_framewise(short_video, tmp_path):
+    """extraction_total resamples the whole video to ~N frames. The pure
+    index-resampling backend (no ffmpeg binary) is exact; an ffmpeg
+    re-encode's fps filter may land a frame either side of N."""
+    from video_features_tpu.io.video import which_ffmpeg
+
+    args = load_config('resnet', overrides={
+        'model_name': 'resnet18', 'device': 'cpu', 'batch_size': 16,
+        'video_paths': short_video, 'extraction_total': 12,
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
+    })
+    out = create_extractor(args).extract(short_video)
+    n = out['resnet'].shape[0]
+    assert out['resnet'].shape[1] == 512
+    assert len(out['timestamps_ms']) == n
+    if which_ffmpeg():
+        assert 10 <= n <= 14
+    else:
+        assert n == 12

@@ -1,4 +1,6 @@
 """I3D extractor: rgb-only E2E + the fused two-stream device step."""
+from pathlib import Path
+
 import numpy as np
 import pytest
 
@@ -119,3 +121,26 @@ def test_show_pred_covers_both_streams(capsys):
     assert 'At stack 0 (rgb stream)' in out
     assert 'At stack 0 (flow stream)' in out
     assert out.count('Logits') == 2
+
+
+def test_e2e_two_stream_with_flow(short_video, tmp_path):
+    """Full flagship path on a real clip: decode → windows → RAFT flow →
+    both I3D towers → concat (T, 2048) under the 'rgb' key (fork naming)."""
+    args = load_config('i3d', overrides={
+        'video_paths': short_video,
+        'device': 'cpu',
+        'stack_size': 16, 'step_size': 16,   # 48-frame clip -> 2 windows
+        'concat_rgb_flow': True,
+        'on_extraction': 'save_numpy',
+        'output_path': str(tmp_path / 'out'),
+        'tmp_path': str(tmp_path / 'tmp'),
+    })
+    ex = create_extractor(args)
+    ex._extract(short_video)
+
+    stem = Path(short_video).stem
+    saved = np.load(tmp_path / 'out' / 'i3d' / f'{stem}.npy')
+    assert saved.shape == (2, 2048)          # rgb || flow concat
+    assert np.isfinite(saved).all()
+    # the two halves come from different towers: they must differ
+    assert not np.allclose(saved[:, :1024], saved[:, 1024:])
