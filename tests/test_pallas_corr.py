@@ -79,3 +79,40 @@ def test_forward_with_all_lookup_impls(monkeypatch):
         got = np.asarray(raft.forward(params, img1, img2, iters=3))
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4,
                                    err_msg=impl)
+
+
+def test_lanes_lookup_matches_gather_oracle():
+    """Lane-packed mask-reduce kernel (interpret mode): identical to the
+    gather oracle, incl. zeros padding at out-of-map coords."""
+    from video_features_tpu.ops import pallas_corr
+
+    rng = np.random.RandomState(1)
+    B, H8, W8, D = 4, 12, 9, 32
+    f1 = jnp.asarray(rng.randn(B, H8, W8, D).astype(np.float32))
+    f2 = jnp.asarray(rng.randn(B, H8, W8, D).astype(np.float32))
+    py = raft.build_corr_pyramid(f1, f2)
+    coords = jnp.asarray(
+        (rng.rand(B, H8, W8, 2) * [W8 * 1.6, H8 * 1.6]
+         - [W8 * 0.3, H8 * 0.3]).astype(np.float32))
+    ref = np.asarray(raft.lookup_corr(py, coords))
+    prepped = pallas_corr.prep_pyramid_lanes(py)
+    got = np.asarray(pallas_corr.lookup_corr_lanes(prepped, coords,
+                                                   interpret=True))
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_forward_with_lanes_lookup(monkeypatch):
+    """Full RAFT forward with the lanes lookup == the gather oracle."""
+    sd = raft.init_state_dict(seed=0)
+    from video_features_tpu.transplant.torch2jax import transplant
+    params = transplant(sd)
+    rng = np.random.RandomState(2)
+    img1 = jnp.asarray(rng.randint(0, 255, (1, 64, 80, 3)).astype(np.float32))
+    img2 = jnp.asarray(rng.randint(0, 255, (1, 64, 80, 3)).astype(np.float32))
+
+    monkeypatch.delenv('VFT_RAFT_PALLAS', raising=False)
+    monkeypatch.setenv('VFT_RAFT_LOOKUP', 'gather')
+    ref = np.asarray(raft.forward(params, img1, img2, iters=3))
+    monkeypatch.setenv('VFT_RAFT_LOOKUP', 'lanes')
+    got = np.asarray(raft.forward(params, img1, img2, iters=3))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)

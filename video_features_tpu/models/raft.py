@@ -267,20 +267,26 @@ def coords_grid(B: int, H: int, W: int, dtype=jnp.float32) -> jax.Array:
 def _lookup_impl() -> str:
     """Which corr-lookup implementation to compile into the forward pass.
 
-    ``VFT_RAFT_LOOKUP`` ∈ {'dense' (default), 'gather', 'pallas'}:
+    ``VFT_RAFT_LOOKUP`` ∈ {'dense' (default), 'gather', 'pallas', 'lanes'}:
       * dense  — :func:`lookup_corr_dense`, gather-free batched matmuls
         (measured ~300× faster than gather on TPU; also fastest on CPU);
       * gather — :func:`lookup_corr`, the XLA gather lowering (reference
         semantics oracle, kept for tests);
-      * pallas — the Pallas TPU kernel (ops/pallas_corr.py; interpret mode
-        automatically off-TPU).
+      * pallas — the Pallas window-slice kernel (ops/pallas_corr.py;
+        interpret mode automatically off-TPU);
+      * lanes  — experimental lane-packed Pallas kernel (mask-reduce window
+        sums, 128 pixels per lane tile): parity-exact, and the prime
+        optimization candidate since the lookup dominates the GRU scan's
+        per-iteration cost (~85% measured on v5e) — but full-pyramid graph
+        compiles are currently slow enough that it stays opt-in until
+        per-level compilation is cached or the unrolling is reduced.
     Legacy ``VFT_RAFT_PALLAS=1`` still selects the pallas path.
     """
     import os
     if os.environ.get('VFT_RAFT_PALLAS') == '1':
         return 'pallas'
     impl = os.environ.get('VFT_RAFT_LOOKUP', 'dense')
-    assert impl in ('dense', 'gather', 'pallas'), impl
+    assert impl in ('dense', 'gather', 'pallas', 'lanes'), impl
     return impl
 
 
@@ -308,11 +314,16 @@ def forward(params: Params, image1: jax.Array, image2: jax.Array,
     up = params['update_block']
 
     impl = _lookup_impl()
-    if impl == 'pallas':
+    if impl in ('pallas', 'lanes'):
         from video_features_tpu.ops import pallas_corr
-        prepped = pallas_corr.prep_pyramid(pyramid, CORR_RADIUS)
+        prep_fn, lookup_fn = {
+            'pallas': (partial(pallas_corr.prep_pyramid, radius=CORR_RADIUS),
+                       pallas_corr.lookup_corr),
+            'lanes': (pallas_corr.prep_pyramid_lanes,
+                      pallas_corr.lookup_corr_lanes),
+        }[impl]
         interp = jax.default_backend() != 'tpu'
-        lookup = partial(pallas_corr.lookup_corr, prepped,
+        lookup = partial(lookup_fn, prep_fn(pyramid),
                          radius=CORR_RADIUS, interpret=interp)
     elif impl == 'gather':
         lookup = partial(lookup_corr, pyramid)

@@ -171,3 +171,115 @@ def lookup_corr(prepped: Sequence[jax.Array], coords: jax.Array,
     out = [_lookup_level(corr_t, flat / (2.0 ** i), radius, interpret)
            for i, corr_t in enumerate(prepped)]
     return jnp.concatenate(out, axis=-1).reshape(b, hh, ww, -1)
+
+
+# ---------------------------------------------------------------------------
+# Lane-packed variant: 128 pixels per lane tile, mask-reduce window sums.
+#
+# The window-slice kernel above iterates pixels serially; this one packs 128
+# pixels into the lane dimension and extracts windows with iota-compare
+# masks + reductions — pure VPU work with no dynamic slicing at all, so it
+# both satisfies Mosaic's layout rules and vectorizes fully. Out-of-range
+# window indices simply never match the iota, which reproduces the
+# reference's zeros padding_mode without any pre-padding.
+
+LANES = 128
+
+
+def prep_pyramid_lanes(pyramid: Sequence[jax.Array]) -> List[jax.Array]:
+    """(N, h, w, 1) levels → (h, w, N') with N' padded to a LANES multiple."""
+    out = []
+    for corr in pyramid:
+        c = jnp.squeeze(corr, -1)                        # (N, h, w)
+        pad = -c.shape[0] % LANES
+        c = jnp.pad(c, [(0, pad), (0, 0), (0, 0)])
+        out.append(c.transpose(1, 2, 0))                 # (h, w, N')
+    return out
+
+
+def _lanes_kernel(p1: int, h: int, w: int):
+    """Kernel over one level, one 128-pixel lane tile; p1 = 2r+1."""
+    p2 = p1 + 1
+    r = (p1 - 1) // 2
+
+    def kernel(xi_ref, yi_ref, fx_ref, fy_ref, corr_ref, out_ref):
+        corr = corr_ref[...]                              # (h, w, LANES)
+        fx = fx_ref[0, :]                                 # (LANES,)
+        fy = fy_ref[0, :]
+        xi = xi_ref[0, :]
+        yi = yi_ref[0, :]
+        iota_w = jax.lax.broadcasted_iota(jnp.int32, (w, LANES), 0)
+        iota_h = jax.lax.broadcasted_iota(jnp.int32, (h, LANES), 0)
+
+        # x pass: S_k[h, n] = Σ_w corr[h, w, n] · [w == xi_n + (k - r)]
+        s = []
+        for k in range(p2):
+            mask = (iota_w == (xi[None, :] + (k - r))).astype(corr.dtype)
+            s.append(jnp.sum(corr * mask[None, :, :], axis=1))   # (h, LANES)
+        # bilinear x blend: consecutive sums share the shifted index
+        rows = [(1 - fx)[None, :] * s[i] + fx[None, :] * s[i + 1]
+                for i in range(p1)]                              # 9 × (h, LANES)
+
+        # y pass: the k-masks are row-independent, so compute them once and
+        # contract every row against them; single stacked store at the end
+        # (81 scattered single-sublane stores compile poorly)
+        masks_h = [(iota_h == (yi[None, :] + (k - r))).astype(corr.dtype)
+                   for k in range(p2)]
+        outs = []
+        for i in range(p1):
+            v = [jnp.sum(rows[i] * masks_h[k], axis=0) for k in range(p2)]
+            outs.extend((1 - fy) * v[j] + fy * v[j + 1] for j in range(p1))
+        out_ref[...] = jnp.stack(outs, axis=0)                   # (81, LANES)
+
+    return kernel
+
+
+def _lookup_level_lanes(corr_t: jax.Array, coords: jax.Array, radius: int,
+                        interpret: bool) -> jax.Array:
+    """One (h, w, N') level + (N, 2) coords → (N, (2r+1)²)."""
+    n = coords.shape[0]
+    h, w, n_pad = corr_t.shape
+    p1 = 2 * radius + 1
+
+    x = coords[:, 0]
+    y = coords[:, 1]
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    xi = x0.astype(jnp.int32)[None, :]                   # window base (x)
+    yi = y0.astype(jnp.int32)[None, :]
+    fx = (x - x0).astype(corr_t.dtype)[None, :]
+    fy = (y - y0).astype(corr_t.dtype)[None, :]
+
+    extra = n_pad - n
+    if extra:
+        xi, yi, fx, fy = (jnp.pad(a, [(0, 0), (0, extra)])
+                          for a in (xi, yi, fx, fy))
+
+    vec_spec = pl.BlockSpec((1, LANES), lambda t: (0, t),
+                            memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _lanes_kernel(p1, h, w),
+        grid=(n_pad // LANES,),
+        in_specs=[vec_spec, vec_spec, vec_spec, vec_spec,
+                  pl.BlockSpec((h, w, LANES), lambda t: (0, 0, t),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((p1 * p1, LANES), lambda t: (0, t),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((p1 * p1, n_pad), corr_t.dtype),
+        interpret=interpret,
+    )(xi, yi, fx, fy, corr_t)
+    return out[:, :n].T                                  # (N, 81)
+
+
+def lookup_corr_lanes(prepped: Sequence[jax.Array], coords: jax.Array,
+                      radius: int = 4, interpret: bool = False) -> jax.Array:
+    """Lane-packed lookup over a :func:`prep_pyramid_lanes` pyramid.
+
+    Same output as models/raft.py lookup_corr (dy-major ordering, zeros
+    padding): element ``i·(2r+1)+j`` samples ``(x + d[i], y + d[j])``.
+    """
+    b, hh, ww, _ = coords.shape
+    flat = coords.reshape(b * hh * ww, 2)
+    out = [_lookup_level_lanes(corr_t, flat / (2.0 ** i), radius, interpret)
+           for i, corr_t in enumerate(prepped)]
+    return jnp.concatenate(out, axis=-1).reshape(b, hh, ww, -1)
