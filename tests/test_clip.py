@@ -191,6 +191,32 @@ def test_npz_checkpoint_with_custom_arch(tmp_path, short_video):
     assert out['clip'].shape[1] == 512
 
 
+@pytest.mark.parametrize('name', ['ViT-L/14', 'ViT-L/14@336px', 'RN50x64'])
+def test_infer_model_name_large_variants(name):
+    """Shape-only state_dicts for the large OpenAI checkpoints (reference
+    clip_src/clip.py:33-41 _MODELS): the two ViT-L/14 variants differ only
+    in input resolution, disambiguated by the positional-embedding grid."""
+    cfg = clip_model.VISUAL_CFGS[name]
+    sd = {}
+    if cfg['kind'] == 'vit':
+        grid = cfg['input_resolution'] // cfg['patch']
+        sd['visual.proj'] = np.zeros((cfg['width'], cfg['embed_dim']))
+        sd['visual.conv1.weight'] = np.zeros(
+            (cfg['width'], 3, cfg['patch'], cfg['patch']))
+        sd['visual.positional_embedding'] = np.zeros(
+            (grid * grid + 1, cfg['width']))
+        for i in range(cfg['layers']):
+            sd[f'visual.transformer.resblocks.{i}.ln_1.weight'] = (
+                np.zeros(cfg['width']))
+    else:
+        sd['visual.layer1.0.conv1.weight'] = np.zeros(
+            (cfg['width'], 1, 1, 1))
+        for li, nb in enumerate(cfg['layers'], start=1):
+            for bi in range(nb):
+                sd[f'visual.layer{li}.{bi}.bn1.weight'] = np.zeros(1)
+    assert clip_model.infer_model_name(sd) == name
+
+
 def test_infer_model_name_from_params_rn50(reference_repo):
     CLIP = _load_reference_module(
         reference_repo, 'models/clip/clip_src/model.py', 'ref_clip_model').CLIP

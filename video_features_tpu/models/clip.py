@@ -47,6 +47,12 @@ VISUAL_CFGS = {
                    input_resolution=288, embed_dim=640),
     'RN50x16': dict(kind='resnet', width=96, layers=(6, 8, 18, 8), heads=48,
                     input_resolution=384, embed_dim=768),
+    'RN50x64': dict(kind='resnet', width=128, layers=(3, 15, 36, 10), heads=64,
+                    input_resolution=448, embed_dim=1024),
+    'ViT-L/14': dict(kind='vit', width=1024, layers=24, heads=16, patch=14,
+                     input_resolution=224, embed_dim=768),
+    'ViT-L/14@336px': dict(kind='vit', width=1024, layers=24, heads=16,
+                           patch=14, input_resolution=336, embed_dim=768),
 }
 
 TEXT_CFG = dict(context_length=77, vocab_size=49408)
@@ -210,17 +216,24 @@ def zero_shot_logits(params: Params, image_feats: jax.Array,
     return scale * img @ txt.T
 
 
-def _match_visual_cfg(kind: str, width: int, layers, patch=None) -> str:
-    """Map extracted tower dimensions onto a VISUAL_CFGS key."""
+def _match_visual_cfg(kind: str, width: int, layers, patch=None,
+                      grid=None) -> str:
+    """Map extracted tower dimensions onto a VISUAL_CFGS key.
+
+    ``grid`` (ViT positional-embedding side length) disambiguates variants
+    that differ only in input resolution (ViT-L/14 vs ViT-L/14@336px).
+    """
     for name, cfg in VISUAL_CFGS.items():
         if cfg['kind'] != kind or cfg['width'] != width:
             continue
         if kind == 'vit' and cfg['patch'] == patch and cfg['layers'] == layers:
-            return name
+            if grid is None or cfg['input_resolution'] // cfg['patch'] == grid:
+                return name
         if kind == 'resnet' and tuple(cfg['layers']) == tuple(layers):
             return name
     raise NotImplementedError(
-        f'unrecognized {kind}: width={width} patch={patch} layers={layers}')
+        f'unrecognized {kind}: width={width} patch={patch} layers={layers} '
+        f'grid={grid}')
 
 
 def infer_model_name(state_dict) -> str:
@@ -235,7 +248,8 @@ def infer_model_name(state_dict) -> str:
         patch = shape('visual.conv1.weight')[-1]
         layers = len({k.split('.')[3] for k in state_dict
                       if k.startswith('visual.transformer.resblocks.')})
-        return _match_visual_cfg('vit', width, layers, patch)
+        grid = int(round((shape('visual.positional_embedding')[0] - 1) ** 0.5))
+        return _match_visual_cfg('vit', width, layers, patch, grid)
     width = shape('visual.layer1.0.conv1.weight')[0]
     layers = tuple(
         len({k.split('.')[2] for k in state_dict
@@ -250,7 +264,9 @@ def infer_model_name_from_params(params) -> str:
     if 'proj' in visual:  # ViT tower
         w = visual['conv1']['weight'].shape        # (patch, patch, 3, width)
         layers = len(visual['transformer']['resblocks'])
-        return _match_visual_cfg('vit', w[-1], layers, w[0])
+        npos = visual['positional_embedding'].shape[0]
+        grid = int(round((npos - 1) ** 0.5))
+        return _match_visual_cfg('vit', w[-1], layers, w[0], grid)
     width = visual['layer1']['0']['conv1']['weight'].shape[-1]
     layers = tuple(len(visual[f'layer{li}']) for li in (1, 2, 3, 4))
     return _match_visual_cfg('resnet', width, layers)
