@@ -116,3 +116,21 @@ def test_forward_with_lanes_lookup(monkeypatch):
     monkeypatch.setenv('VFT_RAFT_LOOKUP', 'lanes')
     got = np.asarray(raft.forward(params, img1, img2, iters=3))
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_auto_lookup_dispatch(monkeypatch):
+    """Default dispatch: lanes on TPU within the VMEM budget, dense
+    otherwise (non-TPU backends and oversized level-0 blocks)."""
+    monkeypatch.delenv('VFT_RAFT_PALLAS', raising=False)
+    monkeypatch.delenv('VFT_RAFT_LOOKUP', raising=False)
+    assert raft._lookup_impl() == 'auto'
+
+    monkeypatch.setattr(jax, 'default_backend', lambda: 'tpu')
+    assert raft._resolve_auto_lookup(28, 28) == 'lanes'     # fused i3d shape
+    assert raft._resolve_auto_lookup(135, 240) == 'dense'   # 1080p level 0
+    monkeypatch.setenv('VFT_RAFT_LANES_VMEM_MB', '64')
+    assert raft._resolve_auto_lookup(135, 240) == 'lanes'
+    monkeypatch.delenv('VFT_RAFT_LANES_VMEM_MB')
+
+    monkeypatch.setattr(jax, 'default_backend', lambda: 'cpu')
+    assert raft._resolve_auto_lookup(28, 28) == 'dense'

@@ -1,9 +1,11 @@
-"""Pallas TPU kernel for RAFT's correlation-pyramid window lookup.
+"""Pallas TPU kernels for RAFT's correlation-pyramid window lookup.
 
-NOTE: the production default is the gather-free dense-matmul formulation in
-models/raft.py::lookup_corr_dense (measured faster on both TPU and CPU);
-this kernel is the ``VFT_RAFT_LOOKUP=pallas`` alternate, kept as the
-window-slice formulation of the same op.
+Two kernels live here. The lane-packed :func:`lookup_corr_lanes` (bottom of
+file) is the production TPU default (auto-dispatched by
+models/raft.py::_resolve_auto_lookup; 14.3 → 26.9 clips/s/chip on the fused
+I3D bench on v5e). The window-slice :func:`lookup_corr` below is the
+``VFT_RAFT_LOOKUP=pallas`` alternate formulation of the same op; off-TPU the
+dense-matmul lookup_corr_dense in models/raft.py is used instead.
 
 The reference implements the lookup (reference models/raft/raft_src/corr.py:29-50)
 as 81 independent bilinear samples per pixel per pyramid level — a gather of
