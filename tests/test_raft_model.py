@@ -99,3 +99,44 @@ def test_lookup_dense_matches_gather():
         b = np.asarray(raft_model.lookup_corr_dense(py, coords))
     assert a.shape == b.shape
     np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_forward_consecutive_matches_pairwise():
+    """Frame-deduplicated encoding must equal the stacked-pair forward —
+    same math, each interior frame's fnet encoding computed once."""
+    import jax
+
+    from video_features_tpu.transplant.torch2jax import transplant
+    params = transplant(raft_model.init_state_dict(seed=0))
+    rng = np.random.RandomState(3)
+    frames = rng.randint(0, 255, (5, 48, 64, 3)).astype(np.float32)
+
+    with jax.default_matmul_precision('highest'):
+        ref = np.asarray(raft_model.forward(
+            params, frames[:-1], frames[1:], iters=3))
+        got = np.asarray(raft_model.forward_consecutive(
+            params, frames, iters=3))
+    assert got.shape == ref.shape == (4, 48, 64, 2)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_forward_stack_pairs_matches_pairwise():
+    """The fused-I3D stack form: (B, S+1) frames → (B, S) within-stack
+    flows, equal to pairwise forward on each stack's consecutive pairs."""
+    import jax
+
+    from video_features_tpu.transplant.torch2jax import transplant
+    params = transplant(raft_model.init_state_dict(seed=0))
+    rng = np.random.RandomState(4)
+    B, S = 2, 3
+    stacks = rng.randint(0, 255, (B, S + 1, 48, 64, 3)).astype(np.float32)
+
+    with jax.default_matmul_precision('highest'):
+        f1 = stacks[:, :-1].reshape(B * S, 48, 64, 3)
+        f2 = stacks[:, 1:].reshape(B * S, 48, 64, 3)
+        ref = np.asarray(raft_model.forward(params, f1, f2, iters=3))
+        got = np.asarray(raft_model.forward_stack_pairs(
+            params, stacks, iters=3))
+    assert got.shape == (B, S, 48, 64, 2)
+    np.testing.assert_allclose(got.reshape(B * S, 48, 64, 2), ref,
+                               rtol=1e-4, atol=1e-4)

@@ -50,21 +50,17 @@ def flow_stream_input(raft_params, stacks, pads, crop_size,
                       constrain_pairs=None):
     """(B, S+1, H, W, 3) frames → quantized flow I3D input (B, S, c, c, 2).
 
-    RAFT on /8-padded consecutive pairs, then the kinetics-i3d flow recipe:
-    crop the PADDED flow (the reference never unpads before TensorCenterCrop,
-    extract_i3d.py:156-164) → clamp ±20 → uint8 levels → ±1 rescale.
+    RAFT on /8-padded consecutive pairs (each interior frame's fnet
+    encoding shared between its two pairs — raft.forward_stack_pairs), then
+    the kinetics-i3d flow recipe: crop the PADDED flow (the reference never
+    unpads before TensorCenterCrop, extract_i3d.py:156-164) → clamp ±20 →
+    uint8 levels → ±1 rescale.
     """
-    B, S1, H, W, _ = stacks.shape
-    stack = S1 - 1
     t, b, l, r = pads
     padded = jnp.pad(stacks, [(0, 0), (0, 0), (t, b), (l, r), (0, 0)],
                      mode='edge')
-    f1 = padded[:, :-1].reshape(B * stack, H + t + b, W + l + r, 3)
-    f2 = padded[:, 1:].reshape(B * stack, H + t + b, W + l + r, 3)
-    if constrain_pairs is not None:
-        f1, f2 = constrain_pairs(f1), constrain_pairs(f2)
-    flow = raft_model.forward(raft_params, f1, f2)
-    flow = flow.reshape(B, stack, H + t + b, W + l + r, 2)
+    flow = raft_model.forward_stack_pairs(raft_params, padded,
+                                          constrain=constrain_pairs)
     flow = center_crop(flow, crop_size)
     return scale_to_pm1(flow_to_uint8_levels(flow, 20.0))
 
@@ -75,9 +71,10 @@ def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
 
     The full two-stream graph — RAFT flow, quantization, both I3D towers —
     compiles into a single XLA executable. ``constrain_pairs`` optionally
-    applies a sharding constraint to the (B·stack, h, w, C) flow-pair
-    tensors so the RAFT sub-graph spreads over a (data, time) mesh
-    (sequence parallelism over temporal pairs — see parallel.mesh).
+    applies a sharding constraint to the leading-flattened tensors feeding
+    RAFT's heavy sub-graphs (unique frames, fmap pairs, cnet input) so they
+    spread over a (data, time) mesh (sequence parallelism over temporal
+    pairs — see parallel.mesh).
     """
     out = {}
     if 'rgb' in streams:

@@ -80,8 +80,9 @@ class ExtractRAFT(BaseExtractor):
 
     @staticmethod
     def _flow_batch(params, frames):
-        """(B+1, Hp, Wp, 3) padded frames → (B, Hp, Wp, 2) flows."""
-        return raft_model.forward(params, frames[:-1], frames[1:])
+        """(B+1, Hp, Wp, 3) padded frames → (B, Hp, Wp, 2) flows; interior
+        frames are fnet-encoded once (forward_consecutive), not twice."""
+        return raft_model.forward_consecutive(params, frames)
 
     @staticmethod
     def _flow_pairs(params, f1, f2):

@@ -314,6 +314,11 @@ def _resolve_auto_lookup(h8: int, w8: int) -> str:
     return 'dense'
 
 
+def _normalize_frames(img: jax.Array) -> jax.Array:
+    """0..255 RGB → ±1 (done inside forward in the reference, raft.py:121-122)."""
+    return 2.0 * (jnp.asarray(img, jnp.float32) / 255.0) - 1.0
+
+
 def forward(params: Params, image1: jax.Array, image2: jax.Array,
             iters: int = ITERS) -> jax.Array:
     """Two (B, H, W, 3) frames (values 0..255) → (B, H, W, 2) flow.
@@ -321,14 +326,62 @@ def forward(params: Params, image1: jax.Array, image2: jax.Array,
     H, W must be divisible by 8 (reference pads with InputPadder, raft.py:30-48
     — see :func:`pad_to_multiple` / :func:`unpad`).
     """
-    image1 = 2.0 * (jnp.asarray(image1, jnp.float32) / 255.0) - 1.0
-    image2 = 2.0 * (jnp.asarray(image2, jnp.float32) / 255.0) - 1.0
-
+    image1 = _normalize_frames(image1)
+    image2 = _normalize_frames(image2)
     fmap1 = basic_encoder(params['fnet'], image1, 'instance')
     fmap2 = basic_encoder(params['fnet'], image2, 'instance')
-    pyramid = build_corr_pyramid(fmap1, fmap2)
-
     cnet = basic_encoder(params['cnet'], image1, 'batch')
+    return _refine(params, fmap1, fmap2, cnet, iters)
+
+
+def forward_consecutive(params: Params, frames: jax.Array,
+                        iters: int = ITERS) -> jax.Array:
+    """(N, H, W, 3) consecutive frames → (N-1, H, W, 2) pairwise flows.
+
+    Same math as :func:`forward` on ``(frames[:-1], frames[1:])`` — the
+    extractors' consecutive-pair batching (reference
+    base_flow_extractor.py:76-84) makes every interior frame both the
+    ``image2`` of one pair and the ``image1`` of the next, so its fnet
+    encoding is computed ONCE here and shared, where the reference's
+    stacked-pair form encodes it twice (raft.py:84-85).
+    """
+    return forward_stack_pairs(params, frames[None], iters)[0]
+
+
+def forward_stack_pairs(params: Params, stacks: jax.Array, iters: int = ITERS,
+                        constrain=None) -> jax.Array:
+    """(B, S+1, H, W, 3) frame stacks → (B, S, H, W, 2) within-stack flows.
+
+    The fused I3D path's form of :func:`forward_consecutive`: fnet runs on
+    the B·(S+1) unique frames instead of the 2·B·S stacked pair halves.
+    ``constrain`` (optional) applies a sharding constraint to every
+    leading-flattened tensor entering the heavy sub-graphs (frames, fmap
+    pairs, cnet) so the sub-graphs spread over a (data, time) mesh.
+    """
+    B, S1, H, W, C = stacks.shape
+    S = S1 - 1
+    flat = _normalize_frames(stacks.reshape(B * S1, H, W, C))
+    if constrain is not None:
+        flat = constrain(flat)
+    fmaps = basic_encoder(params['fnet'], flat, 'instance')
+    h8, w8, c = fmaps.shape[1:]
+    fmaps = fmaps.reshape(B, S1, h8, w8, c)
+    fmap1 = fmaps[:, :-1].reshape(B * S, h8, w8, c)
+    fmap2 = fmaps[:, 1:].reshape(B * S, h8, w8, c)
+    first = flat.reshape(B, S1, H, W, C)[:, :-1].reshape(B * S, H, W, C)
+    if constrain is not None:
+        fmap1, fmap2, first = constrain(fmap1), constrain(fmap2), constrain(first)
+    cnet = basic_encoder(params['cnet'], first, 'batch')
+    flow = _refine(params, fmap1, fmap2, cnet, iters)
+    return flow.reshape(B, S, flow.shape[1], flow.shape[2], 2)
+
+
+def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
+            cnet: jax.Array, iters: int) -> jax.Array:
+    """Correlation pyramid + 20-iteration GRU refinement + 8× upsample —
+    the shared core behind every forward variant (reference raft.py:118-175
+    from the post-encoder point on)."""
+    pyramid = build_corr_pyramid(fmap1, fmap2)
     net, inp = jnp.split(cnet, [HIDDEN_DIM], axis=-1)
     net = jnp.tanh(net)
     inp = relu(inp)
