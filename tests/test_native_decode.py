@@ -10,6 +10,21 @@ needs_native = pytest.mark.skipif(
     not native.available(), reason='libvfdecode.so unavailable')
 
 
+def assert_frames_close(a, b, mean_tol=2.0, frac_tol=2e-3, hard_max=200):
+    """Native vs cv2 frame closeness.
+
+    Both run swscale, but the native service pins SWS_ACCURATE_RND (the
+    alignment-independent paths — required for deterministic output, see
+    native/vfdecode.cc ensure_sws) while cv2 runs the SIMD paths, so the
+    two differ by chroma-rounding noise: mean <1 level on real content,
+    larger excursions only on hard synthetic edges. Bit-equality with cv2
+    is not reproducible (cv2's own output is alignment-luck)."""
+    d = np.abs(np.asarray(a).astype(np.int32) - np.asarray(b).astype(np.int32))
+    assert d.mean() <= mean_tol, f'mean delta {d.mean()}'
+    assert (d > 8).mean() <= frac_tol, f'large-delta fraction {(d > 8).mean()}'
+    assert d.max() <= hard_max, f'max delta {d.max()}'
+
+
 @needs_native
 def test_frame_parity_vs_cv2(sample_video_2):
     nat = list(native.NativeFrameDecoder(sample_video_2))
@@ -17,7 +32,7 @@ def test_frame_parity_vs_cv2(sample_video_2):
     assert len(nat) == len(cv) > 0
     for (i, a), (j, b) in zip(nat[:64], cv[:64]):
         assert i == j
-        np.testing.assert_array_equal(a, b)
+        assert_frames_close(a, b)
 
 
 @needs_native
@@ -49,7 +64,8 @@ def test_videoloader_backend_equivalence(short_video):
     nat, cv = batches('native'), batches('cv2')
     assert len(nat) == len(cv)
     for (nb, nt, ni), (cb, ct, ci) in zip(nat, cv):
-        np.testing.assert_array_equal(nb, cb)
+        assert nb.shape == cb.shape
+        assert_frames_close(nb, cb)
         assert nt == ct and ni == ci
 
 
@@ -63,7 +79,7 @@ def test_videoloader_native_with_fps_resample(short_video):
                       use_ffmpeg=False, backend='cv2')
     ref_frames = [f for b, _, _ in ref for f in b]
     assert len(frames) == len(ref_frames) > 0
-    np.testing.assert_array_equal(np.stack(frames), np.stack(ref_frames))
+    assert_frames_close(np.stack(frames), np.stack(ref_frames))
 
 
 def test_prefetch_order_and_completeness():
@@ -127,7 +143,7 @@ def test_rotation_metadata(short_video, tmp_path):
     cv = [f for _, f in zip(range(4), (fr for _, fr in Cv2FrameDecoder(rot)))]
     if cv[0].shape != nat[0].shape:
         pytest.skip('this cv2 build does not auto-rotate')
-    np.testing.assert_array_equal(np.stack(nat), np.stack(cv))
+    assert_frames_close(np.stack(nat), np.stack(cv))
 
 
 def test_native_audio_tone_roundtrip(tmp_path):
@@ -187,3 +203,31 @@ def test_vggish_native_backend_e2e(sample_video, tmp_path):
     # the sample clip is ~18 s → 18 examples of 0.96 s
     assert feats.shape[1] == 128 and feats.shape[0] >= 15
     assert np.isfinite(feats).all()
+
+
+@needs_native
+def test_decode_deterministic_odd_width(tmp_path):
+    """Repeated decodes must be bit-identical even when width % 8 != 0.
+
+    swscale's SIMD tail paths are alignment-dependent without
+    SWS_BITEXACT|SWS_ACCURATE_RND (native/vfdecode.cc ensure_sws); the
+    destination numpy chunks land at varying addresses, which silently
+    corrupted the last columns differently on every run."""
+    import cv2
+    path = str(tmp_path / 'odd.mp4')
+    w, h = 340, 256  # 340 % 8 == 4 exercises the tail path
+    wr = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*'mp4v'), 25.0, (w, h))
+    rng = np.random.RandomState(0)
+    for t in range(12):
+        frame = (rng.rand(h, w, 3) * 255).astype(np.uint8)
+        wr.write(frame)
+    wr.release()
+
+    def decode():
+        return [f.copy() for _, f in native.NativeFrameDecoder(path)]
+
+    a, b, c = decode(), decode(), decode()
+    assert len(a) == 12
+    for x, y in ((a, b), (a, c)):
+        for fa, fb in zip(x, y):
+            np.testing.assert_array_equal(fa, fb)
