@@ -126,3 +126,23 @@ def test_config_attr_access():
     assert c['b'] == 2
     with pytest.raises(AttributeError):
         _ = c.missing
+
+
+def test_precision_validated(tmp_path):
+    v = _mk_video(tmp_path)
+    args = load_config('resnet', overrides={
+        'video_paths': v, 'device': 'cpu', 'precision': 'default'})
+    assert args.precision == 'default'
+    with pytest.raises(AssertionError, match='precision'):
+        load_config('resnet', overrides={
+            'video_paths': v, 'device': 'cpu', 'precision': 'fp8'})
+
+
+def test_precision_reaches_extractor(tmp_path):
+    from video_features_tpu.registry import create_extractor
+    v = _mk_video(tmp_path)
+    args = load_config('resnet', overrides={
+        'video_paths': v, 'device': 'cpu', 'batch_size': 2,
+        'precision': 'default', 'compilation_cache_dir': None})
+    ex = create_extractor(args)
+    assert ex.precision == 'default'

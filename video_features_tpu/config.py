@@ -158,6 +158,11 @@ def sanity_check(args: Config) -> None:
         args['device'] = 'tpu'
     args['device'] = resolve_device(args.get('device', 'cpu'))
 
+    from video_features_tpu.utils.device import MATMUL_PRECISIONS
+    prec = args.get('precision', 'highest')
+    assert prec in MATMUL_PRECISIONS, (
+        f'precision must be one of {MATMUL_PRECISIONS}; got {prec!r}')
+
     assert args.get('file_with_video_paths') or args.get('video_paths'), \
         '`video_paths` or `file_with_video_paths` must be specified'
     filenames = [Path(p).stem for p in form_list_from_user_input(

@@ -31,6 +31,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
             profile=args.get('profile', False),
+            precision=args.get('precision', 'highest'),
         )
         self.batch_size = args.batch_size
         self.decode_workers = int(args.get('decode_workers', 1))
@@ -75,7 +76,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
         # wrap_iter times decode+preprocess on the prefetch producer thread
         batches = prefetch(
             self.tracer.wrap_iter('decode+preprocess', loader), depth=2)
-        with jax.default_matmul_precision('highest'):
+        with self.precision_scope():
             # decode thread fills batch k+1 while the device runs batch k
             for batch, times, _ in batches:
                 batch = np.stack(batch)

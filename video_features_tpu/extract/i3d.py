@@ -110,6 +110,7 @@ class ExtractI3D(BaseExtractor):
             device=args.device,
             concat_rgb_flow=args.get('concat_rgb_flow', False),
             profile=args.get('profile', False),
+            precision=args.get('precision', 'highest'),
         )
         self.streams: List[str] = (['rgb', 'flow'] if args.streams is None
                                    else [args.streams])
@@ -217,7 +218,7 @@ class ExtractI3D(BaseExtractor):
             if self.show_pred:
                 self.maybe_show_pred(stacks[:valid], state['pads'], window_idx)
 
-        with jax.default_matmul_precision('highest'):
+        with self.precision_scope():
             # decode thread assembles window k+1 while the device runs k
             run_batched_windows(
                 prefetch(self._stream_windows(loader), depth=2),

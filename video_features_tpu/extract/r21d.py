@@ -50,6 +50,7 @@ class ExtractR21D(BaseExtractor):
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
             profile=args.get('profile', False),
+            precision=args.get('precision', 'highest'),
         )
         self.model_name = args.model_name
         self.model_def = MODEL_CFGS[self.model_name]
@@ -125,7 +126,7 @@ class ExtractR21D(BaseExtractor):
                     self.maybe_show_pred(out[k:k + 1], start,
                                          start + self.stack_size)
 
-        with jax.default_matmul_precision('highest'):
+        with self.precision_scope():
             # decode thread assembles stack k+1 while the device runs k
             run_batched_windows(prefetch(windows, depth=2),
                                 self.stack_batch, run)

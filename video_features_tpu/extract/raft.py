@@ -45,6 +45,7 @@ class ExtractRAFT(BaseExtractor):
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
             profile=args.get('profile', False),
+            precision=args.get('precision', 'highest'),
         )
         self.batch_size = args.batch_size
         self.decode_workers = int(args.get('decode_workers', 1))
@@ -113,7 +114,7 @@ class ExtractRAFT(BaseExtractor):
         first = True
         batches = prefetch(
             self.tracer.wrap_iter('decode+preprocess', loader), depth=2)
-        with jax.default_matmul_precision('highest'):
+        with self.precision_scope():
             for batch, times, _ in batches:
                 batch = np.stack(batch)                      # (n, H, W, 3)
                 timestamps.extend(times if first else times[1:])

@@ -35,6 +35,7 @@ class ExtractS3D(BaseExtractor):
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
             profile=args.get('profile', False),
+            precision=args.get('precision', 'highest'),
         )
         self.stack_size = args.stack_size
         self.step_size = args.step_size
@@ -105,7 +106,7 @@ class ExtractS3D(BaseExtractor):
                                          start + self.stack_size,
                                          state['resize_hw'])
 
-        with jax.default_matmul_precision('highest'):
+        with self.precision_scope():
             # decode thread assembles stack k+1 while the device runs k
             run_batched_windows(prefetch(windows, depth=2),
                                 self.stack_batch, run)

@@ -37,6 +37,7 @@ class ExtractVGGish(BaseExtractor):
             keep_tmp_files=args.keep_tmp_files,
             device=args.device,
             profile=args.get('profile', False),
+            precision=args.get('precision', 'highest'),
         )
         if args.show_pred:
             raise NotImplementedError('vggish has no show_pred (reference '
@@ -151,7 +152,7 @@ class ExtractVGGish(BaseExtractor):
             return np.zeros((0, vggish_model.FEAT_DIM), np.float32)
         B = self.example_batch
         out = []
-        with jax.default_matmul_precision('highest'):
+        with self.precision_scope():
             for start in range(0, n, B):
                 chunk = examples[start:start + B]
                 valid = chunk.shape[0]

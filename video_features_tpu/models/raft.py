@@ -356,7 +356,10 @@ def forward_stack_pairs(params: Params, stacks: jax.Array, iters: int = ITERS,
     the B·(S+1) unique frames instead of the 2·B·S stacked pair halves.
     ``constrain`` (optional) applies a sharding constraint to every
     leading-flattened tensor entering the heavy sub-graphs (frames, fmap
-    pairs, cnet) so the sub-graphs spread over a (data, time) mesh.
+    pairs, cnet) so the sub-graphs spread over a (data, time) mesh. The
+    B·(S+1) frames tensor generally does not divide the mesh evenly (the
+    +1 halo); GSPMD pads the last shards, a ≤1-frame-per-shard imbalance
+    on fnet that still beats sharding fnet over the data axis alone.
     """
     B, S1, H, W, C = stacks.shape
     S = S1 - 1

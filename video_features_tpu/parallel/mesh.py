@@ -77,7 +77,10 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def pair_sharding(mesh: Mesh) -> NamedSharding:
-    """(B·S, h, w, C) flow-pair sharding over BOTH axes — each device gets a
-    contiguous run of temporal pairs; no halo exchange is needed because
-    all-pairs correlation is local to a pair."""
+    """Leading-axis sharding over BOTH axes — each device gets a contiguous
+    run of rows; no halo exchange is needed because all-pairs correlation
+    is local to a pair. Used for the (B·S, …) flow-pair/cnet tensors (even
+    split) and the B·(S+1) unique-frames tensor feeding fnet, where the +1
+    halo leaves the last shards padded by ≤1 frame (see
+    raft.forward_stack_pairs)."""
     return NamedSharding(mesh, P((DATA_AXIS, TIME_AXIS)))

@@ -37,5 +37,8 @@ def create_extractor(args: 'Config') -> 'BaseExtractor':
     except KeyError:
         raise NotImplementedError(f'Extractor {feature_type!r} is not implemented. '
                                   f'Known: {", ".join(EXTRACTORS)}')
+    if hasattr(args, 'get'):
+        from video_features_tpu.utils.device import enable_compilation_cache
+        enable_compilation_cache(args.get('compilation_cache_dir'))
     module = importlib.import_module(module_name)
     return getattr(module, class_name)(args)

@@ -1,7 +1,36 @@
 """Device resolution helpers shared by extractors."""
 from __future__ import annotations
 
+import os
+
 import jax
+
+MATMUL_PRECISIONS = ('default', 'high', 'highest',
+                     'bfloat16', 'tensorfloat32', 'float32')
+
+
+def enable_compilation_cache(cache_dir) -> None:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    The fused extraction graphs take minutes to compile at ``highest``
+    precision; the cache makes every process after the first (restarted or
+    concurrent shared-filesystem workers — the reference's scale-out unit,
+    reference README.md:70-84) skip straight to execution. Falsy
+    ``cache_dir`` disables. Safe to call repeatedly; failures (read-only
+    filesystem, backend without executable serialization) degrade to
+    cache misses, never errors.
+    """
+    if not cache_dir:
+        return
+    try:
+        path = os.path.expanduser(str(cache_dir))
+        os.makedirs(path, exist_ok=True)
+        jax.config.update('jax_compilation_cache_dir', path)
+        # default threshold is 60s; our steady-state steps are seconds, so
+        # cache everything that takes meaningful compile time
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+    except Exception as e:  # pragma: no cover - depends on fs/backend
+        print(f'WARNING: compilation cache unavailable ({e}); compiling cold')
 
 
 def pin_cpu_platform() -> None:
