@@ -118,3 +118,10 @@ def test_transform_worker_exception_propagates(short_video):
                          transform_workers=2)
     with pytest.raises(ValueError, match='boom'):
         next(iter(loader))
+
+
+def test_missing_file_raises_filenotfound(tmp_path):
+    with pytest.raises(FileNotFoundError, match='does not exist'):
+        VideoLoader('/nonexistent/clip.mp4', batch_size=4)
+    with pytest.raises(FileNotFoundError, match='does not exist'):
+        VideoLoader(str(tmp_path), batch_size=4)  # a directory is not a video

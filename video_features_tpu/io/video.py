@@ -176,6 +176,10 @@ class VideoLoader:
         self._tmp_file: Optional[str] = None
 
         path = str(path)
+        if not os.path.isfile(path):
+            # probe failures otherwise surface as opaque downstream errors
+            # (e.g. cv2 reporting negative frame counts)
+            raise FileNotFoundError(f'video does not exist: {path}')
         props = self._probe_props(path)
         self.height, self.width = props['height'], props['width']
         src_fps, src_frames = props['fps'], props['num_frames']
