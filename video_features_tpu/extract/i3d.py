@@ -195,11 +195,15 @@ class ExtractI3D(BaseExtractor):
         from video_features_tpu.extract.streaming import run_batched_windows
         from video_features_tpu.io.video import prefetch
 
+        # frames stay uint8 until they are on the device: values are exact
+        # integers either way, and a (B, S+1, 256, W, 3) float32 stack batch
+        # is 4x the host->device bytes of the uint8 one — H2D bandwidth is
+        # the CLI's bottleneck ahead of the fused compute
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files,
-            transform=lambda f: resize_pil(f, MIN_SIDE_SIZE).astype(np.float32),
+            transform=lambda f: resize_pil(f, MIN_SIDE_SIZE),
             transform_workers=self.decode_workers)
 
         feats: Dict[str, list] = {s: [] for s in self.streams}

@@ -91,9 +91,11 @@ class ExtractRAFT(BaseExtractor):
         return raft_model.forward(params, f1, f2)
 
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
+        # uint8 until on-device (RAFT normalizes in-graph): the values are
+        # exact integers either way and the H2D transfer is 4x smaller
         if self.side_size is not None:
             frame = resize_pil(frame, self.side_size, self.resize_to_smaller_edge)
-        return frame.astype(np.float32)
+        return frame
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         if self.data_parallel and self._mesh is None:

@@ -29,8 +29,12 @@ def to_float_zero_one(x: Array) -> Array:
 
 
 def scale_to_pm1(x: Array) -> Array:
-    """[0,255] → [-1,1] via 2x/255 - 1 (reference transforms.py:146-149)."""
-    return x * (2.0 / 255.0) - 1.0
+    """[0,255] → [-1,1] via 2x/255 - 1 (reference transforms.py:146-149).
+
+    Accepts uint8 (the extractors ship frames to the device undilated) or
+    float input; either way the result is float32.
+    """
+    return jnp.asarray(x, jnp.float32) * (2.0 / 255.0) - 1.0
 
 
 def normalize(x: Array, mean: Sequence[float], std: Sequence[float]) -> Array:
