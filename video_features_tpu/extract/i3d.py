@@ -133,8 +133,8 @@ class ExtractI3D(BaseExtractor):
         self.data_parallel = args.get('data_parallel', False)
         if self.data_parallel:
             from video_features_tpu.parallel import (
-                build_sharded_two_stream_step, make_mesh, put_replicated,
-                round_batch_to_data_axis,
+                build_sharded_two_stream_step, make_mesh, put_batch,
+                put_replicated, round_batch_to_data_axis,
             )
             from video_features_tpu.utils.device import jax_devices_all
             # self._mesh keeps the one-flag-per-extractor invariant from
@@ -145,6 +145,7 @@ class ExtractI3D(BaseExtractor):
             self.batch_size = round_batch_to_data_axis(self.batch_size,
                                                        self.mesh)
             self.params = put_replicated(self.mesh, self.load_params(args))
+            self._put_batch = partial(put_batch, self.mesh)
             sharded = build_sharded_two_stream_step(
                 self.mesh, streams=tuple(self.streams))
 
@@ -192,7 +193,7 @@ class ExtractI3D(BaseExtractor):
                               self.tracer, 'decode+preprocess')
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        from video_features_tpu.extract.streaming import run_batched_windows
+        from video_features_tpu.extract.streaming import iter_batched_windows
         from video_features_tpu.io.video import prefetch
 
         # frames stay uint8 until they are on the device: values are exact
@@ -209,6 +210,14 @@ class ExtractI3D(BaseExtractor):
         feats: Dict[str, list] = {s: [] for s in self.streams}
         state = {'pads': None}
 
+        def to_device(item):
+            # async copy started on the producer thread — the H2D transfer
+            # of batch k+1 overlaps the device computing batch k
+            stacks, valid, window_idx = item
+            if self._mesh is not None:
+                return self._put_batch(stacks), valid, window_idx
+            return jax.device_put(stacks, self._device), valid, window_idx
+
         def run(stacks, valid, window_idx):
             if state['pads'] is None:
                 H, W = stacks.shape[2:4]
@@ -223,10 +232,15 @@ class ExtractI3D(BaseExtractor):
                 self.maybe_show_pred(stacks[:valid], state['pads'], window_idx)
 
         with self.precision_scope():
-            # decode thread assembles window k+1 while the device runs k
-            run_batched_windows(
-                prefetch(self._stream_windows(loader), depth=2),
-                self.batch_size, run)
+            # decode thread assembles + transfers batch k+1 while the
+            # device runs batch k; depth=1 bounds the extra device-resident
+            # input buffers to ~2 batches (queued + mid-transfer) — deeper
+            # queues pin more HBM for no additional overlap
+            batches = iter_batched_windows(
+                self._stream_windows(loader), self.batch_size)
+            for stacks, valid, window_idx in prefetch(
+                    map(to_device, batches), depth=1):
+                run(stacks, valid, window_idx)
 
         return {
             s: (np.concatenate(v, axis=0) if v

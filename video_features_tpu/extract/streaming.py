@@ -20,34 +20,37 @@ import numpy as np
 from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
 
 
-def run_batched_windows(windows: Iterable[np.ndarray], batch: int,
-                        run: Callable[[np.ndarray, int, int], None]) -> None:
-    """Group streamed windows into fixed-size batches and call ``run``.
-
-    ``run(stacks, valid, window_idx)`` receives a (batch, ...) array whose
-    tail is padded by repeating the last window (mask with ``[:valid]``)
-    and the absolute index of the first window in the batch. Shared by the
-    stack-based extractors so the pad/mask/flush bookkeeping exists once.
+def iter_batched_windows(windows: Iterable[np.ndarray],
+                         batch: int) -> Iterator[tuple]:
+    """Group streamed windows into fixed-size ``(stacks, valid, window_idx)``
+    batches: a (batch, ...) array whose tail is padded by repeating the last
+    window (mask with ``[:valid]``) plus the absolute index of the batch's
+    first window. Generator form so a caller can map a device transfer over
+    it inside ``io.video.prefetch`` — batch assembly AND host→device copy
+    then run on the producer thread, overlapped with device compute.
     """
     pending: List[np.ndarray] = []
     window_idx = 0
-
-    def flush() -> None:
-        nonlocal window_idx
-        valid = len(pending)
-        while len(pending) < batch:
-            pending.append(pending[-1])
-        stacks = np.stack(pending)
-        pending.clear()
-        run(stacks, valid, window_idx)
-        window_idx += valid
-
     for window in windows:
         pending.append(window)
         if len(pending) == batch:
-            flush()
+            valid = len(pending)
+            yield np.stack(pending), valid, window_idx
+            pending.clear()
+            window_idx += valid
     if pending:
-        flush()
+        valid = len(pending)
+        while len(pending) < batch:
+            pending.append(pending[-1])
+        yield np.stack(pending), valid, window_idx
+
+
+def run_batched_windows(windows: Iterable[np.ndarray], batch: int,
+                        run: Callable[[np.ndarray, int, int], None]) -> None:
+    """Callback form of :func:`iter_batched_windows` — shared by the
+    stack-based extractors so the pad/mask/flush bookkeeping exists once."""
+    for stacks, valid, window_idx in iter_batched_windows(windows, batch):
+        run(stacks, valid, window_idx)
 
 
 def stream_windows(batches: Iterable, win: int, step: int,
