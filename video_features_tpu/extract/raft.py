@@ -131,7 +131,6 @@ class ExtractRAFT(BaseExtractor):
                 # attributes host vs device time consistently across extractors
                 padded, pads = raft_model.pad_to_multiple(
                     batch, mode=self.finetuned_on)
-                padded = np.asarray(padded)
                 with self.tracer.stage('model'):
                     if self._mesh is not None:
                         flow = self._dp_step(self.params,

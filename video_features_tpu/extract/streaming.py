@@ -31,18 +31,23 @@ def iter_batched_windows(windows: Iterable[np.ndarray],
     """
     pending: List[np.ndarray] = []
     window_idx = 0
-    for window in windows:
-        pending.append(window)
-        if len(pending) == batch:
-            valid = len(pending)
-            yield np.stack(pending), valid, window_idx
-            pending.clear()
-            window_idx += valid
-    if pending:
+
+    def flush():
         valid = len(pending)
         while len(pending) < batch:
             pending.append(pending[-1])
-        yield np.stack(pending), valid, window_idx
+        out = (np.stack(pending), valid, window_idx)
+        pending.clear()
+        return out, valid
+
+    for window in windows:
+        pending.append(window)
+        if len(pending) == batch:
+            out, valid = flush()
+            yield out
+            window_idx += valid
+    if pending:
+        yield flush()[0]
 
 
 def run_batched_windows(windows: Iterable[np.ndarray], batch: int,

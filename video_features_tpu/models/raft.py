@@ -436,6 +436,9 @@ def pad_to_multiple(x: jax.Array, mode: str = 'sintel',
 
     Reference InputPadder (raft.py:30-48): sintel centers the pad; kitti pads
     bottom-only in height. Returns (padded, (top, bottom, left, right)).
+    numpy input pads with numpy (a ``jnp.pad`` here would silently bounce a
+    host batch through the default device and back — one extra H2D+D2H round
+    trip per extraction step).
     """
     H, W = x.shape[1], x.shape[2]
     pad_h = (((H // multiple) + 1) * multiple - H) % multiple
@@ -445,7 +448,8 @@ def pad_to_multiple(x: jax.Array, mode: str = 'sintel',
     else:
         pads = (0, pad_h, pad_w // 2, pad_w - pad_w // 2)
     t, b, l, r = pads
-    x = jnp.pad(x, [(0, 0), (t, b), (l, r), (0, 0)], mode='edge')
+    pad_fn = np.pad if isinstance(x, np.ndarray) else jnp.pad
+    x = pad_fn(x, [(0, 0), (t, b), (l, r), (0, 0)], mode='edge')
     return x, pads
 
 
