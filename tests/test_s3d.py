@@ -69,3 +69,12 @@ def test_e2e_extraction(short_video, tmp_path):
     feats = ex.extract(short_video)['s3d']
     assert feats.shape == (3, 1024)
     assert np.isfinite(feats).all()
+
+
+def test_too_small_stack_clear_error():
+    """stack_size < 16 leaves < 2 temporal positions at the head — must
+    fail with a clear message, not an opaque reshape ZeroDivisionError."""
+    params = transplant(s3d_model.init_state_dict())
+    x = np.zeros((1, 8, 224, 224, 3), np.float32)
+    with pytest.raises(ValueError, match='stack_size >= 16'):
+        s3d_model.forward(params, x)

@@ -71,6 +71,17 @@ class BaseExtractor:
         import jax
         return jax.default_matmul_precision(self.precision)
 
+    def put_input(self, batch):
+        """Place one host input batch on the device(s): sharded over the
+        mesh when data-parallel, else committed to the extractor's device.
+        Safe to call from prefetch producer threads (device_put is async
+        and thread-safe), which is how extractors overlap the H2D transfer
+        of batch k+1 with the device computing batch k."""
+        if self._mesh is not None:
+            return self._put_batch(batch)
+        import jax
+        return jax.device_put(batch, self._device)
+
     def _ensure_mesh(self, batch_attr: str) -> None:
         """Lazy in-graph data-parallel setup shared by every DP extractor.
 

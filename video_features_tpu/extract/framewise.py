@@ -17,7 +17,8 @@ import jax
 import numpy as np
 
 from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.io.video import VideoLoader, prefetch
+from video_features_tpu.extract.streaming import transfer_batches
+from video_features_tpu.io.video import VideoLoader
 
 
 class BaseFrameWiseExtractor(BaseExtractor):
@@ -73,19 +74,24 @@ class BaseFrameWiseExtractor(BaseExtractor):
             transform_workers=self.decode_workers,
         )
         feats, timestamps = [], []
-        # wrap_iter times decode+preprocess on the prefetch producer thread
-        batches = prefetch(
-            self.tracer.wrap_iter('decode+preprocess', loader), depth=2)
-        with self.precision_scope():
-            # decode thread fills batch k+1 while the device runs batch k
-            for batch, times, _ in batches:
+
+        def assembled():
+            # pad tails to the compiled batch shape on the producer thread
+            for batch, times, _ in self.tracer.wrap_iter(
+                    'decode+preprocess', loader):
                 batch = np.stack(batch)
                 valid = batch.shape[0]
-                if valid < self.batch_size:  # pad tail to the compiled shape
-                    pad = np.repeat(batch[-1:], self.batch_size - valid, axis=0)
+                if valid < self.batch_size:
+                    pad = np.repeat(batch[-1:], self.batch_size - valid,
+                                    axis=0)
                     batch = np.concatenate([batch, pad], axis=0)
-                if self._mesh is not None:
-                    batch = self._put_batch(batch)
+                yield batch, valid, times
+
+        with self.precision_scope():
+            # transfer of batch k+1 overlaps the device running batch k
+            # (see streaming.transfer_batches)
+            for batch, _, valid, times in transfer_batches(
+                    assembled(), self.put_input):
                 with self.tracer.stage('model'):
                     out = np.asarray(self.device_step(batch))[:valid]
                 feats.append(out)

@@ -193,8 +193,9 @@ class ExtractI3D(BaseExtractor):
                               self.tracer, 'decode+preprocess')
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        from video_features_tpu.extract.streaming import iter_batched_windows
-        from video_features_tpu.io.video import prefetch
+        from video_features_tpu.extract.streaming import (
+            iter_batched_windows, transfer_batches,
+        )
 
         # frames stay uint8 until they are on the device: values are exact
         # integers either way, and a (B, S+1, 256, W, 3) float32 stack batch
@@ -209,14 +210,6 @@ class ExtractI3D(BaseExtractor):
 
         feats: Dict[str, list] = {s: [] for s in self.streams}
         state = {'pads': None}
-
-        def to_device(item):
-            # async copy started on the producer thread — the H2D transfer
-            # of batch k+1 overlaps the device computing batch k
-            stacks, valid, window_idx = item
-            if self._mesh is not None:
-                return self._put_batch(stacks), valid, window_idx
-            return jax.device_put(stacks, self._device), valid, window_idx
 
         def run(stacks, valid, window_idx):
             if state['pads'] is None:
@@ -233,13 +226,11 @@ class ExtractI3D(BaseExtractor):
 
         with self.precision_scope():
             # decode thread assembles + transfers batch k+1 while the
-            # device runs batch k; depth=1 bounds the extra device-resident
-            # input buffers to ~2 batches (queued + mid-transfer) — deeper
-            # queues pin more HBM for no additional overlap
+            # device runs batch k (see streaming.transfer_batches)
             batches = iter_batched_windows(
                 self._stream_windows(loader), self.batch_size)
-            for stacks, valid, window_idx in prefetch(
-                    map(to_device, batches), depth=1):
+            for stacks, _, valid, window_idx in transfer_batches(
+                    batches, self.put_input):
                 run(stacks, valid, window_idx)
 
         return {

@@ -99,7 +99,6 @@ class ExtractR21D(BaseExtractor):
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         from video_features_tpu.extract.streaming import stream_windows
-        from video_features_tpu.io.video import prefetch
 
         if self.data_parallel:
             self._ensure_mesh('stack_batch')
@@ -110,26 +109,26 @@ class ExtractR21D(BaseExtractor):
         windows = stream_windows(loader, self.stack_size, self.step_size,
                                  self.tracer, 'decode')
 
-        from video_features_tpu.extract.streaming import run_batched_windows
+        from video_features_tpu.extract.streaming import (
+            iter_batched_windows, transfer_batches,
+        )
 
         feats: list = []
 
-        def run(stacks, valid, window_idx):
-            if self._mesh is not None:
-                stacks = self._put_batch(stacks)
-            with self.tracer.stage('model'):
-                out = np.asarray(self._step(self.params, stacks))[:valid]
-            feats.append(out)
-            if self.show_pred:
-                for k in range(valid):
-                    start = (window_idx + k) * self.step_size
-                    self.maybe_show_pred(out[k:k + 1], start,
-                                         start + self.stack_size)
-
         with self.precision_scope():
-            # decode thread assembles stack k+1 while the device runs k
-            run_batched_windows(prefetch(windows, depth=2),
-                                self.stack_batch, run)
+            # decode thread assembles + transfers stack batch k+1 while
+            # the device runs k (see streaming.transfer_batches)
+            for stacks, _, valid, window_idx in transfer_batches(
+                    iter_batched_windows(windows, self.stack_batch),
+                    self.put_input):
+                with self.tracer.stage('model'):
+                    out = np.asarray(self._step(self.params, stacks))[:valid]
+                feats.append(out)
+                if self.show_pred:
+                    for k in range(valid):
+                        start = (window_idx + k) * self.step_size
+                        self.maybe_show_pred(out[k:k + 1], start,
+                                             start + self.stack_size)
 
         feats = (np.concatenate(feats, axis=0) if feats
                  else np.zeros((0, 512), np.float32))

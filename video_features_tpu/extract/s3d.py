@@ -67,7 +67,6 @@ class ExtractS3D(BaseExtractor):
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         from video_features_tpu.extract.streaming import stream_windows
-        from video_features_tpu.io.video import prefetch
 
         if self.data_parallel:
             self._ensure_mesh('stack_batch')
@@ -78,12 +77,14 @@ class ExtractS3D(BaseExtractor):
         windows = stream_windows(loader, self.stack_size, self.step_size,
                                  self.tracer, 'decode')
 
-        from video_features_tpu.extract.streaming import run_batched_windows
+        from video_features_tpu.extract.streaming import (
+            iter_batched_windows, transfer_batches,
+        )
 
         state = {'step': None, 'resize_hw': None}
         feats: list = []
 
-        def run(stacks, valid, window_idx):
+        def run(stacks, host_stacks, valid, window_idx):
             if state['step'] is None:
                 # short-side 224, torch F.interpolate semantics, static per
                 # video geometry
@@ -92,24 +93,24 @@ class ExtractS3D(BaseExtractor):
                                       else (int(224 * h / w), 224))
                 state['step'] = jax.jit(
                     partial(self._forward, resize_hw=state['resize_hw']))
-            if self._mesh is not None:
-                stacks = self._put_batch(stacks)
             with self.tracer.stage('model'):
                 out = np.asarray(state['step'](self.params, stacks))[:valid]
             feats.append(out)
             if self.show_pred:
-                # one D2H transfer for the whole (possibly sharded) batch
-                stacks_np = np.asarray(stacks)
                 for k in range(valid):
                     start = (window_idx + k) * self.step_size
-                    self.maybe_show_pred(stacks_np[k:k + 1], start,
+                    self.maybe_show_pred(host_stacks[k:k + 1], start,
                                          start + self.stack_size,
                                          state['resize_hw'])
 
         with self.precision_scope():
-            # decode thread assembles stack k+1 while the device runs k
-            run_batched_windows(prefetch(windows, depth=2),
-                                self.stack_batch, run)
+            # decode thread assembles + transfers stack batch k+1 while
+            # the device runs k; the host batch rides along for show_pred
+            # (see streaming.transfer_batches)
+            for stacks, host_stacks, valid, window_idx in transfer_batches(
+                    iter_batched_windows(windows, self.stack_batch),
+                    self.put_input, keep_host=self.show_pred):
+                run(stacks, host_stacks, valid, window_idx)
 
         feats = (np.concatenate(feats, axis=0) if feats
                  else np.zeros((0, s3d_model.FEAT_DIM), np.float32))

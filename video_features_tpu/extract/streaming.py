@@ -13,7 +13,7 @@ are dropped, like the reference, extract_i3d.py:126-129).
 """
 from __future__ import annotations
 
-from typing import Callable, Iterable, Iterator, List
+from typing import Iterable, Iterator, List
 
 import numpy as np
 
@@ -50,12 +50,31 @@ def iter_batched_windows(windows: Iterable[np.ndarray],
         yield flush()[0]
 
 
-def run_batched_windows(windows: Iterable[np.ndarray], batch: int,
-                        run: Callable[[np.ndarray, int, int], None]) -> None:
-    """Callback form of :func:`iter_batched_windows` — shared by the
-    stack-based extractors so the pad/mask/flush bookkeeping exists once."""
-    for stacks, valid, window_idx in iter_batched_windows(windows, batch):
-        run(stacks, valid, window_idx)
+def transfer_batches(items: Iterable[tuple], put,
+                     keep_host: bool = False) -> Iterator[tuple]:
+    """Overlap host→device input transfer with device compute.
+
+    ``items`` yields ``(host_batch, *meta)``; ``put`` places one batch on
+    the device(s) (``BaseExtractor.put_input``). Returns a prefetched
+    iterator of ``(device_batch, host_batch | None, *meta)`` where the
+    async copy of batch k+1 starts on the producer thread while the
+    consumer runs batch k. ``depth=1`` bounds the extra device-resident
+    input buffers to ~2 batches (queued + mid-transfer) — deeper queues
+    pin more HBM for no additional overlap. ``keep_host=True`` carries the
+    host array alongside (debug surfaces like show_pred read pixels
+    without paying a D2H round trip). The single home for this transfer
+    policy — every batched extractor drives its device loop through here.
+    """
+    from video_features_tpu.io.video import prefetch
+
+    def to_device(item):
+        batch = item[0]
+        host = batch if keep_host else None
+        return (put(batch), host) + tuple(item[1:])
+
+    return prefetch(map(to_device, items), depth=1)
+
+
 
 
 def stream_windows(batches: Iterable, win: int, step: int,

@@ -95,6 +95,12 @@ def forward(params: Params, x: jax.Array, features: bool = True) -> jax.Array:
             x = max_pool(x, spec['k'], stride=spec['s'], padding=spec['p'])
     # head: avg over (2, H, W) window stride 1, then mean over time
     B, T, H, W, C = x.shape
+    if T < 2:
+        # temporal stride through the net is 8; the reference's torch
+        # avg_pool3d fails the same way, just more opaquely
+        raise ValueError(
+            f'S3D head needs >= 2 temporal positions after downsampling '
+            f'(got {T}); use stack_size >= 16')
     x = avg_pool(x, (2, H, W), stride=1)          # (B, T-1, 1, 1, C)
     if not features:
         x = conv(x, params['fc']['0']['weight'], bias=params['fc']['0']['bias'])
