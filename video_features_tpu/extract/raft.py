@@ -26,7 +26,8 @@ import jax
 import numpy as np
 
 from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.io.video import VideoLoader, prefetch
+from video_features_tpu.extract.streaming import transfer_batches
+from video_features_tpu.io.video import VideoLoader
 from video_features_tpu.models import raft as raft_model
 from video_features_tpu.ops.transforms import resize_pil
 from video_features_tpu.utils.device import jax_device
@@ -113,35 +114,54 @@ class ExtractRAFT(BaseExtractor):
             overlap=1,
         )
         flows, timestamps = [], []
-        first = True
-        batches = prefetch(
-            self.tracer.wrap_iter('decode+preprocess', loader), depth=2)
-        with self.precision_scope():
-            for batch, times, _ in batches:
+
+        def assembled():
+            # stack + tail-pad + /8-pad on the producer thread; 'model'
+            # stage stays pure device time
+            first = True
+            for batch, times, _ in self.tracer.wrap_iter(
+                    'decode+preprocess', loader):
                 batch = np.stack(batch)                      # (n, H, W, 3)
-                timestamps.extend(times if first else times[1:])
+                ts = times if first else times[1:]
                 first = False
                 if batch.shape[0] < 2:
+                    yield None, None, 0, ts   # timestamps only, no pairs
                     continue
                 valid = batch.shape[0] - 1
                 if batch.shape[0] < self.batch_size + 1:
-                    pad = np.repeat(batch[-1:], self.batch_size + 1 - batch.shape[0], axis=0)
+                    pad = np.repeat(
+                        batch[-1:], self.batch_size + 1 - batch.shape[0],
+                        axis=0)
                     batch = np.concatenate([batch, pad], axis=0)
-                # host-side padding stays outside 'model' so the stage table
-                # attributes host vs device time consistently across extractors
                 padded, pads = raft_model.pad_to_multiple(
                     batch, mode=self.finetuned_on)
+                yield padded, pads, valid, ts
+
+        def put(padded):
+            if padded is None:
+                return None
+            if self._mesh is not None:
+                # dp feeds the pair tensors data-sharded (one-frame halo
+                # paid host-side) rather than the B+1 frame batch
+                return (self._put_batch(padded[:-1]),
+                        self._put_batch(padded[1:]))
+            return self.put_input(padded)
+
+        with self.precision_scope():
+            # transfer of batch k+1 overlaps the device running batch k
+            for dev, _, pads, valid, ts in transfer_batches(assembled(), put):
+                timestamps.extend(ts)
+                if dev is None:
+                    continue
                 with self.tracer.stage('model'):
                     if self._mesh is not None:
-                        flow = self._dp_step(self.params,
-                                             self._put_batch(padded[:-1]),
-                                             self._put_batch(padded[1:]))
+                        flow = self._dp_step(self.params, *dev)
                     else:
-                        flow = self._step(self.params, padded)
+                        flow = self._step(self.params, dev)
                     flow = np.asarray(raft_model.unpad(flow, pads))[:valid]
                 flows.append(flow)
                 if self.show_pred:
-                    self.maybe_show_pred(flow, batch[:valid])
+                    self.maybe_show_pred(flow)
 
         if flows:
             features = np.concatenate(flows, axis=0).transpose(0, 3, 1, 2)
@@ -157,7 +177,7 @@ class ExtractRAFT(BaseExtractor):
             'timestamps_ms': np.array(timestamps),
         }
 
-    def maybe_show_pred(self, flows: np.ndarray, frames: np.ndarray) -> None:
+    def maybe_show_pred(self, flows: np.ndarray) -> None:
         """Render flow frames via the Middlebury wheel (headless-safe)."""
         from video_features_tpu.utils.flow_viz import flow_to_image
         for flow in flows[:1]:

@@ -75,8 +75,6 @@ def transfer_batches(items: Iterable[tuple], put,
     return prefetch(map(to_device, items), depth=1)
 
 
-
-
 def stream_windows(batches: Iterable, win: int, step: int,
                    tracer: Tracer = NULL_TRACER,
                    stage: str = 'decode') -> Iterator[np.ndarray]:

@@ -39,6 +39,7 @@ def create_extractor(args: 'Config') -> 'BaseExtractor':
                                   f'Known: {", ".join(EXTRACTORS)}')
     if hasattr(args, 'get'):
         from video_features_tpu.utils.device import enable_compilation_cache
-        enable_compilation_cache(args.get('compilation_cache_dir'))
+        enable_compilation_cache(args.get('compilation_cache_dir'),
+                                 str(args.get('device') or 'any'))
     module = importlib.import_module(module_name)
     return getattr(module, class_name)(args)

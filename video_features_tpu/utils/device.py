@@ -9,7 +9,27 @@ MATMUL_PRECISIONS = ('default', 'high', 'highest',
                      'bfloat16', 'tensorfloat32', 'float32')
 
 
-def enable_compilation_cache(cache_dir) -> None:
+def _host_fingerprint() -> str:
+    """Architecture + CPU-feature-flag hash identifying this host's
+    executable compatibility. Same-arch hosts with different ISA extensions
+    (AVX-512 vs not) must NOT share XLA:CPU AOT cache entries — the
+    architecture name alone ('x86_64') cannot tell them apart."""
+    import hashlib
+    import platform as _platform
+    flags = ''
+    try:
+        with open('/proc/cpuinfo') as f:
+            for line in f:
+                if line.startswith(('flags', 'Features')):
+                    flags = line
+                    break
+    except OSError:
+        flags = _platform.processor()
+    h = hashlib.sha1(flags.encode()).hexdigest()[:8]
+    return f'{_platform.machine()}-{h}'
+
+
+def enable_compilation_cache(cache_dir, device: str = 'any') -> None:
     """Point jax's persistent compilation cache at ``cache_dir``.
 
     The fused extraction graphs take minutes to compile at ``highest``
@@ -19,11 +39,19 @@ def enable_compilation_cache(cache_dir) -> None:
     ``cache_dir`` disables. Safe to call repeatedly; failures (read-only
     filesystem, backend without executable serialization) degrade to
     cache misses, never errors.
+
+    ``device`` (the resolved config device — passed rather than asking
+    jax, which would initialize backends before a CPU run pins its
+    platform) scopes the directory: XLA:CPU AOT entries record the
+    compiling machine's CPU features and can SIGILL when loaded on a
+    different machine, so a shared dir must never serve entries across
+    backends or heterogeneous hosts.
     """
     if not cache_dir:
         return
     try:
-        path = os.path.expanduser(str(cache_dir))
+        sub = f'{device}-{_host_fingerprint()}'
+        path = os.path.join(os.path.expanduser(str(cache_dir)), sub)
         os.makedirs(path, exist_ok=True)
         jax.config.update('jax_compilation_cache_dir', path)
         # default threshold is 60s; our steady-state steps are seconds, so
