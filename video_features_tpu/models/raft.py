@@ -223,19 +223,37 @@ def motion_encoder(p: Params, flow: jax.Array, corr: jax.Array) -> jax.Array:
     return jnp.concatenate([out, flow], -1)
 
 
+def fuse_gru_params(p: Params) -> Params:
+    """Stack each direction's z/r gate conv weights on the output axis.
+
+    The z and r gates read the same ``hx`` input, so one conv with stacked
+    output channels computes both — identical per-channel math (each output
+    channel's reduction is independent), half the ``hx`` HBM reads. Done
+    once before the GRU scan so the concat provably never re-runs per
+    iteration.
+    """
+    out = {}
+    for suffix in ('1', '2'):
+        zw, rw = p[f'convz{suffix}'], p[f'convr{suffix}']
+        out[f'convzr{suffix}'] = {
+            'weight': jnp.concatenate([zw['weight'], rw['weight']], axis=-1),
+            'bias': jnp.concatenate([zw['bias'], rw['bias']]),
+        }
+        out[f'convq{suffix}'] = p[f'convq{suffix}']
+    return out
+
+
 def sep_conv_gru(p: Params, h: jax.Array, x: jax.Array) -> jax.Array:
+    """SepConvGRU (reference update.py:39-77): 1×5 then 5×1 passes over
+    :func:`fuse_gru_params`-prepared weights."""
     for suffix, pad in (('1', [(0, 0), (2, 2)]), ('2', [(2, 2), (0, 0)])):
         hx = jnp.concatenate([h, x], -1)
-        z = jax.nn.sigmoid(_conv_b(p[f'convz{suffix}'], hx, padding=pad))
-        r = jax.nn.sigmoid(_conv_b(p[f'convr{suffix}'], hx, padding=pad))
+        zr = jax.nn.sigmoid(_conv_b(p[f'convzr{suffix}'], hx, padding=pad))
+        z, r = jnp.split(zr, 2, axis=-1)
         q = jnp.tanh(_conv_b(p[f'convq{suffix}'],
                              jnp.concatenate([r * h, x], -1), padding=pad))
         h = (1 - z) * h + z * q
     return h
-
-
-def flow_head(p: Params, x: jax.Array) -> jax.Array:
-    return _conv_b(p['conv2'], relu(_conv_b(p['conv1'], x, padding=1)), padding=1)
 
 
 def upsample_flow(flow: jax.Array, mask: jax.Array) -> jax.Array:
@@ -412,16 +430,28 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
     else:
         lookup = partial(lookup_corr_dense, pyramid)
 
+    # The flow head's conv1 and the mask head's first conv both read
+    # net_new through a 3x3 conv + relu — fuse them with stacked output
+    # channels (independent per-channel math; the weight concat is
+    # loop-invariant and hoists out of the scan), halving that read.
+    fh, mk = up['flow_head'], up['mask']
+    head_w = jnp.concatenate([fh['conv1']['weight'], mk['0']['weight']],
+                             axis=-1)
+    head_b = jnp.concatenate([fh['conv1']['bias'], mk['0']['bias']])
+    head_split = fh['conv1']['weight'].shape[-1]
+    gru = fuse_gru_params(up['gru'])
+
     def step(carry, _):
         net, coords1, _ = carry
         corr = lookup(coords1)
         flow = coords1 - coords0
         motion = motion_encoder(up['encoder'], flow, corr)
-        net_new = sep_conv_gru(up['gru'], net, jnp.concatenate([inp, motion], -1))
-        delta = flow_head(up['flow_head'], net_new)
+        net_new = sep_conv_gru(gru, net, jnp.concatenate([inp, motion], -1))
+        t = relu(conv(net_new, head_w, padding=1, bias=head_b))
+        t_flow, t_mask = jnp.split(t, [head_split], axis=-1)
+        delta = _conv_b(fh['conv2'], t_flow, padding=1)
         coords1_new = coords1 + delta
-        mask = 0.25 * _conv_b(up['mask']['2'],
-                              relu(_conv_b(up['mask']['0'], net_new, padding=1)))
+        mask = 0.25 * _conv_b(mk['2'], t_mask)
         return (net_new, coords1_new, mask), None
 
     mask0 = jnp.zeros((B, H8, W8, 576), net.dtype)
