@@ -315,3 +315,36 @@ def test_data_parallel_warn_path_for_future_unsupported(
     })
     assert args['data_parallel'] is False
     assert 'not implemented for resnet' in capsys.readouterr().out
+
+
+def test_raft_halo_shard_dp_matches_single_device():
+    """The data-parallel halo layout (each device gets its k+1-frame run,
+    boundary frames duplicated host-side) must reproduce the single-device
+    forward_consecutive at few iterations (same fp-noise caveat as the
+    pair-sharding test)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from video_features_tpu.models import raft as raft_model
+    from video_features_tpu.parallel import put_batch, put_replicated
+    from video_features_tpu.transplant.torch2jax import transplant
+
+    params = transplant(raft_model.init_state_dict())
+    rng = np.random.RandomState(4)
+    n, k = 8, 2
+    frames = rng.randint(0, 255, (n * k + 1, 64, 64, 3)).astype(np.float32)
+
+    with jax.default_matmul_precision('highest'):
+        ref = np.asarray(raft_model.forward_consecutive(
+            params, frames, iters=3))
+
+        mesh = make_mesh(n_devices=n, time_parallel=1)
+        halo = np.stack([frames[d * k: d * k + k + 1] for d in range(n)])
+        halo = halo.reshape(n * (k + 1), 64, 64, 3)
+        step = jax.jit(shard_map(
+            lambda p, f: raft_model.forward_consecutive(p, f, iters=3),
+            mesh=mesh, in_specs=(P(), P('data')), out_specs=P('data')))
+        out = np.asarray(step(put_replicated(mesh, params),
+                              put_batch(mesh, halo)))
+    assert out.shape == ref.shape == (n * k, 64, 64, 2)
+    np.testing.assert_allclose(out, ref, atol=5e-4, rtol=1e-4)

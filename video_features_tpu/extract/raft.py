@@ -60,11 +60,12 @@ class ExtractRAFT(BaseExtractor):
         self.show_pred = args.show_pred
         self.output_feat_keys = [self.feature_type, 'fps', 'timestamps_ms']
         # data_parallel=true spreads the B consecutive-pair flows over all
-        # local devices: the pair tensors f1=frames[:-1], f2=frames[1:] are
-        # materialized on the host (the one-frame halo is paid once there)
-        # and fed with a data-axis sharding, so each device receives only
-        # its own pairs — no replication of the frame batch, no in-graph
-        # halo exchange.
+        # local devices: the host hands each device its own run of k+1
+        # frames (k = B / n_devices; the one-frame halo at shard boundaries
+        # is duplicated host-side), and a shard_map'd forward_consecutive
+        # encodes each device's frames ONCE — interior frames share their
+        # fnet encoding between their two pairs exactly like the
+        # single-device path, and no in-graph halo exchange is needed.
         self.data_parallel = args.get('data_parallel', False)
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
@@ -86,10 +87,27 @@ class ExtractRAFT(BaseExtractor):
         frames are fnet-encoded once (forward_consecutive), not twice."""
         return raft_model.forward_consecutive(params, frames)
 
-    @staticmethod
-    def _flow_pairs(params, f1, f2):
-        """Pair-tensor form for data_parallel: inputs arrive data-sharded."""
-        return raft_model.forward(params, f1, f2)
+    def _build_dp_step(self):
+        """shard_map'd per-device forward_consecutive over the data axis.
+
+        Input is the host-assembled halo layout (n·(k+1), Hp, Wp, 3):
+        device d's shard holds frames [d·k, d·k + k] inclusive, so its k
+        flows concatenate to the global (B, Hp, Wp, 2) result in order.
+        """
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        return jax.jit(shard_map(
+            raft_model.forward_consecutive, mesh=self._mesh,
+            in_specs=(P(), P('data')), out_specs=P('data')))
+
+    def _halo_shards(self, padded: np.ndarray) -> np.ndarray:
+        """(B+1, ...) frames → (n·(k+1), ...) per-device runs with the
+        boundary frame duplicated; fnet cost is B + n frame encodes instead
+        of the pair form's 2·B."""
+        n = self._mesh.shape['data']
+        k = (padded.shape[0] - 1) // n
+        halo = np.stack([padded[d * k: d * k + k + 1] for d in range(n)])
+        return halo.reshape((n * (k + 1),) + padded.shape[1:])
 
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
         # uint8 until on-device (RAFT normalizes in-graph): the values are
@@ -101,7 +119,7 @@ class ExtractRAFT(BaseExtractor):
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         if self.data_parallel and self._mesh is None:
             self._ensure_mesh('batch_size')
-            self._dp_step = jax.jit(self._flow_pairs)
+            self._dp_step = self._build_dp_step()
         loader = VideoLoader(
             video_path,
             batch_size=self.batch_size + 1,
@@ -141,10 +159,9 @@ class ExtractRAFT(BaseExtractor):
             if padded is None:
                 return None
             if self._mesh is not None:
-                # dp feeds the pair tensors data-sharded (one-frame halo
-                # paid host-side) rather than the B+1 frame batch
-                return (self._put_batch(padded[:-1]),
-                        self._put_batch(padded[1:]))
+                # dp feeds per-device frame runs (host-duplicated one-frame
+                # halo) so each device fnet-encodes its frames once
+                return self._put_batch(self._halo_shards(padded))
             return self.put_input(padded)
 
         with self.precision_scope():
@@ -154,10 +171,9 @@ class ExtractRAFT(BaseExtractor):
                 if dev is None:
                     continue
                 with self.tracer.stage('model'):
-                    if self._mesh is not None:
-                        flow = self._dp_step(self.params, *dev)
-                    else:
-                        flow = self._step(self.params, dev)
+                    step = (self._dp_step if self._mesh is not None
+                            else self._step)
+                    flow = step(self.params, dev)
                     flow = np.asarray(raft_model.unpad(flow, pads))[:valid]
                 flows.append(flow)
                 if self.show_pred:

@@ -408,7 +408,10 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
     inp = relu(inp)
 
     B, H8, W8, _ = fmap1.shape
-    coords0 = coords_grid(B, H8, W8)
+    # + zeros_like keeps shard_map's varying-axes type: constant carry
+    # inits must match the varying outputs of the scan body when _refine
+    # runs inside a shard_map shard (the add folds away otherwise)
+    coords0 = coords_grid(B, H8, W8) + jnp.zeros_like(fmap1[..., :2])
     up = params['update_block']
 
     impl = _lookup_impl()
@@ -454,7 +457,7 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
         mask = 0.25 * _conv_b(mk['2'], t_mask)
         return (net_new, coords1_new, mask), None
 
-    mask0 = jnp.zeros((B, H8, W8, 576), net.dtype)
+    mask0 = jnp.zeros((B, H8, W8, 576), net.dtype) + jnp.zeros_like(net[..., :1])
     (net, coords1, mask), _ = lax.scan(step, (net, coords0, mask0), None,
                                        length=iters)
     return upsample_flow(coords1 - coords0, mask)

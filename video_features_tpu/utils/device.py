@@ -51,10 +51,11 @@ def enable_compilation_cache(cache_dir, device: str = 'any') -> None:
         return
     try:
         # the ISA-fingerprint hazard only applies to XLA:CPU AOT entries;
-        # accelerator executables don't depend on host CPU features, so
-        # 'tpu' keeps one shared subdir across pod hosts (full hit rate)
-        sub = (f'{device}-{_host_fingerprint()}' if device != 'tpu'
-               else device)
+        # accelerator executables don't depend on host CPU features, so any
+        # non-CPU device keeps one shared subdir across hosts (full hit
+        # rate). 'any' (unresolved device) gets the safe fingerprinted dir.
+        sub = (f'{device}-{_host_fingerprint()}'
+               if device in ('cpu', 'any') else device)
         path = os.path.join(os.path.expanduser(str(cache_dir)), sub)
         os.makedirs(path, exist_ok=True)
         jax.config.update('jax_compilation_cache_dir', path)
