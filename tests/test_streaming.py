@@ -1,0 +1,74 @@
+"""Unit tests for the shared streaming helpers: window batching semantics
+(tail padding, valid counts, window indices) and the producer-thread
+transfer pipeline (ordering, keep_host, passthrough put)."""
+import numpy as np
+
+from video_features_tpu.extract.streaming import (
+    iter_batched_windows, stream_windows, transfer_batches,
+)
+
+
+def _windows(n, shape=(2, 3)):
+    return [np.full(shape, i, np.float32) for i in range(n)]
+
+
+def test_iter_batched_windows_exact_multiple():
+    out = list(iter_batched_windows(iter(_windows(6)), batch=3))
+    assert [(v, i) for _, v, i in out] == [(3, 0), (3, 3)]
+    for stacks, _, start in out:
+        assert stacks.shape == (3, 2, 3)
+        np.testing.assert_array_equal(stacks[:, 0, 0],
+                                      np.arange(start, start + 3))
+
+
+def test_iter_batched_windows_tail_padding():
+    out = list(iter_batched_windows(iter(_windows(5)), batch=3))
+    assert [(v, i) for _, v, i in out] == [(3, 0), (2, 3)]
+    tail = out[-1][0]
+    # tail padded by repeating the last window; mask with [:valid]
+    np.testing.assert_array_equal(tail[:, 0, 0], [3.0, 4.0, 4.0])
+
+
+def test_iter_batched_windows_empty_and_single():
+    assert list(iter_batched_windows(iter([]), batch=4)) == []
+    out = list(iter_batched_windows(iter(_windows(1)), batch=4))
+    assert len(out) == 1
+    stacks, valid, idx = out[0]
+    assert (valid, idx) == (1, 0)
+    assert stacks.shape == (4, 2, 3)
+
+
+def test_transfer_batches_order_and_meta():
+    items = [(np.full((2,), i, np.float32), 10 * i, f'm{i}')
+             for i in range(7)]
+    seen_by_put = []
+
+    def put(batch):
+        seen_by_put.append(float(batch[0]))
+        return batch + 1000.0  # stand-in for a device placement
+
+    out = list(transfer_batches(iter(items), put))
+    assert seen_by_put == [float(i) for i in range(7)]  # producer order
+    for i, (dev, host, meta1, meta2) in enumerate(out):
+        assert float(dev[0]) == 1000.0 + i
+        assert host is None
+        assert (meta1, meta2) == (10 * i, f'm{i}')
+
+
+def test_transfer_batches_keep_host():
+    items = [(np.full((2,), i, np.float32), i) for i in range(3)]
+    out = list(transfer_batches(iter(items), put=lambda b: b * 0, keep_host=True))
+    for i, (dev, host, meta) in enumerate(out):
+        assert float(host[0]) == float(i)   # untouched host array
+        assert float(dev[0]) == 0.0
+        assert meta == i
+
+
+def test_stream_windows_overlapping_steps():
+    """step < win: overlapping windows, matching form_slices semantics."""
+    frames = [np.full((1,), i, np.float32) for i in range(10)]
+    batches = iter([(frames[:4], None, None), (frames[4:], None, None)])
+    wins = list(stream_windows(batches, win=4, step=2))
+    # starts at 0, 2, 4, 6; start 8 would need frame 11 -> dropped
+    assert [int(w[0, 0]) for w in wins] == [0, 2, 4, 6]
+    assert all(w.shape == (4, 1) for w in wins)
