@@ -11,7 +11,7 @@ like the reference (:75-79).
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+from typing import Dict
 
 import jax
 import numpy as np

@@ -13,10 +13,9 @@ the parameter budget of a full 3-D conv: mid = (i*o*27) // (i*9 + 3*o).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from video_features_tpu.ops.nn import adaptive_avg_pool, batch_norm, conv, linear, relu

@@ -7,7 +7,7 @@ state_dict names; layout NHWC.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict
 
 import jax
 import numpy as np

@@ -16,7 +16,6 @@ Numerics parity notes (vs torch, for checkpoint-transplant fidelity):
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, Optional, Sequence, Tuple, Union
 
 import jax
