@@ -1,0 +1,8 @@
+"""Regular package marker.
+
+Required: parity tests put /root/reference on sys.path, and the reference
+repo's own ``tests`` directory is a regular package — without this
+__init__.py ours would be a namespace portion, and regular packages beat
+namespace portions regardless of sys.path order, silently shadowing
+``tests.torch_mirrors`` / ``tests.reference_pipeline``.
+"""

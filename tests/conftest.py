@@ -18,6 +18,12 @@ import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
 
+# Pretrained blobs are not bundled: the suite intentionally runs random
+# weights (parity tests transplant seeded torch modules instead). The
+# production path hard-errors without this escape — tests/test_weights.py
+# unsets it to assert that.
+os.environ.setdefault('VFT_ALLOW_RANDOM_WEIGHTS', '1')
+
 REPO_ROOT = Path(__file__).parent.parent
 REFERENCE_ROOT = Path('/root/reference')
 
@@ -59,6 +65,31 @@ def short_video(tmp_path_factory) -> str:
     h = int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
     writer = cv2.VideoWriter(out, cv2.VideoWriter_fourcc(*'mp4v'), fps, (w, h))
     for _ in range(48):
+        ok, frame = cap.read()
+        if not ok:
+            break
+        writer.write(frame)
+    writer.release()
+    cap.release()
+    return out
+
+
+@pytest.fixture(scope='session')
+def video_33(tmp_path_factory) -> str:
+    """A 33-frame clip: exactly two stack_size=16 windows (2·16+1 frames)
+    for the end-to-end golden parity tests."""
+    import cv2
+
+    src = REFERENCE_ROOT / 'sample' / 'v_ZNVhz7ctTq0.mp4'
+    if not src.exists():
+        pytest.skip('sample video unavailable')
+    out = str(tmp_path_factory.mktemp('vids33') / 'clip33.mp4')
+    cap = cv2.VideoCapture(str(src))
+    fps = cap.get(cv2.CAP_PROP_FPS)
+    w = int(cap.get(cv2.CAP_PROP_FRAME_WIDTH))
+    h = int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
+    writer = cv2.VideoWriter(out, cv2.VideoWriter_fourcc(*'mp4v'), fps, (w, h))
+    for _ in range(33):
         ok, frame = cap.read()
         if not ok:
             break

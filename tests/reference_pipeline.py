@@ -1,0 +1,137 @@
+"""Reference-equivalent end-to-end I3D two-stream pipeline.
+
+The reference's full CLI stack needs omegaconf + torchvision (absent here),
+but everything that defines its *numerics* imports cleanly: the I3D net
+(models/i3d/i3d_src/i3d_net.py), RAFT (models/raft/raft_src/raft.py), and
+the transform classes (models/transforms.py). This module re-composes the
+exact extraction loop of reference models/i3d/extract_i3d.py:95-170 from
+those pieces — cv2 decode → ResizeImproved(256) → (stack_size+1)-frame
+stacks → RAFT on padded consecutive pairs → per-stream transforms → I3D —
+so golden end-to-end fixtures can be recorded from the reference
+implementation and compared against ours at the `.npy` level.
+
+Run with any state dicts: seeded-random ones in this environment (the
+pretrained blobs are not available — see .MISSING_LARGE_BLOBS), or the real
+checkpoints when present; the comparison harness is identical either way.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def build_reference_nets(seed: int = 0, streams=('rgb', 'flow')):
+    """Seeded reference torch nets {rgb, flow, raft} in eval mode.
+
+    Requires /root/reference on sys.path (tests: the `reference_repo`
+    fixture). With real checkpoints, load their state dicts into these same
+    modules instead.
+    """
+    import torch
+
+    from models.i3d.i3d_src.i3d_net import I3D
+    from models.raft.raft_src.raft import RAFT
+
+    torch.manual_seed(seed)
+    nets = {}
+    for stream in streams:
+        if stream in ('rgb', 'flow'):
+            nets[stream] = I3D(num_classes=400, modality=stream).eval()
+    if 'flow' in streams:
+        nets['raft'] = RAFT().eval()
+    return nets
+
+
+def save_state_dicts(nets, out_dir) -> Dict[str, str]:
+    """Write each net's state_dict as a .pt checkpoint; returns name→path."""
+    import torch
+    from pathlib import Path
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    paths = {}
+    for name, net in nets.items():
+        path = out_dir / f'{name}_seeded.pt'
+        torch.save(net.state_dict(), str(path))
+        paths[name] = str(path)
+    return paths
+
+
+def run_reference_i3d(video_path: str, nets, stack_size: int = 16,
+                      step_size: Optional[int] = None,
+                      streams=('rgb', 'flow'),
+                      min_side: int = 256,
+                      crop: int = 224) -> Dict[str, np.ndarray]:
+    """The reference extract loop, verbatim semantics, composed by hand.
+
+    Mirrors reference models/i3d/extract_i3d.py:
+      * cv2 BGR→RGB, ToPILImage→ResizeImproved(256)→PILToTensor→ToFloat
+        (:43-48, :106-108);
+      * stacks of stack_size+1 frames; flow = RAFT(padded[:-1], padded[1:])
+        (:115-123, :156-158);
+      * rgb stream uses the first stack_size frames (:160-163);
+      * rgb transforms: TensorCenterCrop(224)→ScaleTo1_1;
+        flow: TensorCenterCrop(224)→Clamp(±20)→ToUInt8→ScaleTo1_1 (:49-62);
+      * partial final stacks are dropped (:126-129).
+    """
+    import cv2
+    import torch
+    from PIL import Image
+
+    from models.raft.raft_src.raft import InputPadder
+    from models.transforms import (
+        Clamp, PILToTensor, ResizeImproved, ScaleTo1_1, TensorCenterCrop,
+        ToFloat, ToUInt8,
+    )
+
+    resize_improved = ResizeImproved(min_side)
+    pil_to_tensor = PILToTensor()
+    to_float = ToFloat()
+    t_crop = TensorCenterCrop(crop)
+    t_clamp = Clamp(-20, 20)
+    t_uint8 = ToUInt8()
+    t_scale = ScaleTo1_1()
+
+    if step_size is None:
+        step_size = stack_size
+
+    def preprocess(bgr):
+        rgb = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+        t = to_float(pil_to_tensor(resize_improved(Image.fromarray(rgb))))
+        return t.unsqueeze(0)
+
+    feats: Dict[str, List] = {s: [] for s in streams}
+    rgb_stack: List = []
+    padder = None
+    cap = cv2.VideoCapture(video_path)
+    first_frame = True
+    with torch.no_grad():
+        while cap.isOpened():
+            frame_exists, frame = cap.read()
+            if first_frame:
+                first_frame = False
+                if frame_exists is False:
+                    continue
+            if not frame_exists:
+                cap.release()
+                break
+            t = preprocess(frame)
+            if padder is None:
+                padder = InputPadder(t.shape)
+            rgb_stack.append(t)
+            if len(rgb_stack) - 1 == stack_size:
+                batch = torch.cat(rgb_stack)
+                for stream in streams:
+                    if stream == 'flow':
+                        x = nets['raft'](padder.pad(batch)[:-1],
+                                         padder.pad(batch)[1:])
+                        x = t_scale(t_uint8(t_clamp(t_crop(x))))
+                    else:
+                        x = t_scale(t_crop(batch[:-1]))
+                    # PermuteAndUnsqueeze: (T, C, H, W) → (1, C, T, H, W)
+                    x = x.permute(1, 0, 2, 3).unsqueeze(0)
+                    feats[stream].extend(
+                        nets[stream](x, features=True).numpy().tolist())
+                rgb_stack = rgb_stack[step_size:]
+    return {s: np.asarray(v, dtype=np.float32) for s, v in feats.items()}

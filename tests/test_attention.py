@@ -72,3 +72,18 @@ def test_ring_custom_scale():
     got = sequence_sharded_attention(mesh, q, k, v, scale=0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_blockwise_ragged_matches_dense():
+    """ViT token counts (grid²+1) are never block-aligned; the pad+mask
+    path must agree with dense attention."""
+    import numpy as np
+
+    from video_features_tpu.ops.attention import (
+        blockwise_attention, dense_attention,
+    )
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(2, 197, 3, 16).astype(np.float32) for _ in range(3))
+    ref = np.asarray(dense_attention(q, k, v))
+    got = np.asarray(blockwise_attention(q, k, v, block_size=64))
+    np.testing.assert_allclose(got, ref, atol=2e-5)

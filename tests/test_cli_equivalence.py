@@ -17,6 +17,9 @@ from video_features_tpu.config import load_config
 from video_features_tpu.registry import create_extractor
 from video_features_tpu.utils.output import load_numpy, load_pickle
 
+pytestmark = pytest.mark.slow  # parity/e2e/sharding: full lane only
+
+
 KEYS = ('resnet', 'fps', 'timestamps_ms')
 
 

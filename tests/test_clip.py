@@ -8,6 +8,9 @@ from video_features_tpu.models import clip as clip_model
 from video_features_tpu.registry import create_extractor
 from video_features_tpu.transplant.torch2jax import transplant
 
+pytestmark = pytest.mark.slow  # parity/e2e/sharding: full lane only
+
+
 
 def _load_reference_module(reference_repo, relpath, name):
     """Import a reference source file directly, bypassing package __init__s

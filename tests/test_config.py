@@ -133,7 +133,8 @@ def test_precision_validated(tmp_path):
     args = load_config('resnet', overrides={
         'video_paths': v, 'device': 'cpu', 'precision': 'default'})
     assert args.precision == 'default'
-    with pytest.raises(AssertionError, match='precision'):
+    # ValueError (not assert) so validation survives `python -O`
+    with pytest.raises(ValueError, match='precision'):
         load_config('resnet', overrides={
             'video_paths': v, 'device': 'cpu', 'precision': 'fp8'})
 

@@ -8,6 +8,7 @@ from video_features_tpu.config import load_config
 from video_features_tpu.registry import create_extractor
 
 
+@pytest.mark.slow
 def test_e2e_rgb_only(short_video, tmp_path):
     args = load_config('i3d', overrides={
         'video_paths': short_video,
@@ -31,6 +32,7 @@ def test_e2e_rgb_only(short_video, tmp_path):
     assert (tmp_path / 'out' / 'i3d' / f'{stem}.npy').exists()
 
 
+@pytest.mark.slow
 def test_fused_two_stream_step():
     """The flagship fused graph: stacks → RAFT flow → both I3D towers."""
     args = load_config('i3d', overrides={
@@ -94,9 +96,11 @@ def test_stream_windows_matches_form_slices(stack, step, total):
         np.testing.assert_array_equal(g, w)
 
 
-def test_show_pred_covers_both_streams(capsys):
+def test_show_pred_covers_both_streams(capsys, tmp_path):
     """Reference parity: the classifier head prints top-5 for EVERY stream
-    (reference extract_i3d.py:212-216), flow included."""
+    (reference extract_i3d.py:212-216), flow included; headless flow viz
+    preserves the cv2-window artifact as a PNG (base_flow_extractor.py:
+    134-149)."""
     import jax
     jax.config.update('jax_platforms', 'cpu')
     import numpy as np
@@ -108,6 +112,8 @@ def test_show_pred_covers_both_streams(capsys):
 
     ex = ExtractI3D.__new__(ExtractI3D)
     ex.streams = ['rgb', 'flow']
+    ex.output_path = str(tmp_path / 'out')
+    ex._device = jax.devices('cpu')[0]
     ex.params = {
         'rgb': transplant(i3d_model.init_state_dict(modality='rgb')),
         'flow': transplant(i3d_model.init_state_dict(modality='flow')),
@@ -121,8 +127,11 @@ def test_show_pred_covers_both_streams(capsys):
     assert 'At stack 0 (rgb stream)' in out
     assert 'At stack 0 (flow stream)' in out
     assert out.count('Logits') == 2
+    pngs = list((tmp_path / 'out' / 'flow_debug').glob('*.png'))
+    assert pngs, 'flow stream show_pred must write the rendered flow PNG'
 
 
+@pytest.mark.slow
 def test_e2e_two_stream_with_flow(short_video, tmp_path):
     """Full flagship path on a real clip: decode → windows → RAFT flow →
     both I3D towers → concat (T, 2048) under the 'rgb' key (fork naming)."""

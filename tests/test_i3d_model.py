@@ -6,6 +6,9 @@ import torch
 from video_features_tpu.models import i3d as i3d_model
 from video_features_tpu.transplant.torch2jax import transplant
 
+pytestmark = pytest.mark.slow  # parity/e2e/sharding: full lane only
+
+
 
 def _torch_i3d(reference_repo, modality):
     from models.i3d.i3d_src.i3d_net import I3D

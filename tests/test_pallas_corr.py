@@ -15,6 +15,9 @@ import jax.numpy as jnp  # noqa: E402
 from video_features_tpu.models import raft  # noqa: E402
 from video_features_tpu.ops import pallas_corr  # noqa: E402
 
+pytestmark = pytest.mark.slow  # parity/e2e/sharding: full lane only
+
+
 
 def _random_pyramid(rng, n, h, w, levels=4):
     pyr = []
@@ -125,12 +128,9 @@ def test_auto_lookup_dispatch(monkeypatch):
     monkeypatch.delenv('VFT_RAFT_LOOKUP', raising=False)
     assert raft._lookup_impl() == 'auto'
 
-    monkeypatch.setattr(jax, 'default_backend', lambda: 'tpu')
-    assert raft._resolve_auto_lookup(28, 28) == 'lanes'     # fused i3d shape
-    assert raft._resolve_auto_lookup(135, 240) == 'dense'   # 1080p level 0
+    assert raft._resolve_auto_lookup(28, 28, 'tpu') == 'lanes'   # fused i3d
+    assert raft._resolve_auto_lookup(28, 28, 'cpu') == 'dense'   # off-TPU
+    assert raft._resolve_auto_lookup(135, 240, 'tpu') == 'dense'  # 1080p L0
     monkeypatch.setenv('VFT_RAFT_LANES_VMEM_MB', '64')
-    assert raft._resolve_auto_lookup(135, 240) == 'lanes'
+    assert raft._resolve_auto_lookup(135, 240, 'tpu') == 'lanes'
     monkeypatch.delenv('VFT_RAFT_LANES_VMEM_MB')
-
-    monkeypatch.setattr(jax, 'default_backend', lambda: 'cpu')
-    assert raft._resolve_auto_lookup(28, 28) == 'dense'

@@ -13,6 +13,8 @@ from video_features_tpu.parallel import (
     factor_mesh_shape, make_mesh, shard_worklist, shuffled,
 )
 
+pytestmark = pytest.mark.slow  # parity/e2e/sharding: full lane only
+
 
 def test_factor_mesh_shape():
     assert factor_mesh_shape(8) == (4, 2)

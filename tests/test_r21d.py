@@ -24,6 +24,7 @@ def test_forward_shapes():
     assert logits.shape == (2, 400)
 
 
+@pytest.mark.slow
 def test_e2e_extraction(short_video, tmp_path):
     args = load_config('r21d', overrides={
         'video_paths': short_video,
@@ -47,6 +48,7 @@ def test_e2e_extraction(short_video, tmp_path):
     np.testing.assert_allclose(saved, f, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_forward_shapes_r34_variants():
     """The ig65m R(2+1)D-34 registry entries (reference extract_r21d.py:30-43):
     deeper blocks, 8- and 32-frame stacks, same 512-d features."""
@@ -57,3 +59,34 @@ def test_forward_shapes_r34_variants():
         feats = np.asarray(r21d_model.forward(params, x, arch='r2plus1d_34'))
         assert feats.shape == (1, 512), stack
         assert np.isfinite(feats).all()
+
+
+@pytest.mark.slow
+def test_parity_vs_torch_mirror():
+    """Numerics vs a state-dict-compatible torchvision VideoResNet mirror
+    (R2Plus1dStem + Conv2Plus1D blocks) — the net behind reference
+    extract_r21d.py:109-118 and BASELINE config 1. rel L2 < 1e-3 at
+    float32."""
+    import jax
+    import torch
+
+    from tests.torch_mirrors import TorchVideoResNet, randomize_bn_stats
+
+    torch.manual_seed(0)
+    mirror = TorchVideoResNet('r2plus1d_18').eval()
+    randomize_bn_stats(mirror)
+    params = transplant(mirror.state_dict())
+
+    x = (np.random.RandomState(1).rand(2, 8, 56, 56, 3).astype(np.float32)
+         * 2 - 1)
+    with torch.no_grad():
+        xt = torch.from_numpy(x).permute(0, 4, 1, 2, 3)  # NTHWC → NCTHW
+        ref = mirror(xt).numpy()
+        ref_logits = mirror(xt, features=False).numpy()
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(r21d_model.forward(params, x))
+        got_logits = np.asarray(r21d_model.forward(params, x, features=False))
+
+    for ours, theirs in ((got, ref), (got_logits, ref_logits)):
+        rel = np.linalg.norm(ours - theirs) / np.linalg.norm(theirs)
+        assert rel < 1e-3, f'rel L2 {rel}'

@@ -1,5 +1,8 @@
 """RAFT extractor: E2E flow extraction with pair batching + flow_viz."""
+from pathlib import Path
+
 import numpy as np
+import pytest
 
 from video_features_tpu.config import load_config
 from video_features_tpu.io.video import get_video_props
@@ -7,17 +10,24 @@ from video_features_tpu.registry import create_extractor
 from video_features_tpu.utils.flow_viz import flow_to_image, make_colorwheel
 
 
+@pytest.mark.slow
 def test_e2e_flow(short_video, tmp_path):
     args = load_config('raft', overrides={
         'video_paths': short_video,
         'device': 'cpu',
         'batch_size': 16,
         'side_size': 128,        # small frames keep CPU runtime sane
+        'show_pred': True,       # headless flow viz writes PNG artifacts
         'output_path': str(tmp_path / 'out'),
         'tmp_path': str(tmp_path / 'tmp'),
     })
     ex = create_extractor(args)
     feats = ex.extract(short_video)
+
+    # headless show_pred preserves the reference's cv2-window debug
+    # capability (base_flow_extractor.py:134-149) as on-disk PNGs
+    pngs = list((Path(args.output_path) / 'flow_debug').glob('*.png'))
+    assert pngs, 'show_pred=true must write rendered flow PNGs'
 
     n = get_video_props(short_video)['num_frames']
     flow = feats['raft']

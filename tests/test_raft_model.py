@@ -6,6 +6,9 @@ import torch
 from video_features_tpu.models import raft as raft_model
 from video_features_tpu.transplant.torch2jax import transplant
 
+pytestmark = pytest.mark.slow  # parity/e2e/sharding: full lane only
+
+
 
 @pytest.fixture(scope='module')
 def torch_raft(reference_repo):

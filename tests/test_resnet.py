@@ -14,3 +14,33 @@ def test_forward_shapes_all_archs(arch):
     feats = np.asarray(resnet_model.forward(params, x, arch=arch))
     assert feats.shape == (1, cfg['feat_dim']), arch
     assert np.isfinite(feats).all()
+
+
+@pytest.mark.parametrize('arch', ['resnet18', 'resnet50'])
+def test_parity_vs_torch_mirror(arch):
+    """Numerics vs a state-dict-compatible torchvision mirror (BasicBlock
+    for 18, Bottleneck/V1.5 for 50) — the net behind reference
+    extract_resnet.py:38-40. rel L2 < 1e-3 at float32."""
+    import jax
+    import torch
+
+    from tests.torch_mirrors import TorchResNet, randomize_bn_stats
+
+    torch.manual_seed(0)
+    mirror = TorchResNet(arch).eval()
+    randomize_bn_stats(mirror)
+    params = transplant(mirror.state_dict())
+
+    x = np.random.RandomState(1).rand(2, 112, 112, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+        ref = mirror(xt).numpy()
+        ref_logits = mirror(xt, features=False).numpy()
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(resnet_model.forward(params, x, arch=arch))
+        got_logits = np.asarray(
+            resnet_model.forward(params, x, arch=arch, features=False))
+
+    for ours, theirs in ((got, ref), (got_logits, ref_logits)):
+        rel = np.linalg.norm(ours - theirs) / np.linalg.norm(theirs)
+        assert rel < 1e-3, f'{arch}: rel L2 {rel}'

@@ -18,6 +18,7 @@ def torch_s3d(reference_repo):
     return model
 
 
+@pytest.mark.slow
 def test_parity_vs_reference_torch(torch_s3d):
     """Random-weight transplant: our forward must match torch to float32 noise.
 
@@ -56,6 +57,7 @@ def test_parity_logits(torch_s3d):
     np.testing.assert_allclose(ours, ref, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_e2e_extraction(short_video, tmp_path):
     args = load_config('s3d', overrides={
         'video_paths': short_video,
@@ -71,6 +73,7 @@ def test_e2e_extraction(short_video, tmp_path):
     assert np.isfinite(feats).all()
 
 
+@pytest.mark.slow
 def test_too_small_stack_clear_error():
     """stack_size < 16 leaves < 2 temporal positions at the head — must
     fail with a clear message, not an opaque reshape ZeroDivisionError."""

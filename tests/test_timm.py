@@ -144,3 +144,48 @@ def test_unknown_model_rejected(tmp_path):
     })
     with pytest.raises(NotImplementedError):
         create_extractor(args)
+
+
+def test_pos_embed_interpolation_identity_and_resample():
+    import jax.numpy as jnp
+
+    pos = np.random.RandomState(0).randn(1, 1 + 14 * 14, 8).astype(np.float32)
+    same = vit_model.interpolate_pos_embed(jnp.asarray(pos), (14, 14))
+    np.testing.assert_array_equal(np.asarray(same), pos)
+    up = np.asarray(vit_model.interpolate_pos_embed(jnp.asarray(pos), (20, 20)))
+    assert up.shape == (1, 1 + 20 * 20, 8)
+    # cls position untouched
+    np.testing.assert_array_equal(up[:, 0], pos[:, 0])
+
+
+def test_vit_high_res_forward_crosses_blockwise_threshold():
+    """352px at patch16 → 485 tokens with an interpolated pos embed; with
+    the threshold dropped the same input runs the blockwise (ragged) path
+    and must match the dense result — the high-res production consumer of
+    blockwise attention."""
+    import video_features_tpu.models.vit as vit
+
+    arch = 'vit_tiny_patch16_224'
+    params = transplant(vit_model.init_state_dict(arch=arch))
+    x = np.random.RandomState(0).rand(1, 352, 352, 3).astype(np.float32)
+
+    dense = np.asarray(vit_model.forward(params, x, arch=arch))
+    assert dense.shape == (1, 192)
+    old = vit.BLOCKWISE_THRESHOLD
+    try:
+        vit.BLOCKWISE_THRESHOLD = 256  # force the long-token path
+        block = np.asarray(vit_model.forward(params, x, arch=arch))
+    finally:
+        vit.BLOCKWISE_THRESHOLD = old
+    np.testing.assert_allclose(block, dense, atol=2e-4)
+
+
+def test_timm_image_size_must_divide_patch(tmp_path):
+    args = load_config('timm', overrides={
+        'video_paths': 'v.mp4', 'device': 'cpu',
+        'model_name': 'vit_tiny_patch16_224', 'image_size': 350,
+        'allow_random_weights': True,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    with pytest.raises(ValueError, match='multiple of the patch'):
+        create_extractor(args)

@@ -39,6 +39,7 @@ def test_examples_framing():
     assert ex.dtype == np.float32
 
 
+@pytest.mark.slow
 def test_net_parity_vs_torch():
     """Same weights, same input → same embeddings as a torch net with the
     reference's architecture (vggish_slim.py:15-37,100-111), including the

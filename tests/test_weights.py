@@ -1,0 +1,48 @@
+"""Missing-checkpoint hard error + allow_random_weights escape.
+
+The reference always runs real weights (extract_i3d.py:180-183,
+extract_resnet.py:38-40); our equivalent guarantee is that a run without a
+configured checkpoint fails loudly, naming the config key, unless random
+weights are explicitly allowed (extract/weights.py).
+"""
+import pytest
+
+from video_features_tpu.config import load_config
+from video_features_tpu.extract.weights import ENV_FLAG, MissingCheckpointError
+from video_features_tpu.registry import create_extractor
+
+
+def _resnet_args(tmp_path, **over):
+    return load_config('resnet', overrides={
+        'video_paths': 'v.mp4', 'output_path': str(tmp_path / 'o'),
+        'tmp_path': str(tmp_path / 't'), 'device': 'cpu',
+        'model_name': 'resnet18', **over})
+
+
+def test_missing_checkpoint_is_hard_error(tmp_path, monkeypatch):
+    monkeypatch.delenv(ENV_FLAG, raising=False)
+    with pytest.raises(MissingCheckpointError) as exc:
+        create_extractor(_resnet_args(tmp_path))
+    assert 'checkpoint_path' in str(exc.value)
+    assert 'fetch_checkpoints' in str(exc.value)
+
+
+def test_i3d_error_names_stream_specific_key(tmp_path, monkeypatch):
+    monkeypatch.delenv(ENV_FLAG, raising=False)
+    args = load_config('i3d', overrides={
+        'video_paths': 'v.mp4', 'output_path': str(tmp_path / 'o'),
+        'tmp_path': str(tmp_path / 't'), 'device': 'cpu', 'streams': 'rgb'})
+    with pytest.raises(MissingCheckpointError, match='i3d_rgb_checkpoint_path'):
+        create_extractor(args)
+
+
+def test_allow_random_weights_config_flag(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv(ENV_FLAG, raising=False)
+    ex = create_extractor(_resnet_args(tmp_path, allow_random_weights=True))
+    assert ex is not None
+    assert 'RANDOM weights' in capsys.readouterr().out
+
+
+def test_env_escape_hatch(tmp_path, monkeypatch):
+    monkeypatch.setenv(ENV_FLAG, '1')
+    assert create_extractor(_resnet_args(tmp_path)) is not None

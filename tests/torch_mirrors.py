@@ -1,0 +1,180 @@
+"""State-dict-compatible torch mirrors of torchvision ResNet / VideoResNet.
+
+torchvision is not installed in this environment, but the reference's r21d
+and resnet extractors are thin wrappers over torchvision nets
+(reference models/r21d/extract_r21d.py:109-118,
+models/resnet/extract_resnet.py:38-40). These mirrors reproduce the exact
+module tree — same state_dict keys, same math — so parity tests can
+transplant a seeded torch net into our JAX models and compare numerics,
+and real torchvision checkpoints load into them unchanged.
+"""
+from __future__ import annotations
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+from video_features_tpu.models.r21d import midplanes
+
+# ---------------------------------------------------------------- resnet --
+
+
+class _TVBasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, in_p, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_p, planes, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return F.relu(out + identity)
+
+
+class _TVBottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, in_p, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_p, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        # stride on the 3x3 = torchvision's ResNet V1.5 convention
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(planes * 4)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return F.relu(out + identity)
+
+
+class TorchResNet(nn.Module):
+    """torchvision.models.resnet* mirror (IMAGENET1K layout)."""
+
+    CFGS = {
+        'resnet18': (_TVBasicBlock, [2, 2, 2, 2]),
+        'resnet34': (_TVBasicBlock, [3, 4, 6, 3]),
+        'resnet50': (_TVBottleneck, [3, 4, 6, 3]),
+        'resnet101': (_TVBottleneck, [3, 4, 23, 3]),
+        'resnet152': (_TVBottleneck, [3, 8, 36, 3]),
+    }
+
+    def __init__(self, arch='resnet50', num_classes=1000):
+        super().__init__()
+        block, layers = self.CFGS[arch]
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        in_p = 64
+        for li, (nb, planes) in enumerate(zip(layers, [64, 128, 256, 512]), 1):
+            blocks = []
+            for bi in range(nb):
+                stride = 2 if (li > 1 and bi == 0) else 1
+                down = None
+                if stride != 1 or in_p != planes * block.expansion:
+                    down = nn.Sequential(
+                        nn.Conv2d(in_p, planes * block.expansion, 1, stride,
+                                  bias=False),
+                        nn.BatchNorm2d(planes * block.expansion))
+                blocks.append(block(in_p, planes, stride, down))
+                in_p = planes * block.expansion
+            setattr(self, f'layer{li}', nn.Sequential(*blocks))
+        self.fc = nn.Linear(in_p, num_classes)
+
+    def forward(self, x, features=True):
+        x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+        for li in range(1, 5):
+            x = getattr(self, f'layer{li}')(x)
+        x = x.mean(dim=(2, 3))
+        return x if features else self.fc(x)
+
+
+# ------------------------------------------------------------------ r21d --
+
+
+class _Conv2Plus1D(nn.Sequential):
+    """torchvision Conv2Plus1D: spatial conv → BN → ReLU → temporal conv."""
+
+    def __init__(self, in_p, out_p, mid, stride=1):
+        super().__init__(
+            nn.Conv3d(in_p, mid, (1, 3, 3), (1, stride, stride), (0, 1, 1),
+                      bias=False),
+            nn.BatchNorm3d(mid),
+            nn.ReLU(inplace=True),
+            nn.Conv3d(mid, out_p, (3, 1, 1), (stride, 1, 1), (1, 0, 0),
+                      bias=False))
+
+
+class _VRBasicBlock(nn.Module):
+    def __init__(self, in_p, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Sequential(
+            _Conv2Plus1D(in_p, planes, midplanes(in_p, planes), stride),
+            nn.BatchNorm3d(planes), nn.ReLU(inplace=True))
+        self.conv2 = nn.Sequential(
+            _Conv2Plus1D(planes, planes, midplanes(planes, planes)),
+            nn.BatchNorm3d(planes))
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        return F.relu(self.conv2(self.conv1(x)) + identity)
+
+
+class TorchVideoResNet(nn.Module):
+    """torchvision.models.video.r2plus1d_18/34 mirror (R2Plus1dStem)."""
+
+    CFGS = {'r2plus1d_18': [2, 2, 2, 2], 'r2plus1d_34': [3, 4, 6, 3]}
+
+    def __init__(self, arch='r2plus1d_18', num_classes=400):
+        super().__init__()
+        layers = self.CFGS[arch]
+        self.stem = nn.Sequential(
+            nn.Conv3d(3, 45, (1, 7, 7), (1, 2, 2), (0, 3, 3), bias=False),
+            nn.BatchNorm3d(45), nn.ReLU(inplace=True),
+            nn.Conv3d(45, 64, (3, 1, 1), 1, (1, 0, 0), bias=False),
+            nn.BatchNorm3d(64), nn.ReLU(inplace=True))
+        in_p = 64
+        for li, (nb, planes) in enumerate(zip(layers, [64, 128, 256, 512]), 1):
+            blocks = []
+            for bi in range(nb):
+                stride = 2 if (li > 1 and bi == 0) else 1
+                down = None
+                if stride != 1 or in_p != planes:
+                    down = nn.Sequential(
+                        nn.Conv3d(in_p, planes, 1, (stride, stride, stride),
+                                  bias=False),
+                        nn.BatchNorm3d(planes))
+                blocks.append(_VRBasicBlock(in_p, planes, stride, down))
+                in_p = planes
+            setattr(self, f'layer{li}', nn.Sequential(*blocks))
+        self.fc = nn.Linear(512, num_classes)
+
+    def forward(self, x, features=True):
+        x = self.stem(x)
+        for li in range(1, 5):
+            x = getattr(self, f'layer{li}')(x)
+        x = x.mean(dim=(2, 3, 4))
+        return x if features else self.fc(x)
+
+
+def randomize_bn_stats(model: nn.Module, seed: int = 0) -> None:
+    """Give every BN layer non-trivial running stats (fresh modules carry
+    mean=0/var=1, which would hide transplant bugs in those tensors)."""
+    gen = torch.Generator().manual_seed(seed)
+    for m in model.modules():
+        if isinstance(m, (nn.BatchNorm2d, nn.BatchNorm3d)):
+            m.running_mean = torch.randn(
+                m.num_features, generator=gen) * 0.1
+            m.running_var = torch.rand(m.num_features, generator=gen) + 0.5

@@ -1,0 +1,272 @@
+#!/usr/bin/env python3
+"""Provision pretrained weights from the sources the reference uses.
+
+One command turns an empty host into one that can run real-weight
+extraction — download, sha256-verify, and (by default) convert each
+checkpoint to a torch-free ``.npz`` for TPU hosts:
+
+    python tools/fetch_checkpoints.py clip resnet r21d vggish --out ./checkpoints
+    python tools/fetch_checkpoints.py all --from-checkout ~/video_features
+
+Sources mirror the reference implementation exactly:
+  * clip   — OpenAI's sha256-prefixed URLs (reference
+             models/clip/clip_src/clip.py:32-43; the hash embedded in the
+             URL path verifies the download);
+  * resnet — torchvision IMAGENET1K_V1 weight URLs (reference
+             models/resnet/extract_resnet.py:38-40; torch-hub filename
+             convention: the trailing ``-xxxxxxxx`` is the sha256 prefix);
+  * r21d   — torchvision ``r2plus1d_18`` URL + the ig65m variants via
+             ``torch.hub.load('moabitcoin/ig65m-pytorch', ...)`` exactly as
+             the reference does (models/r21d/extract_r21d.py:109-118);
+  * vggish — the torchvggish release URLs (reference
+             models/vggish/vggish_src/vggish_slim.py:119-131);
+  * i3d / raft / s3d — the reference BUNDLES these blobs in its repo
+             (models/i3d/checkpoints/*.pt, models/raft/checkpoints/*.pth,
+             models/s3d/checkpoint/*.pt); they have no public URL, so they
+             are copied out of an existing checkout via ``--from-checkout``.
+
+Offline hosts: ``--url-base`` rewrites every URL's origin to a local mirror
+(``file:///...`` works), and already-present files that pass their sha256
+check are never re-downloaded.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import shutil
+import sys
+import urllib.request
+from pathlib import Path
+from typing import Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+_CLIP = 'https://openaipublic.azureedge.net/clip/models'
+_TV = 'https://download.pytorch.org/models'
+_VGGISH = 'https://github.com/harritaylor/torchvggish/releases/download/v0.1'
+
+# Every artifact: how to obtain it + how to verify it + how to convert it.
+#   kind='url'      — download; sha256 full hash, or 'filename' = torch-hub
+#                     trailing-8-hex-prefix convention;
+#   kind='hub'      — torch.hub.load(repo, model) state_dict (needs network
+#                     + torch, like the reference's own path);
+#   kind='bundled'  — copy from --from-checkout <reference checkout>.
+# 'convert' names the .npz conversion recipe ('plain' | 'clip_jit').
+SOURCES: Dict[str, List[dict]] = {
+    'clip': [
+        {'kind': 'url', 'name': f, 'convert': 'clip_jit',
+         'url': f'{_CLIP}/{sha}/{f}', 'sha256': sha}
+        for f, sha in [
+            ('RN50.pt', 'afeb0e10f9e5a86da6080e35cf09123aca3b358a0c3e3b6c78a7b63bc04b6762'),
+            ('RN101.pt', '8fa8567bab74a42d41c5915025a8e4538c3bdbe8804a470a72f30b0d94fab599'),
+            ('RN50x4.pt', '7e526bd135e493cef0776de27d5f42653e6b4c8bf9e0f653bb11773263205fdd'),
+            ('RN50x16.pt', '52378b407f34354e150460fe41077663dd5b39c54cd0bfd2b27167a4a06ec9aa'),
+            ('RN50x64.pt', 'be1cfb55d75a9666199fb2206c106743da0f6468c9d327f3e0d0a543a9919d9c'),
+            ('ViT-B-32.pt', '40d365715913c9da98579312b702a82c18be219cc2a73407c4526f58eba950af'),
+            ('ViT-B-16.pt', '5806e77cd80f8b59890b7e101eabd078d9fb84e6937f9e85e4ecb61988df416f'),
+            ('ViT-L-14.pt', 'b8cca3fd41ae0c99ba7e8951adf17d267cdb84cd88be6f7c2e0eca1737a03836'),
+            ('ViT-L-14-336px.pt', '3035c92b350959924f9f00213499208652fc7ea050643e8b385c2dac08641f02'),
+        ]
+    ],
+    'resnet': [
+        {'kind': 'url', 'name': f, 'convert': 'plain',
+         'url': f'{_TV}/{f}', 'sha256': 'filename'}
+        for f in ['resnet18-f37072fd.pth', 'resnet34-b627a593.pth',
+                  'resnet50-0676ba61.pth', 'resnet101-63fe2227.pth',
+                  'resnet152-394f9c45.pth']
+    ],
+    'r21d': [
+        {'kind': 'url', 'name': 'r2plus1d_18-91a641e6.pth', 'convert': 'plain',
+         'url': f'{_TV}/r2plus1d_18-91a641e6.pth', 'sha256': 'filename'},
+        {'kind': 'hub', 'name': 'r2plus1d_34_8_ig65m_ft_kinetics.pth',
+         'convert': 'plain', 'repo': 'moabitcoin/ig65m-pytorch',
+         'model': 'r2plus1d_34_8_kinetics', 'num_classes': 400},
+        {'kind': 'hub', 'name': 'r2plus1d_34_32_ig65m_ft_kinetics.pth',
+         'convert': 'plain', 'repo': 'moabitcoin/ig65m-pytorch',
+         'model': 'r2plus1d_34_32_kinetics', 'num_classes': 400},
+    ],
+    'vggish': [
+        {'kind': 'url', 'name': 'vggish-10086976.pth', 'convert': 'plain',
+         'url': f'{_VGGISH}/vggish-10086976.pth', 'sha256': 'filename'},
+        {'kind': 'url', 'name': 'vggish_pca_params-970ea276.pth',
+         'convert': 'pca',
+         'url': f'{_VGGISH}/vggish_pca_params-970ea276.pth',
+         'sha256': 'filename'},
+    ],
+    'i3d': [
+        {'kind': 'bundled', 'name': 'i3d_rgb.pt', 'convert': 'plain',
+         'path': 'models/i3d/checkpoints/i3d_rgb.pt'},
+        {'kind': 'bundled', 'name': 'i3d_flow.pt', 'convert': 'plain',
+         'path': 'models/i3d/checkpoints/i3d_flow.pt'},
+    ],
+    'raft': [
+        {'kind': 'bundled', 'name': 'raft-sintel.pth', 'convert': 'plain',
+         'path': 'models/raft/checkpoints/raft-sintel.pth'},
+        {'kind': 'bundled', 'name': 'raft-kitti.pth', 'convert': 'plain',
+         'path': 'models/raft/checkpoints/raft-kitti.pth'},
+    ],
+    's3d': [
+        {'kind': 'bundled', 'name': 'S3D_kinetics400_torchified.pt',
+         'convert': 'plain',
+         'path': 'models/s3d/checkpoint/S3D_kinetics400_torchified.pt'},
+    ],
+}
+
+
+def sha256_of(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, 'rb') as f:
+        for chunk in iter(lambda: f.read(1 << 20), b''):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def expected_hash(art: dict) -> Optional[str]:
+    """Full sha256, or the torch-hub 8-hex filename prefix, or None."""
+    spec = art.get('sha256')
+    if spec == 'filename':
+        stem = Path(art['name']).stem
+        return stem.rsplit('-', 1)[-1] if '-' in stem else None
+    return spec
+
+
+def verify(path: Path, art: dict) -> bool:
+    want = expected_hash(art)
+    if want is None:
+        return path.exists()
+    return path.exists() and sha256_of(path).startswith(want)
+
+
+def download(url: str, dest: Path) -> None:
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dest.with_suffix(dest.suffix + '.part')
+    with urllib.request.urlopen(url) as src, open(tmp, 'wb') as out:
+        shutil.copyfileobj(src, out, length=1 << 20)
+    tmp.rename(dest)
+
+
+def rebase(url: str, url_base: Optional[str]) -> str:
+    """Swap the URL origin for a mirror base (``file:///...`` works)."""
+    if not url_base:
+        return url
+    from urllib.parse import urlsplit
+    parts = urlsplit(url)
+    return url_base.rstrip('/') + parts.path
+
+
+def fetch_artifact(art: dict, out: Path, url_base: Optional[str] = None,
+                   checkout: Optional[Path] = None) -> Path:
+    """Obtain one artifact into ``out`` and verify; returns the local path."""
+    dest = out / art['name']
+    if verify(dest, art):
+        print(f'  {art["name"]}: present, checksum ok')
+        return dest
+    if art['kind'] == 'url':
+        url = rebase(art['url'], url_base)
+        print(f'  {art["name"]}: downloading {url}')
+        download(url, dest)
+        if not verify(dest, art):
+            dest.unlink()
+            raise RuntimeError(
+                f'{art["name"]}: sha256 mismatch after download '
+                f'(expected {expected_hash(art)})')
+    elif art['kind'] == 'hub':
+        print(f'  {art["name"]}: torch.hub.load({art["repo"]!r}, '
+              f'{art["model"]!r})')
+        import torch
+        model = torch.hub.load(art['repo'], model=art['model'],
+                               num_classes=art['num_classes'],
+                               pretrained=True)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        torch.save(model.state_dict(), dest)
+    elif art['kind'] == 'bundled':
+        if checkout is None:
+            raise RuntimeError(
+                f'{art["name"]} has no public URL (the reference bundles it '
+                f'in-repo at {art["path"]}); pass --from-checkout '
+                f'<path to a video_features checkout>')
+        src = checkout / art['path']
+        if not src.exists():
+            raise RuntimeError(f'{src} not found in checkout')
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(src, dest)
+        print(f'  {art["name"]}: copied from {src}')
+    else:  # pragma: no cover
+        raise ValueError(art['kind'])
+    return dest
+
+
+def convert_artifact(src: Path, recipe: str) -> Path:
+    """.pt/.pth → torch-free .npz next to it, per-family recipe."""
+    from video_features_tpu.transplant.torch2jax import (
+        load_torch_checkpoint, save_transplanted, transplant,
+    )
+    dst = src.with_suffix('.npz')
+    if recipe == 'pca':
+        # PCA params are plain arrays, not network weights: no transposes.
+        import numpy as np
+        import torch
+        sd = torch.load(src, map_location='cpu', weights_only=False)
+        np.savez(dst, **{k: np.asarray(v) for k, v in sd.items()})
+    elif recipe == 'clip_jit':
+        import numpy as np
+        import torch
+
+        from video_features_tpu.models import clip as clip_model
+        try:  # OpenAI ships TorchScript archives
+            sd = torch.jit.load(src, map_location='cpu').state_dict()
+        except RuntimeError:
+            sd = torch.load(src, map_location='cpu', weights_only=False)
+            if hasattr(sd, 'state_dict'):
+                sd = sd.state_dict()
+        params = transplant(sd, no_transpose=set(clip_model.NO_TRANSPOSE),
+                            dtype=np.float32)
+        save_transplanted(params, str(dst))
+    else:
+        save_transplanted(load_torch_checkpoint(str(src)), str(dst))
+    print(f'  {src.name} → {dst.name}')
+    return dst
+
+
+def fetch(families: List[str], out: Path, convert: bool = True,
+          url_base: Optional[str] = None,
+          checkout: Optional[Path] = None) -> List[Path]:
+    got = []
+    for fam in families:
+        print(f'[{fam}]')
+        for art in SOURCES[fam]:
+            path = fetch_artifact(art, out, url_base, checkout)
+            if convert:
+                path = convert_artifact(path, art['convert'])
+            got.append(path)
+    return got
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('families', nargs='+',
+                    help=f'feature families, or "all": {", ".join(SOURCES)}')
+    ap.add_argument('--out', default='./checkpoints', type=Path)
+    ap.add_argument('--no-convert', action='store_true',
+                    help='keep raw torch files; skip the .npz conversion')
+    ap.add_argument('--url-base', default=None,
+                    help='mirror origin replacing each URL host '
+                         '(file:///local/mirror works)')
+    ap.add_argument('--from-checkout', default=None, type=Path,
+                    help='existing video_features checkout holding the '
+                         'bundled i3d/raft/s3d blobs')
+    ns = ap.parse_args()
+
+    fams = list(SOURCES) if ns.families == ['all'] else ns.families
+    unknown = [f for f in fams if f not in SOURCES]
+    if unknown:
+        ap.error(f'unknown families: {unknown}; known: {", ".join(SOURCES)}')
+    got = fetch(fams, ns.out, convert=not ns.no_convert,
+                url_base=ns.url_base, checkout=ns.from_checkout)
+    print(f'{len(got)} artifacts ready under {ns.out}')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -160,8 +160,17 @@ def sanity_check(args: Config) -> None:
 
     from video_features_tpu.utils.device import MATMUL_PRECISIONS
     prec = args.get('precision', 'highest')
-    assert prec in MATMUL_PRECISIONS, (
-        f'precision must be one of {MATMUL_PRECISIONS}; got {prec!r}')
+    # ValueError, not assert: user-facing validation must survive `python -O`
+    # (an invalid value would otherwise surface later as an opaque
+    # jax.default_matmul_precision error inside the per-video loop)
+    if prec not in MATMUL_PRECISIONS:
+        raise ValueError(
+            f'precision must be one of {MATMUL_PRECISIONS}; got {prec!r}')
+    backend = args.get('decode_backend', 'auto')
+    if backend not in ('auto', 'native', 'cv2'):
+        raise ValueError(
+            f"decode_backend must be 'auto', 'native', or 'cv2'; "
+            f'got {backend!r}')
 
     assert args.get('file_with_video_paths') or args.get('video_paths'), \
         '`video_paths` or `file_with_video_paths` must be specified'

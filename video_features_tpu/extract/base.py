@@ -67,9 +67,24 @@ class BaseExtractor:
         """Matmul-precision context for the device loop. ``highest`` (the
         default) keeps full float32 passes for reference parity; ``default``
         lets the TPU run bf16 MXU passes — ~an order of magnitude faster at
-        CLI geometry (see configs' ``precision`` key)."""
+        CLI geometry; ``mixed`` = parity-grade fast mode (ops/precision.py):
+        ambient 3-pass bf16, measured ≤1e-3 feature drift on the fused path
+        at ~1.7x the 'highest' throughput; ``precision_pins`` carries any
+        tuned per-sub-graph overrides to extractors that support them."""
         import jax
-        return jax.default_matmul_precision(self.precision)
+
+        from video_features_tpu.ops.precision import MIXED_AMBIENT
+        ambient = MIXED_AMBIENT if self.precision == 'mixed' else self.precision
+        return jax.default_matmul_precision(ambient)
+
+    @property
+    def precision_pins(self):
+        """Per-sub-graph precision overrides for ``precision='mixed'``
+        (None otherwise) — thread into step functions that support pins."""
+        if self.precision == 'mixed':
+            from video_features_tpu.ops.precision import MIXED_PINS
+            return MIXED_PINS
+        return None
 
     def put_input(self, batch):
         """Place one host input batch on the device(s): sharded over the

@@ -67,6 +67,13 @@ class ExtractCLIP(BaseFrameWiseExtractor):
         ckpt = args.get('checkpoint_path')
         if self.model_name == 'custom' and not ckpt:
             ckpt = './checkpoints/CLIP-custom.pth'
+        if not ckpt:
+            # hard error unless random weights are explicitly allowed —
+            # the reference always downloads real CLIP weights
+            # (clip_src/clip.py:32-74)
+            from video_features_tpu.extract.weights import require_checkpoint
+            require_checkpoint(args, 'checkpoint_path', feature_type='clip',
+                               what=f'clip ({self.model_name})')
         if ckpt and str(ckpt).endswith('.npz'):
             # via load_torch_checkpoint for the same float32 upcast the
             # .pt path (and every other extractor) applies

@@ -36,6 +36,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
         )
         self.batch_size = args.batch_size
         self.decode_workers = int(args.get('decode_workers', 1))
+        self.decode_backend = args.get('decode_backend', 'auto')
         # data_parallel=true shards frame batches over ALL local devices:
         # params are re-placed replicated and batches arrive with a
         # data-axis sharding, so the subclass's jitted step compiles into
@@ -72,6 +73,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
             keep_tmp=self.keep_tmp_files,
             transform=self.host_transform,
             transform_workers=self.decode_workers,
+            backend=self.decode_backend,
         )
         feats, timestamps = [], []
 
@@ -91,7 +93,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
             # transfer of batch k+1 overlaps the device running batch k
             # (see streaming.transfer_batches)
             for batch, _, valid, times in transfer_batches(
-                    assembled(), self.put_input):
+                    assembled(), self.put_input, tracer=self.tracer):
                 with self.tracer.stage('model'):
                     out = np.asarray(self.device_step(batch))[:valid]
                 feats.append(out)

@@ -21,6 +21,7 @@ reference instead runs a python frame loop with per-stack device round trips.
 from __future__ import annotations
 
 from functools import partial
+from pathlib import Path
 from typing import Dict, List
 
 import jax
@@ -47,7 +48,7 @@ def rgb_stream_input(stacks, crop_size):
 
 
 def flow_stream_input(raft_params, stacks, pads, crop_size,
-                      constrain_pairs=None):
+                      constrain_pairs=None, platform=None, pins=None):
     """(B, S+1, H, W, 3) frames → quantized flow I3D input (B, S, c, c, 2).
 
     RAFT on /8-padded consecutive pairs (each interior frame's fnet
@@ -60,13 +61,14 @@ def flow_stream_input(raft_params, stacks, pads, crop_size,
     padded = jnp.pad(stacks, [(0, 0), (0, 0), (t, b), (l, r), (0, 0)],
                      mode='edge')
     flow = raft_model.forward_stack_pairs(raft_params, padded,
-                                          constrain=constrain_pairs)
+                                          constrain=constrain_pairs,
+                                          platform=platform, pins=pins)
     flow = center_crop(flow, crop_size)
     return scale_to_pm1(flow_to_uint8_levels(flow, 20.0))
 
 
 def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
-                          crop_size=CROP_SIZE):
+                          crop_size=CROP_SIZE, platform=None, pins=None):
     """(B, stack+1, H, W, 3) float frames → {stream: (B, 1024)}.
 
     The full two-stream graph — RAFT flow, quantization, both I3D towers —
@@ -74,28 +76,49 @@ def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
     applies a sharding constraint to the leading-flattened tensors feeding
     RAFT's heavy sub-graphs (unique frames, fmap pairs, cnet input) so they
     spread over a (data, time) mesh (sequence parallelism over temporal
-    pairs — see parallel.mesh).
+    pairs — see parallel.mesh). ``pins`` selects per-sub-graph matmul
+    precision (ops/precision.py: 'encoder'/'corr'/'iter'/'upsample' inside
+    RAFT, 'i3d' for both towers) — the precision='mixed' fast-parity mode.
     """
+    from video_features_tpu.ops.precision import pin_scope
     out = {}
     if 'rgb' in streams:
         rgb = rgb_stream_input(stacks, crop_size)
-        out['rgb'] = i3d_model.forward(params['rgb'], rgb, features=True)
+        with pin_scope(pins, 'i3d'):
+            out['rgb'] = i3d_model.forward(params['rgb'], rgb, features=True)
     if 'flow' in streams:
         flow = flow_stream_input(params['raft'], stacks, pads, crop_size,
-                                 constrain_pairs)
-        out['flow'] = i3d_model.forward(params['flow'], flow, features=True)
+                                 constrain_pairs, platform=platform,
+                                 pins=pins)
+        with pin_scope(pins, 'i3d'):
+            out['flow'] = i3d_model.forward(params['flow'], flow,
+                                            features=True)
     return out
 
 
-@partial(jax.jit, static_argnames=('stream', 'pads', 'crop_size'))
-def _pred_logits(params, stacks, stream, pads, crop_size):
+@partial(jax.jit, static_argnames=('stream', 'pads', 'crop_size', 'platform'))
+def _pred_logits(params, stacks, stream, pads, crop_size, platform=None):
     """Classifier logits for one stream — the show_pred debug surface,
     compiled so it doesn't pay eager dispatch per displayed batch."""
     if stream == 'rgb':
         x = rgb_stream_input(stacks, crop_size)
     else:
-        x = flow_stream_input(params['raft'], stacks, pads, crop_size)
+        x = flow_stream_input(params['raft'], stacks, pads, crop_size,
+                              platform=platform)
     return i3d_model.forward(params[stream], x, features=False)[1]
+
+
+@partial(jax.jit, static_argnames=('pads', 'crop_size', 'platform'))
+def _debug_flow(raft_params, stacks, pads, crop_size, platform=None):
+    """Cropped un-quantized flow of the FIRST pair of the first stack —
+    the frame the reference renders in its cv2 window
+    (base_flow_extractor.py:134-149). Debug surface only."""
+    t, b, l, r = pads
+    pair = jnp.pad(stacks[:1, :2], [(0, 0), (0, 0), (t, b), (l, r), (0, 0)],
+                   mode='edge')
+    flow = raft_model.forward_stack_pairs(raft_params, pair,
+                                          platform=platform)
+    return center_crop(flow, crop_size)[0, 0]
 
 
 class ExtractI3D(BaseExtractor):
@@ -123,6 +146,7 @@ class ExtractI3D(BaseExtractor):
         self.extraction_fps = args.extraction_fps
         self.batch_size = args.get('batch_size', 1)
         self.decode_workers = int(args.get('decode_workers', 1))
+        self.decode_backend = args.get('decode_backend', 'auto')
         self.show_pred = args.show_pred
         self.output_feat_keys = list(self.streams)
         self._device = jax_device(self.device)
@@ -147,7 +171,8 @@ class ExtractI3D(BaseExtractor):
             self.params = put_replicated(self.mesh, self.load_params(args))
             self._put_batch = partial(put_batch, self.mesh)
             sharded = build_sharded_two_stream_step(
-                self.mesh, streams=tuple(self.streams))
+                self.mesh, streams=tuple(self.streams),
+                pins=self.precision_pins)
 
             def _step(params, stacks, pads, streams):
                 return sharded(params, stacks, pads)
@@ -155,28 +180,36 @@ class ExtractI3D(BaseExtractor):
             self._step = _step
         else:
             self.params = jax.device_put(self.load_params(args), self._device)
-            # pads/streams are static so one executable serves each geometry
-            self._step = jax.jit(self._stack_batch,
-                                 static_argnames=('pads', 'streams'))
+            # pads/streams are static so one executable serves each geometry;
+            # the resolved device's platform drives the RAFT corr-lookup
+            # dispatch (not the process default backend)
+            self._step = jax.jit(
+                partial(self._stack_batch, platform=self._device.platform,
+                        pins=self.precision_pins),
+                static_argnames=('pads', 'streams'))
 
     def load_params(self, args):
-        """{'rgb': i3d params, 'flow': i3d params, 'raft': raft params}."""
-        from video_features_tpu.transplant.torch2jax import (
-            load_torch_checkpoint, transplant,
-        )
+        """{'rgb': i3d params, 'flow': i3d params, 'raft': raft params}.
+
+        Missing checkpoint paths are a hard error unless random weights are
+        explicitly allowed (extract.weights; the reference always loads real
+        weights, extract_i3d.py:180-183).
+        """
+        from video_features_tpu.extract.weights import load_or_init
         params = {}
-        get = args.get if hasattr(args, 'get') else lambda k: None
         if 'rgb' in self.streams:
-            ckpt = get('i3d_rgb_checkpoint_path')
-            params['rgb'] = (load_torch_checkpoint(ckpt) if ckpt
-                             else transplant(i3d_model.init_state_dict(modality='rgb')))
+            params['rgb'] = load_or_init(
+                args, 'i3d_rgb_checkpoint_path',
+                partial(i3d_model.init_state_dict, modality='rgb'),
+                feature_type='i3d', what='i3d rgb stream')
         if 'flow' in self.streams:
-            ckpt = get('i3d_flow_checkpoint_path')
-            params['flow'] = (load_torch_checkpoint(ckpt) if ckpt
-                              else transplant(i3d_model.init_state_dict(modality='flow')))
-            raft_ckpt = get('raft_checkpoint_path')
-            params['raft'] = (load_torch_checkpoint(raft_ckpt) if raft_ckpt
-                              else transplant(raft_model.init_state_dict()))
+            params['flow'] = load_or_init(
+                args, 'i3d_flow_checkpoint_path',
+                partial(i3d_model.init_state_dict, modality='flow'),
+                feature_type='i3d', what='i3d flow stream')
+            params['raft'] = load_or_init(
+                args, 'raft_checkpoint_path', raft_model.init_state_dict,
+                feature_type='i3d', what='i3d flow stream (raft)')
         return params
 
     # -- the fused device step ----------------------------------------------
@@ -206,7 +239,8 @@ class ExtractI3D(BaseExtractor):
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files,
             transform=lambda f: resize_pil(f, MIN_SIDE_SIZE),
-            transform_workers=self.decode_workers)
+            transform_workers=self.decode_workers,
+            backend=self.decode_backend)
 
         feats: Dict[str, list] = {s: [] for s in self.streams}
         state = {'pads': None}
@@ -230,7 +264,7 @@ class ExtractI3D(BaseExtractor):
             batches = iter_batched_windows(
                 self._stream_windows(loader), self.batch_size)
             for stacks, _, valid, window_idx in transfer_batches(
-                    batches, self.put_input):
+                    batches, self.put_input, tracer=self.tracer):
                 run(stacks, valid, window_idx)
 
         return {
@@ -249,6 +283,24 @@ class ExtractI3D(BaseExtractor):
         for stream in self.streams:
             logits = _pred_logits(self.params, jnp.asarray(stacks),
                                   stream=stream, pads=tuple(pads),
-                                  crop_size=crop)
+                                  crop_size=crop,
+                                  platform=self._device.platform)
             print(f'At stack {stack_counter} ({stream} stream)')
             show_predictions_on_dataset(np.asarray(logits), 'kinetics')
+        if 'flow' in self.streams:
+            # headless counterpart of the reference's cv2 flow window:
+            # write the Middlebury-rendered first flow frame as a PNG
+            try:
+                import cv2
+
+                from video_features_tpu.utils.flow_viz import flow_to_image
+                flow = np.asarray(_debug_flow(
+                    self.params['raft'], jnp.asarray(stacks),
+                    pads=tuple(pads), crop_size=crop,
+                    platform=self._device.platform))
+                out_dir = Path(self.output_path) / 'flow_debug'
+                out_dir.mkdir(parents=True, exist_ok=True)
+                path = out_dir / f'stack_{stack_counter:06d}.png'
+                cv2.imwrite(str(path), flow_to_image(flow)[..., ::-1])
+            except Exception as e:  # debug surface: never fail extraction
+                print(f'[flow viz] PNG write skipped: {e}')

@@ -64,6 +64,7 @@ class ExtractR21D(BaseExtractor):
         self.stack_batch = args.get('batch_size') or STACK_BATCH
         # data_parallel=true shards stack batches over all local devices
         # (params replicated, batch data-sharded — same scheme as framewise)
+        self.decode_backend = args.get('decode_backend', 'auto')
         self.data_parallel = args.get('data_parallel', False)
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
@@ -73,14 +74,13 @@ class ExtractR21D(BaseExtractor):
     # -- model --------------------------------------------------------------
 
     def load_params(self, args):
-        """Transplanted torch checkpoint if provided, else documented-shape
-        random init (pretrained blobs are not bundled; see transplant/)."""
-        ckpt = args.get('checkpoint_path') if hasattr(args, 'get') else None
-        if ckpt:
-            from video_features_tpu.transplant.torch2jax import load_torch_checkpoint
-            return load_torch_checkpoint(ckpt)
-        from video_features_tpu.transplant.torch2jax import transplant
-        return transplant(r21d_model.init_state_dict(arch=self.model_def['arch']))
+        """Transplanted torch checkpoint; missing path is a hard error unless
+        random weights are explicitly allowed (extract.weights)."""
+        from video_features_tpu.extract.weights import load_or_init
+        return load_or_init(
+            args, 'checkpoint_path',
+            partial(r21d_model.init_state_dict, arch=self.model_def['arch']),
+            feature_type='r21d')
 
     @staticmethod
     def _forward_batch(params, stacks, arch):
@@ -105,7 +105,8 @@ class ExtractR21D(BaseExtractor):
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
-            keep_tmp=self.keep_tmp_files)
+            keep_tmp=self.keep_tmp_files,
+            backend=self.decode_backend)
         windows = stream_windows(loader, self.stack_size, self.step_size,
                                  self.tracer, 'decode')
 
@@ -120,7 +121,7 @@ class ExtractR21D(BaseExtractor):
             # the device runs k (see streaming.transfer_batches)
             for stacks, _, valid, window_idx in transfer_batches(
                     iter_batched_windows(windows, self.stack_batch),
-                    self.put_input):
+                    self.put_input, tracer=self.tracer):
                 with self.tracer.stage('model'):
                     out = np.asarray(self._step(self.params, stacks))[:valid]
                 feats.append(out)

@@ -20,6 +20,8 @@ tails are padded to the compiled shape and masked.
 """
 from __future__ import annotations
 
+from functools import partial
+from pathlib import Path
 from typing import Dict
 
 import jax
@@ -50,6 +52,7 @@ class ExtractRAFT(BaseExtractor):
         )
         self.batch_size = args.batch_size
         self.decode_workers = int(args.get('decode_workers', 1))
+        self.decode_backend = args.get('decode_backend', 'auto')
         self.side_size = args.get('side_size')
         self.resize_to_smaller_edge = args.get('resize_to_smaller_edge', True)
         self.extraction_fps = args.get('extraction_fps')
@@ -69,23 +72,25 @@ class ExtractRAFT(BaseExtractor):
         self.data_parallel = args.get('data_parallel', False)
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
-        self._step = jax.jit(self._flow_batch)
+        # thread the resolved device's platform so the corr-lookup dispatch
+        # matches where the operands actually live, not the process default
+        self._step = jax.jit(partial(self._flow_batch,
+                                     platform=self._device.platform,
+                                     pins=self.precision_pins))
 
     def load_params(self, args):
-        ckpt = args.get('checkpoint_path') if hasattr(args, 'get') else None
-        if ckpt:
-            from video_features_tpu.transplant.torch2jax import load_torch_checkpoint
-            # RAFT checkpoints were saved from nn.DataParallel — prefixes are
-            # stripped by the transplant layer
-            return load_torch_checkpoint(ckpt)
-        from video_features_tpu.transplant.torch2jax import transplant
-        return transplant(raft_model.init_state_dict())
+        # RAFT checkpoints were saved from nn.DataParallel — prefixes are
+        # stripped by the transplant layer
+        from video_features_tpu.extract.weights import load_or_init
+        return load_or_init(args, 'checkpoint_path', raft_model.init_state_dict,
+                            feature_type='raft')
 
     @staticmethod
-    def _flow_batch(params, frames):
+    def _flow_batch(params, frames, platform=None, pins=None):
         """(B+1, Hp, Wp, 3) padded frames → (B, Hp, Wp, 2) flows; interior
         frames are fnet-encoded once (forward_consecutive), not twice."""
-        return raft_model.forward_consecutive(params, frames)
+        return raft_model.forward_consecutive(params, frames,
+                                              platform=platform, pins=pins)
 
     def _build_dp_step(self):
         """shard_map'd per-device forward_consecutive over the data axis.
@@ -97,8 +102,10 @@ class ExtractRAFT(BaseExtractor):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
         return jax.jit(shard_map(
-            raft_model.forward_consecutive, mesh=self._mesh,
-            in_specs=(P(), P('data')), out_specs=P('data')))
+            partial(raft_model.forward_consecutive,
+                    platform=self._device.platform,
+                    pins=self.precision_pins),
+            mesh=self._mesh, in_specs=(P(), P('data')), out_specs=P('data')))
 
     def _halo_shards(self, padded: np.ndarray) -> np.ndarray:
         """(B+1, ...) frames → (n·(k+1), ...) per-device runs with the
@@ -120,6 +127,7 @@ class ExtractRAFT(BaseExtractor):
         if self.data_parallel and self._mesh is None:
             self._ensure_mesh('batch_size')
             self._dp_step = self._build_dp_step()
+        self._viz_stem, self._viz_count = Path(video_path).stem, 0
         loader = VideoLoader(
             video_path,
             batch_size=self.batch_size + 1,
@@ -129,6 +137,7 @@ class ExtractRAFT(BaseExtractor):
             keep_tmp=self.keep_tmp_files,
             transform=self.host_transform,
             transform_workers=self.decode_workers,
+            backend=self.decode_backend,
             overlap=1,
         )
         flows, timestamps = [], []
@@ -166,7 +175,8 @@ class ExtractRAFT(BaseExtractor):
 
         with self.precision_scope():
             # transfer of batch k+1 overlaps the device running batch k
-            for dev, _, pads, valid, ts in transfer_batches(assembled(), put):
+            for dev, _, pads, valid, ts in transfer_batches(
+                    assembled(), put, tracer=self.tracer):
                 timestamps.extend(ts)
                 if dev is None:
                     continue
@@ -194,9 +204,24 @@ class ExtractRAFT(BaseExtractor):
         }
 
     def maybe_show_pred(self, flows: np.ndarray) -> None:
-        """Render flow frames via the Middlebury wheel (headless-safe)."""
+        """Render flow frames via the Middlebury wheel (headless-safe).
+
+        The reference opens cv2 windows per frame (reference
+        base_flow_extractor.py:134-149); TPU hosts are headless, so the
+        rendered image is preserved as a PNG artifact under
+        ``<output_path>/flow_debug/`` instead (one per device batch).
+        """
         from video_features_tpu.utils.flow_viz import flow_to_image
         for flow in flows[:1]:
             img = flow_to_image(flow)
             print(f'[flow viz] frame rendered: shape={img.shape}, '
                   f'mean_mag={np.linalg.norm(flow, axis=-1).mean():.3f}')
+            try:
+                import cv2
+                out_dir = Path(self.output_path) / 'flow_debug'
+                out_dir.mkdir(parents=True, exist_ok=True)
+                path = out_dir / f'{self._viz_stem}_{self._viz_count:06d}.png'
+                cv2.imwrite(str(path), img[..., ::-1])  # RGB → BGR on disk
+                self._viz_count += 1
+            except Exception as e:  # debug surface: never fail extraction
+                print(f'[flow viz] PNG write skipped: {e}')

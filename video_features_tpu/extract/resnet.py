@@ -34,12 +34,11 @@ class ExtractResNet(BaseFrameWiseExtractor):
         self._step = jax.jit(partial(self._forward, arch=self.model_name))
 
     def load_params(self, args):
-        ckpt = args.get('checkpoint_path') if hasattr(args, 'get') else None
-        if ckpt:
-            from video_features_tpu.transplant.torch2jax import load_torch_checkpoint
-            return load_torch_checkpoint(ckpt)
-        from video_features_tpu.transplant.torch2jax import transplant
-        return transplant(resnet_model.init_state_dict(arch=self.model_name))
+        from video_features_tpu.extract.weights import load_or_init
+        return load_or_init(
+            args, 'checkpoint_path',
+            partial(resnet_model.init_state_dict, arch=self.model_name),
+            feature_type='resnet', what=f'resnet ({self.model_name})')
 
     @staticmethod
     def _forward(params, batch, arch):

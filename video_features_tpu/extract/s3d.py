@@ -44,6 +44,7 @@ class ExtractS3D(BaseExtractor):
         self.output_feat_keys = [self.feature_type]
         # stacks per device step; 64-frame stacks are large, so default 1
         self.stack_batch = args.get('batch_size') or STACK_BATCH
+        self.decode_backend = args.get('decode_backend', 'auto')
         self.data_parallel = args.get('data_parallel', False)
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
@@ -51,12 +52,9 @@ class ExtractS3D(BaseExtractor):
         # per aspect ratio (see extract())
 
     def load_params(self, args):
-        ckpt = args.get('checkpoint_path') if hasattr(args, 'get') else None
-        if ckpt:
-            from video_features_tpu.transplant.torch2jax import load_torch_checkpoint
-            return load_torch_checkpoint(ckpt)
-        from video_features_tpu.transplant.torch2jax import transplant
-        return transplant(s3d_model.init_state_dict())
+        from video_features_tpu.extract.weights import load_or_init
+        return load_or_init(args, 'checkpoint_path', s3d_model.init_state_dict,
+                            feature_type='s3d')
 
     @staticmethod
     def _forward(params, stacks, resize_hw):
@@ -73,7 +71,8 @@ class ExtractS3D(BaseExtractor):
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
-            keep_tmp=self.keep_tmp_files)
+            keep_tmp=self.keep_tmp_files,
+            backend=self.decode_backend)
         windows = stream_windows(loader, self.stack_size, self.step_size,
                                  self.tracer, 'decode')
 
@@ -109,7 +108,8 @@ class ExtractS3D(BaseExtractor):
             # (see streaming.transfer_batches)
             for stacks, host_stacks, valid, window_idx in transfer_batches(
                     iter_batched_windows(windows, self.stack_batch),
-                    self.put_input, keep_host=self.show_pred):
+                    self.put_input, keep_host=self.show_pred,
+                    tracer=self.tracer):
                 run(stacks, host_stacks, valid, window_idx)
 
         feats = (np.concatenate(feats, axis=0) if feats

@@ -50,8 +50,8 @@ def iter_batched_windows(windows: Iterable[np.ndarray],
         yield flush()[0]
 
 
-def transfer_batches(items: Iterable[tuple], put,
-                     keep_host: bool = False) -> Iterator[tuple]:
+def transfer_batches(items: Iterable[tuple], put, keep_host: bool = False,
+                     tracer: Tracer = NULL_TRACER) -> Iterator[tuple]:
     """Overlap host→device input transfer with device compute.
 
     ``items`` yields ``(host_batch, *meta)``; ``put`` places one batch on
@@ -64,13 +64,18 @@ def transfer_batches(items: Iterable[tuple], put,
     host array alongside (debug surfaces like show_pred read pixels
     without paying a D2H round trip). The single home for this transfer
     policy — every batched extractor drives its device loop through here.
+    ``tracer`` attributes the producer-thread transfer time to an ``h2d``
+    stage (it runs outside the extract loop, so without this it would be
+    invisible in the profile table).
     """
     from video_features_tpu.io.video import prefetch
 
     def to_device(item):
         batch = item[0]
         host = batch if keep_host else None
-        return (put(batch), host) + tuple(item[1:])
+        with tracer.stage('h2d'):
+            dev = put(batch)
+        return (dev, host) + tuple(item[1:])
 
     return prefetch(map(to_device, items), depth=1)
 

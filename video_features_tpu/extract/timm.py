@@ -69,6 +69,25 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         self.family, self.arch = spec['family'], spec['arch']
         super().__init__(args, feat_dim=spec['feat_dim'])
         self.data_cfg = _data_cfg(self.family)
+        # image_size overrides the checkpoint's native resolution: the crop
+        # becomes image_size and the resize scales to keep the family's
+        # crop_pct. For ViT this resamples the pos embed to the larger patch
+        # grid (models/vit.py:interpolate_pos_embed); past ~736px the token
+        # count crosses BLOCKWISE_THRESHOLD and attention runs blockwise —
+        # the high-resolution / long-token production path.
+        image_size = args.get('image_size')
+        if image_size:
+            image_size = int(image_size)
+            if self.family == 'vit':
+                patch = vit_model.ARCHS[self.arch]['patch']
+                if image_size % patch:
+                    raise ValueError(
+                        f'image_size={image_size} must be a multiple of the '
+                        f'patch size ({patch}) for {self.arch}')
+            factor = image_size / self.data_cfg['crop']
+            self.data_cfg['resize'] = int(round(
+                self.data_cfg['resize'] * factor))
+            self.data_cfg['crop'] = image_size
         self._device = jax_device(self.device)
         self.params = jax.device_put(self._load_params(args), self._device)
         self._step = jax.jit(partial(
@@ -102,6 +121,12 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                 interpolation=data.get('interpolation', 'bilinear'),
                 mean=tuple(data['mean']), std=tuple(data['std']))
             return transplant(model.state_dict())
+        # no checkpoint and no pip-timm: hard error unless random weights
+        # are explicitly allowed (the reference's timm path always loads
+        # pretrained weights, extract_timm.py:48)
+        from video_features_tpu.extract.weights import require_checkpoint
+        require_checkpoint(args, 'checkpoint_path', feature_type='timm',
+                           what=f'timm ({self.model_name})')
         init = (vit_model if self.family == 'vit' else resnet_model)
         return transplant(init.init_state_dict(arch=self.arch))
 

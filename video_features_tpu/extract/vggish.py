@@ -74,14 +74,10 @@ class ExtractVGGish(BaseExtractor):
                 pca['pca_means'].astype(np.float32).reshape(-1), self._device)
 
     def load_params(self, args):
-        ckpt = args.get('checkpoint_path')
-        if ckpt:
-            from video_features_tpu.transplant.torch2jax import (
-                load_torch_checkpoint,
-            )
-            return load_torch_checkpoint(ckpt)
-        from video_features_tpu.transplant.torch2jax import transplant
-        return transplant(vggish_model.init_state_dict())
+        from video_features_tpu.extract.weights import load_or_init
+        return load_or_init(args, 'checkpoint_path',
+                            vggish_model.init_state_dict,
+                            feature_type='vggish')
 
     def _read_audio(self, video_path: str):
         """(waveform, sr, tmp_files_to_clean) for any supported input."""

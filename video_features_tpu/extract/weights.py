@@ -1,0 +1,83 @@
+"""Checkpoint resolution with a loud failure when weights are missing.
+
+The reference always runs real weights — every extractor self-provisions
+them (reference models/i3d/extract_i3d.py:180-183 loads bundled .pt files,
+models/resnet/extract_resnet.py:38-40 uses torchvision's pretrained enums,
+models/r21d/extract_r21d.py:109-118 torch.hub). This framework reads local
+checkpoint files instead (TPU hosts are often torch-free and air-gapped), so
+a *missing* path must be a hard error: silently falling back to random
+weights would hand the user plausible-looking garbage features.
+
+Escape hatches for tests/benches that intentionally run random weights:
+  * config: ``allow_random_weights=true``
+  * env:    ``VFT_ALLOW_RANDOM_WEIGHTS=1`` (set by the test suite's conftest)
+
+``tools/fetch_checkpoints.py`` provisions real weights from the same sources
+the reference downloads from.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+ENV_FLAG = 'VFT_ALLOW_RANDOM_WEIGHTS'
+
+
+class MissingCheckpointError(ValueError):
+    """No checkpoint configured and random weights were not explicitly allowed."""
+
+
+def _get(args: Any, key: str, default: Any = None) -> Any:
+    if hasattr(args, 'get'):
+        return args.get(key, default)
+    return getattr(args, key, default)
+
+
+def random_weights_allowed(args: Any) -> bool:
+    if _get(args, 'allow_random_weights'):
+        return True
+    return os.environ.get(ENV_FLAG, '').lower() not in ('', '0', 'false')
+
+
+def require_checkpoint(args: Any, key: str, *, feature_type: str,
+                       what: Optional[str] = None) -> Optional[str]:
+    """Return ``args[key]``; raise if absent unless random weights are allowed.
+
+    Returns None ONLY when the caller may proceed with random init (the
+    explicit escape hatch was set). ``what`` names the weights in messages
+    (defaults to the feature type).
+    """
+    ckpt = _get(args, key)
+    if ckpt:
+        return str(ckpt)
+    what = what or feature_type
+    if not random_weights_allowed(args):
+        raise MissingCheckpointError(
+            f'No checkpoint configured for {what}: set `{key}=<path to a '
+            f'.pt/.pth/.npz checkpoint>` (feature_type={feature_type}). '
+            f'Provision real weights with `python tools/fetch_checkpoints.py '
+            f'{feature_type}` (see docs/checkpoints.md). To intentionally run '
+            f'RANDOM weights (tests/benchmarks only — features will be '
+            f'meaningless), set `allow_random_weights=true`.')
+    print(f'WARNING: {what}: no `{key}` configured — running RANDOM weights '
+          f'(allow_random_weights is set). Extracted features are '
+          f'meaningless for downstream use.')
+    return None
+
+
+def load_or_init(args: Any, key: str, init_fn: Callable[[], Dict[str, Any]],
+                 *, feature_type: str, what: Optional[str] = None,
+                 load: Optional[Callable[[str], Dict[str, Any]]] = None,
+                 ) -> Dict[str, Any]:
+    """Transplanted params from ``args[key]``, or gated random init.
+
+    ``load`` overrides the default :func:`load_torch_checkpoint` for
+    families with special checkpoint handling.
+    """
+    from video_features_tpu.transplant.torch2jax import (
+        load_torch_checkpoint, transplant,
+    )
+    ckpt = require_checkpoint(args, key, feature_type=feature_type, what=what)
+    if ckpt:
+        return load(ckpt) if load is not None else load_torch_checkpoint(ckpt)
+    return transplant(init_fn())

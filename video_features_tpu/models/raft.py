@@ -23,7 +23,7 @@ Input: two (B, H, W, 3) uint8/float RGB frames, H and W divisible by 8
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -317,7 +317,7 @@ def _lookup_impl() -> str:
     return impl
 
 
-def _resolve_auto_lookup(h8: int, w8: int) -> str:
+def _resolve_auto_lookup(h8: int, w8: int, platform: str) -> str:
     """'lanes' when on TPU and the level-0 (h8, w8, LANES) block fits the
     VMEM budget; 'dense' otherwise. Shapes are static at trace time, so the
     choice compiles away."""
@@ -327,7 +327,7 @@ def _resolve_auto_lookup(h8: int, w8: int) -> str:
     budget = float(os.environ.get('VFT_RAFT_LANES_VMEM_MB',
                                   LANES_VMEM_BUDGET_MB))
     block_mb = h8 * w8 * LANES * 4 / 2 ** 20
-    if jax.default_backend() == 'tpu' and block_mb <= budget:
+    if platform == 'tpu' and block_mb <= budget:
         return 'lanes'
     return 'dense'
 
@@ -338,22 +338,29 @@ def _normalize_frames(img: jax.Array) -> jax.Array:
 
 
 def forward(params: Params, image1: jax.Array, image2: jax.Array,
-            iters: int = ITERS) -> jax.Array:
+            iters: int = ITERS, platform: Optional[str] = None,
+            pins=None) -> jax.Array:
     """Two (B, H, W, 3) frames (values 0..255) → (B, H, W, 2) flow.
 
     H, W must be divisible by 8 (reference pads with InputPadder, raft.py:30-48
-    — see :func:`pad_to_multiple` / :func:`unpad`).
+    — see :func:`pad_to_multiple` / :func:`unpad`). ``platform`` selects the
+    corr-lookup implementation for the platform the graph will run on (see
+    :func:`_refine`); ``pins`` per-sub-graph precision (ops/precision.py).
     """
+    from video_features_tpu.ops.precision import pin_scope
     image1 = _normalize_frames(image1)
     image2 = _normalize_frames(image2)
-    fmap1 = basic_encoder(params['fnet'], image1, 'instance')
-    fmap2 = basic_encoder(params['fnet'], image2, 'instance')
-    cnet = basic_encoder(params['cnet'], image1, 'batch')
-    return _refine(params, fmap1, fmap2, cnet, iters)
+    with pin_scope(pins, 'encoder'):
+        fmap1 = basic_encoder(params['fnet'], image1, 'instance')
+        fmap2 = basic_encoder(params['fnet'], image2, 'instance')
+        cnet = basic_encoder(params['cnet'], image1, 'batch')
+    return _refine(params, fmap1, fmap2, cnet, iters, platform, pins)
 
 
 def forward_consecutive(params: Params, frames: jax.Array,
-                        iters: int = ITERS) -> jax.Array:
+                        iters: int = ITERS,
+                        platform: Optional[str] = None,
+                        pins=None) -> jax.Array:
     """(N, H, W, 3) consecutive frames → (N-1, H, W, 2) pairwise flows.
 
     Same math as :func:`forward` on ``(frames[:-1], frames[1:])`` — the
@@ -363,11 +370,14 @@ def forward_consecutive(params: Params, frames: jax.Array,
     encoding is computed ONCE here and shared, where the reference's
     stacked-pair form encodes it twice (raft.py:84-85).
     """
-    return forward_stack_pairs(params, frames[None], iters)[0]
+    return forward_stack_pairs(params, frames[None], iters,
+                               platform=platform, pins=pins)[0]
 
 
 def forward_stack_pairs(params: Params, stacks: jax.Array, iters: int = ITERS,
-                        constrain=None) -> jax.Array:
+                        constrain=None,
+                        platform: Optional[str] = None,
+                        pins=None) -> jax.Array:
     """(B, S+1, H, W, 3) frame stacks → (B, S, H, W, 2) within-stack flows.
 
     The fused I3D path's form of :func:`forward_consecutive`: fnet runs on
@@ -379,12 +389,14 @@ def forward_stack_pairs(params: Params, stacks: jax.Array, iters: int = ITERS,
     +1 halo); GSPMD pads the last shards, a ≤1-frame-per-shard imbalance
     on fnet that still beats sharding fnet over the data axis alone.
     """
+    from video_features_tpu.ops.precision import pin_scope
     B, S1, H, W, C = stacks.shape
     S = S1 - 1
     flat = _normalize_frames(stacks.reshape(B * S1, H, W, C))
     if constrain is not None:
         flat = constrain(flat)
-    fmaps = basic_encoder(params['fnet'], flat, 'instance')
+    with pin_scope(pins, 'encoder'):
+        fmaps = basic_encoder(params['fnet'], flat, 'instance')
     h8, w8, c = fmaps.shape[1:]
     fmaps = fmaps.reshape(B, S1, h8, w8, c)
     fmap1 = fmaps[:, :-1].reshape(B * S, h8, w8, c)
@@ -392,17 +404,31 @@ def forward_stack_pairs(params: Params, stacks: jax.Array, iters: int = ITERS,
     first = flat.reshape(B, S1, H, W, C)[:, :-1].reshape(B * S, H, W, C)
     if constrain is not None:
         fmap1, fmap2, first = constrain(fmap1), constrain(fmap2), constrain(first)
-    cnet = basic_encoder(params['cnet'], first, 'batch')
-    flow = _refine(params, fmap1, fmap2, cnet, iters)
+    with pin_scope(pins, 'encoder'):
+        cnet = basic_encoder(params['cnet'], first, 'batch')
+    flow = _refine(params, fmap1, fmap2, cnet, iters, platform, pins)
     return flow.reshape(B, S, flow.shape[1], flow.shape[2], 2)
 
 
 def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
-            cnet: jax.Array, iters: int) -> jax.Array:
+            cnet: jax.Array, iters: int,
+            platform: Optional[str] = None, pins=None) -> jax.Array:
     """Correlation pyramid + 20-iteration GRU refinement + 8× upsample —
     the shared core behind every forward variant (reference raft.py:118-175
-    from the post-encoder point on)."""
-    pyramid = build_corr_pyramid(fmap1, fmap2)
+    from the post-encoder point on).
+
+    ``platform`` is the platform the compiled graph will RUN on ('tpu' /
+    'cpu' / ...); it picks the corr-lookup implementation and Pallas
+    interpret mode. Defaults to ``jax.default_backend()``, which is only
+    correct when the operands live on the default backend — extractors
+    thread their resolved device's platform instead (a CPU-committed call
+    in a TPU-default process must not get the Mosaic lanes kernel).
+    ``pins`` optionally overrides matmul precision per sub-graph
+    (ops/precision.py): 'corr', 'iter', 'upsample'."""
+    from video_features_tpu.ops.precision import pin_scope
+    platform = platform or jax.default_backend()
+    with pin_scope(pins, 'corr'):
+        pyramid = build_corr_pyramid(fmap1, fmap2)
     net, inp = jnp.split(cnet, [HIDDEN_DIM], axis=-1)
     net = jnp.tanh(net)
     inp = relu(inp)
@@ -416,7 +442,7 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
 
     impl = _lookup_impl()
     if impl == 'auto':
-        impl = _resolve_auto_lookup(H8, W8)
+        impl = _resolve_auto_lookup(H8, W8, platform)
     if impl in ('pallas', 'lanes'):
         from video_features_tpu.ops import pallas_corr
         prep_fn, lookup_fn = {
@@ -425,8 +451,10 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
             'lanes': (pallas_corr.prep_pyramid_lanes,
                       pallas_corr.lookup_corr_lanes),
         }[impl]
-        interp = jax.default_backend() != 'tpu'
-        lookup = partial(lookup_fn, prep_fn(pyramid),
+        interp = platform != 'tpu'
+        with pin_scope(pins, 'corr'):
+            prepped = prep_fn(pyramid)
+        lookup = partial(lookup_fn, prepped,
                          radius=CORR_RADIUS, interpret=interp)
     elif impl == 'gather':
         lookup = partial(lookup_corr, pyramid)
@@ -446,21 +474,25 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
 
     def step(carry, _):
         net, coords1, _ = carry
-        corr = lookup(coords1)
+        with pin_scope(pins, 'corr'):
+            corr = lookup(coords1)
         flow = coords1 - coords0
-        motion = motion_encoder(up['encoder'], flow, corr)
-        net_new = sep_conv_gru(gru, net, jnp.concatenate([inp, motion], -1))
-        t = relu(conv(net_new, head_w, padding=1, bias=head_b))
-        t_flow, t_mask = jnp.split(t, [head_split], axis=-1)
-        delta = _conv_b(fh['conv2'], t_flow, padding=1)
-        coords1_new = coords1 + delta
-        mask = 0.25 * _conv_b(mk['2'], t_mask)
+        with pin_scope(pins, 'iter'):
+            motion = motion_encoder(up['encoder'], flow, corr)
+            net_new = sep_conv_gru(gru, net,
+                                   jnp.concatenate([inp, motion], -1))
+            t = relu(conv(net_new, head_w, padding=1, bias=head_b))
+            t_flow, t_mask = jnp.split(t, [head_split], axis=-1)
+            delta = _conv_b(fh['conv2'], t_flow, padding=1)
+            coords1_new = coords1 + delta
+            mask = 0.25 * _conv_b(mk['2'], t_mask)
         return (net_new, coords1_new, mask), None
 
     mask0 = jnp.zeros((B, H8, W8, 576), net.dtype) + jnp.zeros_like(net[..., :1])
     (net, coords1, mask), _ = lax.scan(step, (net, coords0, mask0), None,
                                        length=iters)
-    return upsample_flow(coords1 - coords0, mask)
+    with pin_scope(pins, 'upsample'):
+        return upsample_flow(coords1 - coords0, mask)
 
 
 def pad_to_multiple(x: jax.Array, mode: str = 'sintel',

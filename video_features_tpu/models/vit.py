@@ -63,7 +63,7 @@ def _attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
     qkv = x @ p['qkv']['weight'] + p['qkv']['bias']          # (B, N, 3D)
     qkv = qkv.reshape(B, N, 3, num_heads, head_dim)
     q, k, v = jnp.moveaxis(qkv, 2, 0)                        # (B, N, H, hd)
-    if N >= BLOCKWISE_THRESHOLD and N % _BLOCK == 0:
+    if N >= BLOCKWISE_THRESHOLD:
         out = blockwise_attention(q, k, v, block_size=_BLOCK)
     else:
         out = dense_attention(q, k, v)
@@ -81,12 +81,38 @@ def _block(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
     return x + h
 
 
+def interpolate_pos_embed(pos_embed: jax.Array,
+                          grid: "tuple[int, int]") -> jax.Array:
+    """Resample a (1, 1+g², D) pos embed to a new (gh, gw) patch grid.
+
+    The standard timm recipe for non-native input resolutions
+    (`resample_abs_pos_embed`): keep the cls position, bicubically resize
+    the 2-D grid positions. Lets 224-trained checkpoints run at higher
+    resolutions (more tokens — the blockwise-attention regime).
+    """
+    n = pos_embed.shape[1] - 1
+    side = int(round(n ** 0.5))
+    if (side, side) == grid:
+        return pos_embed
+    cls_pos, grid_pos = pos_embed[:, :1], pos_embed[:, 1:]
+    d = pos_embed.shape[-1]
+    grid_pos = grid_pos.reshape(1, side, side, d)
+    grid_pos = jax.image.resize(grid_pos, (1, grid[0], grid[1], d),
+                                method='bicubic')
+    return jnp.concatenate(
+        [cls_pos, grid_pos.reshape(1, grid[0] * grid[1], d)], axis=1)
+
+
 def forward(params: Params, x: jax.Array, arch: str = 'vit_base_patch16_224',
             features: bool = True) -> jax.Array:
     """(B, H, W, 3) float in model space → (B, width) cls-token features.
 
     With ``features=False`` and a transplanted ``head``, returns (B, 1000)
     logits (the reference's show_pred path, extract_timm.py:63-91).
+    Inputs need not be the checkpoint's native 224px — the pos embed is
+    bicubically resampled to the actual patch grid (timm's high-res recipe),
+    and past BLOCKWISE_THRESHOLD tokens attention switches to the
+    O(N·block) blockwise path.
     """
     cfg = ARCHS[arch]
     width, num_heads, patch = cfg['width'], cfg['heads'], cfg['patch']
@@ -97,9 +123,11 @@ def forward(params: Params, x: jax.Array, arch: str = 'vit_base_patch16_224',
     x = jax.lax.conv_general_dilated(
         x, k['weight'], window_strides=(patch, patch), padding='VALID',
         dimension_numbers=('NHWC', 'HWIO', 'NHWC')) + k['bias']
+    grid = (x.shape[1], x.shape[2])
     x = x.reshape(B, -1, width)
     cls = jnp.broadcast_to(params['cls_token'], (B, 1, width))
-    x = jnp.concatenate([cls, x], axis=1) + params['pos_embed']
+    x = jnp.concatenate([cls, x], axis=1) + interpolate_pos_embed(
+        params['pos_embed'], grid)
     for i in range(cfg['layers']):
         x = _block(params['blocks'][str(i)], x, num_heads)
     x = layer_norm(x, params['norm'])

@@ -41,9 +41,16 @@ def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum('bhqk,bkhd->bqhd', p, v)
 
 
-def _online_block(q, m, l, o, kb, vb, scale):
-    """One online-softmax accumulation step against KV block (kb, vb)."""
+def _online_block(q, m, l, o, kb, vb, scale, valid=None):
+    """One online-softmax accumulation step against KV block (kb, vb).
+
+    ``valid`` (block_size,) bool masks padded keys out of the softmax
+    (scores → -inf ⇒ p → 0); fully-padded blocks leave the carry unchanged
+    because m_new falls back to the running max.
+    """
     s = jnp.einsum('bqhd,bkhd->bqhk', q, kb).astype(jnp.float32) * scale
+    if valid is not None:
+        s = jnp.where(valid, s, -jnp.inf)
     m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
     alpha = jnp.exp(m - m_new)
@@ -66,21 +73,35 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         scale: Optional[float] = None) -> jax.Array:
     """Memory-efficient attention: scan over KV blocks, O(S·block) memory.
 
-    S must divide by ``block_size`` (pad+mask upstream if ragged; every
-    model here produces fixed token counts).
+    Ragged S is handled by zero-padding KV to a block multiple and masking
+    the padded keys out of the online softmax — a ViT token count
+    (grid² + 1 cls) is never block-aligned, and this is the production path
+    for high-resolution inputs past BLOCKWISE_THRESHOLD tokens.
     """
     b, sk, h, d = k.shape
     block_size = min(block_size, sk)
-    assert sk % block_size == 0, (sk, block_size)
+    pad = (-sk) % block_size
     sc = _scale(q, scale)
-    kb = k.reshape(b, sk // block_size, block_size, h, d).swapaxes(0, 1)
-    vb = v.reshape(b, sk // block_size, block_size, h, d).swapaxes(0, 1)
+    valid = None
+    if pad:
+        k = jnp.pad(k, [(0, 0), (0, pad), (0, 0), (0, 0)])
+        v = jnp.pad(v, [(0, 0), (0, pad), (0, 0), (0, 0)])
+        valid = (jnp.arange(sk + pad) < sk).reshape(-1, block_size)
+    n_blocks = (sk + pad) // block_size
+    kb = k.reshape(b, n_blocks, block_size, h, d).swapaxes(0, 1)
+    vb = v.reshape(b, n_blocks, block_size, h, d).swapaxes(0, 1)
 
-    def step(carry, kv):
-        m, l, o = _online_block(q, *carry, kv[0], kv[1], sc)
+    def step(carry, blk):
+        if valid is None:
+            kv_k, kv_v = blk
+            mask = None
+        else:
+            kv_k, kv_v, mask = blk
+        m, l, o = _online_block(q, *carry, kv_k, kv_v, sc, valid=mask)
         return (m, l, o), None
 
-    (m, l, o), _ = lax.scan(step, _online_init(q), (kb, vb))
+    xs = (kb, vb) if valid is None else (kb, vb, valid)
+    (m, l, o), _ = lax.scan(step, _online_init(q), xs)
     return (o / l).astype(q.dtype)
 
 

@@ -1,0 +1,65 @@
+"""Targeted matmul-precision pinning for mixed-precision graphs.
+
+The parity bar (feature rel L2 ≤ 1e-3 vs the reference, BASELINE.json)
+forces ``precision=highest`` when applied globally — bf16 MXU passes drift
+1.3e-2 through the fused RAFT→quantize→I3D path because the flow uint8
+quantization cliff amplifies small flow errors. But the drift is not
+uniform across the graph: a few numerically sensitive sub-graphs (the
+correlation volume, the per-iteration refinement whose error compounds over
+20 GRU steps, the I3D towers reading the quantized flow) dominate it, while
+the one-shot encoders tolerate fast passes.
+
+``pins`` name sub-graphs to run at a DIFFERENT matmul precision than the
+ambient one: a tuple of (component, precision) pairs — hashable so it can
+ride jit static args and participate in the compile cache key. Components
+wired up:
+
+  * raft: 'encoder' (fnet/cnet), 'corr' (pyramid build + lookup),
+    'iter' (motion encoder + GRU + flow/mask heads), 'upsample';
+  * the fused I3D step: 'i3d' (both towers).
+
+``precision='mixed'`` in an extraction config = ambient 'default' (fast
+MXU passes) + the measured-safe pins (MIXED_PINS below, tuned on TPU by
+tools/precision_study.py).
+"""
+from __future__ import annotations
+
+from contextlib import nullcontext
+from typing import Dict, Optional, Tuple, Union
+
+import jax
+
+Pins = Tuple[Tuple[str, str], ...]
+
+# The 'mixed' policy, tuned by tools/precision_study.py on v5e (fused
+# two-stream path, drift = feature rel L2 vs all-float32 on identical
+# inputs/weights): ambient 'high' (3-pass bf16 ≈ fp32 to ~2^-21 per
+# matmul) measures 8.4e-4 flow / 1.3e-4 rgb — under the ≤1e-3 parity bar —
+# at 24.2 clips/s vs 14.6 at 'highest' (batch 8, stack 16, 224px). No
+# sub-graph survives 1-pass: encoder-at-default alone is 1.04e-2, and
+# corr-at-default under ambient high is 4.4e-3 (the flow-quantization
+# cliff amplifies both). So 'mixed' is ambient 'high' with no down-pins;
+# the pins machinery stays for study sweeps and future per-op tuning.
+MIXED_AMBIENT = 'high'
+MIXED_PINS: Pins = ()
+
+
+def normalize_pins(pins: Union[None, Pins, Dict[str, str]]) -> Optional[Pins]:
+    """dict/tuple → canonical sorted tuple (None stays None)."""
+    if pins is None:
+        return None
+    items = pins.items() if isinstance(pins, dict) else pins
+    return tuple(sorted((str(k), str(v)) for k, v in items))
+
+
+def pin_scope(pins: Optional[Pins], component: str):
+    """Trace-time context: matmul precision override for one sub-graph.
+
+    Returns a null context when the component is not pinned, so call sites
+    cost nothing in the common (unpinned) case.
+    """
+    if pins:
+        for name, prec in pins:
+            if name == component:
+                return jax.default_matmul_precision(prec)
+    return nullcontext()

@@ -28,7 +28,8 @@ from video_features_tpu.parallel.mesh import (
 
 def build_sharded_two_stream_step(mesh: Mesh,
                                   streams: Tuple[str, ...] = ('rgb', 'flow'),
-                                  donate_stacks: bool = False):
+                                  donate_stacks: bool = False,
+                                  pins=None):
     """jit-compiled ``step(params, stacks, pads, crop_size=…)`` over ``mesh``.
 
     ``stacks`` is (B, stack+1, H, W, 3) with B divisible by the data-axis
@@ -41,10 +42,15 @@ def build_sharded_two_stream_step(mesh: Mesh,
     def constrain_pairs(t: jax.Array) -> jax.Array:
         return jax.lax.with_sharding_constraint(t, pair_sharding(mesh))
 
+    # the mesh's devices say where the program runs — drive the RAFT
+    # corr-lookup dispatch from them, not the process default backend
+    platform = mesh.devices.flat[0].platform
+
     def step(params, stacks, pads, crop_size):
         return fused_two_stream_step(params, stacks, pads, streams,
                                      constrain_pairs=constrain_pairs,
-                                     crop_size=crop_size)
+                                     crop_size=crop_size, platform=platform,
+                                     pins=pins)
 
     jitted = jax.jit(
         step,

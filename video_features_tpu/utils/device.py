@@ -5,28 +5,8 @@ import os
 
 import jax
 
-MATMUL_PRECISIONS = ('default', 'high', 'highest',
+MATMUL_PRECISIONS = ('default', 'high', 'highest', 'mixed',
                      'bfloat16', 'tensorfloat32', 'float32')
-
-
-def _host_fingerprint() -> str:
-    """Architecture + CPU-feature-flag hash identifying this host's
-    executable compatibility. Same-arch hosts with different ISA extensions
-    (AVX-512 vs not) must NOT share XLA:CPU AOT cache entries — the
-    architecture name alone ('x86_64') cannot tell them apart."""
-    import hashlib
-    import platform as _platform
-    flags = ''
-    try:
-        with open('/proc/cpuinfo') as f:
-            for line in f:
-                if line.startswith(('flags', 'Features')):
-                    flags = line
-                    break
-    except OSError:
-        flags = _platform.processor()
-    h = hashlib.sha1(flags.encode()).hexdigest()[:8]
-    return f'{_platform.machine()}-{h}'
 
 
 def enable_compilation_cache(cache_dir, device: str = 'any') -> None:
@@ -42,21 +22,44 @@ def enable_compilation_cache(cache_dir, device: str = 'any') -> None:
 
     ``device`` (the resolved config device — passed rather than asking
     jax, which would initialize backends before a CPU run pins its
-    platform) scopes the directory: XLA:CPU AOT entries record the
-    compiling machine's CPU features and can SIGILL when loaded on a
-    different machine, so a shared dir must never serve entries across
-    backends or heterogeneous hosts.
+    platform) scopes the directory. XLA:CPU gets NO persistent cache:
+    its AOT entries record the compiling machine's CPU feature list and
+    the loader rejects (or worse, SIGILLs on) any mismatch — including
+    same-host mismatches from feature-canonicalization differences
+    (observed: '+prefer-no-scatter' recorded at compile, absent at load).
+    CPU compiles are seconds, not minutes; the cache only pays on
+    accelerators, whose serialized executables are host-independent.
     """
-    if not cache_dir:
+    try:
+        current = jax.config.jax_compilation_cache_dir
+    except AttributeError:  # pragma: no cover - very old jax
+        current = None
+    if not cache_dir or device in ('cpu', 'any'):
+        # The cache config is process-global: if an accelerator extractor
+        # already enabled it, a later CPU extractor would persist XLA:CPU
+        # AOT entries (host-ISA-fingerprinted) into the host-SHARED
+        # accelerator dir — reject/SIGILL fodder for other hosts. Clear it;
+        # correctness beats the accelerator cache in mixed-device processes.
+        if current:
+            print('compilation cache disabled for this process '
+                  f'(device={device!r} must not persist XLA:CPU entries '
+                  f'into the shared dir {current})')
+            try:
+                jax.config.update('jax_compilation_cache_dir', None)
+            except Exception:  # pragma: no cover
+                pass
         return
     try:
-        # the ISA-fingerprint hazard only applies to XLA:CPU AOT entries;
-        # accelerator executables don't depend on host CPU features, so any
-        # non-CPU device keeps one shared subdir across hosts (full hit
-        # rate). 'any' (unresolved device) gets the safe fingerprinted dir.
-        sub = (f'{device}-{_host_fingerprint()}'
-               if device in ('cpu', 'any') else device)
-        path = os.path.join(os.path.expanduser(str(cache_dir)), sub)
+        # accelerator executables don't depend on host CPU features, so
+        # each non-CPU platform keeps one shared subdir across hosts
+        # (full hit rate)
+        path = os.path.join(os.path.expanduser(str(cache_dir)), device)
+        if current and current != path:
+            # the cache dir is process-global; a second extractor with a
+            # different dir/device would silently redirect the first one's
+            print(f'WARNING: compilation cache already at {current}; '
+                  f'redirecting to {path} (process-global — earlier '
+                  f'extractors in this process now use the new dir)')
         os.makedirs(path, exist_ok=True)
         jax.config.update('jax_compilation_cache_dir', path)
         # default threshold is 60s; our steady-state steps are seconds, so
