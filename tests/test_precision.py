@@ -69,3 +69,24 @@ def test_mixed_mode_extractor_runs_and_matches_on_cpu(tmp_path):
         outs[precision] = {k: np.asarray(v) for k, v in out.items()}
     for k in ('rgb', 'flow'):
         np.testing.assert_array_equal(outs['mixed'][k], outs['highest'][k])
+
+
+def test_iter_early_pin_structurally_sound():
+    """iter_early splits the GRU scan; on CPU (fp32 everywhere) the split
+    must be bit-identical to the single scan, for any split point."""
+    import jax
+
+    from video_features_tpu.models import raft as raft_model
+    from video_features_tpu.transplant.torch2jax import transplant
+
+    params = transplant(raft_model.init_state_dict())
+    rng = np.random.RandomState(0)
+    f1 = (rng.rand(1, 64, 64, 3) * 255).astype(np.float32)
+    f2 = (rng.rand(1, 64, 64, 3) * 255).astype(np.float32)
+    with jax.default_matmul_precision('highest'):
+        base = np.asarray(raft_model.forward(params, f1, f2, iters=6))
+        for n in (0, 3, 6, 99):
+            split = np.asarray(raft_model.forward(
+                params, f1, f2, iters=6,
+                pins=(('iter_early', f'default:{n}'),)))
+            np.testing.assert_array_equal(split, base)

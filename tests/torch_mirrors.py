@@ -178,3 +178,74 @@ def randomize_bn_stats(model: nn.Module, seed: int = 0) -> None:
             m.running_mean = torch.randn(
                 m.num_features, generator=gen) * 0.1
             m.running_var = torch.rand(m.num_features, generator=gen) + 0.5
+
+
+# -------------------------------------------------------------- convnext --
+
+
+class _LayerNorm2d(nn.LayerNorm):
+    """timm LayerNorm2d: LN over C of an NCHW tensor."""
+
+    def forward(self, x):
+        x = x.permute(0, 2, 3, 1)
+        x = super().forward(x)
+        return x.permute(0, 3, 1, 2)
+
+
+class _CNBlock(nn.Module):
+    def __init__(self, dim):
+        super().__init__()
+        self.conv_dw = nn.Conv2d(dim, dim, 7, padding=3, groups=dim)
+        self.norm = nn.LayerNorm(dim, eps=1e-6)
+        self.mlp = nn.Module()
+        self.mlp.fc1 = nn.Linear(dim, 4 * dim)
+        self.mlp.fc2 = nn.Linear(4 * dim, dim)
+        self.gamma = nn.Parameter(torch.full((dim,), 1e-6))
+
+    def forward(self, x):
+        h = self.conv_dw(x).permute(0, 2, 3, 1)
+        h = self.mlp.fc2(F.gelu(self.mlp.fc1(self.norm(h))))
+        return x + (self.gamma * h).permute(0, 3, 1, 2)
+
+
+class _CNStage(nn.Module):
+    def __init__(self, in_dim, dim, depth, downsample):
+        super().__init__()
+        if downsample:
+            self.downsample = nn.Sequential(
+                _LayerNorm2d(in_dim, eps=1e-6),
+                nn.Conv2d(in_dim, dim, 2, 2))
+        self.blocks = nn.Sequential(*[_CNBlock(dim) for _ in range(depth)])
+
+    def forward(self, x):
+        if hasattr(self, 'downsample'):
+            x = self.downsample(x)
+        return self.blocks(x)
+
+
+class TorchConvNeXt(nn.Module):
+    """timm `ConvNeXt` mirror (stem/stages/head state_dict layout)."""
+
+    CFGS = {
+        'convnext_tiny': ((3, 3, 9, 3), (96, 192, 384, 768)),
+        'convnext_small': ((3, 3, 27, 3), (96, 192, 384, 768)),
+        'convnext_base': ((3, 3, 27, 3), (128, 256, 512, 1024)),
+        'convnext_large': ((3, 3, 27, 3), (192, 384, 768, 1536)),
+    }
+
+    def __init__(self, arch='convnext_tiny', num_classes=1000):
+        super().__init__()
+        depths, dims = self.CFGS[arch]
+        self.stem = nn.Sequential(nn.Conv2d(3, dims[0], 4, 4),
+                                  _LayerNorm2d(dims[0], eps=1e-6))
+        self.stages = nn.Sequential(*[
+            _CNStage(dims[max(s - 1, 0)], dims[s], depths[s], s > 0)
+            for s in range(4)])
+        self.head = nn.Module()
+        self.head.norm = nn.LayerNorm(dims[-1], eps=1e-6)
+        self.head.fc = nn.Linear(dims[-1], num_classes)
+
+    def forward(self, x, features=True):
+        x = self.stages(self.stem(x)).mean(dim=(2, 3))
+        x = self.head.norm(x)
+        return x if features else self.head.fc(x)

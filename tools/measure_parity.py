@@ -1,0 +1,413 @@
+#!/usr/bin/env python3
+"""Measure numerics parity vs the reference implementation; write PARITY.md.
+
+For every model family this runs the reference-side computation (the
+reference repo's own torch nets where importable, state-dict-compatible
+torch mirrors where the reference delegates to torchvision/timm) and ours
+on identical inputs and weights, then the end-to-end pipelines on a real
+clip, and reports feature rel L2 against the ≤1e-3 bar (BASELINE.json).
+
+Weights: seeded-random by default (the reference's pretrained blobs are
+absent in this environment — reference/.MISSING_LARGE_BLOBS). Pass
+``--checkpoints DIR`` holding files provisioned by tools/fetch_checkpoints
+(i3d_rgb.pt, i3d_flow.pt, raft-sintel.pth, S3D_kinetics400_torchified.pt)
+to measure the same numbers on real weights — the loaders put them into
+BOTH sides, so the comparison methodology is identical.
+
+    python tools/measure_parity.py --out PARITY.md          # full (~30 min CPU)
+    python tools/measure_parity.py --only e2e_i3d --json    # one row
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parents[1]
+REFERENCE = Path('/root/reference')
+sys.path.insert(0, str(REPO))
+
+BAR = 1e-3
+
+
+def _rel(a, b):
+    return float(np.linalg.norm(np.asarray(a) - np.asarray(b))
+                 / max(np.linalg.norm(np.asarray(b)), 1e-12))
+
+
+def _load_sd(ckpt_dir, *names):
+    """First existing checkpoint under --checkpoints, else None (seeded)."""
+    import torch
+    if ckpt_dir is None:
+        return None
+    for name in names:
+        p = Path(ckpt_dir) / name
+        if p.exists():
+            sd = torch.load(str(p), map_location='cpu', weights_only=False)
+            if isinstance(sd, dict) and 'state_dict' in sd:
+                sd = sd['state_dict']
+            return sd
+    return None
+
+
+def _highest():
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    return jax.default_matmul_precision('highest')
+
+
+# -- model-level measurements ------------------------------------------------
+
+def measure_i3d(ckpt_dir):
+    import torch
+
+    from models.i3d.i3d_src.i3d_net import I3D
+    from video_features_tpu.models import i3d as i3d_model
+    from video_features_tpu.transplant.torch2jax import (
+        strip_dataparallel, transplant,
+    )
+    rows = []
+    for modality, ch, ckpts in [
+            ('rgb', 3, ('i3d_rgb.pt',)), ('flow', 2, ('i3d_flow.pt',))]:
+        torch.manual_seed(0)
+        net = I3D(num_classes=400, modality=modality).eval()
+        sd = _load_sd(ckpt_dir, *ckpts)
+        real = sd is not None
+        if real:
+            net.load_state_dict(strip_dataparallel(sd))
+        params = transplant(net.state_dict())
+        x = (np.random.RandomState(0).rand(1, 16, 224, 224, ch)
+             .astype(np.float32) * 2 - 1)
+        with torch.no_grad():
+            ref = net(torch.from_numpy(x).permute(0, 4, 1, 2, 3),
+                      features=True).numpy()
+        with _highest():
+            ours = np.asarray(i3d_model.forward(params, x, features=True))
+        rows.append((f'i3d {modality} tower', _rel(ours, ref), real))
+    return rows
+
+
+def measure_raft(ckpt_dir):
+    import torch
+
+    from models.raft.raft_src.raft import RAFT
+    from video_features_tpu.models import raft as raft_model
+    from video_features_tpu.transplant.torch2jax import (
+        strip_dataparallel, transplant,
+    )
+    torch.manual_seed(0)
+    net = RAFT().eval()
+    sd = _load_sd(ckpt_dir, 'raft-sintel.pth')
+    real = sd is not None
+    if real:
+        net.load_state_dict(strip_dataparallel(sd))
+    params = transplant(net.state_dict())
+    rng = np.random.RandomState(0)
+    f1 = (rng.rand(1, 128, 160, 3) * 255).astype(np.float32)
+    f2 = np.clip(f1 + rng.rand(1, 128, 160, 3) * 20, 0, 255).astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.from_numpy(f1).permute(0, 3, 1, 2),
+                  torch.from_numpy(f2).permute(0, 3, 1, 2)
+                  ).permute(0, 2, 3, 1).numpy()
+    with _highest():
+        ours = np.asarray(raft_model.forward(params, f1, f2))
+    return [('raft flow (20 GRU iters)', _rel(ours, ref), real)]
+
+
+def measure_s3d(ckpt_dir):
+    import torch
+
+    from models.s3d.s3d_src.s3d import S3D
+    from video_features_tpu.models import s3d as s3d_model
+    from video_features_tpu.transplant.torch2jax import transplant
+    torch.manual_seed(0)
+    net = S3D(num_class=400).eval()
+    sd = _load_sd(ckpt_dir, 'S3D_kinetics400_torchified.pt')
+    real = sd is not None
+    if real:
+        net.load_state_dict(sd)
+    params = transplant(net.state_dict())
+    x = np.random.RandomState(0).rand(1, 32, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x).permute(0, 4, 1, 2, 3),
+                  features=True).numpy()
+    with _highest():
+        ours = np.asarray(s3d_model.forward(params, x, features=True))
+    return [('s3d features', _rel(ours, ref), real)]
+
+
+def measure_clip(ckpt_dir):
+    import importlib.util
+
+    import torch
+
+    from video_features_tpu.models import clip as clip_model
+    from video_features_tpu.transplant.torch2jax import transplant
+    spec = importlib.util.spec_from_file_location(
+        'ref_clip_model', REFERENCE / 'models/clip/clip_src/model.py')
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    torch.manual_seed(0)
+    net = mod.CLIP(embed_dim=512, image_resolution=224, vision_layers=12,
+                   vision_width=768, vision_patch_size=32, context_length=77,
+                   vocab_size=512, transformer_width=512, transformer_heads=8,
+                   transformer_layers=2).eval().float()
+    params = transplant(net.state_dict(),
+                        no_transpose=set(clip_model.NO_TRANSPOSE))
+    x = np.random.RandomState(0).rand(2, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        ref = net.encode_image(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    with _highest():
+        ours = np.asarray(clip_model.encode_image(params, x, 'ViT-B/32'))
+    return [('clip image tower (ViT-B/32 geometry)', _rel(ours, ref), False)]
+
+
+def measure_vggish(ckpt_dir):
+    from models.vggish.vggish_src import mel_features as ref_mel
+
+    from video_features_tpu.ops import audio as audio_ops
+    rng = np.random.RandomState(0)
+    data = rng.randn(16000 * 2).astype(np.float64) * 0.1
+    ours = audio_ops.log_mel_spectrogram(data, 16000)
+    theirs = ref_mel.log_mel_spectrogram(
+        data, audio_sample_rate=16000, log_offset=0.01,
+        window_length_secs=0.025, hop_length_secs=0.010,
+        num_mel_bins=64, lower_edge_hertz=125.0, upper_edge_hertz=7500.0)
+    return [('vggish log-mel frontend', _rel(ours, theirs), 'n/a')]
+
+
+def measure_mirrors(ckpt_dir):
+    import torch
+
+    from tests.torch_mirrors import (
+        TorchConvNeXt, TorchResNet, TorchVideoResNet, randomize_bn_stats,
+    )
+    from video_features_tpu.models import convnext as convnext_model
+    from video_features_tpu.models import r21d as r21d_model
+    from video_features_tpu.models import resnet as resnet_model
+    from video_features_tpu.transplant.torch2jax import transplant
+    rows = []
+    rng = np.random.RandomState(1)
+
+    torch.manual_seed(0)
+    m = TorchResNet('resnet50').eval()
+    randomize_bn_stats(m)
+    sd = _load_sd(ckpt_dir, 'resnet50-0676ba61.pth')
+    real = sd is not None
+    if real:
+        m.load_state_dict(sd)
+    x = rng.rand(2, 112, 112, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    with _highest():
+        ours = np.asarray(resnet_model.forward(
+            transplant(m.state_dict()), x, arch='resnet50'))
+    rows.append(('resnet50 (torchvision mirror)', _rel(ours, ref), real))
+
+    torch.manual_seed(0)
+    m = TorchVideoResNet('r2plus1d_18').eval()
+    randomize_bn_stats(m)
+    sd = _load_sd(ckpt_dir, 'r2plus1d_18-91a641e6.pth')
+    real = sd is not None
+    if real:
+        m.load_state_dict(sd)
+    x = rng.rand(2, 8, 56, 56, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x).permute(0, 4, 1, 2, 3)).numpy()
+    with _highest():
+        ours = np.asarray(r21d_model.forward(transplant(m.state_dict()), x,
+                                             arch='r2plus1d_18'))
+    rows.append(('r2plus1d_18 (torchvision mirror)', _rel(ours, ref), real))
+
+    torch.manual_seed(0)
+    m = TorchConvNeXt('convnext_tiny').eval()
+    x = rng.rand(2, 96, 96, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    with _highest():
+        ours = np.asarray(convnext_model.forward(
+            transplant(m.state_dict()), x, arch='convnext_tiny'))
+    rows.append(('convnext_tiny (timm mirror)', _rel(ours, ref), False))
+    return rows
+
+
+# -- end-to-end measurements -------------------------------------------------
+
+def _make_clip33(tmp):
+    import cv2
+    src = REFERENCE / 'sample' / 'v_ZNVhz7ctTq0.mp4'
+    out = str(Path(tmp) / 'clip33.mp4')
+    cap = cv2.VideoCapture(str(src))
+    wr = cv2.VideoWriter(out, cv2.VideoWriter_fourcc(*'mp4v'),
+                         cap.get(cv2.CAP_PROP_FPS),
+                         (int(cap.get(3)), int(cap.get(4))))
+    for _ in range(33):
+        ok, f = cap.read()
+        if not ok:
+            break
+        wr.write(f)
+    wr.release()
+    cap.release()
+    return out
+
+
+def measure_e2e_i3d(ckpt_dir):
+    import tempfile
+
+    import torch
+
+    from tests.reference_pipeline import (
+        build_reference_nets, run_reference_i3d, save_state_dicts,
+    )
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    from video_features_tpu.transplant.torch2jax import strip_dataparallel
+    with tempfile.TemporaryDirectory() as tmp:
+        video = _make_clip33(tmp)
+        nets = build_reference_nets(seed=0)
+        real = False
+        for key, names in [('rgb', ('i3d_rgb.pt',)),
+                           ('flow', ('i3d_flow.pt',)),
+                           ('raft', ('raft-sintel.pth',))]:
+            sd = _load_sd(ckpt_dir, *names)
+            if sd is not None:
+                nets[key].load_state_dict(strip_dataparallel(sd))
+                real = True
+        ckpts = save_state_dicts(nets, Path(tmp) / 'ckpts')
+        golden = run_reference_i3d(video, nets, stack_size=16)
+        args = load_config('i3d', overrides={
+            'video_paths': video, 'device': 'cpu', 'precision': 'highest',
+            'decode_backend': 'cv2', 'stack_size': 16, 'step_size': 16,
+            'concat_rgb_flow': True,
+            'i3d_rgb_checkpoint_path': ckpts['rgb'],
+            'i3d_flow_checkpoint_path': ckpts['flow'],
+            'raft_checkpoint_path': ckpts['raft'],
+            'output_path': str(Path(tmp) / 'o'),
+            'tmp_path': str(Path(tmp) / 't')})
+        out = create_extractor(args).extract(video)
+        return [
+            ('E2E i3d rgb stream (file→features)',
+             _rel(out['rgb'], golden['rgb']), real),
+            ('E2E i3d flow stream (file→features)',
+             _rel(out['flow'], golden['flow']), real),
+            ('E2E i3d rgb∥flow concat (T, 2048)',
+             _rel(np.concatenate([out['rgb'], out['flow']], -1),
+                  np.concatenate([golden['rgb'], golden['flow']], -1)),
+             real),
+        ]
+
+
+def measure_e2e_raft(ckpt_dir):
+    import tempfile
+
+    import cv2
+    import torch
+
+    from models.raft.raft_src.raft import InputPadder
+    from tests.reference_pipeline import build_reference_nets, save_state_dicts
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    from video_features_tpu.transplant.torch2jax import strip_dataparallel
+    with tempfile.TemporaryDirectory() as tmp:
+        video = _make_clip33(tmp)
+        nets = build_reference_nets(seed=0, streams=('flow',))
+        sd = _load_sd(ckpt_dir, 'raft-sintel.pth')
+        real = sd is not None
+        if real:
+            nets['raft'].load_state_dict(strip_dataparallel(sd))
+        ckpts = save_state_dicts({'raft': nets['raft']}, Path(tmp) / 'ckpts')
+        cap = cv2.VideoCapture(video)
+        frames = []
+        while True:
+            ok, bgr = cap.read()
+            if not ok:
+                break
+            frames.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
+        cap.release()
+        batch = torch.from_numpy(np.stack(frames)).permute(0, 3, 1, 2).float()
+        padder = InputPadder(batch.shape)
+        with torch.no_grad():
+            p = padder.pad(batch)
+            ref = torch.cat([padder.unpad(nets['raft'](p[i:i + 1],
+                                                       p[i + 1:i + 2]))
+                             for i in range(len(frames) - 1)]).numpy()
+        args = load_config('raft', overrides={
+            'video_paths': video, 'device': 'cpu', 'precision': 'highest',
+            'decode_backend': 'cv2', 'batch_size': 16,
+            'checkpoint_path': ckpts['raft'],
+            'output_path': str(Path(tmp) / 'o'),
+            'tmp_path': str(Path(tmp) / 't')})
+        ours = create_extractor(args).extract(video)['raft']
+        return [('E2E raft flow field (file→flows)', _rel(ours, ref), real)]
+
+
+MEASURES = {
+    'i3d': measure_i3d,
+    'raft': measure_raft,
+    's3d': measure_s3d,
+    'clip': measure_clip,
+    'vggish': measure_vggish,
+    'mirrors': measure_mirrors,
+    'e2e_i3d': measure_e2e_i3d,
+    'e2e_raft': measure_e2e_raft,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--out', default=None, help='write PARITY.md here')
+    ap.add_argument('--only', nargs='*', default=None,
+                    help=f'subset of: {", ".join(MEASURES)}')
+    ap.add_argument('--checkpoints', default=None,
+                    help='dir of real checkpoints (fetch_checkpoints.py)')
+    ap.add_argument('--json', action='store_true')
+    ns = ap.parse_args()
+
+    if str(REFERENCE) not in sys.path:
+        # APPEND, never prepend: the reference's `tests` is a regular
+        # package and would shadow our tests.* helper modules if it came
+        # before REPO on sys.path (repo tests/__init__.py documents this)
+        sys.path.append(str(REFERENCE))
+
+    rows = []
+    for name in (ns.only or MEASURES):
+        t0 = time.time()
+        try:
+            new = list(MEASURES[name](ns.checkpoints))
+        except Exception as e:
+            new = [(f'{name} [FAILED: {type(e).__name__}: {e}]',
+                    float('nan'), False)]
+        print(f'# {name}: {time.time() - t0:.0f}s', file=sys.stderr)
+        rows.extend(new)
+        if ns.json:
+            for r, rel, real in new:
+                print(json.dumps({
+                    'measure': r,
+                    'rel_l2': rel if rel == rel else None,  # NaN → null
+                    'real_weights': real}))
+
+    lines = []
+    for r, rel, real in rows:
+        mark = '✅' if rel == rel and rel < BAR else '⚠️'
+        w = ('weight-free (DSP)' if real == 'n/a'
+             else 'real' if real else 'seeded-random')
+        lines.append(f'| {r} | {rel:.2e} | {w} | {mark} |')
+        if not ns.json:
+            print(lines[-1])
+    if ns.out:
+        header = Path(REPO / 'tools' / 'parity_header.md')
+        text = (header.read_text() if header.exists() else
+                '# PARITY — measured numerics vs the reference\n\n')
+        text += ('| measurement | rel L2 | weights | ≤1e-3 |\n'
+                 '|---|---|---|---|\n' + '\n'.join(lines) + '\n')
+        Path(ns.out).write_text(text)
+        print(f'wrote {ns.out}', file=sys.stderr)
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -67,6 +67,15 @@ def transfer_batches(items: Iterable[tuple], put, keep_host: bool = False,
     ``tracer`` attributes the producer-thread transfer time to an ``h2d``
     stage (it runs outside the extract loop, so without this it would be
     invisible in the profile table).
+
+    Backend caveat (measured on the axon remote-TPU tunnel): some remote
+    backends DEFER the physical copy of an async ``device_put`` until a
+    computation consumes the buffer, and transfer + compute share one
+    connection — host-side prefetch then reorders but cannot hide the
+    copy, and forcing eager materialization (dispatching a reduction over
+    the buffer from the producer thread) only adds a round trip. On real
+    TPU hosts ``device_put`` copies eagerly over PCIe and this prefetch
+    genuinely overlaps.
     """
     from video_features_tpu.io.video import prefetch
 

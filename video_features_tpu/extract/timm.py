@@ -22,6 +22,7 @@ import jax
 import numpy as np
 
 from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
+from video_features_tpu.models import convnext as convnext_model
 from video_features_tpu.models import resnet as resnet_model
 from video_features_tpu.models import vit as vit_model
 from video_features_tpu.ops.transforms import (
@@ -34,8 +35,17 @@ def _data_cfg(family: str) -> Dict[str, Any]:
     """timm resolve_data_config equivalents for the native families:
     resize = floor(input_size / crop_pct), family-default interpolation."""
     if family == 'vit':
+        # timm vit: crop_pct 0.9, bicubic, 0.5 "inception" stats
         return dict(resize=248, crop=224, interpolation='bicubic',
                     mean=vit_model.MEAN, std=vit_model.STD)
+    if family == 'deit':
+        # timm deit _cfg: crop_pct 0.9, bicubic, ImageNet stats
+        return dict(resize=248, crop=224, interpolation='bicubic',
+                    mean=convnext_model.MEAN, std=convnext_model.STD)
+    if family == 'convnext':
+        # timm convnext default_cfg: crop_pct 0.875, bicubic, ImageNet stats
+        return dict(resize=256, crop=224, interpolation='bicubic',
+                    mean=convnext_model.MEAN, std=convnext_model.STD)
     return dict(resize=256, crop=224, interpolation='bilinear',
                 mean=resnet_model.MEAN, std=resnet_model.STD)
 
@@ -44,12 +54,29 @@ def _registry() -> Dict[str, Dict[str, Any]]:
     reg = {}
     for name, cfg in vit_model.ARCHS.items():
         reg[name] = dict(family='vit', arch=name, feat_dim=cfg['width'])
+    # non-distilled DeiT IS timm's VisionTransformer (same module tree and
+    # state_dict; only the data config differs) — alias onto the vit archs
+    for deit, vit_arch in [
+        ('deit_tiny_patch16_224', 'vit_tiny_patch16_224'),
+        ('deit_small_patch16_224', 'vit_small_patch16_224'),
+        ('deit_base_patch16_224', 'vit_base_patch16_224'),
+    ]:
+        reg[deit] = dict(family='deit', arch=vit_arch,
+                         feat_dim=vit_model.ARCHS[vit_arch]['width'])
     for name, cfg in resnet_model.ARCHS.items():
         reg[name] = dict(family='resnet', arch=name, feat_dim=cfg['feat_dim'])
+    for name, cfg in convnext_model.ARCHS.items():
+        reg[name] = dict(family='convnext', arch=name,
+                         feat_dim=cfg['dims'][-1])
     return reg
 
 
 REGISTRY = _registry()
+
+# family → native model module (deit shares the vit graph; only the data
+# config differs — see _data_cfg)
+_MODEL_MODULES = {'vit': vit_model, 'deit': vit_model,
+                  'resnet': resnet_model, 'convnext': convnext_model}
 
 
 class ExtractTIMM(BaseFrameWiseExtractor):
@@ -69,6 +96,10 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         self.family, self.arch = spec['family'], spec['arch']
         super().__init__(args, feat_dim=spec['feat_dim'])
         self.data_cfg = _data_cfg(self.family)
+        self._device = jax_device(self.device)
+        # _load_params may refine data_cfg from pip-timm's resolved config,
+        # so the image_size override must come AFTER it
+        self.params = jax.device_put(self._load_params(args), self._device)
         # image_size overrides the checkpoint's native resolution: the crop
         # becomes image_size and the resize scales to keep the family's
         # crop_pct. For ViT this resamples the pos embed to the larger patch
@@ -78,7 +109,7 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         image_size = args.get('image_size')
         if image_size:
             image_size = int(image_size)
-            if self.family == 'vit':
+            if self.family in ('vit', 'deit'):
                 patch = vit_model.ARCHS[self.arch]['patch']
                 if image_size % patch:
                     raise ValueError(
@@ -88,8 +119,6 @@ class ExtractTIMM(BaseFrameWiseExtractor):
             self.data_cfg['resize'] = int(round(
                 self.data_cfg['resize'] * factor))
             self.data_cfg['crop'] = image_size
-        self._device = jax_device(self.device)
-        self.params = jax.device_put(self._load_params(args), self._device)
         self._step = jax.jit(partial(
             self._forward, family=self.family, arch=self.arch,
             mean=self.data_cfg['mean'], std=self.data_cfg['std']))
@@ -127,16 +156,15 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         from video_features_tpu.extract.weights import require_checkpoint
         require_checkpoint(args, 'checkpoint_path', feature_type='timm',
                            what=f'timm ({self.model_name})')
-        init = (vit_model if self.family == 'vit' else resnet_model)
+        init = _MODEL_MODULES[self.family]
         return transplant(init.init_state_dict(arch=self.arch))
 
     @staticmethod
     def _forward(params, batch, family, arch, mean, std):
         x = to_float_zero_one(batch)
         x = normalize(x, mean, std)
-        if family == 'vit':
-            return vit_model.forward(params, x, arch=arch, features=True)
-        return resnet_model.forward(params, x, arch=arch, features=True)
+        return _MODEL_MODULES[family].forward(params, x, arch=arch,
+                                              features=True)
 
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
         frame = resize_pil(frame, self.data_cfg['resize'],
@@ -147,8 +175,12 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         return self._step(self.params, batch)
 
     def maybe_show_pred(self, feats: np.ndarray) -> None:
-        head = self.params.get('head') if self.family == 'vit' else \
-            self.params.get('fc')
+        if self.family in ('vit', 'deit'):
+            head = self.params.get('head')
+        elif self.family == 'convnext':
+            head = (self.params.get('head') or {}).get('fc')
+        else:
+            head = self.params.get('fc')
         if not head:
             return
         import jax.numpy as jnp

@@ -1,0 +1,124 @@
+"""ConvNeXt image backbones (timm `convnext_*` state_dict layout).
+
+Widens the torch-free native registry behind the timm extractor (the
+reference accepts any pip-timm model, reference models/timm/
+extract_timm.py:48; without pip-timm we cover the workhorse families
+natively). Params mirror timm's ``ConvNeXt`` naming exactly —
+``stem.{0,1}``, ``stages.S.blocks.B.{conv_dw,norm,mlp.fc1,mlp.fc2,gamma}``,
+``stages.S.downsample.{0,1}``, ``head.{norm,fc}`` — so real timm
+checkpoints transplant mechanically.
+
+Layout NHWC; LayerNorms normalize the trailing channel axis directly (timm
+inserts NCHW permutes around nn.LayerNorm — a layout dance that does not
+exist in channels-last). Inference path only: stochastic depth is identity
+and layer-scale ``gamma`` multiplies the block branch.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from video_features_tpu.ops.nn import conv, linear
+
+Params = Dict[str, Any]
+
+# timm default_cfg: 224px eval at crop_pct 0.875, bicubic, ImageNet stats
+MEAN = (0.485, 0.456, 0.406)
+STD = (0.229, 0.224, 0.225)
+
+ARCHS = {
+    'convnext_tiny': dict(depths=(3, 3, 9, 3), dims=(96, 192, 384, 768)),
+    'convnext_small': dict(depths=(3, 3, 27, 3), dims=(96, 192, 384, 768)),
+    'convnext_base': dict(depths=(3, 3, 27, 3), dims=(128, 256, 512, 1024)),
+    'convnext_large': dict(depths=(3, 3, 27, 3), dims=(192, 384, 768, 1536)),
+}
+
+
+def layer_norm(x: jax.Array, p: Params, eps: float = 1e-6) -> jax.Array:
+    mean = x.mean(axis=-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mean) / jax.numpy.sqrt(var + eps) * p['weight'] + p['bias']
+
+
+def _block(p: Params, x: jax.Array) -> jax.Array:
+    """dw7x7 → LN → fc1 → GELU → fc2 → layer-scale, residual."""
+    c = x.shape[-1]
+    h = conv(x, p['conv_dw']['weight'], padding=3, groups=c,
+             bias=p['conv_dw']['bias'])
+    h = layer_norm(h, p['norm'])
+    h = linear(h, p['mlp']['fc1'])
+    h = jax.nn.gelu(h, approximate=False)   # timm nn.GELU = exact erf
+    h = linear(h, p['mlp']['fc2'])
+    if 'gamma' in p:
+        h = h * p['gamma']
+    return x + h
+
+
+def forward(params: Params, x: jax.Array, arch: str = 'convnext_tiny',
+            features: bool = True) -> jax.Array:
+    """(B, H, W, 3) normalized image → (B, dims[-1]) pooled features.
+
+    ``features=False`` additionally applies the classifier: global avg pool
+    → head.norm (LN) → head.fc, timm's ``head(x)`` with default pooling.
+    """
+    cfg = ARCHS[arch]
+    x = conv(x, params['stem']['0']['weight'], stride=4,
+             bias=params['stem']['0']['bias'])
+    x = layer_norm(x, params['stem']['1'])
+    for s, depth in enumerate(cfg['depths']):
+        stage = params['stages'][str(s)]
+        if 'downsample' in stage:
+            x = layer_norm(x, stage['downsample']['0'])
+            x = conv(x, stage['downsample']['1']['weight'], stride=2,
+                     bias=stage['downsample']['1']['bias'])
+        for b in range(depth):
+            x = _block(stage['blocks'][str(b)], x)
+    x = x.mean(axis=(1, 2))                       # global average pool
+    x = layer_norm(x, params['head']['norm'])
+    if features:
+        return x
+    return linear(x, params['head']['fc'])
+
+
+def init_state_dict(seed: int = 0, arch: str = 'convnext_tiny',
+                    num_classes: int = 1000) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict (keys/shapes exactly as timm saves)."""
+    cfg = ARCHS[arch]
+    rng = np.random.RandomState(seed)
+
+    def f32(*shape, scale=0.02):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    def ln(name, c):
+        sd[f'{name}.weight'] = np.ones(c, np.float32)
+        sd[f'{name}.bias'] = np.zeros(c, np.float32)
+
+    dims = cfg['dims']
+    sd: Dict[str, np.ndarray] = {
+        'stem.0.weight': f32(dims[0], 3, 4, 4),
+        'stem.0.bias': np.zeros(dims[0], np.float32),
+    }
+    ln('stem.1', dims[0])
+    for s, depth in enumerate(cfg['depths']):
+        if s > 0:
+            ln(f'stages.{s}.downsample.0', dims[s - 1])
+            sd[f'stages.{s}.downsample.1.weight'] = f32(dims[s], dims[s - 1],
+                                                        2, 2)
+            sd[f'stages.{s}.downsample.1.bias'] = np.zeros(dims[s],
+                                                           np.float32)
+        for b in range(depth):
+            base = f'stages.{s}.blocks.{b}'
+            sd[f'{base}.conv_dw.weight'] = f32(dims[s], 1, 7, 7)
+            sd[f'{base}.conv_dw.bias'] = np.zeros(dims[s], np.float32)
+            ln(f'{base}.norm', dims[s])
+            sd[f'{base}.mlp.fc1.weight'] = f32(4 * dims[s], dims[s])
+            sd[f'{base}.mlp.fc1.bias'] = np.zeros(4 * dims[s], np.float32)
+            sd[f'{base}.mlp.fc2.weight'] = f32(dims[s], 4 * dims[s])
+            sd[f'{base}.mlp.fc2.bias'] = np.zeros(dims[s], np.float32)
+            sd[f'{base}.gamma'] = np.full(dims[s], 1e-6, np.float32)
+    ln('head.norm', dims[-1])
+    sd['head.fc.weight'] = f32(num_classes, dims[-1])
+    sd['head.fc.bias'] = np.zeros(num_classes, np.float32)
+    return sd

@@ -472,25 +472,47 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
     head_split = fh['conv1']['weight'].shape[-1]
     gru = fuse_gru_params(up['gru'])
 
-    def step(carry, _):
-        net, coords1, _ = carry
-        with pin_scope(pins, 'corr'):
-            corr = lookup(coords1)
-        flow = coords1 - coords0
-        with pin_scope(pins, 'iter'):
-            motion = motion_encoder(up['encoder'], flow, corr)
-            net_new = sep_conv_gru(gru, net,
-                                   jnp.concatenate([inp, motion], -1))
-            t = relu(conv(net_new, head_w, padding=1, bias=head_b))
-            t_flow, t_mask = jnp.split(t, [head_split], axis=-1)
-            delta = _conv_b(fh['conv2'], t_flow, padding=1)
-            coords1_new = coords1 + delta
-            mask = 0.25 * _conv_b(mk['2'], t_mask)
-        return (net_new, coords1_new, mask), None
+    def make_step(early_prec=None):
+        """Scan body; ``early_prec`` overrides the WHOLE body's matmul
+        precision (the 'iter_early' pin — see below)."""
+        def step(carry, _):
+            from contextlib import nullcontext
+            outer = (jax.default_matmul_precision(early_prec)
+                     if early_prec else nullcontext())
+            with outer:
+                net, coords1, _ = carry
+                with pin_scope(pins, 'corr'):
+                    corr = lookup(coords1)
+                flow = coords1 - coords0
+                with pin_scope(pins, 'iter'):
+                    motion = motion_encoder(up['encoder'], flow, corr)
+                    net_new = sep_conv_gru(gru, net,
+                                           jnp.concatenate([inp, motion], -1))
+                    t = relu(conv(net_new, head_w, padding=1, bias=head_b))
+                    t_flow, t_mask = jnp.split(t, [head_split], axis=-1)
+                    delta = _conv_b(fh['conv2'], t_flow, padding=1)
+                    coords1_new = coords1 + delta
+                    mask = 0.25 * _conv_b(mk['2'], t_mask)
+            return (net_new, coords1_new, mask), None
+        return step
+
+    # 'iter_early' pin ('<precision>:<n>') runs the FIRST n refinement
+    # iterations at a faster precision: RAFT is iterative refinement, so
+    # early-iteration error is substantially corrected by the remaining
+    # full-precision iterations (measured by tools/precision_study.py).
+    early_prec, early_n = None, 0
+    for name, val in (pins or ()):
+        if name == 'iter_early':
+            early_prec, _, n = str(val).partition(':')
+            early_n = min(int(n or 0), iters)
 
     mask0 = jnp.zeros((B, H8, W8, 576), net.dtype) + jnp.zeros_like(net[..., :1])
-    (net, coords1, mask), _ = lax.scan(step, (net, coords0, mask0), None,
-                                       length=iters)
+    carry = (net, coords0, mask0)
+    if early_n:
+        carry, _ = lax.scan(make_step(early_prec), carry, None,
+                            length=early_n)
+    (net, coords1, mask), _ = lax.scan(make_step(), carry, None,
+                                       length=iters - early_n)
     with pin_scope(pins, 'upsample'):
         return upsample_flow(coords1 - coords0, mask)
 
