@@ -124,13 +124,17 @@ def bench_e2e(precision: str, batch: int, stack: int, tmp_dir: str,
     warm = ex.extract(video)                   # compile + cache warm
     clips = warm['rgb'].shape[0]
     assert clips > 0 and np.isfinite(warm['rgb']).all()
+    # median of independent runs: remote tunnels hiccup (a single stalled
+    # transfer can triple one run's wall time), and the median is the
+    # honest steady-state a user sees
     runs = int(os.environ.get('BENCH_E2E_RUNS', 3))
-    t0 = time.perf_counter()
+    rates = []
     for _ in range(runs):
+        t0 = time.perf_counter()
         out = ex.extract(video)
-    elapsed = time.perf_counter() - t0
-    assert out['rgb'].shape[0] == clips
-    return clips * runs / elapsed
+        rates.append(clips / (time.perf_counter() - t0))
+        assert out['rgb'].shape[0] == clips
+    return float(np.median(rates))
 
 
 def main() -> None:

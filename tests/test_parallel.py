@@ -350,3 +350,63 @@ def test_raft_halo_shard_dp_matches_single_device():
                               put_batch(mesh, halo)))
     assert out.shape == ref.shape == (n * k, 64, 64, 2)
     np.testing.assert_allclose(out, ref, atol=5e-4, rtol=1e-4)
+
+
+def test_vit_sequence_parallel_matches_single_device():
+    """Ring-attention sequence parallelism over the token axis: the
+    production consumer path for very long token sequences. 197 ragged
+    tokens pad to 200 over an 8-device time axis (masked keys rotate with
+    their shards) and must match the unsharded forward."""
+    from video_features_tpu.models import vit as vit_model
+    from video_features_tpu.transplant.torch2jax import transplant
+
+    params = transplant(vit_model.init_state_dict(arch='vit_tiny_patch16_224'))
+    x = np.random.RandomState(0).rand(2, 224, 224, 3).astype(np.float32)
+    mesh = make_mesh(time_parallel=8)
+    assert mesh.shape['time'] == 8
+
+    with jax.default_matmul_precision('highest'):
+        ref = np.asarray(vit_model.forward(params, x,
+                                           arch='vit_tiny_patch16_224'))
+        got = np.asarray(jax.jit(
+            lambda p, t: vit_model.forward_sequence_parallel(
+                p, t, mesh, arch='vit_tiny_patch16_224'))(params, x))
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 1e-5, f'rel L2 {rel}'
+
+
+def test_timm_sequence_parallel_extractor_e2e(short_video, tmp_path):
+    """sequence_parallel=true through the real extractor: tokens shard over
+    all 8 virtual devices, features match the single-device extractor."""
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+
+    common = {
+        'video_paths': short_video, 'device': 'cpu', 'batch_size': 8,
+        'model_name': 'vit_tiny_patch16_224', 'allow_random_weights': True,
+        'extraction_fps': 2,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    }
+    sp = create_extractor(load_config('timm', overrides={
+        **common, 'sequence_parallel': True}))
+    assert sp._mesh is not None and sp._mesh.shape['time'] == 8
+    single = create_extractor(load_config('timm', overrides=common))
+
+    feats_sp = sp.extract(short_video)
+    feats_single = single.extract(short_video)
+    np.testing.assert_allclose(feats_sp['timm'], feats_single['timm'],
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_timm_sequence_parallel_rejects_conv_families(tmp_path):
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+
+    args = load_config('timm', overrides={
+        'video_paths': 'v.mp4', 'device': 'cpu',
+        'model_name': 'resnet18', 'sequence_parallel': True,
+        'allow_random_weights': True,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    with pytest.raises(NotImplementedError, match='token axis'):
+        create_extractor(args)

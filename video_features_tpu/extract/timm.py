@@ -119,6 +119,45 @@ class ExtractTIMM(BaseFrameWiseExtractor):
             self.data_cfg['resize'] = int(round(
                 self.data_cfg['resize'] * factor))
             self.data_cfg['crop'] = image_size
+        # sequence_parallel=true (ViT/DeiT only): the TOKEN axis of every
+        # frame shards over ALL local devices and attention runs as a KV
+        # ring over ICI (ops/attention.ring_attention) — the multi-chip
+        # long-token path for resolutions whose token count exceeds one
+        # chip (pairs with image_size; single-chip long-token inputs use
+        # blockwise attention automatically).
+        self.sequence_parallel = args.get('sequence_parallel', False)
+        if self.sequence_parallel:
+            if self.family not in ('vit', 'deit'):
+                raise NotImplementedError(
+                    'sequence_parallel is implemented for the ViT/DeiT '
+                    f'families (attention over tokens); {self.family} has '
+                    'no token axis to shard')
+            if self.data_parallel:
+                raise NotImplementedError(
+                    'sequence_parallel claims every local device for the '
+                    'token axis; combine with data parallelism across '
+                    'hosts (multihost=true), not data_parallel=true')
+            from video_features_tpu.parallel import (
+                make_mesh, put_batch, put_replicated,
+            )
+            from video_features_tpu.utils.device import jax_devices_all
+            devices = jax_devices_all(self.device)
+            self._mesh = make_mesh(devices=devices,
+                                   time_parallel=len(devices))
+            # data axis is 1: put_input replicates each frame batch
+            self._put_batch = partial(put_batch, self._mesh)
+            mesh, arch = self._mesh, self.arch
+            mean, std = self.data_cfg['mean'], self.data_cfg['std']
+
+            def _sp_forward(params, batch):
+                x = to_float_zero_one(batch)
+                x = normalize(x, mean, std)
+                return vit_model.forward_sequence_parallel(
+                    params, x, mesh, arch=arch)
+
+            self.params = put_replicated(mesh, self.params)
+            self._step = jax.jit(_sp_forward)
+            return
         self._step = jax.jit(partial(
             self._forward, family=self.family, arch=self.arch,
             mean=self.data_cfg['mean'], std=self.data_cfg['std']))

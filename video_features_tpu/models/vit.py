@@ -53,8 +53,14 @@ BLOCKWISE_THRESHOLD = 2048
 _BLOCK = 512
 
 
-def _attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
-    """timm `Attention`: fused qkv linear, per-head scaled dot product."""
+def _attention(p: Params, x: jax.Array, num_heads: int,
+               attn_impl=None) -> jax.Array:
+    """timm `Attention`: fused qkv linear, per-head scaled dot product.
+
+    ``attn_impl`` overrides the core attention op (``(q, k, v) → out`` on
+    (B, N, H, hd) tensors) — the sequence-parallel path injects a ring
+    kernel here; default picks dense or blockwise by token count.
+    """
     from video_features_tpu.ops.attention import (
         blockwise_attention, dense_attention,
     )
@@ -63,7 +69,9 @@ def _attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
     qkv = x @ p['qkv']['weight'] + p['qkv']['bias']          # (B, N, 3D)
     qkv = qkv.reshape(B, N, 3, num_heads, head_dim)
     q, k, v = jnp.moveaxis(qkv, 2, 0)                        # (B, N, H, hd)
-    if N >= BLOCKWISE_THRESHOLD:
+    if attn_impl is not None:
+        out = attn_impl(q, k, v)
+    elif N >= BLOCKWISE_THRESHOLD:
         out = blockwise_attention(q, k, v, block_size=_BLOCK)
     else:
         out = dense_attention(q, k, v)
@@ -71,9 +79,11 @@ def _attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
     return out @ p['proj']['weight'] + p['proj']['bias']
 
 
-def _block(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
+def _block(p: Params, x: jax.Array, num_heads: int,
+           attn_impl=None) -> jax.Array:
     """Pre-norm transformer block with exact-erf GELU (torch nn.GELU)."""
-    x = x + _attention(p['attn'], layer_norm(x, p['norm1']), num_heads)
+    x = x + _attention(p['attn'], layer_norm(x, p['norm1']), num_heads,
+                       attn_impl)
     h = layer_norm(x, p['norm2'])
     h = h @ p['mlp']['fc1']['weight'] + p['mlp']['fc1']['bias']
     h = jax.nn.gelu(h, approximate=False)
@@ -103,6 +113,41 @@ def interpolate_pos_embed(pos_embed: jax.Array,
         [cls_pos, grid_pos.reshape(1, grid[0] * grid[1], d)], axis=1)
 
 
+def embed(params: Params, x: jax.Array,
+          arch: str = 'vit_base_patch16_224') -> jax.Array:
+    """(B, H, W, 3) → (B, 1+grid², width) embedded tokens (patch conv +
+    cls + resampled pos embed)."""
+    cfg = ARCHS[arch]
+    width, patch = cfg['width'], cfg['patch']
+    B = x.shape[0]
+    # patch embed: conv stride=patch, then row-major flatten (timm flattens
+    # NCHW as (B, D, H', W') → (B, H'·W', D); NHWC flatten matches directly)
+    k = params['patch_embed']['proj']
+    x = jax.lax.conv_general_dilated(
+        x, k['weight'], window_strides=(patch, patch), padding='VALID',
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC')) + k['bias']
+    grid = (x.shape[1], x.shape[2])
+    x = x.reshape(B, -1, width)
+    cls = jnp.broadcast_to(params['cls_token'], (B, 1, width))
+    return jnp.concatenate([cls, x], axis=1) + interpolate_pos_embed(
+        params['pos_embed'], grid)
+
+
+def trunk(params: Params, tokens: jax.Array, arch: str,
+          attn_impl=None) -> jax.Array:
+    """All transformer blocks over (B, N, width) tokens (no final norm).
+
+    Every op except attention is token-local, so under ``shard_map`` with
+    the token axis sharded this runs unmodified — only ``attn_impl`` needs
+    to be a sequence-parallel kernel (see forward_sequence_parallel).
+    """
+    cfg = ARCHS[arch]
+    for i in range(cfg['layers']):
+        tokens = _block(params['blocks'][str(i)], tokens, cfg['heads'],
+                        attn_impl)
+    return tokens
+
+
 def forward(params: Params, x: jax.Array, arch: str = 'vit_base_patch16_224',
             features: bool = True) -> jax.Array:
     """(B, H, W, 3) float in model space → (B, width) cls-token features.
@@ -114,23 +159,54 @@ def forward(params: Params, x: jax.Array, arch: str = 'vit_base_patch16_224',
     and past BLOCKWISE_THRESHOLD tokens attention switches to the
     O(N·block) blockwise path.
     """
-    cfg = ARCHS[arch]
-    width, num_heads, patch = cfg['width'], cfg['heads'], cfg['patch']
-    B = x.shape[0]
-    # patch embed: conv stride=patch, then row-major flatten (timm flattens
-    # NCHW as (B, D, H', W') → (B, H'·W', D); NHWC flatten matches directly)
-    k = params['patch_embed']['proj']
-    x = jax.lax.conv_general_dilated(
-        x, k['weight'], window_strides=(patch, patch), padding='VALID',
-        dimension_numbers=('NHWC', 'HWIO', 'NHWC')) + k['bias']
-    grid = (x.shape[1], x.shape[2])
-    x = x.reshape(B, -1, width)
-    cls = jnp.broadcast_to(params['cls_token'], (B, 1, width))
-    x = jnp.concatenate([cls, x], axis=1) + interpolate_pos_embed(
-        params['pos_embed'], grid)
-    for i in range(cfg['layers']):
-        x = _block(params['blocks'][str(i)], x, num_heads)
+    x = trunk(params, embed(params, x, arch), arch)
     x = layer_norm(x, params['norm'])
+    feats = x[:, 0]
+    if features:
+        return feats
+    return feats @ params['head']['weight'] + params['head']['bias']
+
+
+def forward_sequence_parallel(params: Params, x: jax.Array, mesh,
+                              arch: str = 'vit_base_patch16_224',
+                              axis: str = 'time',
+                              features: bool = True) -> jax.Array:
+    """ViT forward with the TOKEN axis sharded over a mesh axis.
+
+    The sequence-parallel production path for inputs whose token count
+    exceeds one chip's memory (very high resolution / long token videos):
+    tokens are zero-padded to a multiple of the axis size with a validity
+    mask, every token-local op (LN, MLP, patch projection output) runs
+    unchanged inside ``shard_map``, and attention is
+    :func:`ops.attention.ring_attention` — KV shards rotate over ICI
+    neighbor hops while each device accumulates its queries' online
+    softmax; padded keys are masked out of every softmax and the mask
+    rotates with its shard.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from video_features_tpu.ops.attention import ring_attention
+
+    tokens = embed(params, x, arch)
+    B, N, width = tokens.shape
+    n = mesh.shape[axis]
+    pad = (-N) % n
+    if pad:
+        tokens = jnp.pad(tokens, [(0, 0), (0, pad), (0, 0)])
+    valid = jnp.arange(N + pad) < N
+
+    def shard_fn(p, tok, val):
+        def attn(q, k, v):
+            return ring_attention(q, k, v, axis_name=axis, kv_valid=val)
+        return trunk(p, tok, arch, attn_impl=attn)
+
+    out = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(None, axis, None), P(axis)),
+        out_specs=P(None, axis, None),
+    )(params, tokens, valid)
+    x = layer_norm(out[:, :N], params['norm'])
     feats = x[:, 0]
     if features:
         return feats

@@ -52,8 +52,13 @@ def _online_block(q, m, l, o, kb, vb, scale, valid=None):
     if valid is not None:
         s = jnp.where(valid, s, -jnp.inf)
     m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m - m_new)
+    # m_new stays -inf until the first unmasked key (a fully-padded shard
+    # can be processed first under ring sharding); exponentiate against a
+    # finite stand-in so exp(-inf - -inf) never makes a NaN — p and alpha
+    # are then exactly 0 and the carry passes through unchanged.
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe)
+    alpha = jnp.exp(m - m_safe)
     l_new = l * alpha + p.sum(axis=-1, keepdims=True)
     o_new = o * alpha + jnp.einsum('bqhk,bkhd->bqhd', p,
                                    vb.astype(jnp.float32))
@@ -107,7 +112,8 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   kv_valid: Optional[jax.Array] = None) -> jax.Array:
     """Sequence-parallel attention over a mesh axis (call under shard_map).
 
     Each device holds one (B, S/n, H, D) shard of q, k, v. KV shards rotate
@@ -115,27 +121,43 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     after n steps every query has attended every key. Online softmax makes
     the accumulation order-invariant, so results match dense attention on
     the unsharded sequence to fp tolerance.
+
+    ``kv_valid`` (S/n,) bool masks this device's PADDED key positions out
+    of every query's softmax (it rotates around the ring with its KV
+    shard) — how ragged token counts (e.g. a ViT's grid²+1) shard over a
+    mesh axis that does not divide them. Rows of fully-masked q padding
+    produce garbage (denominator from real keys only) — slice them off
+    after gathering.
     """
     n = lax.psum(1, axis_name)
     sc = _scale(q, scale)
     perm = [(j, (j + 1) % n) for j in range(n)]
+    synthesized_mask = kv_valid is None
+    if synthesized_mask:
+        kv_valid = jnp.ones(k.shape[1], bool)
 
     def step(i, carry):
-        m, l, o, kb, vb = carry
-        m, l, o = _online_block(q, m, l, o, kb, vb, sc)
+        m, l, o, kb, vb, maskb = carry
+        m, l, o = _online_block(q, m, l, o, kb, vb, sc, valid=maskb)
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        return m, l, o, kb, vb
+        maskb = lax.ppermute(maskb, axis_name, perm)
+        return m, l, o, kb, vb, maskb
 
     # mark the constant-valued init as device-varying so the loop carry
     # type-checks under shard_map's varying-axis typing (pcast is the
     # non-deprecated spelling of pvary from jax 0.9)
     if hasattr(lax, 'pcast'):
-        m, l, o = (lax.pcast(t, axis_name, to='varying')
-                   for t in _online_init(q))
+        def cast(t):
+            return lax.pcast(t, axis_name, to='varying')
     else:
-        m, l, o = (lax.pvary(t, axis_name) for t in _online_init(q))
+        def cast(t):
+            return lax.pvary(t, axis_name)
+    m, l, o = (cast(t) for t in _online_init(q))
+    if synthesized_mask:   # caller-provided masks are already device-varying
+        kv_valid = cast(kv_valid)
     # n-1 rotations interleaved with compute; the final block needs no send.
-    m, l, o, kb, vb = lax.fori_loop(0, n - 1, step, (m, l, o, k, v))
-    m, l, o = _online_block(q, m, l, o, kb, vb, sc)
+    m, l, o, kb, vb, maskb = lax.fori_loop(
+        0, n - 1, step, (m, l, o, k, v, kv_valid))
+    m, l, o = _online_block(q, m, l, o, kb, vb, sc, valid=maskb)
     return (o / l).astype(q.dtype)
