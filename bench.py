@@ -179,22 +179,28 @@ def main() -> None:
     }, device)
 
     rungs = {}
-    rungs[f'ingraph_{precision}'] = round(
+    headline_key = f'ingraph_{precision}'
+    rungs[headline_key] = round(
         bench_ingraph(jax, ambient, pins, device, platform, params,
                       stack, size, batch, iters), 3)
 
     mode = os.environ.get('BENCH_MODE', 'both' if on_accel else 'ingraph')
-    headline_key = f'ingraph_{precision}'
     if mode in ('both', 'e2e'):
         with tempfile.TemporaryDirectory() as tmp_dir:
             try:
                 rungs[f'e2e_{precision}'] = round(
                     bench_e2e(precision, min(batch, 8), stack, tmp_dir,
                               platform), 3)
-                headline_key = f'e2e_{precision}'
-            except Exception as e:  # no video/decoder: in-graph headline
+            except Exception as e:
                 rungs['e2e_error'] = f'{type(e).__name__}: {e}'
+    if mode == 'e2e' and f'e2e_{precision}' in rungs:
+        headline_key = f'e2e_{precision}'
 
+    # Headline = the in-graph rung: on this environment's remote-TPU
+    # tunnel the e2e rung is transfer-bound at any precision (~20-50 MB/s
+    # shared link; see docs/benchmarks.md "End-to-end ... measurement
+    # environment") — it is recorded in `rungs` with that caveat, and
+    # BENCH_MODE=e2e promotes it on hosts where the transfer is real PCIe.
     value = rungs[headline_key]
     print(json.dumps({
         'metric': f'i3d_two_stream_{headline_key}_clips_per_sec_'
