@@ -1,0 +1,35 @@
+"""The driver contract: `python bench.py` prints exactly ONE JSON line.
+
+The driver records this line as BENCH_r{N}.json at the end of every round;
+a malformed line or a second print loses the round's benchmark. Runs the
+real script on CPU at a tiny smoke geometry.
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_bench_prints_one_json_line():
+    env = dict(os.environ, BENCH_PLATFORM='cpu', BENCH_SIZE='48',
+               BENCH_ITERS='1', JAX_PLATFORMS='cpu')
+    out = subprocess.run(
+        [sys.executable, str(REPO / 'bench.py')], env=env, cwd=str(REPO),
+        capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f'expected ONE line, got: {lines}'
+    rec = json.loads(lines[0])
+    assert set(rec) == {'metric', 'value', 'unit', 'vs_baseline', 'rungs'}
+    assert rec['unit'] == 'clips/sec/chip'
+    assert rec['value'] > 0
+    # the metric name must stamp the precision that produced the number
+    assert 'mixed' in rec['metric'] or os.environ.get('BENCH_PRECISION')
+    assert rec['rungs']
