@@ -135,3 +135,71 @@ def run_reference_i3d(video_path: str, nets, stack_size: int = 16,
                         nets[stream](x, features=True).numpy().tolist())
                 rgb_stack = rgb_stack[step_size:]
     return {s: np.asarray(v, dtype=np.float32) for s, v in feats.items()}
+
+
+def run_reference_r21d(video_path: str, net, stack_size: int = 16,
+                       step_size: int = 16) -> np.ndarray:
+    """The reference r21d extraction, verbatim semantics (BASELINE config 1).
+
+    Mirrors reference models/r21d/extract_r21d.py:60-91: whole-video read
+    (cv2 stands in for torchvision.io.read_video — same decoded frames),
+    ToFloatTensorInZeroOne → Resize(128, 171) → Normalize → CenterCrop(112)
+    over the WHOLE video (:102-107), `form_slices` windows (:77), one net
+    forward per stack with the classifier stripped (:122-129). ``net`` must
+    return FEATURES from a plain ``net(x)`` call — the mirror's default
+    (tests/torch_mirrors.py), or real torchvision with
+    ``model.fc = nn.Identity()`` exactly as the reference constructs it.
+    """
+    import cv2
+    import torch
+
+    from models.transforms import (
+        CenterCrop, Normalize, Resize, ToFloatTensorInZeroOne,
+    )
+
+    from video_features_tpu.utils.slicing import form_slices
+
+    cap = cv2.VideoCapture(video_path)
+    frames = []
+    while True:
+        ok, bgr = cap.read()
+        if not ok:
+            break
+        frames.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
+    cap.release()
+
+    rgb = torch.from_numpy(np.stack(frames))                 # (T, H, W, C)
+    rgb = ToFloatTensorInZeroOne()(rgb)                      # (C, T, H, W)
+    rgb = Resize((128, 171))(rgb)
+    rgb = Normalize(mean=[0.43216, 0.394666, 0.37645],
+                    std=[0.22803, 0.22145, 0.216989])(rgb)
+    rgb = CenterCrop((112, 112))(rgb).unsqueeze(0)           # (1, C, T, H, W)
+
+    feats = []
+    with torch.no_grad():
+        for start, end in form_slices(rgb.size(2), stack_size, step_size):
+            out = net(rgb[:, :, start:end])
+            feats.extend(out.numpy().tolist())
+    return np.asarray(feats, dtype=np.float32)
+
+
+def build_reference_r21d_net(seed: int = 0, state_dict=None):
+    """Seeded (or checkpoint-loaded) torchvision-mirror VideoResNet +
+    the .pt path ingredients shared by test_golden_e2e and measure_parity."""
+    import torch
+
+    from tests.torch_mirrors import TorchVideoResNet, randomize_bn_stats
+
+    torch.manual_seed(seed)
+    net = TorchVideoResNet('r2plus1d_18').eval()
+    randomize_bn_stats(net, seed=seed)
+    if state_dict is not None:
+        net.load_state_dict(state_dict)
+    return net
+
+
+R21D_OVERRIDES = {
+    'device': 'cpu', 'precision': 'highest', 'decode_backend': 'cv2',
+    'model_name': 'r2plus1d_18_16_kinetics', 'stack_size': 16,
+    'step_size': 16,
+}

@@ -91,6 +91,35 @@ def test_i3d_two_stream_e2e_golden(golden, video_33, tmp_path):
     assert rels['concat'] < 5 * REL_L2_TARGET, f'concat rel L2: {rels}'
 
 
+def test_r21d_e2e_golden(reference_repo, video_33, tmp_path):
+    """BASELINE config 1 end-to-end: the r21d family's whole-file (T, 512)
+    output vs the reference extraction recipe (whole-video transform chain
+    + form_slices windows + torchvision VideoResNet) on the same frames."""
+    import torch
+
+    from tests.reference_pipeline import (
+        R21D_OVERRIDES, build_reference_r21d_net, run_reference_r21d,
+    )
+
+    net = build_reference_r21d_net(seed=0)
+    ckpt = tmp_path / 'r21d_seeded.pt'
+    torch.save(net.state_dict(), str(ckpt))
+
+    ref = run_reference_r21d(video_33, net, stack_size=16, step_size=16)
+
+    args = load_config('r21d', overrides={
+        **R21D_OVERRIDES, 'video_paths': video_33,
+        'checkpoint_path': str(ckpt),
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ours = create_extractor(args).extract(video_33)['r21d']
+
+    assert ours.shape == ref.shape == (2, 512)
+    rel = _rel_l2(ours, ref)
+    print(f'[golden e2e] r21d rel L2: {rel}')
+    assert rel < REL_L2_TARGET, f'r21d e2e rel L2 {rel}'
+
+
 def test_raft_flow_e2e_golden(reference_repo, video_33, tmp_path):
     """Un-quantized flow end-to-end at the STRICT bar: the raft family's
     whole-file (T-1, 2, H, W) output vs the reference RAFT loop on the

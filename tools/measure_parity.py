@@ -301,6 +301,33 @@ def measure_e2e_i3d(ckpt_dir):
         ]
 
 
+def measure_e2e_r21d(ckpt_dir):
+    import tempfile
+
+    import torch
+
+    from tests.reference_pipeline import (
+        R21D_OVERRIDES, build_reference_r21d_net, run_reference_r21d,
+    )
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    with tempfile.TemporaryDirectory() as tmp:
+        video = _make_clip33(tmp)
+        sd = _load_sd(ckpt_dir, 'r2plus1d_18-91a641e6.pth')
+        real = sd is not None
+        net = build_reference_r21d_net(seed=0, state_dict=sd)
+        ckpt = Path(tmp) / 'r21d.pt'
+        torch.save(net.state_dict(), str(ckpt))
+        ref = run_reference_r21d(video, net, stack_size=16, step_size=16)
+        args = load_config('r21d', overrides={
+            **R21D_OVERRIDES, 'video_paths': video,
+            'checkpoint_path': str(ckpt),
+            'output_path': str(Path(tmp) / 'o'),
+            'tmp_path': str(Path(tmp) / 't')})
+        ours = create_extractor(args).extract(video)['r21d']
+        return [('E2E r21d (T, 512) (file→features)', _rel(ours, ref), real)]
+
+
 def measure_e2e_raft(ckpt_dir):
     import tempfile
 
@@ -353,6 +380,7 @@ MEASURES = {
     'vggish': measure_vggish,
     'mirrors': measure_mirrors,
     'e2e_i3d': measure_e2e_i3d,
+    'e2e_r21d': measure_e2e_r21d,
     'e2e_raft': measure_e2e_raft,
 }
 
