@@ -137,6 +137,25 @@ def run_reference_i3d(video_path: str, nets, stack_size: int = 16,
     return {s: np.asarray(v, dtype=np.float32) for s, v in feats.items()}
 
 
+def _read_frames_rgb(video_path: str) -> np.ndarray:
+    """(T, H, W, 3) uint8 via cv2 — the decode stand-in shared by the
+    whole-video reference recipes (decode parity with our loaders is
+    covered by tests/test_video_loader.py)."""
+    import cv2
+
+    cap = cv2.VideoCapture(video_path)
+    frames = []
+    while True:
+        ok, bgr = cap.read()
+        if not ok:
+            break
+        frames.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
+    cap.release()
+    if not frames:
+        raise ValueError(f'no frames decoded from {video_path}')
+    return np.stack(frames)
+
+
 def run_reference_r21d(video_path: str, net, stack_size: int = 16,
                        step_size: int = 16) -> np.ndarray:
     """The reference r21d extraction, verbatim semantics (BASELINE config 1).
@@ -150,7 +169,6 @@ def run_reference_r21d(video_path: str, net, stack_size: int = 16,
     (tests/torch_mirrors.py), or real torchvision with
     ``model.fc = nn.Identity()`` exactly as the reference constructs it.
     """
-    import cv2
     import torch
 
     from models.transforms import (
@@ -159,16 +177,7 @@ def run_reference_r21d(video_path: str, net, stack_size: int = 16,
 
     from video_features_tpu.utils.slicing import form_slices
 
-    cap = cv2.VideoCapture(video_path)
-    frames = []
-    while True:
-        ok, bgr = cap.read()
-        if not ok:
-            break
-        frames.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
-    cap.release()
-
-    rgb = torch.from_numpy(np.stack(frames))                 # (T, H, W, C)
+    rgb = torch.from_numpy(_read_frames_rgb(video_path))     # (T, H, W, C)
     rgb = ToFloatTensorInZeroOne()(rgb)                      # (C, T, H, W)
     rgb = Resize((128, 171))(rgb)
     rgb = Normalize(mean=[0.43216, 0.394666, 0.37645],
@@ -179,6 +188,36 @@ def run_reference_r21d(video_path: str, net, stack_size: int = 16,
     with torch.no_grad():
         for start, end in form_slices(rgb.size(2), stack_size, step_size):
             out = net(rgb[:, :, start:end])
+            feats.extend(out.numpy().tolist())
+    return np.asarray(feats, dtype=np.float32)
+
+
+def run_reference_s3d(video_path: str, net, stack_size: int = 16,
+                      step_size: int = 16) -> np.ndarray:
+    """The reference s3d extraction, verbatim semantics.
+
+    Mirrors reference models/s3d/extract_s3d.py:30-35,47-76: whole-video
+    read, ToFloatTensorInZeroOne → Resize(224, short side) →
+    CenterCrop(224) — deliberately NO normalization (kylemin/S3D
+    convention) — then `form_slices` windows and `net(x, features=True)`.
+    Run both sides at native fps (the reference's default fps-25 re-encode
+    needs ffmpeg; retiming parity is covered by the VideoLoader tests).
+    """
+    import torch
+
+    from models.transforms import CenterCrop, Resize, ToFloatTensorInZeroOne
+
+    from video_features_tpu.utils.slicing import form_slices
+
+    rgb = torch.from_numpy(_read_frames_rgb(video_path))     # (T, H, W, C)
+    rgb = ToFloatTensorInZeroOne()(rgb)                      # (C, T, H, W)
+    rgb = Resize(224)(rgb)
+    rgb = CenterCrop((224, 224))(rgb).unsqueeze(0)           # (1, C, T, H, W)
+
+    feats = []
+    with torch.no_grad():
+        for start, end in form_slices(rgb.size(2), stack_size, step_size):
+            out = net(rgb[:, :, start:end], features=True)
             feats.extend(out.numpy().tolist())
     return np.asarray(feats, dtype=np.float32)
 

@@ -120,6 +120,37 @@ def test_r21d_e2e_golden(reference_repo, video_33, tmp_path):
     assert rel < REL_L2_TARGET, f'r21d e2e rel L2 {rel}'
 
 
+def test_s3d_e2e_golden(reference_repo, video_33, tmp_path):
+    """s3d family end-to-end: whole-file (T, 1024) output vs the reference
+    recipe (no-normalization convention, torch-bilinear short-side resize,
+    form_slices windows) with the reference's own S3D net."""
+    import torch
+
+    from models.s3d.s3d_src.s3d import S3D
+    from tests.reference_pipeline import run_reference_s3d
+
+    torch.manual_seed(0)
+    net = S3D(num_class=400).eval()
+    ckpt = tmp_path / 's3d_seeded.pt'
+    torch.save(net.state_dict(), str(ckpt))
+
+    ref = run_reference_s3d(video_33, net, stack_size=16, step_size=16)
+
+    args = load_config('s3d', overrides={
+        'video_paths': video_33, 'device': 'cpu', 'precision': 'highest',
+        'decode_backend': 'cv2', 'stack_size': 16, 'step_size': 16,
+        'extraction_fps': None,       # native fps both sides (no ffmpeg)
+        'checkpoint_path': str(ckpt),
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ours = create_extractor(args).extract(video_33)['s3d']
+
+    assert ours.shape == ref.shape == (2, 1024)
+    rel = _rel_l2(ours, ref)
+    print(f'[golden e2e] s3d rel L2: {rel}')
+    assert rel < REL_L2_TARGET, f's3d e2e rel L2 {rel}'
+
+
 def test_raft_flow_e2e_golden(reference_repo, video_33, tmp_path):
     """Un-quantized flow end-to-end at the STRICT bar: the raft family's
     whole-file (T-1, 2, H, W) output vs the reference RAFT loop on the
@@ -133,18 +164,10 @@ def test_raft_flow_e2e_golden(reference_repo, video_33, tmp_path):
 
     # reference side: cv2 decode → RAFT on padded consecutive pairs →
     # unpad (reference base_flow_extractor.py:76-115)
-    import cv2
-
     from models.raft.raft_src.raft import InputPadder
-    cap = cv2.VideoCapture(video_33)
-    frames = []
-    while True:
-        ok, bgr = cap.read()
-        if not ok:
-            break
-        frames.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
-    cap.release()
-    batch = torch.from_numpy(np.stack(frames)).permute(0, 3, 1, 2).float()
+    from tests.reference_pipeline import _read_frames_rgb
+    frames = _read_frames_rgb(video_33)
+    batch = torch.from_numpy(frames).permute(0, 3, 1, 2).float()
     padder = InputPadder(batch.shape)
     with torch.no_grad():
         padded = padder.pad(batch)

@@ -65,3 +65,33 @@ def test_flow_uint8_quantization_matches_reference_recipe():
     expected = np.round((np.clip(flow, -20, 20) + 20) / 40 * 255)
     np.testing.assert_array_equal(out, expected)
     assert out.min() >= 0 and out.max() <= 255
+
+
+def test_resize_bilinear_scale_matches_torch_scale_factor():
+    """The reference's short-side Resize(int) interpolates at the GIVEN
+    scale (F.interpolate(scale_factor=s, recompute_scale_factor=False)),
+    whose grid differs from size-based out/in on the non-short axis —
+    resize_bilinear_scale must match torch exactly."""
+    import torch
+    import torch.nn.functional as F
+
+    from video_features_tpu.ops.transforms import resize_bilinear_scale
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 240, 320, 3).astype(np.float32)
+    scale = 224.0 / 240.0
+
+    ref = F.interpolate(torch.from_numpy(x).permute(0, 3, 1, 2),
+                        scale_factor=scale, mode='bilinear',
+                        align_corners=False, recompute_scale_factor=False)
+    ref = ref.permute(0, 2, 3, 1).numpy()            # (2, 224, 298, 3)
+
+    got = np.asarray(resize_bilinear_scale(x, ref.shape[1:3], scale))
+    assert got.shape == ref.shape
+    # matmul-lerp vs scalar-lerp fp32 accumulation: ~2.5e-5 abs noise; a
+    # grid mismatch (the bug this guards) shows up at the 1e-2 level
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+    # and the size-based grid must NOT match (the non-short axis differs)
+    from video_features_tpu.ops.transforms import resize_bilinear
+    size_based = np.asarray(resize_bilinear(x, ref.shape[1:3]))
+    assert np.abs(size_based - ref).max() > 1e-3

@@ -328,6 +328,36 @@ def measure_e2e_r21d(ckpt_dir):
         return [('E2E r21d (T, 512) (file→features)', _rel(ours, ref), real)]
 
 
+def measure_e2e_s3d(ckpt_dir):
+    import tempfile
+
+    import torch
+
+    from models.s3d.s3d_src.s3d import S3D
+    from tests.reference_pipeline import run_reference_s3d
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    with tempfile.TemporaryDirectory() as tmp:
+        video = _make_clip33(tmp)
+        torch.manual_seed(0)
+        net = S3D(num_class=400).eval()
+        sd = _load_sd(ckpt_dir, 'S3D_kinetics400_torchified.pt')
+        real = sd is not None
+        if real:
+            net.load_state_dict(sd)
+        ckpt = Path(tmp) / 's3d.pt'
+        torch.save(net.state_dict(), str(ckpt))
+        ref = run_reference_s3d(video, net, stack_size=16, step_size=16)
+        args = load_config('s3d', overrides={
+            'video_paths': video, 'device': 'cpu', 'precision': 'highest',
+            'decode_backend': 'cv2', 'stack_size': 16, 'step_size': 16,
+            'extraction_fps': None, 'checkpoint_path': str(ckpt),
+            'output_path': str(Path(tmp) / 'o'),
+            'tmp_path': str(Path(tmp) / 't')})
+        ours = create_extractor(args).extract(video)['s3d']
+        return [('E2E s3d (T, 1024) (file→features)', _rel(ours, ref), real)]
+
+
 def measure_e2e_raft(ckpt_dir):
     import tempfile
 
@@ -381,6 +411,7 @@ MEASURES = {
     'mirrors': measure_mirrors,
     'e2e_i3d': measure_e2e_i3d,
     'e2e_r21d': measure_e2e_r21d,
+    'e2e_s3d': measure_e2e_s3d,
     'e2e_raft': measure_e2e_raft,
 }
 

@@ -17,7 +17,7 @@ from video_features_tpu.extract.base import BaseExtractor
 from video_features_tpu.io.video import VideoLoader
 from video_features_tpu.models import s3d as s3d_model
 from video_features_tpu.ops.transforms import (
-    center_crop, resize_bilinear, to_float_zero_one,
+    center_crop, resize_bilinear_scale, to_float_zero_one,
 )
 from video_features_tpu.utils.device import jax_device
 
@@ -57,9 +57,12 @@ class ExtractS3D(BaseExtractor):
                             feature_type='s3d')
 
     @staticmethod
-    def _forward(params, stacks, resize_hw):
+    def _forward(params, stacks, resize_hw, resize_scale):
         x = to_float_zero_one(stacks)
-        x = resize_bilinear(x, resize_hw)
+        # the reference's short-side Resize(224) interpolates at the GIVEN
+        # scale 224/min(h, w), not out/in (reference models/transforms.py:
+        # 76-96, scale_factor + recompute_scale_factor=False)
+        x = resize_bilinear_scale(x, resize_hw, resize_scale)
         x = center_crop(x, (224, 224))
         return s3d_model.forward(params, x, features=True)
 
@@ -80,18 +83,26 @@ class ExtractS3D(BaseExtractor):
             iter_batched_windows, transfer_batches,
         )
 
-        state = {'step': None, 'resize_hw': None}
+        state = {'step': None, 'resize_hw': None, 'scale': None}
         feats: list = []
 
         def run(stacks, host_stacks, valid, window_idx):
             if state['step'] is None:
-                # short-side 224, torch F.interpolate semantics, static per
-                # video geometry
+                # short-side 224 at the GIVEN scale 224/min(h, w): BOTH the
+                # output sizes and the sampling grid follow torch's
+                # F.interpolate(scale_factor=s, recompute_scale_factor=
+                # False) — sizes are floor(dim * s) with the exact float s
+                # (e.g. floor(480 * (224/336)) = 319, and a 107px short
+                # side floors to 223, not 224 — the subsequent CenterCrop
+                # then behaves exactly like the reference's)
+                import math
                 h, w = stacks.shape[2:4]
-                state['resize_hw'] = ((224, int(224 * w / h)) if h < w
-                                      else (int(224 * h / w), 224))
+                state['scale'] = 224.0 / min(h, w)
+                state['resize_hw'] = (math.floor(h * state['scale']),
+                                      math.floor(w * state['scale']))
                 state['step'] = jax.jit(
-                    partial(self._forward, resize_hw=state['resize_hw']))
+                    partial(self._forward, resize_hw=state['resize_hw'],
+                            resize_scale=state['scale']))
             with self.tracer.stage('model'):
                 out = np.asarray(state['step'](self.params, stacks))[:valid]
             feats.append(out)
@@ -100,7 +111,7 @@ class ExtractS3D(BaseExtractor):
                     start = (window_idx + k) * self.step_size
                     self.maybe_show_pred(host_stacks[k:k + 1], start,
                                          start + self.stack_size,
-                                         state['resize_hw'])
+                                         state['resize_hw'], state['scale'])
 
         with self.precision_scope():
             # decode thread assembles + transfers stack batch k+1 while
@@ -116,12 +127,11 @@ class ExtractS3D(BaseExtractor):
                  else np.zeros((0, s3d_model.FEAT_DIM), np.float32))
         return {self.feature_type: feats}
 
-    def maybe_show_pred(self, stacks, start_idx, end_idx, resize_hw):
+    def maybe_show_pred(self, stacks, start_idx, end_idx, resize_hw, scale):
         import jax.numpy as jnp
-        from video_features_tpu.ops.transforms import normalize  # noqa: F401
         from video_features_tpu.utils.preds import show_predictions_on_dataset
         x = to_float_zero_one(jnp.asarray(stacks))
-        x = resize_bilinear(x, resize_hw)
+        x = resize_bilinear_scale(x, resize_hw, scale)
         x = center_crop(x, (224, 224))
         logits = np.asarray(s3d_model.forward(self.params, x, features=False))
         print(f'At frames ({start_idx}, {end_idx})')

@@ -52,6 +52,42 @@ def resize_bilinear(x: Array, size: Tuple[int, int]) -> Array:
     return jax.image.resize(x, out_shape, method='bilinear', antialias=False)
 
 
+def _interp_matrix(in_len: int, out_len: int, scale: float) -> np.ndarray:
+    """(out_len, in_len) bilinear interpolation matrix for torch's
+    align_corners=False grid at a GIVEN scale: src = (dst+0.5)/scale - 0.5,
+    clamped to [0, in_len-1]."""
+    src = np.maximum((np.arange(out_len) + 0.5) / scale - 0.5, 0.0)
+    src = np.minimum(src, in_len - 1)
+    lo = np.floor(src).astype(np.int64)
+    hi = np.minimum(lo + 1, in_len - 1)
+    w = (src - lo).astype(np.float32)
+    m = np.zeros((out_len, in_len), np.float32)
+    m[np.arange(out_len), lo] += 1.0 - w
+    m[np.arange(out_len), hi] += w
+    return m
+
+
+def resize_bilinear_scale(x: Array, size: Tuple[int, int],
+                          scale: float) -> Array:
+    """Bilinear resize whose sampling grid uses an explicitly GIVEN scale.
+
+    torch's ``F.interpolate(..., scale_factor=s, recompute_scale_factor=
+    False)`` — the reference's short-side ``Resize(int)``
+    (models/transforms.py:76-96) — maps output→input coordinates with the
+    *requested* scale, not ``out_len/in_len``; the two grids differ on the
+    non-short axis (e.g. 320→298 at scale 224/240: 0.9333 vs 0.93125, up
+    to ~0.7 px at the right edge — a 1e-2 feature drift through S3D).
+    Implemented as two small dense interpolation matmuls (MXU-friendly,
+    no gathers); the matrices are trace-time constants per geometry.
+    """
+    *lead, h, w, c = x.shape
+    mh = jnp.asarray(_interp_matrix(h, size[0], scale))
+    mw = jnp.asarray(_interp_matrix(w, size[1], scale))
+    # (..., H, W, C): contract H with mh, then W with mw
+    out = jnp.einsum('oh,...hwc->...owc', mh, x)
+    return jnp.einsum('pw,...owc->...opc', mw, out)
+
+
 def center_crop(x: Array, size: Union[int, Tuple[int, int]]) -> Array:
     """Center crop of (..., H, W, C); torch CenterCrop offset convention
     (round-half-down via int division)."""
