@@ -222,6 +222,96 @@ def run_reference_s3d(video_path: str, net, stack_size: int = 16,
     return np.asarray(feats, dtype=np.float32)
 
 
+def build_reference_clip(seed: int = 0):
+    """Seeded reduced-geometry reference CLIP (full ViT-B/32 visual tower,
+    2-layer text transformer — encode_image is unaffected by the text
+    reduction and the full text checkpoint needs real weights)."""
+    import importlib.util
+
+    import torch
+
+    spec = importlib.util.spec_from_file_location(
+        'ref_clip_model_e2e',
+        '/root/reference/models/clip/clip_src/model.py')
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    torch.manual_seed(seed)
+    return mod.CLIP(embed_dim=512, image_resolution=224, vision_layers=12,
+                    vision_width=768, vision_patch_size=32,
+                    context_length=77, vocab_size=512,
+                    transformer_width=512, transformer_heads=8,
+                    transformer_layers=2).eval().float()
+
+
+def _framewise_reference_inputs(video_path, resize, crop, interp, mean, std):
+    """Per-frame torchvision-PIL eval preprocessing (the chain shared by
+    the reference's frame-wise extractors): PIL short-side resize
+    (truncating long-side formula) → round-offset CenterCrop → ToTensor
+    (/255) → Normalize. Yields (1, C, crop, crop) tensors."""
+    import torch
+    from PIL import Image
+
+    mean = torch.tensor(mean).view(3, 1, 1)
+    std = torch.tensor(std).view(3, 1, 1)
+    for frame in _read_frames_rgb(video_path):
+        img = Image.fromarray(frame)
+        w, h = img.size
+        if w < h:
+            size = (resize, int(resize * h / w))   # torchvision Resize(int)
+        else:
+            size = (int(resize * w / h), resize)
+        img = img.resize(size, interp)
+        w, h = img.size
+        top = int(round((h - crop) / 2.0))
+        left = int(round((w - crop) / 2.0))
+        img = img.crop((left, top, left + crop, top + crop))
+        x = torch.from_numpy(np.asarray(img)).permute(2, 0, 1).float()
+        yield ((x / 255.0 - mean) / std).unsqueeze(0)
+
+
+def run_reference_clip(video_path: str, net) -> np.ndarray:
+    """The reference CLIP frame-wise extraction, verbatim semantics.
+
+    Mirrors reference models/clip/extract_clip.py + clip_src/clip.py
+    `_transform`: per frame, PIL bicubic resize short-side → input
+    resolution, CenterCrop, ToTensor (/255), Normalize(CLIP stats), then
+    `encode_image` (extract_clip.py:69-84).
+    """
+    import torch
+    from PIL import Image
+
+    feats = []
+    with torch.no_grad():
+        for x in _framewise_reference_inputs(
+                video_path, resize=224, crop=224, interp=Image.BICUBIC,
+                mean=[0.48145466, 0.4578275, 0.40821073],
+                std=[0.26862954, 0.26130258, 0.27577711]):
+            feats.extend(net.encode_image(x).numpy().tolist())
+    return np.asarray(feats, dtype=np.float32)
+
+
+def run_reference_resnet(video_path: str, net) -> np.ndarray:
+    """The reference resnet frame-wise extraction, verbatim semantics.
+
+    Mirrors reference models/resnet/extract_resnet.py:38-50: torchvision's
+    IMAGENET1K_V1 eval transform — ToPILImage → PIL bilinear resize short
+    side 256 → CenterCrop(224) → ToTensor → Normalize(ImageNet stats) —
+    then the fc-stripped net. ``net`` must return features from a plain
+    ``net(x)`` call (the torchvision mirror's default, or real torchvision
+    with ``model.fc = nn.Identity()``).
+    """
+    import torch
+    from PIL import Image
+
+    feats = []
+    with torch.no_grad():
+        for x in _framewise_reference_inputs(
+                video_path, resize=256, crop=224, interp=Image.BILINEAR,
+                mean=[0.485, 0.456, 0.406], std=[0.229, 0.224, 0.225]):
+            feats.extend(net(x).numpy().tolist())
+    return np.asarray(feats, dtype=np.float32)
+
+
 def build_reference_r21d_net(seed: int = 0, state_dict=None):
     """Seeded (or checkpoint-loaded) torchvision-mirror VideoResNet +
     the .pt path ingredients shared by test_golden_e2e and measure_parity."""

@@ -151,6 +151,65 @@ def test_s3d_e2e_golden(reference_repo, video_33, tmp_path):
     assert rel < REL_L2_TARGET, f's3d e2e rel L2 {rel}'
 
 
+def test_clip_e2e_golden(reference_repo, video_33, tmp_path):
+    """clip family end-to-end: whole-file (T, 512) output vs the reference
+    transform chain + encode_image (reduced-geometry reference CLIP; the
+    visual tower is the full ViT-B/32 layout)."""
+    import torch
+
+    from tests.reference_pipeline import build_reference_clip, run_reference_clip
+
+    net = build_reference_clip(seed=0)
+    ckpt = tmp_path / 'clip_seeded.pt'
+    torch.save(net.state_dict(), str(ckpt))
+
+    ref = run_reference_clip(video_33, net)
+
+    args = load_config('clip', overrides={
+        'video_paths': video_33, 'device': 'cpu', 'precision': 'highest',
+        'decode_backend': 'cv2', 'batch_size': 16, 'model_name': 'custom',
+        'checkpoint_path': str(ckpt),
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ours = create_extractor(args).extract(video_33)['clip']
+
+    assert ours.shape == ref.shape == (33, 512)
+    rel = _rel_l2(ours, ref)
+    print(f'[golden e2e] clip rel L2: {rel}')
+    assert rel < REL_L2_TARGET, f'clip e2e rel L2 {rel}'
+
+
+def test_resnet_e2e_golden(reference_repo, video_33, tmp_path):
+    """resnet family end-to-end: whole-file (T, 2048) output vs the
+    reference recipe (torchvision IMAGENET1K_V1 eval transform + the
+    fc-stripped mirror net)."""
+    import torch
+
+    from tests.reference_pipeline import run_reference_resnet
+    from tests.torch_mirrors import TorchResNet, randomize_bn_stats
+
+    torch.manual_seed(0)
+    net = TorchResNet('resnet50').eval()
+    randomize_bn_stats(net)
+    ckpt = tmp_path / 'resnet50_seeded.pt'
+    torch.save(net.state_dict(), str(ckpt))
+
+    ref = run_reference_resnet(video_33, net)
+
+    args = load_config('resnet', overrides={
+        'video_paths': video_33, 'device': 'cpu', 'precision': 'highest',
+        'decode_backend': 'cv2', 'batch_size': 16, 'model_name': 'resnet50',
+        'checkpoint_path': str(ckpt),
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ours = create_extractor(args).extract(video_33)['resnet']
+
+    assert ours.shape == ref.shape == (33, 2048)
+    rel = _rel_l2(ours, ref)
+    print(f'[golden e2e] resnet rel L2: {rel}')
+    assert rel < REL_L2_TARGET, f'resnet e2e rel L2 {rel}'
+
+
 def test_raft_flow_e2e_golden(reference_repo, video_33, tmp_path):
     """Un-quantized flow end-to-end at the STRICT bar: the raft family's
     whole-file (T-1, 2, H, W) output vs the reference RAFT loop on the

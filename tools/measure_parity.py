@@ -328,6 +328,31 @@ def measure_e2e_r21d(ckpt_dir):
         return [('E2E r21d (T, 512) (file→features)', _rel(ours, ref), real)]
 
 
+def measure_e2e_clip(ckpt_dir):
+    import tempfile
+
+    import torch
+
+    from tests.reference_pipeline import build_reference_clip, run_reference_clip
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    with tempfile.TemporaryDirectory() as tmp:
+        video = _make_clip33(tmp)
+        net = build_reference_clip(seed=0)
+        ckpt = Path(tmp) / 'clip.pt'
+        torch.save(net.state_dict(), str(ckpt))
+        ref = run_reference_clip(video, net)
+        args = load_config('clip', overrides={
+            'video_paths': video, 'device': 'cpu', 'precision': 'highest',
+            'decode_backend': 'cv2', 'batch_size': 16,
+            'model_name': 'custom', 'checkpoint_path': str(ckpt),
+            'output_path': str(Path(tmp) / 'o'),
+            'tmp_path': str(Path(tmp) / 't')})
+        ours = create_extractor(args).extract(video)['clip']
+        return [('E2E clip (T, 512) (file→features)', _rel(ours, ref),
+                 False)]
+
+
 def measure_e2e_s3d(ckpt_dir):
     import tempfile
 
@@ -356,6 +381,38 @@ def measure_e2e_s3d(ckpt_dir):
             'tmp_path': str(Path(tmp) / 't')})
         ours = create_extractor(args).extract(video)['s3d']
         return [('E2E s3d (T, 1024) (file→features)', _rel(ours, ref), real)]
+
+
+def measure_e2e_resnet(ckpt_dir):
+    import tempfile
+
+    import torch
+
+    from tests.reference_pipeline import run_reference_resnet
+    from tests.torch_mirrors import TorchResNet, randomize_bn_stats
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    with tempfile.TemporaryDirectory() as tmp:
+        video = _make_clip33(tmp)
+        torch.manual_seed(0)
+        net = TorchResNet('resnet50').eval()
+        randomize_bn_stats(net)
+        sd = _load_sd(ckpt_dir, 'resnet50-0676ba61.pth')
+        real = sd is not None
+        if real:
+            net.load_state_dict(sd)
+        ckpt = Path(tmp) / 'resnet50.pt'
+        torch.save(net.state_dict(), str(ckpt))
+        ref = run_reference_resnet(video, net)
+        args = load_config('resnet', overrides={
+            'video_paths': video, 'device': 'cpu', 'precision': 'highest',
+            'decode_backend': 'cv2', 'batch_size': 16,
+            'model_name': 'resnet50', 'checkpoint_path': str(ckpt),
+            'output_path': str(Path(tmp) / 'o'),
+            'tmp_path': str(Path(tmp) / 't')})
+        ours = create_extractor(args).extract(video)['resnet']
+        return [('E2E resnet50 (T, 2048) (file→features)', _rel(ours, ref),
+                 real)]
 
 
 def measure_e2e_raft(ckpt_dir):
@@ -410,8 +467,10 @@ MEASURES = {
     'vggish': measure_vggish,
     'mirrors': measure_mirrors,
     'e2e_i3d': measure_e2e_i3d,
+    'e2e_clip': measure_e2e_clip,
     'e2e_r21d': measure_e2e_r21d,
     'e2e_s3d': measure_e2e_s3d,
+    'e2e_resnet': measure_e2e_resnet,
     'e2e_raft': measure_e2e_raft,
 }
 
