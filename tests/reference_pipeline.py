@@ -265,7 +265,9 @@ def _framewise_reference_inputs(video_path, resize, crop, interp, mean, std):
         top = int(round((h - crop) / 2.0))
         left = int(round((w - crop) / 2.0))
         img = img.crop((left, top, left + crop, top + crop))
-        x = torch.from_numpy(np.asarray(img)).permute(2, 0, 1).float()
+        # np.array copies: PIL hands back a read-only buffer and
+        # torch.from_numpy warns on non-writable arrays
+        x = torch.from_numpy(np.array(img)).permute(2, 0, 1).float()
         yield ((x / 255.0 - mean) / std).unsqueeze(0)
 
 
