@@ -99,3 +99,22 @@ def test_main_rejects_unknown_family(tmp_path, monkeypatch):
                          str(tmp_path)])
     with pytest.raises(SystemExit):
         fc.main()
+
+
+def test_main_happy_path_offline(tmp_path, monkeypatch, capsys):
+    """CLI end-to-end with the bundled-blob source + --no-convert (the
+    offline provisioning path)."""
+    torch = pytest.importorskip('torch')
+    checkout = tmp_path / 'checkout'
+    for rel in ['models/raft/checkpoints/raft-sintel.pth',
+                'models/raft/checkpoints/raft-kitti.pth']:
+        p = checkout / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        torch.save({'w': torch.zeros(2)}, p)
+    monkeypatch.setattr(sys, 'argv', [
+        'fetch_checkpoints.py', 'raft', '--out', str(tmp_path / 'out'),
+        '--no-convert', '--from-checkout', str(checkout)])
+    assert fc.main() == 0
+    assert (tmp_path / 'out' / 'raft-sintel.pth').exists()
+    assert (tmp_path / 'out' / 'raft-kitti.pth').exists()
+    assert '2 artifacts ready' in capsys.readouterr().out
