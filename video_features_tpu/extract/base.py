@@ -69,7 +69,7 @@ class BaseExtractor:
         lets the TPU run bf16 MXU passes — ~an order of magnitude faster at
         CLI geometry; ``mixed`` = parity-grade fast mode (ops/precision.py):
         ambient 3-pass bf16, measured ≤1e-3 feature drift on the fused path
-        at ~1.7x the 'highest' throughput; ``precision_pins`` carries any
+        at ~1.9x the 'highest' throughput; ``precision_pins`` carries any
         tuned per-sub-graph overrides to extractors that support them."""
         import jax
 

@@ -35,7 +35,8 @@ Pins = Tuple[Tuple[str, str], ...]
 # two-stream path, drift = feature rel L2 vs all-float32 on identical
 # inputs/weights): ambient 'high' (3-pass bf16 ≈ fp32 to ~2^-21 per
 # matmul) measures 8.4e-4 flow / 1.3e-4 rgb — under the ≤1e-3 parity bar —
-# at 24.2 clips/s vs 14.6 at 'highest' (batch 8, stack 16, 224px). No
+# at ~1.9x the float32 rate (14.9 vs 7.9 clips/s, quiet-host bench.py at
+# stack 16 / 224px). No
 # sub-graph survives 1-pass: encoder-at-default alone is 1.04e-2, and
 # corr-at-default under ambient high is 4.4e-3 (the flow-quantization
 # cliff amplifies both). So 'mixed' is ambient 'high' with no down-pins;
