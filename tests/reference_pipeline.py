@@ -21,12 +21,27 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
-def build_reference_nets(seed: int = 0, streams=('rgb', 'flow')):
+def build_reference_nets(seed: int = 0, streams=('rgb', 'flow'),
+                         flow_head_scale: float = 0.5):
     """Seeded reference torch nets {rgb, flow, raft} in eval mode.
 
     Requires /root/reference on sys.path (tests: the `reference_repo`
     fixture). With real checkpoints, load their state dicts into these same
     modules instead.
+
+    ``flow_head_scale`` shapes the seeded RAFT so its flow fields have
+    REALISTIC dynamics for the uint8 quantization stage downstream
+    (reference transforms.py ToUInt8: flow → round(128 + 255/40·clamp)).
+    Unscaled seeded weights drive ~0.05% of pixels to |flow| ≥ 20 px where
+    the clamp value itself sits exactly on a rounding boundary (±20 ↦
+    q = 0.5 / 255.5), so sub-1e-6 numeric differences between the two
+    pipelines flip full uint8 levels there — an artifact of unrealistically
+    hot random weights, not of either pipeline. Scaling the flow-head
+    output conv by 0.5 yields fields with std ≈ 3 px and |flow| < 13
+    (real pretrained RAFT on the sample clips is in the same regime), and
+    the quantized comparison then measures what it should: pipeline
+    parity. The scaling is applied to the state dict BEFORE it is saved,
+    so both pipelines consume identical weights either way.
     """
     import torch
 
@@ -39,7 +54,11 @@ def build_reference_nets(seed: int = 0, streams=('rgb', 'flow')):
         if stream in ('rgb', 'flow'):
             nets[stream] = I3D(num_classes=400, modality=stream).eval()
     if 'flow' in streams:
-        nets['raft'] = RAFT().eval()
+        raft = RAFT().eval()
+        if flow_head_scale != 1.0:
+            with torch.no_grad():
+                raft.update_block.flow_head.conv2.weight.mul_(flow_head_scale)
+        nets['raft'] = raft
     return nets
 
 
@@ -62,7 +81,8 @@ def run_reference_i3d(video_path: str, nets, stack_size: int = 16,
                       step_size: Optional[int] = None,
                       streams=('rgb', 'flow'),
                       min_side: int = 256,
-                      crop: int = 224) -> Dict[str, np.ndarray]:
+                      crop: int = 224,
+                      raft_iters: Optional[int] = None) -> Dict[str, np.ndarray]:
     """The reference extract loop, verbatim semantics, composed by hand.
 
     Mirrors reference models/i3d/extract_i3d.py:
@@ -124,8 +144,10 @@ def run_reference_i3d(video_path: str, nets, stack_size: int = 16,
                 batch = torch.cat(rgb_stack)
                 for stream in streams:
                     if stream == 'flow':
+                        kw = ({} if raft_iters is None
+                              else {'iters': raft_iters})
                         x = nets['raft'](padder.pad(batch)[:-1],
-                                         padder.pad(batch)[1:])
+                                         padder.pad(batch)[1:], **kw)
                         x = t_scale(t_uint8(t_clamp(t_crop(x))))
                     else:
                         x = t_scale(t_crop(batch[:-1]))
@@ -312,6 +334,92 @@ def run_reference_resnet(video_path: str, net) -> np.ndarray:
                 mean=[0.485, 0.456, 0.406], std=[0.229, 0.224, 0.225]):
             feats.extend(net(x).numpy().tolist())
     return np.asarray(feats, dtype=np.float32)
+
+
+def write_real_audio_wav(path: str, sr: int = 16000,
+                         source_video: str = '/root/reference/sample/'
+                                             'v_GGSY1Qvo990.mp4') -> str:
+    """Write a 16 kHz 16-bit PCM wav with REAL audio content: the sample
+    clip's soundtrack via the native decoder when built, else a synthesized
+    chirp+noise mix. The single fixture builder shared by the vggish golden
+    test and tools/measure_parity.py — both sides of each comparison read
+    the identical file, so provenance affects realism only."""
+    import wave
+
+    from video_features_tpu.io import native
+
+    if native.available():
+        from video_features_tpu.io.native import read_audio_native
+        data, got_sr = read_audio_native(source_video, sr)
+        assert got_sr == sr
+    else:  # pragma: no cover - env without the native decoder
+        rng = np.random.RandomState(0)
+        t = np.arange(sr * 10) / sr
+        data = (0.4 * np.sin(2 * np.pi * (200 + 40 * t) * t)
+                + 0.1 * rng.randn(len(t)))
+    pcm = np.clip(np.asarray(data, np.float64) * 32768.0,
+                  -32768, 32767).astype('<i2')
+    with wave.open(str(path), 'wb') as f:
+        f.setnchannels(1)
+        f.setsampwidth(2)
+        f.setframerate(sr)
+        f.writeframes(pcm.tobytes())
+    return str(path)
+
+
+def run_reference_vggish(wav_path: str, net) -> np.ndarray:
+    """The reference vggish extraction, verbatim semantics, composed from
+    the reference's own importable pieces.
+
+    Mirrors reference models/vggish/extract_vggish.py:31-62 +
+    vggish_src/vggish_input.py:75-99 at a 16 kHz wav input (the rate its
+    ffmpeg stage produces, so the resampy branch — whose import is the only
+    un-importable dependency here — is a no-op): int16 wav → /32768 → mono
+    → the reference's OWN mel_features.log_mel_spectrogram with
+    vggish_params constants → mel_features.frame into (N, 96, 64) examples
+    → the VGG net (postprocess is a no-op by default: the vendored
+    Postprocessor.forward returns its input unless post_process=True,
+    vggish_slim.py:150-156). ``net`` is the state-dict-matched torch mirror
+    (tests/torch_mirrors.TorchVGGish) or the real checkpoint loaded into it.
+    """
+    import wave
+
+    import torch
+
+    from models.vggish.vggish_src import mel_features, vggish_params
+
+    with wave.open(wav_path, 'rb') as f:
+        assert f.getsampwidth() == 2, 'expected 16-bit PCM'
+        sr = f.getframerate()
+        raw = np.frombuffer(f.readframes(f.getnframes()), dtype='<i2')
+        if f.getnchannels() > 1:
+            raw = raw.reshape(-1, f.getnchannels())
+    assert sr == vggish_params.SAMPLE_RATE, (
+        f'run_reference_vggish needs a {vggish_params.SAMPLE_RATE} Hz wav '
+        f'(got {sr}); the resampy path is not importable here')
+    samples = raw / 32768.0                      # sf.read int16 convention
+    if samples.ndim > 1:
+        samples = np.mean(samples, axis=1)
+
+    log_mel = mel_features.log_mel_spectrogram(
+        samples,
+        audio_sample_rate=vggish_params.SAMPLE_RATE,
+        log_offset=vggish_params.LOG_OFFSET,
+        window_length_secs=vggish_params.STFT_WINDOW_LENGTH_SECONDS,
+        hop_length_secs=vggish_params.STFT_HOP_LENGTH_SECONDS,
+        num_mel_bins=vggish_params.NUM_MEL_BINS,
+        lower_edge_hertz=vggish_params.MEL_MIN_HZ,
+        upper_edge_hertz=vggish_params.MEL_MAX_HZ)
+    features_sample_rate = 1.0 / vggish_params.STFT_HOP_LENGTH_SECONDS
+    window = int(round(vggish_params.EXAMPLE_WINDOW_SECONDS
+                       * features_sample_rate))
+    hop = int(round(vggish_params.EXAMPLE_HOP_SECONDS * features_sample_rate))
+    examples = mel_features.frame(log_mel, window_length=window,
+                                  hop_length=hop)
+
+    x = torch.tensor(examples)[:, None, :, :].float()
+    with torch.no_grad():
+        return net(x).numpy().astype(np.float32)
 
 
 def build_reference_r21d_net(seed: int = 0, state_dict=None):

@@ -75,20 +75,19 @@ def test_i3d_two_stream_e2e_golden(golden, video_33, tmp_path):
         rels[stream] = _rel_l2(out[:, i * 1024:(i + 1) * 1024],
                                golden['feats'][stream])
     print(f'[golden e2e] rel L2: {rels}')
-    # rgb is the strict bar: decode → resize → crop → I3D is deterministic
-    # and measures ~1e-6 (any regression in the frame pipeline fails this
-    # hard). The flow stream passes through the uint8 quantization cliff
-    # (clamp ±20 → 255/40·x rounding): with SEEDED-RANDOM weights the flow
-    # field is near-zero noise, so huge numbers of pixels sit on rounding
-    # boundaries and sub-1e-3 flow differences (the model-level parity bar,
-    # tests/test_raft_model.py) flip ±1 level — measured 2.7e-3 feature
-    # drift here, an artifact of random weights, not a pipeline bug. The
-    # un-quantized flow path is held to the strict bar end-to-end by
-    # test_raft_flow_e2e_golden below; with real checkpoints
-    # (tools/measure_parity.py) the strict bar applies to every stream.
+    # Every stream is held to the BASELINE.json bar with no loosening.
+    # rgb: decode → resize → crop → I3D is deterministic and measures
+    # ~1e-6 (any regression in the frame pipeline fails this hard).
+    # flow: passes through the uint8 quantization stage (clamp ±20 →
+    # round(128 + 255/40·x)); the seeded weights are shaped so the flow
+    # field has realistic magnitude (see reference_pipeline.
+    # build_reference_nets) and the quantized comparison measures pipeline
+    # parity rather than clamp-boundary rounding artifacts. The
+    # un-quantized flow path is additionally held to the strict bar
+    # end-to-end by test_raft_flow_e2e_golden below.
     assert rels['rgb'] < REL_L2_TARGET, f'rgb rel L2: {rels}'
-    assert rels['flow'] < 5 * REL_L2_TARGET, f'flow rel L2: {rels}'
-    assert rels['concat'] < 5 * REL_L2_TARGET, f'concat rel L2: {rels}'
+    assert rels['flow'] < REL_L2_TARGET, f'flow rel L2: {rels}'
+    assert rels['concat'] < REL_L2_TARGET, f'concat rel L2: {rels}'
 
 
 def test_r21d_e2e_golden(reference_repo, video_33, tmp_path):
@@ -208,6 +207,52 @@ def test_resnet_e2e_golden(reference_repo, video_33, tmp_path):
     rel = _rel_l2(ours, ref)
     print(f'[golden e2e] resnet rel L2: {rel}')
     assert rel < REL_L2_TARGET, f'resnet e2e rel L2 {rel}'
+
+
+@pytest.fixture(scope='module')
+def real_audio_wav(sample_video, tmp_path_factory):
+    """A 16 kHz 16-bit PCM wav with real audio content (shared builder:
+    reference_pipeline.write_real_audio_wav). Both pipelines read this
+    identical file, so the wav's provenance does not affect the parity
+    measurement — only realism."""
+    from tests.reference_pipeline import write_real_audio_wav
+
+    return write_real_audio_wav(
+        str(tmp_path_factory.mktemp('aud') / 'real_audio_16k.wav'),
+        source_video=sample_video)
+
+
+def test_vggish_e2e_golden(reference_repo, real_audio_wav, tmp_path):
+    """vggish family end-to-end: whole-file (Ta, 128) output vs the
+    reference's own mel_features + framing + the state-dict-matched VGG
+    (reference extract_vggish.py:31-62 at the .wav entry point — the mp4
+    leg needs ffmpeg, absent here; mp4→wav chain parity is covered by
+    tests/test_vggish.py's backend tests)."""
+    import torch
+
+    from tests.reference_pipeline import run_reference_vggish
+    from tests.torch_mirrors import TorchVGGish
+
+    torch.manual_seed(0)
+    net = TorchVGGish().eval()
+    ckpt = tmp_path / 'vggish_seeded.pt'
+    torch.save(net.state_dict(), str(ckpt))
+
+    ref = run_reference_vggish(real_audio_wav, net)
+
+    args = load_config('vggish', overrides={
+        'video_paths': real_audio_wav, 'device': 'cpu',
+        'precision': 'highest',
+        'checkpoint_path': str(ckpt),
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ours = create_extractor(args).extract(real_audio_wav)['vggish']
+
+    assert ours.shape == ref.shape and ref.shape[1] == 128
+    assert ref.shape[0] >= 5, 'fixture should yield several 0.96 s examples'
+    rel = _rel_l2(ours, ref)
+    print(f'[golden e2e] vggish rel L2: {rel}')
+    assert rel < REL_L2_TARGET, f'vggish e2e rel L2 {rel}'
 
 
 def test_raft_flow_e2e_golden(reference_repo, video_33, tmp_path):

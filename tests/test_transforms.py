@@ -58,13 +58,28 @@ def test_normalize():
     np.testing.assert_allclose(out, 0)
 
 
-def test_flow_uint8_quantization_matches_reference_recipe():
-    # reference transforms.py:168-176: clamp ±20, (x+20)/40*255, round
-    flow = np.array([-25.0, -20.0, 0.0, 10.0, 20.0, 30.0], np.float32)
+def test_flow_uint8_quantization_matches_reference_recipe(reference_repo):
+    """Bit-match the reference's ACTUAL ToUInt8 (transforms.py:175:
+    round(128 + 255/40·x) — offset 128, NOT the symmetric 127.5 its own
+    docstring suggests; a 127.5 offset shifts ~half of all pixels one
+    level and cost ~3e-3 E2E flow-feature drift before round 3 caught it).
+    Probe values sit just off half-level boundaries where the two offsets
+    disagree, plus the exact clamp edges."""
+    import torch
+
+    from models.transforms import Clamp, ToUInt8
+
+    rng = np.random.RandomState(0)
+    flow = np.concatenate([
+        np.array([-25.0, -20.0, 0.0, 10.0, 20.0, 30.0], np.float32),
+        (rng.rand(4096).astype(np.float32) * 50 - 25),
+        # values whose 6.375·x fraction is near 0.5 (offset-sensitive)
+        (np.arange(-127, 128) + 0.499).astype(np.float32) * (40 / 255.0),
+    ])
     out = np.asarray(flow_to_uint8_levels(flow, 20.0))
-    expected = np.round((np.clip(flow, -20, 20) + 20) / 40 * 255)
+    with torch.no_grad():
+        expected = ToUInt8()(Clamp(-20, 20)(torch.from_numpy(flow))).numpy()
     np.testing.assert_array_equal(out, expected)
-    assert out.min() >= 0 and out.max() <= 255
 
 
 def test_resize_bilinear_scale_matches_torch_scale_factor():

@@ -107,12 +107,21 @@ def clamp(x: Array, min_val: float, max_val: float) -> Array:
 def flow_to_uint8_levels(x: Array, bound: float = 20.0) -> Array:
     """Flow [-bound, bound] → quantized [0, 255] levels then back to float.
 
-    The kinetics-i3d flow recipe (reference transforms.py:168-176 `ToUInt8`):
-    round((x + bound) / (2 * bound) * 255), keeping float dtype so the
-    subsequent ScaleTo1_1 sees the same values torch's uint8 tensor held.
+    The kinetics-i3d flow recipe, bit-matching reference transforms.py:175
+    `ToUInt8`: ``round(128 + 255/(2·bound)·x)`` — the OFFSET IS 128, not the
+    symmetric 127.5 a textbook quantizer (or the reference's own
+    "[-20, 20] -> [0, 255]" comment) would suggest, so zero flow lands
+    exactly on level 128 and the clamp bounds map to the half-open 0.5 /
+    255.5 rounding edges. Using 127.5 here shifts ~half of ALL pixels one
+    level (wherever frac(6.375·x) < 0.5) — a systematic ~3e-3 feature
+    drift through the flow tower that round-2's golden misattributed to
+    random-weight quantization noise. Keeps float dtype so the subsequent
+    ScaleTo1_1 sees the same values torch's tensor held (including 256.0
+    for exactly-saturated positive flow, which torch's round-half-even
+    produces and never re-clips).
     """
     x = jnp.clip(x, -bound, bound)
-    return jnp.round((x + bound) * (255.0 / (2.0 * bound)))
+    return jnp.round(128.0 + x * (255.0 / (2.0 * bound)))
 
 
 def resize_pil(frame: np.ndarray, size: int,
