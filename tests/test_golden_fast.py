@@ -1,0 +1,84 @@
+"""FAST-lane flagship golden: the full i3d two-stream composition, reduced.
+
+tests/test_golden_e2e.py holds the full-geometry (T, 2048) golden but runs
+only in the slow lane (~10 CPU-minutes); this variant guards the SAME
+composition — decode → resize 256 → 17-frame window → RAFT → crop → clamp →
+uint8 quantize → both I3D towers → concat → .npy — against the reference
+pipeline on every fast-lane run, cut down where the reference's own knobs
+allow: one stack (17 frames) and raft_iters=4 (reference
+raft_src/raft.py:118 `iters` parameter; spatial geometry cannot shrink —
+the reference I3D's fixed avg_pool3d(2,7,7) needs the 224 crop).
+"""
+import numpy as np
+import pytest
+
+from video_features_tpu.config import load_config
+from video_features_tpu.registry import create_extractor
+
+REL_L2_TARGET = 1e-3
+RAFT_ITERS = 4
+
+
+@pytest.fixture(scope='module')
+def video_17(tmp_path_factory):
+    """Exactly one stack_size=16 window (17 frames)."""
+    import cv2
+
+    from tests.conftest import REFERENCE_ROOT
+
+    src = REFERENCE_ROOT / 'sample' / 'v_ZNVhz7ctTq0.mp4'
+    if not src.exists():
+        pytest.skip('sample video unavailable')
+    out = str(tmp_path_factory.mktemp('vids17') / 'clip17.mp4')
+    cap = cv2.VideoCapture(str(src))
+    fps = cap.get(cv2.CAP_PROP_FPS)
+    w = int(cap.get(cv2.CAP_PROP_FRAME_WIDTH))
+    h = int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
+    writer = cv2.VideoWriter(out, cv2.VideoWriter_fourcc(*'mp4v'), fps, (w, h))
+    for _ in range(17):
+        ok, frame = cap.read()
+        assert ok
+        writer.write(frame)
+    cap.release()
+    writer.release()
+    return out
+
+
+def test_i3d_two_stream_golden_reduced(reference_repo, video_17, tmp_path):
+    from tests.reference_pipeline import (
+        build_reference_nets, run_reference_i3d, save_state_dicts,
+    )
+
+    nets = build_reference_nets(seed=0)
+    ckpts = save_state_dicts(nets, tmp_path / 'ckpts')
+    ref = run_reference_i3d(video_17, nets, stack_size=16,
+                            raft_iters=RAFT_ITERS)
+
+    args = load_config('i3d', overrides={
+        'video_paths': video_17, 'device': 'cpu', 'precision': 'highest',
+        'decode_backend': 'cv2', 'stack_size': 16, 'step_size': 16,
+        'raft_iters': RAFT_ITERS, 'batch_size': 1,
+        'concat_rgb_flow': True, 'on_extraction': 'save_numpy',
+        'i3d_rgb_checkpoint_path': ckpts['rgb'],
+        'i3d_flow_checkpoint_path': ckpts['flow'],
+        'raft_checkpoint_path': ckpts['raft'],
+        'output_path': str(tmp_path / 'out'),
+        'tmp_path': str(tmp_path / 'tmp'),
+    })
+    ex = create_extractor(args)
+    ex._extract(video_17)                       # the full CLI save path
+
+    from video_features_tpu.utils.output import make_path
+    out = np.load(make_path(args.output_path, video_17, 'rgb', '.npy'))
+
+    expected = np.concatenate([ref['rgb'], ref['flow']], axis=-1)
+    assert out.shape == expected.shape == (1, 2048)
+    rels = {'concat': np.linalg.norm(out - expected)
+            / np.linalg.norm(expected)}
+    for i, stream in enumerate(('rgb', 'flow')):
+        seg = out[:, i * 1024:(i + 1) * 1024]
+        rels[stream] = (np.linalg.norm(seg - ref[stream])
+                        / np.linalg.norm(ref[stream]))
+    print(f'[golden fast] rel L2: {rels}')
+    for k, v in rels.items():
+        assert v < REL_L2_TARGET, f'{k} rel L2: {rels}'

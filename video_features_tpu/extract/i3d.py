@@ -48,19 +48,23 @@ def rgb_stream_input(stacks, crop_size):
 
 
 def flow_stream_input(raft_params, stacks, pads, crop_size,
-                      constrain_pairs=None, platform=None, pins=None):
+                      constrain_pairs=None, platform=None, pins=None,
+                      raft_iters=raft_model.ITERS):
     """(B, S+1, H, W, 3) frames → quantized flow I3D input (B, S, c, c, 2).
 
     RAFT on /8-padded consecutive pairs (each interior frame's fnet
     encoding shared between its two pairs — raft.forward_stack_pairs), then
     the kinetics-i3d flow recipe: crop the PADDED flow (the reference never
     unpads before TensorCenterCrop, extract_i3d.py:156-164) → clamp ±20 →
-    uint8 levels → ±1 rescale.
+    uint8 levels → ±1 rescale. ``raft_iters`` trades refinement quality for
+    speed (the reference's own RAFT default was 12 before the fork pinned
+    20, raft_src/raft.py:117-118).
     """
     t, b, l, r = pads
     padded = jnp.pad(stacks, [(0, 0), (0, 0), (t, b), (l, r), (0, 0)],
                      mode='edge')
     flow = raft_model.forward_stack_pairs(raft_params, padded,
+                                          iters=raft_iters,
                                           constrain=constrain_pairs,
                                           platform=platform, pins=pins)
     flow = center_crop(flow, crop_size)
@@ -68,7 +72,8 @@ def flow_stream_input(raft_params, stacks, pads, crop_size,
 
 
 def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
-                          crop_size=CROP_SIZE, platform=None, pins=None):
+                          crop_size=CROP_SIZE, platform=None, pins=None,
+                          raft_iters=raft_model.ITERS):
     """(B, stack+1, H, W, 3) float frames → {stream: (B, 1024)}.
 
     The full two-stream graph — RAFT flow, quantization, both I3D towers —
@@ -89,7 +94,7 @@ def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
     if 'flow' in streams:
         flow = flow_stream_input(params['raft'], stacks, pads, crop_size,
                                  constrain_pairs, platform=platform,
-                                 pins=pins)
+                                 pins=pins, raft_iters=raft_iters)
         with pin_scope(pins, 'i3d'):
             out['flow'] = i3d_model.forward(params['flow'], flow,
                                             features=True)
@@ -143,6 +148,8 @@ class ExtractI3D(BaseExtractor):
             raise NotImplementedError('only flow_type=raft is supported')
         self.stack_size = 64 if args.stack_size is None else args.stack_size
         self.step_size = 64 if args.step_size is None else args.step_size
+        # refinement-depth knob; 20 = the fork's pin = full parity
+        self.raft_iters = int(args.get('raft_iters') or raft_model.ITERS)
         self.extraction_fps = args.extraction_fps
         self.batch_size = args.get('batch_size', 1)
         self.decode_workers = int(args.get('decode_workers', 1))
@@ -172,7 +179,7 @@ class ExtractI3D(BaseExtractor):
             self._put_batch = partial(put_batch, self.mesh)
             sharded = build_sharded_two_stream_step(
                 self.mesh, streams=tuple(self.streams),
-                pins=self.precision_pins)
+                pins=self.precision_pins, raft_iters=self.raft_iters)
 
             def _step(params, stacks, pads, streams):
                 return sharded(params, stacks, pads)
@@ -185,7 +192,8 @@ class ExtractI3D(BaseExtractor):
             # dispatch (not the process default backend)
             self._step = jax.jit(
                 partial(self._stack_batch, platform=self._device.platform,
-                        pins=self.precision_pins),
+                        pins=self.precision_pins,
+                        raft_iters=self.raft_iters),
                 static_argnames=('pads', 'streams'))
 
     def load_params(self, args):

@@ -5,7 +5,11 @@ extractor (reference models/raft/raft_src/ — raft.py, extractor.py, update.py,
 corr.py). TPU-native design choices:
 
   * the 20 recurrent GRU iterations are a single ``lax.scan`` body compiled
-    once (reference loops in python, raft.py:153-171);
+    once (reference loops in python, raft.py:153-171), with two exact-math
+    FLOP cuts: the context encoder's loop-invariant contribution to every
+    GRU conv is hoisted out of the scan (see :func:`fuse_gru_params`), and
+    the convex-upsample mask head runs once after the scan instead of per
+    iteration (only the final mask is ever consumed);
   * the all-pairs correlation volume is one batched matmul
     (B, H·W, H·W)/√dim (corr.py:53-60) and its 4-level pyramid lives as four
     arrays closed over by the scan;
@@ -223,35 +227,72 @@ def motion_encoder(p: Params, flow: jax.Array, corr: jax.Array) -> jax.Array:
     return jnp.concatenate([out, flow], -1)
 
 
-def fuse_gru_params(p: Params) -> Params:
-    """Stack each direction's z/r gate conv weights on the output axis.
+GRU_PADS = (('1', ((0, 0), (2, 2))), ('2', ((2, 2), (0, 0))))
 
-    The z and r gates read the same ``hx`` input, so one conv with stacked
-    output channels computes both — identical per-channel math (each output
-    channel's reduction is independent), half the ``hx`` HBM reads. Done
-    once before the GRU scan so the concat provably never re-runs per
-    iteration.
+
+def fuse_gru_params(p: Params, hidden: int = HIDDEN_DIM,
+                    context: int = CONTEXT_DIM) -> Params:
+    """Restructure the six GRU conv weights for the scan body, once.
+
+    Two exact-math transforms (reference math: update.py:39-77):
+
+      * the z and r gates read the same input, so each direction's z/r
+        weights stack on the OUTPUT axis — one conv computes both gates
+        (independent per-output-channel reductions), halving that input's
+        HBM reads;
+      * every GRU conv's INPUT channels split as (h | inp | motion), and
+        the ``inp`` block — the context encoder's half, reference
+        raft.py:139-143 — is LOOP-INVARIANT across the 20 refinement
+        iterations. Conv is linear in input channels, so the inp
+        contribution is a per-pixel constant computed once before the scan
+        (:func:`gru_inp_terms`); the per-iteration convs then contract 256
+        channels instead of 384 — a third of the GRU FLOPs deleted from
+        the scan with identical math (the q conv's input is
+        ``concat(r·h, x)``: the r gate never multiplies the inp block, so
+        its term is invariant too).
     """
     out = {}
-    for suffix in ('1', '2'):
+    sl_h = slice(0, hidden)
+    sl_i = slice(hidden, hidden + context)
+    sl_m = slice(hidden + context, None)
+    for suffix, _ in GRU_PADS:
         zw, rw = p[f'convz{suffix}'], p[f'convr{suffix}']
-        out[f'convzr{suffix}'] = {
-            'weight': jnp.concatenate([zw['weight'], rw['weight']], axis=-1),
-            'bias': jnp.concatenate([zw['bias'], rw['bias']]),
-        }
-        out[f'convq{suffix}'] = p[f'convq{suffix}']
+        w = jnp.concatenate([zw['weight'], rw['weight']], axis=-1)
+        b = jnp.concatenate([zw['bias'], rw['bias']])
+        qw = p[f'convq{suffix}']['weight']
+        out[f'zr{suffix}'] = {
+            'hm': jnp.concatenate([w[:, :, sl_h], w[:, :, sl_m]], axis=2),
+            'inp': w[:, :, sl_i], 'bias': b}
+        out[f'q{suffix}'] = {
+            'hm': jnp.concatenate([qw[:, :, sl_h], qw[:, :, sl_m]], axis=2),
+            'inp': qw[:, :, sl_i], 'bias': p[f'convq{suffix}']['bias']}
     return out
 
 
-def sep_conv_gru(p: Params, h: jax.Array, x: jax.Array) -> jax.Array:
+def gru_inp_terms(fused: Params, inp: jax.Array) -> Params:
+    """The loop-invariant context contribution to all four GRU convs
+    (+ their biases), computed once before the refinement scan."""
+    terms = {}
+    for suffix, pad in GRU_PADS:
+        for gate in ('zr', 'q'):
+            pp = fused[f'{gate}{suffix}']
+            terms[f'{gate}{suffix}'] = conv(inp, pp['inp'], padding=list(pad),
+                                            bias=pp['bias'])
+    return terms
+
+
+def sep_conv_gru(fused: Params, terms: Params, h: jax.Array,
+                 motion: jax.Array) -> jax.Array:
     """SepConvGRU (reference update.py:39-77): 1×5 then 5×1 passes over
-    :func:`fuse_gru_params`-prepared weights."""
-    for suffix, pad in (('1', [(0, 0), (2, 2)]), ('2', [(2, 2), (0, 0)])):
-        hx = jnp.concatenate([h, x], -1)
-        zr = jax.nn.sigmoid(_conv_b(p[f'convzr{suffix}'], hx, padding=pad))
+    :func:`fuse_gru_params`-prepared weights + precomputed context terms."""
+    for suffix, pad in GRU_PADS:
+        hm = jnp.concatenate([h, motion], -1)
+        zr = jax.nn.sigmoid(conv(hm, fused[f'zr{suffix}']['hm'],
+                                 padding=list(pad)) + terms[f'zr{suffix}'])
         z, r = jnp.split(zr, 2, axis=-1)
-        q = jnp.tanh(_conv_b(p[f'convq{suffix}'],
-                             jnp.concatenate([r * h, x], -1), padding=pad))
+        q = jnp.tanh(conv(jnp.concatenate([r * h, motion], -1),
+                          fused[f'q{suffix}']['hm'], padding=list(pad))
+                     + terms[f'q{suffix}'])
         h = (1 - z) * h + z * q
     return h
 
@@ -461,16 +502,10 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
     else:
         lookup = partial(lookup_corr_dense, pyramid)
 
-    # The flow head's conv1 and the mask head's first conv both read
-    # net_new through a 3x3 conv + relu — fuse them with stacked output
-    # channels (independent per-channel math; the weight concat is
-    # loop-invariant and hoists out of the scan), halving that read.
     fh, mk = up['flow_head'], up['mask']
-    head_w = jnp.concatenate([fh['conv1']['weight'], mk['0']['weight']],
-                             axis=-1)
-    head_b = jnp.concatenate([fh['conv1']['bias'], mk['0']['bias']])
-    head_split = fh['conv1']['weight'].shape[-1]
     gru = fuse_gru_params(up['gru'])
+    with pin_scope(pins, 'iter'):
+        gru_terms = gru_inp_terms(gru, inp)
 
     def make_step(early_prec=None):
         """Scan body; ``early_prec`` overrides the WHOLE body's matmul
@@ -480,20 +515,17 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
             outer = (jax.default_matmul_precision(early_prec)
                      if early_prec else nullcontext())
             with outer:
-                net, coords1, _ = carry
+                net, coords1 = carry
                 with pin_scope(pins, 'corr'):
                     corr = lookup(coords1)
                 flow = coords1 - coords0
                 with pin_scope(pins, 'iter'):
                     motion = motion_encoder(up['encoder'], flow, corr)
-                    net_new = sep_conv_gru(gru, net,
-                                           jnp.concatenate([inp, motion], -1))
-                    t = relu(conv(net_new, head_w, padding=1, bias=head_b))
-                    t_flow, t_mask = jnp.split(t, [head_split], axis=-1)
-                    delta = _conv_b(fh['conv2'], t_flow, padding=1)
+                    net_new = sep_conv_gru(gru, gru_terms, net, motion)
+                    t = relu(_conv_b(fh['conv1'], net_new, padding=1))
+                    delta = _conv_b(fh['conv2'], t, padding=1)
                     coords1_new = coords1 + delta
-                    mask = 0.25 * _conv_b(mk['2'], t_mask)
-            return (net_new, coords1_new, mask), None
+            return (net_new, coords1_new), None
         return step
 
     # 'iter_early' pin ('<precision>:<n>') runs the FIRST n refinement
@@ -506,13 +538,21 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
             early_prec, _, n = str(val).partition(':')
             early_n = min(int(n or 0), iters)
 
-    mask0 = jnp.zeros((B, H8, W8, 576), net.dtype) + jnp.zeros_like(net[..., :1])
-    carry = (net, coords0, mask0)
+    carry = (net, coords0)
     if early_n:
         carry, _ = lax.scan(make_step(early_prec), carry, None,
                             length=early_n)
-    (net, coords1, mask), _ = lax.scan(make_step(), carry, None,
-                                       length=iters - early_n)
+    (net, coords1), _ = lax.scan(make_step(), carry, None,
+                                 length=iters - early_n)
+    # Convex-upsample mask head, ONCE after the scan: the reference
+    # computes `.25·mask(net)` every iteration (update.py:139-144) but the
+    # extractor consumes only the final flow (raft.py:153-175 predictions
+    # [-1]) — every non-final mask is dead code, so 19/20 of the mask
+    # head's FLOPs (a 3×3 128→256 + 1×1 256→576 stack) leave the scan
+    # with bit-identical output.
+    with pin_scope(pins, 'iter'):
+        t_mask = relu(_conv_b(mk['0'], net, padding=1))
+        mask = 0.25 * _conv_b(mk['2'], t_mask)
     with pin_scope(pins, 'upsample'):
         return upsample_flow(coords1 - coords0, mask)
 

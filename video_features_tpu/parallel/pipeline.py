@@ -29,7 +29,7 @@ from video_features_tpu.parallel.mesh import (
 def build_sharded_two_stream_step(mesh: Mesh,
                                   streams: Tuple[str, ...] = ('rgb', 'flow'),
                                   donate_stacks: bool = False,
-                                  pins=None):
+                                  pins=None, raft_iters=None):
     """jit-compiled ``step(params, stacks, pads, crop_size=…)`` over ``mesh``.
 
     ``stacks`` is (B, stack+1, H, W, 3) with B divisible by the data-axis
@@ -47,10 +47,11 @@ def build_sharded_two_stream_step(mesh: Mesh,
     platform = mesh.devices.flat[0].platform
 
     def step(params, stacks, pads, crop_size):
+        kw = {} if raft_iters is None else {'raft_iters': raft_iters}
         return fused_two_stream_step(params, stacks, pads, streams,
                                      constrain_pairs=constrain_pairs,
                                      crop_size=crop_size, platform=platform,
-                                     pins=pins)
+                                     pins=pins, **kw)
 
     jitted = jax.jit(
         step,
