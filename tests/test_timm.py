@@ -266,3 +266,67 @@ def test_pip_timm_bridge_end_to_end(short_video, tmp_path):
     out = create_extractor(args).extract(short_video)
     assert out['timm'].shape[1] == 192
     assert np.isfinite(out['timm']).all()
+
+
+def test_swin_parity_vs_torch_mirror():
+    """Swin numerics vs the timm-0.9.12-layout mirror: windowed attention
+    with relative position bias, SHIFTED windows with the -100 additive
+    mask (blocks 1,3,...), stage-start PatchMerging, NHWC final norm+pool.
+    192px input makes stage maps (48,24,12,6): stage-3 maps smaller than
+    the window exercise the window-collapse rule, and stage-2 exercises
+    the real shift mask."""
+    import jax
+
+    from tests.torch_mirrors import TorchSwin
+    from video_features_tpu.models import swin as swin_model
+
+    torch.manual_seed(0)
+    mirror = TorchSwin('swin_tiny_patch4_window7_224', num_classes=5,
+                       img_size=192).eval()
+    params = transplant(mirror.state_dict())
+
+    x = np.random.RandomState(1).rand(2, 192, 192, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+        ref_logits = mirror(xt).numpy()
+        mirror.head.fc = torch.nn.Identity()
+        ref = mirror(xt).numpy()
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(swin_model.forward(
+            params, x, arch='swin_tiny_patch4_window7_224'))
+        got_logits = np.asarray(swin_model.forward(
+            params, x, arch='swin_tiny_patch4_window7_224', features=False))
+
+    assert got.shape == ref.shape == (2, 768)
+    for ours, theirs in ((got, ref), (got_logits, ref_logits)):
+        rel = np.linalg.norm(ours - theirs) / np.linalg.norm(theirs)
+        assert rel < 1e-3, f'rel L2 {rel}'
+
+
+def test_swin_state_dict_keys_match_mirror():
+    """init_state_dict emits exactly the timm persistent key set (the
+    non-persistent index/mask buffers excluded) so real checkpoints load
+    into the same tree."""
+    from tests.torch_mirrors import TorchSwin
+    from video_features_tpu.models import swin as swin_model
+
+    ours = set(swin_model.init_state_dict('swin_small_patch4_window7_224'))
+    theirs = set(TorchSwin('swin_small_patch4_window7_224').state_dict())
+    theirs = {k for k in theirs if 'relative_position_index' not in k}
+    assert ours == theirs
+
+
+@pytest.mark.slow
+def test_swin_extractor_e2e(short_video, tmp_path):
+    args = load_config('timm', overrides={
+        'video_paths': short_video, 'device': 'cpu', 'batch_size': 16,
+        'model_name': 'swin_tiny_patch4_window7_224',
+        'allow_random_weights': True, 'extraction_fps': 2,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    assert ex.data_cfg['resize'] == 248
+    out = ex.extract(short_video)
+    assert out['timm'].shape[1] == 768
+    assert out['timm'].shape[0] > 0
+    assert np.isfinite(out['timm']).all()

@@ -249,3 +249,213 @@ class TorchConvNeXt(nn.Module):
         x = self.stages(self.stem(x)).mean(dim=(2, 3))
         x = self.head.norm(x)
         return x if features else self.head.fc(x)
+
+
+# ---------------------------------------------------------------- vggish --
+
+
+class TorchVGGish(nn.Module):
+    """The reference VGG audio net (vggish_slim.py:15-37,100-111): conv
+    feature stack + channels-last flatten + 3-layer FC embeddings. Same
+    state_dict keys as the harritaylor/torchvggish checkpoint the reference
+    downloads, so real weights load unchanged.
+    """
+
+    def __init__(self):
+        super().__init__()
+        layers, in_ch = [], 1
+        for v in [64, 'M', 128, 'M', 256, 256, 'M', 512, 512, 'M']:
+            if v == 'M':
+                layers.append(nn.MaxPool2d(2, 2))
+            else:
+                layers.append(nn.Conv2d(in_ch, v, 3, padding=1))
+                layers.append(nn.ReLU())
+                in_ch = v
+        self.features = nn.Sequential(*layers)
+        self.embeddings = nn.Sequential(
+            nn.Linear(512 * 4 * 6, 4096), nn.ReLU(),
+            nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, 128), nn.ReLU())
+
+    def forward(self, x):
+        # (B, 1, 96, 64) NCHW → NHWC flatten before the FCs (the
+        # tensorflow-era layout quirk the reference preserves)
+        h = self.features(x)
+        h = h.transpose(1, 3).transpose(1, 2).contiguous()
+        return self.embeddings(h.view(h.size(0), -1))
+
+
+# ------------------------------------------------------------------ swin --
+
+
+def _swin_rel_index(wh, ww):
+    import numpy as np
+    coords = np.stack(np.meshgrid(np.arange(wh), np.arange(ww),
+                                  indexing='ij'))
+    flat = coords.reshape(2, -1)
+    rel = flat[:, :, None] - flat[:, None, :]
+    rel = rel.transpose(1, 2, 0).copy()
+    rel[:, :, 0] += wh - 1
+    rel[:, :, 1] += ww - 1
+    rel[:, :, 0] *= 2 * ww - 1
+    return torch.from_numpy(rel.sum(-1)).long()
+
+
+class _SwinWindowAttention(nn.Module):
+    def __init__(self, dim, num_heads, window):
+        super().__init__()
+        self.num_heads = num_heads
+        self.window = window
+        self.relative_position_bias_table = nn.Parameter(
+            torch.zeros((2 * window - 1) ** 2, num_heads))
+        self.register_buffer('relative_position_index',
+                             _swin_rel_index(window, window),
+                             persistent=False)
+        self.qkv = nn.Linear(dim, dim * 3)
+        self.proj = nn.Linear(dim, dim)
+
+    def forward(self, x, mask=None):
+        Bn, N, C = x.shape
+        hd = C // self.num_heads
+        qkv = self.qkv(x).reshape(Bn, N, 3, self.num_heads, hd)
+        q, k, v = qkv.permute(2, 0, 3, 1, 4).unbind(0)      # (Bn, H, N, hd)
+        attn = (q * hd ** -0.5) @ k.transpose(-2, -1)
+        bias = self.relative_position_bias_table[
+            self.relative_position_index.view(-1)].view(N, N, -1)
+        attn = attn + bias.permute(2, 0, 1)
+        if mask is not None:
+            nw = mask.shape[0]
+            attn = attn.view(Bn // nw, nw, self.num_heads, N, N)
+            attn = attn + mask[None, :, None]
+            attn = attn.view(Bn, self.num_heads, N, N)
+        attn = attn.softmax(dim=-1)
+        x = (attn @ v).transpose(1, 2).reshape(Bn, N, C)
+        return self.proj(x)
+
+
+class _SwinBlock(nn.Module):
+    def __init__(self, dim, num_heads, feat, window, shift):
+        super().__init__()
+        self.feat = feat
+        self.window = tuple(f if f <= window else window for f in feat)
+        self.shift = tuple(0 if f <= w else (window // 2 if shift else 0)
+                           for f, w in zip(feat, self.window))
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn = _SwinWindowAttention(dim, num_heads, self.window[0])
+        self.norm2 = nn.LayerNorm(dim)
+        self.mlp = nn.Module()
+        self.mlp.fc1 = nn.Linear(dim, 4 * dim)
+        self.mlp.fc2 = nn.Linear(4 * dim, dim)
+        if any(self.shift):
+            wh, ww = self.window
+            sh, sw = self.shift
+            hp = -(-feat[0] // wh) * wh
+            wp = -(-feat[1] // ww) * ww
+            img = torch.zeros(hp, wp)
+            cnt = 0
+            for hs in (slice(0, -wh), slice(-wh, -sh if sh else None),
+                       slice(-sh, None) if sh else slice(0, 0)):
+                for ws_ in (slice(0, -ww), slice(-ww, -sw if sw else None),
+                            slice(-sw, None) if sw else slice(0, 0)):
+                    img[hs, ws_] = cnt
+                    cnt += 1
+            win = (img.view(hp // wh, wh, wp // ww, ww)
+                   .permute(0, 2, 1, 3).reshape(-1, wh * ww))
+            diff = win[:, None, :] - win[:, :, None]
+            mask = torch.where(diff != 0, torch.tensor(-100.0),
+                               torch.tensor(0.0))
+            self.register_buffer('attn_mask', mask, persistent=False)
+        else:
+            self.attn_mask = None
+
+    def _attn_part(self, x):
+        B, H, W, C = x.shape
+        wh, ww = self.window
+        sh, sw = self.shift
+        if sh or sw:
+            x = torch.roll(x, shifts=(-sh, -sw), dims=(1, 2))
+        pad_h = (wh - H % wh) % wh
+        pad_w = (ww - W % ww) % ww
+        x = F.pad(x, (0, 0, 0, pad_w, 0, pad_h))
+        Hp, Wp = H + pad_h, W + pad_w
+        wins = (x.view(B, Hp // wh, wh, Wp // ww, ww, C)
+                .permute(0, 1, 3, 2, 4, 5).reshape(-1, wh * ww, C))
+        wins = self.attn(wins, self.attn_mask)
+        x = (wins.view(B, Hp // wh, Wp // ww, wh, ww, C)
+             .permute(0, 1, 3, 2, 4, 5).reshape(B, Hp, Wp, C))
+        x = x[:, :H, :W]
+        if sh or sw:
+            x = torch.roll(x, shifts=(sh, sw), dims=(1, 2))
+        return x
+
+    def forward(self, x):
+        x = x + self._attn_part(self.norm1(x))
+        h = self.mlp.fc2(F.gelu(self.mlp.fc1(self.norm2(x))))
+        return x + h
+
+
+class _SwinPatchMerging(nn.Module):
+    def __init__(self, in_dim, out_dim):
+        super().__init__()
+        self.norm = nn.LayerNorm(4 * in_dim)
+        self.reduction = nn.Linear(4 * in_dim, out_dim, bias=False)
+
+    def forward(self, x):
+        B, H, W, C = x.shape
+        x = F.pad(x, (0, 0, 0, W % 2, 0, H % 2))
+        _, H, W, _ = x.shape
+        x = (x.reshape(B, H // 2, 2, W // 2, 2, C)
+             .permute(0, 1, 3, 4, 2, 5).flatten(3))
+        return self.reduction(self.norm(x))
+
+
+class TorchSwin(nn.Module):
+    """timm 0.9.12 SwinTransformer mirror (same module tree / state_dict
+    keys: stage-START PatchMerging, NHWC blocks, non-persistent
+    index/mask buffers, `head.fc`). Reference consumes it through pip-timm
+    (models/timm/extract_timm.py:48, conda_env.yml timm==0.9.12)."""
+
+    CFGS = {
+        'swin_tiny_patch4_window7_224': (96, (2, 2, 6, 2), (3, 6, 12, 24)),
+        'swin_small_patch4_window7_224': (96, (2, 2, 18, 2), (3, 6, 12, 24)),
+        'swin_base_patch4_window7_224': (128, (2, 2, 18, 2), (4, 8, 16, 32)),
+    }
+
+    def __init__(self, arch='swin_tiny_patch4_window7_224', num_classes=0,
+                 img_size=224, patch=4, window=7):
+        super().__init__()
+        C0, depths, heads = self.CFGS[arch]
+        self.patch = patch
+        self.patch_embed = nn.Module()
+        self.patch_embed.proj = nn.Conv2d(3, C0, patch, patch)
+        self.patch_embed.norm = nn.LayerNorm(C0)
+        feat = img_size // patch
+        self.layers = nn.ModuleList()
+        for i, depth in enumerate(depths):
+            dim = C0 * 2 ** i
+            if i > 0:
+                feat //= 2
+            stage = nn.Module()
+            stage.downsample = (_SwinPatchMerging(dim // 2, dim) if i > 0
+                                else nn.Identity())
+            stage.blocks = nn.ModuleList([
+                _SwinBlock(dim, heads[i], (feat, feat), window,
+                           shift=bool(j % 2))
+                for j in range(depth)])
+            self.layers.append(stage)
+        self.norm = nn.LayerNorm(C0 * 8)
+        self.head = nn.Module()
+        self.head.fc = (nn.Linear(C0 * 8, num_classes) if num_classes
+                        else nn.Identity())
+
+    def forward(self, x):
+        x = self.patch_embed.proj(x)                        # (B, C, H, W)
+        x = x.permute(0, 2, 3, 1)                           # NHWC
+        x = self.patch_embed.norm(x)
+        for stage in self.layers:
+            x = stage.downsample(x)
+            for blk in stage.blocks:
+                x = blk(x)
+        x = self.norm(x)
+        x = x.mean(dim=(1, 2))
+        return self.head.fc(x)

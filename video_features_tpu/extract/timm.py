@@ -24,6 +24,7 @@ import numpy as np
 from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
 from video_features_tpu.models import convnext as convnext_model
 from video_features_tpu.models import resnet as resnet_model
+from video_features_tpu.models import swin as swin_model
 from video_features_tpu.models import vit as vit_model
 from video_features_tpu.ops.transforms import (
     center_crop_host, normalize, resize_pil, to_float_zero_one,
@@ -46,6 +47,10 @@ def _data_cfg(family: str) -> Dict[str, Any]:
         # timm convnext default_cfg: crop_pct 0.875, bicubic, ImageNet stats
         return dict(resize=256, crop=224, interpolation='bicubic',
                     mean=convnext_model.MEAN, std=convnext_model.STD)
+    if family == 'swin':
+        # timm swin default_cfg: crop_pct 0.9, bicubic, ImageNet stats
+        return dict(resize=248, crop=224, interpolation='bicubic',
+                    mean=swin_model.MEAN, std=swin_model.STD)
     return dict(resize=256, crop=224, interpolation='bilinear',
                 mean=resnet_model.MEAN, std=resnet_model.STD)
 
@@ -68,6 +73,9 @@ def _registry() -> Dict[str, Dict[str, Any]]:
     for name, cfg in convnext_model.ARCHS.items():
         reg[name] = dict(family='convnext', arch=name,
                          feat_dim=cfg['dims'][-1])
+    for name in swin_model.ARCHS:
+        reg[name] = dict(family='swin', arch=name,
+                         feat_dim=swin_model.feat_dim(name))
     return reg
 
 
@@ -76,7 +84,8 @@ REGISTRY = _registry()
 # family → native model module (deit shares the vit graph; only the data
 # config differs — see _data_cfg)
 _MODEL_MODULES = {'vit': vit_model, 'deit': vit_model,
-                  'resnet': resnet_model, 'convnext': convnext_model}
+                  'resnet': resnet_model, 'convnext': convnext_model,
+                  'swin': swin_model}
 
 
 class ExtractTIMM(BaseFrameWiseExtractor):
@@ -216,7 +225,7 @@ class ExtractTIMM(BaseFrameWiseExtractor):
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         if self.family in ('vit', 'deit'):
             head = self.params.get('head')
-        elif self.family == 'convnext':
+        elif self.family in ('convnext', 'swin'):
             head = (self.params.get('head') or {}).get('fc')
         else:
             head = self.params.get('fc')

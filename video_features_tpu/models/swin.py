@@ -1,0 +1,270 @@
+"""Swin Transformer image backbones (timm `swin_*` state_dict layout).
+
+The reference's timm extractor accepts any pip-timm model (reference
+models/timm/extract_timm.py:48, pinned timm==0.9.12 in conda_env.yml); this
+module natively implements the Swin family — hierarchical windowed
+attention, the structurally-different half of that model space the plain
+ViT/CNN families don't cover — against timm 0.9.12's ``SwinTransformer``
+module tree (``patch_embed.proj``, ``layers.N.downsample.{norm,reduction}``
+at stage START, ``layers.N.blocks.M.{norm1,attn,norm2,mlp}``, ``norm``,
+``head.fc``) so real timm checkpoints transplant mechanically.
+
+TPU-first structure: windows are pure reshape/transpose partitions (no
+gathers), the cyclic shift is ``jnp.roll`` (an XLA collective-permute-
+friendly slice concat), the shifted-window attention mask and the relative-
+position index are trace-time numpy constants folded into the graph, and
+every window attends as one batched (B·nW, 49, 49) dense attention — MXU
+shapes, static bounds. The relative-position bias is the only per-forward
+gather: a (169, heads) table → (heads, 49, 49), microscopic.
+
+Feature semantics match ``num_classes=0`` timm models: global average pool
+over the final-norm NHWC map (reference models/timm/extract_timm.py:59-60).
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from video_features_tpu.ops.nn import conv
+
+Params = Dict[str, Any]
+
+# timm swin default_cfg: 224px, bicubic, crop_pct 0.9, ImageNet stats
+MEAN = (0.485, 0.456, 0.406)
+STD = (0.229, 0.224, 0.225)
+
+ARCHS = {
+    'swin_tiny_patch4_window7_224': dict(
+        embed_dim=96, depths=(2, 2, 6, 2), heads=(3, 6, 12, 24),
+        patch=4, window=7),
+    'swin_small_patch4_window7_224': dict(
+        embed_dim=96, depths=(2, 2, 18, 2), heads=(3, 6, 12, 24),
+        patch=4, window=7),
+    'swin_base_patch4_window7_224': dict(
+        embed_dim=128, depths=(2, 2, 18, 2), heads=(4, 8, 16, 32),
+        patch=4, window=7),
+}
+
+LN_EPS = 1e-5  # timm swin uses the nn.LayerNorm default, not ViT's 1e-6
+
+
+def _layer_norm(x: jax.Array, p: Params) -> jax.Array:
+    mean = x.mean(axis=-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + LN_EPS) * p['weight'] + p['bias']
+
+
+def _linear(x: jax.Array, p: Params) -> jax.Array:
+    y = x @ p['weight']
+    return y + p['bias'] if 'bias' in p else y
+
+
+def _calc_window_shift(feat: Tuple[int, int], window: int,
+                       shift: int) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """timm SwinTransformerBlock._calc_window_shift: a feature map no
+    larger than the window collapses to one unshifted full-map window."""
+    ws = tuple(f if f <= window else window for f in feat)
+    ss = tuple(0 if f <= w else shift for f, w in zip(feat, ws))
+    return ws, ss
+
+
+@lru_cache(maxsize=None)
+def _rel_position_index(wh: int, ww: int) -> np.ndarray:
+    """(wh·ww, wh·ww) gather index into the (2wh-1)(2ww-1) bias table —
+    the standard Swin relative-coordinate flattening (timm
+    get_relative_position_index)."""
+    coords = np.stack(np.meshgrid(np.arange(wh), np.arange(ww),
+                                  indexing='ij'))           # (2, wh, ww)
+    flat = coords.reshape(2, -1)
+    rel = flat[:, :, None] - flat[:, None, :]               # (2, N, N)
+    rel = rel.transpose(1, 2, 0).copy()
+    rel[:, :, 0] += wh - 1
+    rel[:, :, 1] += ww - 1
+    rel[:, :, 0] *= 2 * ww - 1
+    return rel.sum(-1).astype(np.int32)                     # (N, N)
+
+
+@lru_cache(maxsize=None)
+def _shift_attn_mask(h: int, w: int, wh: int, ww: int,
+                     sh: int, sw: int) -> Optional[np.ndarray]:
+    """(nW, N, N) additive mask (0 / -100) keeping shifted-window attention
+    inside original neighborhoods (timm SwinTransformerBlock.__init__),
+    built on the window-padded grid."""
+    if not (sh or sw):
+        return None
+    hp = -(-h // wh) * wh
+    wp = -(-w // ww) * ww
+    img = np.zeros((hp, wp), np.float32)
+    cnt = 0
+    for hs in (slice(0, -wh), slice(-wh, -sh if sh else None),
+               slice(-sh, None) if sh else slice(0, 0)):
+        for ws_ in (slice(0, -ww), slice(-ww, -sw if sw else None),
+                    slice(-sw, None) if sw else slice(0, 0)):
+            img[hs, ws_] = cnt
+            cnt += 1
+    win = (img.reshape(hp // wh, wh, wp // ww, ww)
+           .transpose(0, 2, 1, 3).reshape(-1, wh * ww))     # (nW, N)
+    diff = win[:, None, :] - win[:, :, None]
+    return np.where(diff != 0, -100.0, 0.0).astype(np.float32)
+
+
+def _window_partition(x: jax.Array, wh: int, ww: int) -> jax.Array:
+    """(B, H, W, C) → (B·nW, wh·ww, C), row-major windows."""
+    B, H, W, C = x.shape
+    x = x.reshape(B, H // wh, wh, W // ww, ww, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(-1, wh * ww, C)
+
+
+def _window_reverse(x: jax.Array, wh: int, ww: int, H: int, W: int,
+                    B: int) -> jax.Array:
+    C = x.shape[-1]
+    x = x.reshape(B, H // wh, W // ww, wh, ww, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H, W, C)
+
+
+def _window_attention(p: Params, x: jax.Array, num_heads: int,
+                      wh: int, ww: int,
+                      mask: Optional[np.ndarray]) -> jax.Array:
+    """timm WindowAttention on (B·nW, N, C) windows: qkv → scaled scores +
+    relative-position bias (+ shift mask) → softmax → proj."""
+    Bn, N, C = x.shape
+    hd = C // num_heads
+    qkv = _linear(x, p['qkv']).reshape(Bn, N, 3, num_heads, hd)
+    q, k, v = jnp.moveaxis(qkv, 2, 0)                       # (Bn, N, H, hd)
+    q = q * (hd ** -0.5)
+    scores = jnp.einsum('bnhd,bmhd->bhnm', q, k)            # (Bn, H, N, N)
+    idx = _rel_position_index(wh, ww).reshape(-1)
+    bias = p['relative_position_bias_table'][idx]           # (N·N, H)
+    scores = scores + bias.reshape(N, N, num_heads).transpose(2, 0, 1)
+    if mask is not None:
+        nw = mask.shape[0]
+        scores = scores.reshape(Bn // nw, nw, num_heads, N, N)
+        scores = scores + jnp.asarray(mask)[None, :, None]
+        scores = scores.reshape(Bn, num_heads, N, N)
+    attn = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bhnm,bmhd->bnhd', attn, v).reshape(Bn, N, C)
+    return _linear(out, p['proj'])
+
+
+def _block(p: Params, x: jax.Array, num_heads: int, window: int,
+           shift: bool) -> jax.Array:
+    """timm SwinTransformerBlock on an NHWC map: (shifted-)window attention
+    + MLP, both pre-norm residual."""
+    B, H, W, C = x.shape
+    (wh, ww), (sh, sw) = _calc_window_shift(
+        (H, W), window, window // 2 if shift else 0)
+
+    def attn_part(t):
+        if sh or sw:
+            t = jnp.roll(t, shift=(-sh, -sw), axis=(1, 2))
+        pad_h = (wh - H % wh) % wh
+        pad_w = (ww - W % ww) % ww
+        if pad_h or pad_w:
+            t = jnp.pad(t, [(0, 0), (0, pad_h), (0, pad_w), (0, 0)])
+        Hp, Wp = H + pad_h, W + pad_w
+        wins = _window_partition(t, wh, ww)
+        wins = _window_attention(p['attn'], wins, num_heads, wh, ww,
+                                 _shift_attn_mask(H, W, wh, ww, sh, sw))
+        t = _window_reverse(wins, wh, ww, Hp, Wp, B)[:, :H, :W]
+        if sh or sw:
+            t = jnp.roll(t, shift=(sh, sw), axis=(1, 2))
+        return t
+
+    x = x + attn_part(_layer_norm(x, p['norm1']))
+    h = _layer_norm(x, p['norm2'])
+    h = _linear(h, p['mlp']['fc1'])
+    h = jax.nn.gelu(h, approximate=False)
+    h = _linear(h, p['mlp']['fc2'])
+    return x + h
+
+
+def _patch_merging(p: Params, x: jax.Array) -> jax.Array:
+    """timm PatchMerging: 2×2 neighborhood → channel concat (h-major per
+    column pair) → norm → bias-free halving linear."""
+    B, H, W, C = x.shape
+    if H % 2 or W % 2:
+        x = jnp.pad(x, [(0, 0), (0, H % 2), (0, W % 2), (0, 0)])
+        H, W = H + H % 2, W + W % 2
+    x = x.reshape(B, H // 2, 2, W // 2, 2, C)
+    x = x.transpose(0, 1, 3, 4, 2, 5).reshape(B, H // 2, W // 2, 4 * C)
+    return _linear(_layer_norm(x, p['norm']), p['reduction'])
+
+
+def forward(params: Params, x: jax.Array,
+            arch: str = 'swin_tiny_patch4_window7_224',
+            features: bool = True) -> jax.Array:
+    """(B, H, W, 3) normalized frames → (B, 8·embed_dim) pooled features
+    (or (B, 1000) logits with ``features=False`` and a loaded head)."""
+    cfg = ARCHS[arch]
+    patch, window = cfg['patch'], cfg['window']
+    pe = params['patch_embed']
+    x = conv(x, pe['proj']['weight'], stride=patch, bias=pe['proj']['bias'])
+    x = _layer_norm(x, pe['norm'])                          # (B, H/4, W/4, C)
+
+    for i, depth in enumerate(cfg['depths']):
+        stage = params['layers'][str(i)]
+        if i > 0:                                           # stage-START merge
+            x = _patch_merging(stage['downsample'], x)
+        for j in range(depth):
+            x = _block(stage['blocks'][str(j)], x, cfg['heads'][i],
+                       window, shift=bool(j % 2))
+
+    x = _layer_norm(x, params['norm'])
+    x = x.mean(axis=(1, 2))                                 # NHWC global pool
+    if features or 'head' not in params or 'fc' not in params['head']:
+        return x
+    return _linear(x, params['head']['fc'])
+
+
+def feat_dim(arch: str) -> int:
+    return ARCHS[arch]['embed_dim'] * 8
+
+
+def init_state_dict(arch: str = 'swin_tiny_patch4_window7_224',
+                    seed: int = 0, num_classes: int = 0) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict with timm 0.9.12 swin naming/shapes
+    (relative_position_index / attn_mask are non-persistent buffers there
+    and deliberately absent here — they are derived constants)."""
+    cfg = ARCHS[arch]
+    rng = np.random.RandomState(seed)
+    sd: Dict[str, np.ndarray] = {}
+
+    def lin(name, i, o, bias=True, scale=0.04):
+        sd[f'{name}.weight'] = rng.randn(o, i).astype(np.float32) * scale
+        if bias:
+            sd[f'{name}.bias'] = rng.randn(o).astype(np.float32) * 0.02
+
+    def ln(name, c):
+        sd[f'{name}.weight'] = (rng.rand(c).astype(np.float32) * 0.2 + 0.9)
+        sd[f'{name}.bias'] = rng.randn(c).astype(np.float32) * 0.02
+
+    C0, win = cfg['embed_dim'], cfg['window']
+    sd['patch_embed.proj.weight'] = (
+        rng.randn(C0, 3, cfg['patch'], cfg['patch']).astype(np.float32) * 0.05)
+    sd['patch_embed.proj.bias'] = rng.randn(C0).astype(np.float32) * 0.02
+    ln('patch_embed.norm', C0)
+
+    for i, depth in enumerate(cfg['depths']):
+        dim = C0 * 2 ** i
+        if i > 0:
+            ln(f'layers.{i}.downsample.norm', 2 * dim)
+            lin(f'layers.{i}.downsample.reduction', 2 * dim, dim, bias=False)
+        heads = cfg['heads'][i]
+        for j in range(depth):
+            base = f'layers.{i}.blocks.{j}'
+            ln(f'{base}.norm1', dim)
+            lin(f'{base}.attn.qkv', dim, 3 * dim)
+            sd[f'{base}.attn.relative_position_bias_table'] = (
+                rng.randn((2 * win - 1) ** 2, heads).astype(np.float32) * 0.02)
+            lin(f'{base}.attn.proj', dim, dim)
+            ln(f'{base}.norm2', dim)
+            lin(f'{base}.mlp.fc1', dim, 4 * dim)
+            lin(f'{base}.mlp.fc2', 4 * dim, dim)
+    ln('norm', C0 * 8)
+    if num_classes:
+        lin('head.fc', C0 * 8, num_classes)
+    return sd
