@@ -30,7 +30,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     multihost = bool(cli_args.get('multihost'))
     if multihost:
         from video_features_tpu.parallel.distributed import initialize
-        initialize()
+        # Pod environments autodetect everything (no extra keys needed);
+        # manual clusters pass the coordinator triple per host:
+        #   multihost=true coordinator_address=host0:1234 \
+        #   num_processes=N process_id=<rank>
+        initialize(cli_args.get('coordinator_address'),
+                   cli_args.get('num_processes'),
+                   cli_args.get('process_id'))
     args = load_config(cli_args['feature_type'], overrides=cli_args)
     if args.get('multihost') and not multihost:
         raise ValueError(

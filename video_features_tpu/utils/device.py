@@ -35,15 +35,22 @@ def enable_compilation_cache(cache_dir, device: str = 'any') -> None:
     except AttributeError:  # pragma: no cover - very old jax
         current = None
     if not cache_dir or device in ('cpu', 'any'):
-        # The cache config is process-global: if an accelerator extractor
-        # already enabled it, a later CPU extractor would persist XLA:CPU
-        # AOT entries (host-ISA-fingerprinted) into the host-SHARED
-        # accelerator dir — reject/SIGILL fodder for other hosts. Clear it;
-        # correctness beats the accelerator cache in mixed-device processes.
         if current:
-            print('compilation cache disabled for this process '
-                  f'(device={device!r} must not persist XLA:CPU entries '
-                  f'into the shared dir {current})')
+            if device in ('cpu', 'any'):
+                # The cache config is process-global: if an accelerator
+                # extractor already enabled it, a later CPU extractor would
+                # persist XLA:CPU AOT entries (host-ISA-fingerprinted) into
+                # the host-SHARED accelerator dir — reject/SIGILL fodder
+                # for other hosts. Clear it; correctness beats the
+                # accelerator cache in mixed-device processes.
+                print('compilation cache disabled for this process '
+                      f'(device={device!r} must not persist XLA:CPU entries '
+                      f'into the shared dir {current})')
+            else:
+                # accelerator device with compilation_cache_dir=null: a
+                # plain per-config opt-out, no CPU-entry hazard involved
+                print(f'compilation cache disabled per config '
+                      f'(was {current})')
             try:
                 jax.config.update('jax_compilation_cache_dir', None)
             except Exception:  # pragma: no cover
@@ -91,6 +98,12 @@ def jax_device(device: str) -> jax.Device:
     Tests run with a TPU plugin still registered, so 'cpu' must explicitly
     target the CPU backend rather than the default device (and pin the
     platform first — see :func:`pin_cpu_platform`).
+
+    Always a LOCAL device: under the multi-process runtime
+    (``multihost=true``) ``jax.devices()`` is the pod-GLOBAL list and its
+    [0] is process 0's chip — committing a non-rank-0 extractor there makes
+    every value fetch raise 'spans non-addressable devices' (caught by
+    tests/test_multihost_integration.py).
     """
     platform = 'cpu' if str(device).lower() == 'cpu' else None
     if platform == 'cpu':
@@ -98,7 +111,7 @@ def jax_device(device: str) -> jax.Device:
     if platform is None:
         platforms = {d.platform for d in jax.devices()}
         platform = next((p for p in platforms if p != 'cpu'), 'cpu')
-    return jax.devices(platform)[0]
+    return jax.local_devices(backend=platform)[0]
 
 
 def jax_devices_all(device: str) -> list:
