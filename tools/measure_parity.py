@@ -459,6 +459,41 @@ def measure_e2e_raft(ckpt_dir):
         return [('E2E raft flow field (file→flows)', _rel(ours, ref), real)]
 
 
+def measure_e2e_vggish(ckpt_dir):
+    """Whole-file wav→(Ta,128) against the reference's own mel_features +
+    framing + the state-dict-matched VGG (tests/reference_pipeline.
+    run_reference_vggish; the mp4 leg needs ffmpeg, not present here)."""
+    import tempfile
+
+    import torch
+
+    from tests.reference_pipeline import run_reference_vggish
+    from tests.torch_mirrors import TorchVGGish
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    with tempfile.TemporaryDirectory() as tmp:
+        from tests.reference_pipeline import write_real_audio_wav
+        wav = write_real_audio_wav(str(Path(tmp) / 'audio16k.wav'))
+
+        torch.manual_seed(0)
+        net = TorchVGGish().eval()
+        sd = _load_sd(ckpt_dir, 'vggish-10086976.pth')
+        real = sd is not None
+        if real:
+            net.load_state_dict(sd)
+        ckpt = Path(tmp) / 'vggish.pt'
+        torch.save(net.state_dict(), str(ckpt))
+        ref = run_reference_vggish(wav, net)
+        args = load_config('vggish', overrides={
+            'video_paths': wav, 'device': 'cpu', 'precision': 'highest',
+            'checkpoint_path': str(ckpt),
+            'output_path': str(Path(tmp) / 'o'),
+            'tmp_path': str(Path(tmp) / 't')})
+        ours = create_extractor(args).extract(wav)['vggish']
+        return [('E2E vggish (Ta, 128) (file→features)', _rel(ours, ref),
+                 real)]
+
+
 MEASURES = {
     'i3d': measure_i3d,
     'raft': measure_raft,
@@ -472,6 +507,7 @@ MEASURES = {
     'e2e_s3d': measure_e2e_s3d,
     'e2e_resnet': measure_e2e_resnet,
     'e2e_raft': measure_e2e_raft,
+    'e2e_vggish': measure_e2e_vggish,
 }
 
 
