@@ -134,3 +134,40 @@ def test_auto_lookup_dispatch(monkeypatch):
     monkeypatch.setenv('VFT_RAFT_LANES_VMEM_MB', '64')
     assert raft._resolve_auto_lookup(135, 240, 'tpu') == 'lanes'
     monkeypatch.delenv('VFT_RAFT_LANES_VMEM_MB')
+
+
+def _load_validate_lanes():
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        'validate_lanes',
+        Path(__file__).resolve().parents[1] / 'tools' / 'validate_lanes.py')
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lanes_full_depth_interpret():
+    """The production lanes kernel at FULL 20-iteration depth (reduced
+    geometry, interpret mode): a depth-dependent kernel regression —
+    accumulated window drift steering later lookups off course — fails
+    automation here, not a human remembering tools/validate_lanes.py."""
+    vl = _load_validate_lanes()
+    # smallest geometry whose 4-level pyramid keeps every level nonzero
+    # (H/8 must be ≥ 8 so level 3 is ≥ 1 pixel)
+    rels = vl.measure_drift(h=64, w=88, impls=('dense', 'lanes'),
+                            iters=20, platform='cpu')
+    assert rels['lanes'] < 1e-3, rels
+
+
+@pytest.mark.tpu
+def test_lanes_full_depth_tpu():
+    """The same full-depth validation on real TPU hardware at CLI geometry
+    (the compiled Mosaic kernel, not interpret mode): `pytest -m tpu`."""
+    if jax.devices()[0].platform != 'tpu':
+        pytest.skip('no TPU attached')
+    vl = _load_validate_lanes()
+    rels = vl.measure_drift(impls=('dense', 'lanes', 'gather'))
+    assert rels['lanes'] < 1e-3, rels
+    assert rels['gather'] < 1e-3, rels
