@@ -9,9 +9,9 @@ only timing datapoint is ~4 s/video at stack 16 / step 16 @ 25 fps
 
 Two rungs, both at a PARITY-GRADE precision (the metric name stamps it):
 
-  * ``e2e`` — the headline: video file → decoded frames → device →
-    features, the pipeline a user actually runs (native decoder when built,
-    cv2 otherwise; prefetch + overlapped H2D on).
+  * ``e2e`` — video file → decoded frames → device → features, the
+    pipeline a user actually runs (native decoder when built, cv2
+    otherwise; prefetch + overlapped H2D on).
   * ``ingraph`` — device-only ceiling: the fused graph on device-resident
     batches, timed INSIDE one jit call (``lax.scan`` over distinct input
     batches, result fetched) — remote-dispatch backends can return from
@@ -25,14 +25,19 @@ setting that still meets the reference-parity bar. BENCH_PRECISION
 overrides (e.g. 'highest' for the float32 ladder rung, 'default' for the
 no-parity speed ceiling).
 
-Prints exactly ONE JSON line; the headline value is the E2E rung (falls
-back to in-graph when no video/decoder is available), with every measured
-rung in ``rungs``.
+Prints exactly ONE JSON line (all diagnostics — random-weights warnings,
+decoder chatter, cache notes — go to stderr). The headline value is the
+in-graph rung by policy on this environment (the e2e rung here measures a
+remote-TPU tunnel, not the machine — see docs/benchmarks.md); every
+measured rung is recorded in ``rungs``, and ``BENCH_MODE=e2e`` promotes
+the e2e rung to headline on hosts where the transfer is real PCIe.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import sys
 import time
 from pathlib import Path
 
@@ -83,20 +88,26 @@ def bench_ingraph(jax, precision, pins, device, platform, params,
 
 def _bench_video(tmp_dir: str) -> str:
     """A local benchmark clip: the reference sample if present, else a
-    synthetic one (tools/make_sample_video.py)."""
+    synthetic one (tools/make_sample_video.py). ``BENCH_VIDEO=synthetic``
+    forces the synthetic clip and ``BENCH_E2E_SECONDS`` its length — the
+    contract smoke test uses a 1-stack clip so the e2e path stays cheap
+    on CPU."""
     ref = Path('/root/reference/sample/v_GGSY1Qvo990.mp4')
-    if ref.exists():
+    if ref.exists() and os.environ.get('BENCH_VIDEO') != 'synthetic':
         return str(ref)
+    seconds = os.environ.get('BENCH_E2E_SECONDS', '10')
     out = Path(tmp_dir) / 'synth' / 'sample_moving_pattern.mp4'
     if not out.exists():
         import subprocess
         import sys
+        # child fds bypass redirect_stdout — pin the subprocess's stdout to
+        # stderr so its 'wrote ...' chatter can't break the one-line contract
         subprocess.run(
             [sys.executable, str(Path(__file__).parent / 'tools' /
                                  'make_sample_video.py'),
-             '--out', str(out.parent), '--seconds', '10', '--fps', '25',
+             '--out', str(out.parent), '--seconds', seconds, '--fps', '25',
              '--size', '340x256'],
-            check=True)
+            check=True, stdout=sys.stderr)
     return str(out)
 
 
@@ -137,7 +148,10 @@ def bench_e2e(precision: str, batch: int, stack: int, tmp_dir: str,
     return float(np.median(rates))
 
 
-def main() -> None:
+def run() -> dict:
+    """Measure all rungs; returns the one-line record. Anything this (or
+    the libraries it calls) prints is expected on stderr only — main()
+    enforces that by redirecting stdout around the whole measurement."""
     import tempfile
 
     import jax
@@ -202,14 +216,25 @@ def main() -> None:
     # environment") — it is recorded in `rungs` with that caveat, and
     # BENCH_MODE=e2e promotes it on hosts where the transfer is real PCIe.
     value = rungs[headline_key]
-    print(json.dumps({
+    return {
         'metric': f'i3d_two_stream_{headline_key}_clips_per_sec_'
                   f'{platform}_stack{stack}_{size}px',
         'value': value,
         'unit': 'clips/sec/chip',
         'vs_baseline': round(value / BASELINE_CLIPS_PER_SEC, 3),
         'rungs': rungs,
-    }))
+    }
+
+
+def main() -> None:
+    # The driver contract: stdout carries exactly ONE JSON line. Libraries
+    # along the e2e path print diagnostics (random-weights warnings,
+    # cv2/ffmpeg chatter, cache notes) — shunt ALL of it to stderr and emit
+    # the record on the real stdout afterwards.
+    stdout = sys.stdout
+    with contextlib.redirect_stdout(sys.stderr):
+        record = run()
+    print(json.dumps(record), file=stdout)
 
 
 if __name__ == '__main__':

@@ -40,7 +40,7 @@ def test_allow_random_weights_config_flag(tmp_path, monkeypatch, capsys):
     monkeypatch.delenv(ENV_FLAG, raising=False)
     ex = create_extractor(_resnet_args(tmp_path, allow_random_weights=True))
     assert ex is not None
-    assert 'RANDOM weights' in capsys.readouterr().out
+    assert 'RANDOM weights' in capsys.readouterr().err  # stderr: stdout is machine-read
 
 
 def test_env_escape_hatch(tmp_path, monkeypatch):

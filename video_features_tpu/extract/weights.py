@@ -18,9 +18,17 @@ the reference downloads from.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Any, Callable, Dict, Optional
 
 ENV_FLAG = 'VFT_ALLOW_RANDOM_WEIGHTS'
+
+# Families tools/fetch_checkpoints.py can provision (its SOURCES keys;
+# test_fetch_checkpoints.test_registry_covers_every_family keeps the two in
+# sync). Families outside this set (timm) get their weights elsewhere, so
+# the missing-checkpoint remediation text must not point at the tool.
+FETCHABLE_FAMILIES = frozenset(
+    {'clip', 'resnet', 'r21d', 'vggish', 'i3d', 'raft', 's3d'})
 
 
 class MissingCheckpointError(ValueError):
@@ -52,16 +60,31 @@ def require_checkpoint(args: Any, key: str, *, feature_type: str,
         return str(ckpt)
     what = what or feature_type
     if not random_weights_allowed(args):
+        if feature_type in FETCHABLE_FAMILIES:
+            provision = (f'Provision real weights with `python '
+                         f'tools/fetch_checkpoints.py {feature_type}` '
+                         f'(see docs/checkpoints.md).')
+        else:
+            # timm (and any future bridge-fed family): weights come from
+            # pip-timm via the bridge or a user-supplied converted file,
+            # not from the fetch tool
+            provision = (f'`{feature_type}` weights are not served by '
+                         f'tools/fetch_checkpoints.py — export them from a '
+                         f'host with pip timm installed (`python '
+                         f'tools/convert_checkpoint.py`) or pass a '
+                         f'converted .npz via `{key}` '
+                         f'(see docs/checkpoints.md).')
         raise MissingCheckpointError(
             f'No checkpoint configured for {what}: set `{key}=<path to a '
             f'.pt/.pth/.npz checkpoint>` (feature_type={feature_type}). '
-            f'Provision real weights with `python tools/fetch_checkpoints.py '
-            f'{feature_type}` (see docs/checkpoints.md). To intentionally run '
-            f'RANDOM weights (tests/benchmarks only — features will be '
-            f'meaningless), set `allow_random_weights=true`.')
+            f'{provision} To intentionally run RANDOM weights '
+            f'(tests/benchmarks only — features will be meaningless), set '
+            f'`allow_random_weights=true`.')
+    # stderr: diagnostics must never pollute machine-read stdout (the CLI
+    # print path and bench.py's one-JSON-line contract)
     print(f'WARNING: {what}: no `{key}` configured — running RANDOM weights '
           f'(allow_random_weights is set). Extracted features are '
-          f'meaningless for downstream use.')
+          f'meaningless for downstream use.', file=sys.stderr)
     return None
 
 
