@@ -42,6 +42,16 @@ def test_load_label_map_unknown_dataset():
     assert load_label_map('nonsense') is None
 
 
+def test_bundled_label_maps_resolve_air_gapped(monkeypatch):
+    """The three maps ship as package data: with no env var and no
+    reference checkout, class names must still resolve (air-gapped host)."""
+    monkeypatch.delenv('VFT_LABEL_MAP_DIR', raising=False)
+    for dataset, n in (('kinetics', 400), ('imagenet1k', 1000),
+                      ('imagenet21k', 21843)):
+        classes = load_label_map(dataset)
+        assert classes is not None and len(classes) == n, dataset
+
+
 def test_softmax_rows_sum_to_one():
     x = np.random.RandomState(0).randn(3, 10)
     p = softmax(x)

@@ -1,10 +1,12 @@
 #!/usr/bin/env python3
-"""Write K400/IN1K/IN21K label-map files for ``show_pred`` class names.
+"""REFRESH the K400/IN1K/IN21K label-map files for ``show_pred``.
 
-Class-name files are display sugar for top-5 prediction tables
-(`video_features_tpu/utils/preds.py`); without them indices are printed.
-This tool materializes them from whatever source is available, in priority
-order:
+The three maps already ship as package data
+(`video_features_tpu/utils/label_maps/`), so class names work out of the
+box; this tool only REGENERATES them (e.g. to track an upstream rename)
+into a directory exported as ``$VFT_LABEL_MAP_DIR``, which takes
+precedence over the bundled copies. It materializes from whatever source
+is available, in priority order:
 
   1. torchvision weight metadata (Kinetics-400 from the r2plus1d weights,
      ImageNet-1k from the resnet50 weights) — requires `torchvision`;

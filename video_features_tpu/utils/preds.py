@@ -1,8 +1,11 @@
 """Top-5 prediction printing (reference utils/utils.py:21-54 surface).
 
-Label maps are looked up at runtime: ``$VFT_LABEL_MAP_DIR`` first, then the
-reference checkout if present. Class names are display sugar only — when no
-map is found, indices are printed instead of failing.
+The three public label maps (Kinetics-400, ImageNet-1k/-21k — the same
+files the reference bundles as utils/*_label_map.txt) ship as package data
+in ``utils/label_maps/``, so class names work on air-gapped hosts with no
+env var or reference checkout. ``$VFT_LABEL_MAP_DIR`` still takes
+precedence for user-refreshed maps (tools/fetch_label_maps.py), and when
+nothing resolves, indices are printed instead of failing.
 """
 from __future__ import annotations
 
@@ -20,10 +23,11 @@ _DATASET_TO_FILE = {
 
 def _search_dirs() -> List[str]:
     # read the env var per call so `os.environ['VFT_LABEL_MAP_DIR'] = ...`
-    # after import still takes effect
+    # after import still takes effect; the bundled package copies are the
+    # always-available fallback
     return [
         os.environ.get('VFT_LABEL_MAP_DIR', ''),
-        '/root/reference/utils',
+        str(Path(__file__).parent / 'label_maps'),
     ]
 
 
