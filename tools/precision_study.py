@@ -54,10 +54,31 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 #   measured and REJECTED: high_early8_default → flow 1.30e-2 — the GRU
 #   hidden state carries the early error through every later iteration.
 #   Further parity-precision speed must come from kernels, not precision.
+# Round-3 finer-grain sweep (v5e, after the GRU restructure + quantizer
+# offset fix; drift deterministic, timings tunnel-noisy):
+#   all_high                   flow 8.50e-04  (mixed drift unchanged)
+#   high_motion_default        flow 1.08e-02  ✗
+#   high_head_default          flow 7.81e-03  ✗
+#   high_gru_default           flow 1.00e-02  ✗
+#   high_motion_head_default   flow 1.11e-02  ✗
+# ⇒ the 1-pass intolerance holds at PER-CONV granularity inside the
+#   refinement iteration: every component's output feeds back through the
+#   coords→lookup loop within one iteration, so there is no "cold side" to
+#   down-pin. The precision lever is exhausted at every measured
+#   granularity (docs/benchmarks.md has the consolidated analysis).
 POLICIES = [
     ('all_highest', 'highest', None),                       # baseline
     ('all_high', 'high', None),                             # = 'mixed'
     ('high_early8_default', 'high', (('iter_early', 'default:8'),)),
+    # Round-3 finer-grain sweep: per-component pins INSIDE the refinement
+    # iteration (models/raft.py nests iter_motion/iter_gru/iter_head in
+    # 'iter'), probing whether part of the per-iteration conv stack
+    # tolerates 1-pass while the GRU feedback path stays 3-pass.
+    ('high_motion_default', 'high', (('iter_motion', 'default'),)),
+    ('high_head_default', 'high', (('iter_head', 'default'),)),
+    ('high_gru_default', 'high', (('iter_gru', 'default'),)),
+    ('high_motion_head_default', 'high',
+     (('iter_motion', 'default'), ('iter_head', 'default'))),
 ]
 
 

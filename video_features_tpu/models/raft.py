@@ -519,11 +519,16 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
                 with pin_scope(pins, 'corr'):
                     corr = lookup(coords1)
                 flow = coords1 - coords0
+                # finer pins nest inside 'iter': an unpinned sub-component
+                # inherits the 'iter' (or ambient) precision
                 with pin_scope(pins, 'iter'):
-                    motion = motion_encoder(up['encoder'], flow, corr)
-                    net_new = sep_conv_gru(gru, gru_terms, net, motion)
-                    t = relu(_conv_b(fh['conv1'], net_new, padding=1))
-                    delta = _conv_b(fh['conv2'], t, padding=1)
+                    with pin_scope(pins, 'iter_motion'):
+                        motion = motion_encoder(up['encoder'], flow, corr)
+                    with pin_scope(pins, 'iter_gru'):
+                        net_new = sep_conv_gru(gru, gru_terms, net, motion)
+                    with pin_scope(pins, 'iter_head'):
+                        t = relu(_conv_b(fh['conv1'], net_new, padding=1))
+                        delta = _conv_b(fh['conv2'], t, padding=1)
                     coords1_new = coords1 + delta
             return (net_new, coords1_new), None
         return step
