@@ -136,7 +136,7 @@ def test_e2e_extraction(short_video, tmp_path, model_name, family):
 
 def test_unknown_model_rejected(tmp_path):
     args = load_config('timm', overrides={
-        'model_name': 'efficientnet_b0',
+        'model_name': 'mobilenetv3_large_100',
         'video_paths': '/dev/null',
         'device': 'cpu',
         'output_path': str(tmp_path / 'out'),
@@ -330,3 +330,132 @@ def test_swin_extractor_e2e(short_video, tmp_path):
     assert out['timm'].shape[1] == 768
     assert out['timm'].shape[0] > 0
     assert np.isfinite(out['timm']).all()
+
+
+def test_efficientnet_parity_vs_torch_mirror():
+    """EfficientNet numerics vs the timm-layout mirror: depthwise convs
+    (feature_group_count), squeeze-excite gating, SiLU, inverted residuals,
+    stage-0 depthwise-separable blocks."""
+    import jax
+
+    from tests.torch_mirrors import TorchEfficientNet
+    from video_features_tpu.models import efficientnet as eff_model
+
+    torch.manual_seed(0)
+    mirror = TorchEfficientNet('efficientnet_b0', num_classes=5).eval()
+    # randomize BN running stats so batch_norm parity is actually exercised
+    from tests.torch_mirrors import randomize_bn_stats
+    randomize_bn_stats(mirror)
+    params = transplant(mirror.state_dict())
+
+    x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+        ref_logits = mirror(xt).numpy()
+        mirror.classifier = torch.nn.Identity()
+        ref = mirror(xt).numpy()
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(eff_model.forward(params, x,
+                                           arch='efficientnet_b0'))
+        got_logits = np.asarray(eff_model.forward(
+            params, x, arch='efficientnet_b0', features=False))
+
+    assert got.shape == ref.shape == (2, 1280)
+    for ours, theirs in ((got, ref), (got_logits, ref_logits)):
+        rel = np.linalg.norm(ours - theirs) / np.linalg.norm(theirs)
+        assert rel < 1e-3, f'rel L2 {rel}'
+
+
+def test_efficientnet_state_dict_keys_match_mirror():
+    from tests.torch_mirrors import TorchEfficientNet
+    from video_features_tpu.models import efficientnet as eff_model
+
+    for arch in ('efficientnet_b0', 'efficientnet_b1'):
+        ours = set(eff_model.init_state_dict(arch))
+        theirs = {k for k in TorchEfficientNet(arch).state_dict()
+                  if not k.endswith('num_batches_tracked')}
+        assert ours == theirs, arch
+
+
+@pytest.mark.slow
+def test_efficientnet_extractor_e2e(short_video, tmp_path):
+    args = load_config('timm', overrides={
+        'video_paths': short_video, 'device': 'cpu', 'batch_size': 16,
+        'model_name': 'efficientnet_b1',
+        'allow_random_weights': True, 'extraction_fps': 2,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    assert ex.data_cfg['crop'] == 240            # b1's native resolution
+    out = ex.extract(short_video)
+    assert out['timm'].shape[1] == 1280
+    assert out['timm'].shape[0] > 0
+    assert np.isfinite(out['timm']).all()
+
+
+class _TorchDeiTDistilled(_TorchViT):
+    """timm VisionTransformerDistilled mirror: dist_token + head_dist,
+    2-slot pos-embed prefix, inference = mean of the two tokens/heads."""
+
+    def __init__(self, width, layers, heads, patch, img=224):
+        super().__init__(width, layers, heads, patch, img)
+        self.dist_token = nn.Parameter(torch.randn(1, 1, width) * 0.02)
+        self.pos_embed = nn.Parameter(
+            torch.randn(1, 2 + (img // patch) ** 2, width) * 0.02)
+        self.head_dist = nn.Linear(width, 1000)
+
+    def forward(self, x, features=True):
+        B = x.shape[0]
+        x = self.patch_embed.proj(x).flatten(2).transpose(1, 2)
+        x = torch.cat([self.cls_token.expand(B, -1, -1),
+                       self.dist_token.expand(B, -1, -1), x], 1)
+        x = x + self.pos_embed
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        if features:
+            return (x[:, 0] + x[:, 1]) / 2
+        return (self.head(x[:, 0]) + self.head_dist(x[:, 1])) / 2
+
+
+def test_deit_distilled_parity_vs_torch_mirror():
+    """Distilled DeiT: the dist_token rides the checkpoint — our forward
+    dispatches on its presence (features = mean of cls/dist tokens,
+    logits = mean of the two heads, timm deit.py semantics)."""
+    import jax
+
+    arch = 'vit_tiny_patch16_224'
+    cfg = vit_model.ARCHS[arch]
+    torch.manual_seed(0)
+    ref_model = _TorchDeiTDistilled(cfg['width'], cfg['layers'],
+                                    cfg['heads'], cfg['patch']).eval()
+    params = transplant(ref_model.state_dict())
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+        ref = ref_model(xt).numpy()
+        ref_logits = ref_model(xt, features=False).numpy()
+    with jax.default_matmul_precision('highest'):
+        ours = np.asarray(vit_model.forward(params, x, arch=arch))
+        ours_logits = np.asarray(
+            vit_model.forward(params, x, arch=arch, features=False))
+
+    for a, b in ((ours, ref), (ours_logits, ref_logits)):
+        rel = np.linalg.norm(a - b) / np.linalg.norm(b)
+        assert rel < 1e-3, f'rel L2 {rel}'
+
+
+def test_deit_distilled_registry_and_random_init(tmp_path):
+    from video_features_tpu.extract.timm import REGISTRY
+    spec = REGISTRY['deit_tiny_distilled_patch16_224']
+    assert spec['family'] == 'deit' and spec['init'] == {'distilled': True}
+    args = load_config('timm', overrides={
+        'video_paths': 'v.mp4', 'device': 'cpu', 'pretrained': False,
+        'model_name': 'deit_tiny_distilled_patch16_224',
+        'allow_random_weights': True,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    assert 'dist_token' in ex.params          # distilled graph selected

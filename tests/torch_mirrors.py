@@ -459,3 +459,113 @@ class TorchSwin(nn.Module):
         x = self.norm(x)
         x = x.mean(dim=(1, 2))
         return self.head.fc(x)
+
+
+# ---------------------------------------------------------- efficientnet --
+
+
+class _EffSqueezeExcite(nn.Module):
+    def __init__(self, chs, rd):
+        super().__init__()
+        self.conv_reduce = nn.Conv2d(chs, rd, 1)
+        self.conv_expand = nn.Conv2d(rd, chs, 1)
+
+    def forward(self, x):
+        s = x.mean((2, 3), keepdim=True)
+        s = self.conv_expand(F.silu(self.conv_reduce(s)))
+        return x * torch.sigmoid(s)
+
+
+class _EffDsBlock(nn.Module):
+    def __init__(self, in_chs, out_chs, kernel, stride, rd):
+        super().__init__()
+        self.conv_dw = nn.Conv2d(in_chs, in_chs, kernel, stride,
+                                 kernel // 2, groups=in_chs, bias=False)
+        self.bn1 = nn.BatchNorm2d(in_chs)
+        self.se = _EffSqueezeExcite(in_chs, rd)
+        self.conv_pw = nn.Conv2d(in_chs, out_chs, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(out_chs)
+        self.has_skip = stride == 1 and in_chs == out_chs
+
+    def forward(self, x):
+        h = F.silu(self.bn1(self.conv_dw(x)))
+        h = self.se(h)
+        h = self.bn2(self.conv_pw(h))
+        return x + h if self.has_skip else h
+
+
+class _EffIrBlock(nn.Module):
+    def __init__(self, in_chs, out_chs, kernel, stride, expand, rd):
+        super().__init__()
+        mid = in_chs * expand
+        self.conv_pw = nn.Conv2d(in_chs, mid, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(mid)
+        self.conv_dw = nn.Conv2d(mid, mid, kernel, stride, kernel // 2,
+                                 groups=mid, bias=False)
+        self.bn2 = nn.BatchNorm2d(mid)
+        self.se = _EffSqueezeExcite(mid, rd)
+        self.conv_pwl = nn.Conv2d(mid, out_chs, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(out_chs)
+        self.has_skip = stride == 1 and in_chs == out_chs
+
+    def forward(self, x):
+        h = F.silu(self.bn1(self.conv_pw(x)))
+        h = F.silu(self.bn2(self.conv_dw(h)))
+        h = self.se(h)
+        h = self.bn3(self.conv_pwl(h))
+        return x + h if self.has_skip else h
+
+
+class TorchEfficientNet(nn.Module):
+    """timm 0.9.12 EfficientNet mirror (native efficientnet_b* tree:
+    conv_stem/bn1, blocks.S.B.*, conv_head/bn2, classifier; symmetric
+    k//2 padding — the tf_ ports' asymmetric SAME padding is out of
+    scope). Reference consumes it through pip-timm
+    (models/timm/extract_timm.py:48)."""
+
+    # (kernel, stride, expand, out_channels, repeats) per stage — the
+    # LITERAL timm 0.9.12 geometries, deliberately NOT derived from the
+    # module under test so a wrong channel/repeat rule there fails the
+    # parity/key tests instead of propagating into the mirror
+    STAGES = {
+        'efficientnet_b0': [(3, 1, 1, 16, 1), (3, 2, 6, 24, 2),
+                            (5, 2, 6, 40, 2), (3, 2, 6, 80, 3),
+                            (5, 1, 6, 112, 3), (5, 2, 6, 192, 4),
+                            (3, 1, 6, 320, 1)],
+        'efficientnet_b1': [(3, 1, 1, 16, 2), (3, 2, 6, 24, 3),
+                            (5, 2, 6, 40, 3), (3, 2, 6, 80, 4),
+                            (5, 1, 6, 112, 4), (5, 2, 6, 192, 5),
+                            (3, 1, 6, 320, 2)],
+    }
+
+    def __init__(self, arch='efficientnet_b0', num_classes=0):
+        super().__init__()
+        stem, head = 32, 1280
+        self.conv_stem = nn.Conv2d(3, stem, 3, 2, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(stem)
+        self.blocks = nn.ModuleList()
+        cin = stem
+        for si, (k, s, e, c, r) in enumerate(self.STAGES[arch]):
+            stage = nn.ModuleList()
+            for bi in range(r):
+                block_in = cin if bi == 0 else c
+                stride = s if bi == 0 else 1
+                rd = max(1, block_in // 4)       # se_ratio 0.25 of block in
+                if si == 0:
+                    stage.append(_EffDsBlock(block_in, c, k, stride, rd))
+                else:
+                    stage.append(_EffIrBlock(block_in, c, k, stride, e, rd))
+            self.blocks.append(stage)
+            cin = c
+        self.conv_head = nn.Conv2d(cin, head, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(head)
+        self.classifier = (nn.Linear(head, num_classes) if num_classes
+                           else nn.Identity())
+
+    def forward(self, x):
+        x = F.silu(self.bn1(self.conv_stem(x)))
+        for stage in self.blocks:
+            for blk in stage:
+                x = blk(x)
+        x = F.silu(self.bn2(self.conv_head(x)))
+        return self.classifier(x.mean((2, 3)))

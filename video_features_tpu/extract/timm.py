@@ -23,6 +23,7 @@ import numpy as np
 
 from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
 from video_features_tpu.models import convnext as convnext_model
+from video_features_tpu.models import efficientnet as efficientnet_model
 from video_features_tpu.models import resnet as resnet_model
 from video_features_tpu.models import swin as swin_model
 from video_features_tpu.models import vit as vit_model
@@ -32,9 +33,15 @@ from video_features_tpu.ops.transforms import (
 from video_features_tpu.utils.device import jax_device
 
 
-def _data_cfg(family: str) -> Dict[str, Any]:
+def _data_cfg(family: str, arch: str = '') -> Dict[str, Any]:
     """timm resolve_data_config equivalents for the native families:
     resize = floor(input_size / crop_pct), family-default interpolation."""
+    if family == 'efficientnet':
+        # per-arch input sizes (timm efficientnet default_cfgs)
+        _, _, size, crop_pct = efficientnet_model.ARCHS[arch]
+        return dict(resize=int(size / crop_pct), crop=size,
+                    interpolation='bicubic',
+                    mean=efficientnet_model.MEAN, std=efficientnet_model.STD)
     if family == 'vit':
         # timm vit: crop_pct 0.9, bicubic, 0.5 "inception" stats
         return dict(resize=248, crop=224, interpolation='bicubic',
@@ -60,7 +67,9 @@ def _registry() -> Dict[str, Dict[str, Any]]:
     for name, cfg in vit_model.ARCHS.items():
         reg[name] = dict(family='vit', arch=name, feat_dim=cfg['width'])
     # non-distilled DeiT IS timm's VisionTransformer (same module tree and
-    # state_dict; only the data config differs) — alias onto the vit archs
+    # state_dict; only the data config differs) — alias onto the vit archs;
+    # distilled variants add dist_token/head_dist (models/vit.py dispatches
+    # on the checkpoint's dist_token, so the graph follows the weights)
     for deit, vit_arch in [
         ('deit_tiny_patch16_224', 'vit_tiny_patch16_224'),
         ('deit_small_patch16_224', 'vit_small_patch16_224'),
@@ -68,6 +77,10 @@ def _registry() -> Dict[str, Dict[str, Any]]:
     ]:
         reg[deit] = dict(family='deit', arch=vit_arch,
                          feat_dim=vit_model.ARCHS[vit_arch]['width'])
+        dist = deit.replace('_patch', '_distilled_patch')
+        reg[dist] = dict(family='deit', arch=vit_arch,
+                         feat_dim=vit_model.ARCHS[vit_arch]['width'],
+                         init=dict(distilled=True))
     for name, cfg in resnet_model.ARCHS.items():
         reg[name] = dict(family='resnet', arch=name, feat_dim=cfg['feat_dim'])
     for name, cfg in convnext_model.ARCHS.items():
@@ -76,6 +89,9 @@ def _registry() -> Dict[str, Dict[str, Any]]:
     for name in swin_model.ARCHS:
         reg[name] = dict(family='swin', arch=name,
                          feat_dim=swin_model.feat_dim(name))
+    for name in efficientnet_model.ARCHS:
+        reg[name] = dict(family='efficientnet', arch=name,
+                         feat_dim=efficientnet_model.feat_dim(name))
     return reg
 
 
@@ -85,7 +101,7 @@ REGISTRY = _registry()
 # config differs — see _data_cfg)
 _MODEL_MODULES = {'vit': vit_model, 'deit': vit_model,
                   'resnet': resnet_model, 'convnext': convnext_model,
-                  'swin': swin_model}
+                  'swin': swin_model, 'efficientnet': efficientnet_model}
 
 
 class ExtractTIMM(BaseFrameWiseExtractor):
@@ -103,8 +119,9 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                 f'architectures transplant via checkpoint_path.)')
         spec = REGISTRY[name]
         self.family, self.arch = spec['family'], spec['arch']
+        self._init_kwargs = spec.get('init', {})
         super().__init__(args, feat_dim=spec['feat_dim'])
-        self.data_cfg = _data_cfg(self.family)
+        self.data_cfg = _data_cfg(self.family, self.arch)
         self._device = jax_device(self.device)
         # _load_params may refine data_cfg from pip-timm's resolved config,
         # so the image_size override must come AFTER it
@@ -205,7 +222,8 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         require_checkpoint(args, 'checkpoint_path', feature_type='timm',
                            what=f'timm ({self.model_name})')
         init = _MODEL_MODULES[self.family]
-        return transplant(init.init_state_dict(arch=self.arch))
+        return transplant(init.init_state_dict(arch=self.arch,
+                                               **self._init_kwargs))
 
     @staticmethod
     def _forward(params, batch, family, arch, mean, std):
@@ -224,9 +242,20 @@ class ExtractTIMM(BaseFrameWiseExtractor):
 
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         if self.family in ('vit', 'deit'):
+            if 'dist_token' in self.params:
+                # timm's distilled inference scores the cls and dist tokens
+                # with SEPARATE heads ((head(cls)+head_dist(dist))/2); the
+                # pooled features here can't reconstruct the two tokens, so
+                # any logits printed from them would misrepresent the model
+                print('show_pred: distilled DeiT logits need the separate '
+                      'cls/dist tokens (timm deit.py); skipping the top-5 '
+                      'table for pooled features')
+                return
             head = self.params.get('head')
         elif self.family in ('convnext', 'swin'):
             head = (self.params.get('head') or {}).get('fc')
+        elif self.family == 'efficientnet':
+            head = self.params.get('classifier')
         else:
             head = self.params.get('fc')
         if not head:

@@ -1,0 +1,217 @@
+"""EfficientNet image backbones (timm `efficientnet_b*` state_dict layout).
+
+The reference's timm extractor accepts any pip-timm model (reference
+models/timm/extract_timm.py:48, timm==0.9.12 pinned); this module natively
+implements the EfficientNet family — the mobile-conv half of that model
+space (depthwise separable convs, squeeze-excite gating, SiLU, inverted
+residuals) that the ViT/Swin/ResNet/ConvNeXt families don't cover —
+against timm 0.9.12's ``EfficientNet`` module tree (``conv_stem``/``bn1``,
+``blocks.S.B.{conv_pw,bn1,conv_dw,bn2,se.conv_reduce,se.conv_expand,
+conv_pwl,bn3}``, ``conv_head``/``bn2``, ``classifier``) so real timm
+checkpoints transplant mechanically.
+
+TPU notes: depthwise convs lower to XLA ``feature_group_count=C`` (a VPU
+pattern, cheap at these sizes); squeeze-excite is a global mean + two 1×1
+convs — all static shapes. Covers the native (symmetrically padded)
+``efficientnet_b*`` variants; the ``tf_``-prefixed ports use asymmetric
+SAME padding and remain pip-timm-bridge territory.
+
+Feature semantics match ``num_classes=0`` timm models: global average
+pool of the conv_head output (reference models/timm/extract_timm.py:59-60).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from video_features_tpu.ops.nn import batch_norm, conv
+
+Params = Dict[str, Any]
+
+# timm efficientnet default_cfg: bicubic, ImageNet stats
+MEAN = (0.485, 0.456, 0.406)
+STD = (0.229, 0.224, 0.225)
+
+# Base (b0) stage table: (kernel, stride, expand, out_channels, repeats).
+# Stage 0 is the DepthwiseSeparableConv stage (no expansion conv).
+_BASE_STAGES: List[Tuple[int, int, int, int, int]] = [
+    (3, 1, 1, 16, 1),
+    (3, 2, 6, 24, 2),
+    (5, 2, 6, 40, 2),
+    (3, 2, 6, 80, 3),
+    (5, 1, 6, 112, 3),
+    (5, 2, 6, 192, 4),
+    (3, 1, 6, 320, 1),
+]
+SE_RATIO = 0.25
+
+ARCHS = {
+    # name: (width_mult, depth_mult, input_size, crop_pct) — input/crop per
+    # timm 0.9.12 default_cfgs; the b2+ cfgs moved between timm releases,
+    # so the native registry carries the two stable members and larger
+    # variants ride the pip-timm bridge
+    'efficientnet_b0': (1.0, 1.0, 224, 0.875),
+    'efficientnet_b1': (1.0, 1.1, 240, 0.882),
+}
+
+
+def _round_channels(c: float, mult: float, divisor: int = 8) -> int:
+    """timm round_channels: scale then round to the nearest multiple of 8
+    (never dropping below 90%)."""
+    c *= mult
+    new = max(divisor, int(c + divisor / 2) // divisor * divisor)
+    if new < 0.9 * c:
+        new += divisor
+    return new
+
+
+def _round_repeats(r: int, mult: float) -> int:
+    return int(math.ceil(r * mult))
+
+
+def stage_table(arch: str) -> List[Tuple[int, int, int, int, int]]:
+    wm, dm, _, _ = ARCHS[arch]
+    return [(k, s, e, _round_channels(c, wm), _round_repeats(r, dm))
+            for k, s, e, c, r in _BASE_STAGES]
+
+
+def stem_head_channels(arch: str) -> Tuple[int, int]:
+    wm = ARCHS[arch][0]
+    return _round_channels(32, wm), _round_channels(1280, wm)
+
+
+def feat_dim(arch: str) -> int:
+    return stem_head_channels(arch)[1]
+
+
+def _bn_silu(x: jax.Array, p: Params) -> jax.Array:
+    return jax.nn.silu(batch_norm(x, p))
+
+
+def _se(p: Params, x: jax.Array) -> jax.Array:
+    """Squeeze-excite: global mean → 1×1 reduce → SiLU → 1×1 expand →
+    sigmoid gate (timm SqueezeExcite)."""
+    s = x.mean(axis=(1, 2), keepdims=True)
+    s = jax.nn.silu(conv(s, p['conv_reduce']['weight'],
+                         bias=p['conv_reduce']['bias']))
+    s = conv(s, p['conv_expand']['weight'], bias=p['conv_expand']['bias'])
+    return x * jax.nn.sigmoid(s)
+
+
+def _ds_block(p: Params, x: jax.Array, kernel: int, stride: int) -> jax.Array:
+    """DepthwiseSeparableConv (stage 0): dw → bn+silu → se → pw → bn,
+    residual when shapes allow."""
+    shortcut = x
+    c = x.shape[-1]
+    h = conv(x, p['conv_dw']['weight'], stride=stride, padding=kernel // 2,
+             groups=c)
+    h = _bn_silu(h, p['bn1'])
+    h = _se(p['se'], h)
+    h = conv(h, p['conv_pw']['weight'])
+    h = batch_norm(h, p['bn2'])
+    if stride == 1 and h.shape[-1] == c:
+        h = h + shortcut
+    return h
+
+
+def _ir_block(p: Params, x: jax.Array, kernel: int, stride: int) -> jax.Array:
+    """InvertedResidual: pw expand → bn+silu → dw → bn+silu → se →
+    pw project → bn, residual when shapes allow."""
+    shortcut = x
+    c = x.shape[-1]
+    h = conv(x, p['conv_pw']['weight'])
+    h = _bn_silu(h, p['bn1'])
+    ce = h.shape[-1]
+    h = conv(h, p['conv_dw']['weight'], stride=stride, padding=kernel // 2,
+             groups=ce)
+    h = _bn_silu(h, p['bn2'])
+    h = _se(p['se'], h)
+    h = conv(h, p['conv_pwl']['weight'])
+    h = batch_norm(h, p['bn3'])
+    if stride == 1 and h.shape[-1] == c:
+        h = h + shortcut
+    return h
+
+
+def forward(params: Params, x: jax.Array, arch: str = 'efficientnet_b0',
+            features: bool = True) -> jax.Array:
+    """(B, H, W, 3) normalized frames → (B, head_ch) pooled features (or
+    (B, 1000) logits with ``features=False`` and a loaded classifier)."""
+    x = conv(x, params['conv_stem']['weight'], stride=2, padding=1)
+    x = _bn_silu(x, params['bn1'])
+    for si, (k, s, e, c, r) in enumerate(stage_table(arch)):
+        stage = params['blocks'][str(si)]
+        for bi in range(r):
+            bp = stage[str(bi)]
+            stride = s if bi == 0 else 1
+            if si == 0:
+                x = _ds_block(bp, x, k, stride)
+            else:
+                x = _ir_block(bp, x, k, stride)
+    x = conv(x, params['conv_head']['weight'])
+    x = _bn_silu(x, params['bn2'])
+    x = x.mean(axis=(1, 2))
+    if features:
+        return x
+    cl = params['classifier']    # KeyError on a feature-only checkpoint,
+    return x @ cl['weight'] + cl['bias']  # like the other families
+
+
+def init_state_dict(arch: str = 'efficientnet_b0', seed: int = 0,
+                    num_classes: int = 0) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict with timm 0.9.12 naming/shapes."""
+    rng = np.random.RandomState(seed)
+    sd: Dict[str, np.ndarray] = {}
+
+    def cw(name, o, i, k, bias=False, scale=0.1):
+        sd[f'{name}.weight'] = (rng.randn(o, i, k, k) * scale
+                                ).astype(np.float32)
+        if bias:
+            sd[f'{name}.bias'] = rng.randn(o).astype(np.float32) * 0.02
+
+    def bn(name, c):
+        sd[f'{name}.weight'] = (rng.rand(c) * 0.2 + 0.9).astype(np.float32)
+        sd[f'{name}.bias'] = rng.randn(c).astype(np.float32) * 0.02
+        sd[f'{name}.running_mean'] = (rng.randn(c) * 0.1).astype(np.float32)
+        sd[f'{name}.running_var'] = (rng.rand(c) + 0.5).astype(np.float32)
+
+    stem, head = stem_head_channels(arch)
+    cw('conv_stem', stem, 3, 3)
+    bn('bn1', stem)
+    cin = stem
+    for si, (k, s, e, c, r) in enumerate(stage_table(arch)):
+        for bi in range(r):
+            base = f'blocks.{si}.{bi}'
+            block_in = cin if bi == 0 else c
+            rd = max(1, int(block_in * SE_RATIO))
+            if si == 0:
+                sd[f'{base}.conv_dw.weight'] = (
+                    rng.randn(block_in, 1, k, k) * 0.1).astype(np.float32)
+                bn(f'{base}.bn1', block_in)
+                cw(f'{base}.se.conv_reduce', rd, block_in, 1, bias=True)
+                cw(f'{base}.se.conv_expand', block_in, rd, 1, bias=True)
+                cw(f'{base}.conv_pw', c, block_in, 1)
+                bn(f'{base}.bn2', c)
+            else:
+                ce = block_in * e
+                cw(f'{base}.conv_pw', ce, block_in, 1)
+                bn(f'{base}.bn1', ce)
+                sd[f'{base}.conv_dw.weight'] = (
+                    rng.randn(ce, 1, k, k) * 0.1).astype(np.float32)
+                bn(f'{base}.bn2', ce)
+                cw(f'{base}.se.conv_reduce', rd, ce, 1, bias=True)
+                cw(f'{base}.se.conv_expand', ce, rd, 1, bias=True)
+                cw(f'{base}.conv_pwl', c, ce, 1)
+                bn(f'{base}.bn3', c)
+        cin = c
+    cw('conv_head', head, cin, 1)
+    bn('bn2', head)
+    if num_classes:
+        sd['classifier.weight'] = (
+            rng.randn(num_classes, head) * 0.02).astype(np.float32)
+        sd['classifier.bias'] = np.zeros(num_classes, np.float32)
+    return sd

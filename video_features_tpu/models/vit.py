@@ -92,19 +92,22 @@ def _block(p: Params, x: jax.Array, num_heads: int,
 
 
 def interpolate_pos_embed(pos_embed: jax.Array,
-                          grid: "tuple[int, int]") -> jax.Array:
-    """Resample a (1, 1+g², D) pos embed to a new (gh, gw) patch grid.
+                          grid: "tuple[int, int]",
+                          n_prefix: int = 1) -> jax.Array:
+    """Resample a (1, n_prefix+g², D) pos embed to a new (gh, gw) grid.
 
     The standard timm recipe for non-native input resolutions
-    (`resample_abs_pos_embed`): keep the cls position, bicubically resize
-    the 2-D grid positions. Lets 224-trained checkpoints run at higher
-    resolutions (more tokens — the blockwise-attention regime).
+    (`resample_abs_pos_embed`): keep the ``n_prefix`` prefix positions
+    (cls, plus dist for distilled DeiT), bicubically resize the 2-D grid
+    positions. Lets 224-trained checkpoints run at higher resolutions
+    (more tokens — the blockwise-attention regime).
     """
-    n = pos_embed.shape[1] - 1
+    n = pos_embed.shape[1] - n_prefix
     side = int(round(n ** 0.5))
     if (side, side) == grid:
         return pos_embed
-    cls_pos, grid_pos = pos_embed[:, :1], pos_embed[:, 1:]
+    cls_pos = pos_embed[:, :n_prefix]
+    grid_pos = pos_embed[:, n_prefix:]
     d = pos_embed.shape[-1]
     grid_pos = grid_pos.reshape(1, side, side, d)
     grid_pos = jax.image.resize(grid_pos, (1, grid[0], grid[1], d),
@@ -128,9 +131,11 @@ def embed(params: Params, x: jax.Array,
         dimension_numbers=('NHWC', 'HWIO', 'NHWC')) + k['bias']
     grid = (x.shape[1], x.shape[2])
     x = x.reshape(B, -1, width)
-    cls = jnp.broadcast_to(params['cls_token'], (B, 1, width))
-    return jnp.concatenate([cls, x], axis=1) + interpolate_pos_embed(
-        params['pos_embed'], grid)
+    prefix = [jnp.broadcast_to(params['cls_token'], (B, 1, width))]
+    if 'dist_token' in params:      # distilled DeiT (timm deit.py)
+        prefix.append(jnp.broadcast_to(params['dist_token'], (B, 1, width)))
+    return jnp.concatenate(prefix + [x], axis=1) + interpolate_pos_embed(
+        params['pos_embed'], grid, n_prefix=len(prefix))
 
 
 def trunk(params: Params, tokens: jax.Array, arch: str,
@@ -161,6 +166,16 @@ def forward(params: Params, x: jax.Array, arch: str = 'vit_base_patch16_224',
     """
     x = trunk(params, embed(params, x, arch), arch)
     x = layer_norm(x, params['norm'])
+    if 'dist_token' in params:
+        # distilled DeiT inference (timm deit.py VisionTransformerDistilled):
+        # features = mean of cls and dist tokens; logits = mean of the two
+        # heads' outputs
+        if features:
+            return (x[:, 0] + x[:, 1]) / 2
+        cls_logits = x[:, 0] @ params['head']['weight'] + params['head']['bias']
+        dist_logits = (x[:, 1] @ params['head_dist']['weight']
+                       + params['head_dist']['bias'])
+        return (cls_logits + dist_logits) / 2
     feats = x[:, 0]
     if features:
         return feats
@@ -207,6 +222,15 @@ def forward_sequence_parallel(params: Params, x: jax.Array, mesh,
         out_specs=P(None, axis, None),
     )(params, tokens, valid)
     x = layer_norm(out[:, :N], params['norm'])
+    # same head dispatch as forward() — a distilled checkpoint must yield
+    # identical features on the single-chip and sequence-parallel paths
+    if 'dist_token' in params:
+        if features:
+            return (x[:, 0] + x[:, 1]) / 2
+        cls_logits = x[:, 0] @ params['head']['weight'] + params['head']['bias']
+        dist_logits = (x[:, 1] @ params['head_dist']['weight']
+                       + params['head_dist']['bias'])
+        return (cls_logits + dist_logits) / 2
     feats = x[:, 0]
     if features:
         return feats
@@ -214,11 +238,13 @@ def forward_sequence_parallel(params: Params, x: jax.Array, mesh,
 
 
 def init_state_dict(seed: int = 0, arch: str = 'vit_base_patch16_224',
-                    num_classes: int = 1000) -> Dict[str, np.ndarray]:
-    """Random torch-layout state_dict (keys/shapes as timm saves them)."""
+                    num_classes: int = 1000,
+                    distilled: bool = False) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict (keys/shapes as timm saves them);
+    ``distilled`` adds DeiT's dist_token / head_dist / extra pos slot."""
     cfg = ARCHS[arch]
     width, patch, layers = cfg['width'], cfg['patch'], cfg['layers']
-    n_tokens = 1 + (INPUT_RESOLUTION // patch) ** 2
+    n_tokens = (2 if distilled else 1) + (INPUT_RESOLUTION // patch) ** 2
     rng = np.random.RandomState(seed)
 
     def f32(*shape, scale=0.02):
@@ -234,6 +260,10 @@ def init_state_dict(seed: int = 0, arch: str = 'vit_base_patch16_224',
         'head.weight': f32(num_classes, width),
         'head.bias': np.zeros(num_classes, np.float32),
     }
+    if distilled:
+        sd['dist_token'] = f32(1, 1, width)
+        sd['head_dist.weight'] = f32(num_classes, width)
+        sd['head_dist.bias'] = np.zeros(num_classes, np.float32)
     for i in range(layers):
         b = f'blocks.{i}.'
         sd[b + 'norm1.weight'] = np.ones(width, np.float32)
