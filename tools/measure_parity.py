@@ -232,6 +232,22 @@ def measure_mirrors(ckpt_dir):
         ours = np.asarray(convnext_model.forward(
             transplant(m.state_dict()), x, arch='convnext_tiny'))
     rows.append(('convnext_tiny (timm mirror)', _rel(ours, ref), False))
+
+    from tests.torch_mirrors import TorchSwin
+    from video_features_tpu.models import swin as swin_model
+    torch.manual_seed(0)
+    # 192px: stage-2 runs the real shifted-window mask, stage-3 maps are
+    # smaller than the window (the window-collapse rule)
+    m = TorchSwin('swin_tiny_patch4_window7_224', img_size=192).eval()
+    x = rng.rand(2, 192, 192, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    with _highest():
+        ours = np.asarray(swin_model.forward(
+            transplant(m.state_dict()), x,
+            arch='swin_tiny_patch4_window7_224'))
+    rows.append(('swin_tiny (timm mirror, shifted windows)',
+                 _rel(ours, ref), False))
     return rows
 
 
