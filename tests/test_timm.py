@@ -459,3 +459,22 @@ def test_deit_distilled_registry_and_random_init(tmp_path):
     })
     ex = create_extractor(args)
     assert 'dist_token' in ex.params          # distilled graph selected
+
+
+@pytest.mark.slow
+def test_swin_high_res_extractor(short_video, tmp_path):
+    """image_size works for swin: windows/masks derive from the runtime
+    feature size (stage maps 64->32->16->8 at 256px; stage 3 gets real
+    8>7 windows + shift where 224px collapses it), no pos-embed resample
+    needed (relative bias is window-local)."""
+    args = load_config('timm', overrides={
+        'video_paths': short_video, 'device': 'cpu', 'batch_size': 8,
+        'model_name': 'swin_tiny_patch4_window7_224', 'image_size': 256,
+        'allow_random_weights': True, 'extraction_fps': 1,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    assert ex.data_cfg['crop'] == 256
+    out = ex.extract(short_video)
+    assert out['timm'].shape[1] == 768
+    assert np.isfinite(out['timm']).all()
