@@ -232,3 +232,67 @@ def test_infer_model_name_from_params_rn50(reference_repo):
     params = transplant(model.state_dict(),
                         no_transpose=set(clip_model.NO_TRANSPOSE))
     assert clip_model.infer_model_name_from_params(params) == 'RN50'
+
+
+@pytest.mark.slow
+def test_zero_shot_e2e_golden(torch_clip, video_33, tmp_path):
+    """Whole zero-shot pipeline golden: decode → visual tower → REAL-prompt
+    tokenization → text tower → normalized cosine logits with learned
+    temperature → per-frame softmax, ours vs the reference's own pieces
+    (extract_clip.py:86-105 maybe_show_pred math on run_reference_clip
+    features). Real 'a photo of X' prompts are tokenized with the real BPE,
+    then mapped into the reduced test vocab IDENTICALLY on both sides (the
+    argmax-pooled EOT stays the highest id, model.py:355-368 semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tests.reference_pipeline import run_reference_clip
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    from video_features_tpu.utils.clip_tokenizer import tokenize
+
+    prompts = [f'a photo of {c}' for c in
+               ('archery', 'bowling', 'dancing', 'juggling balls',
+                'playing guitar', 'surfing water')]
+    tokens = np.asarray(tokenize(prompts))
+    # reduced-vocab mapping: content ids into [1, 510), EOT (argmax pool
+    # position = the sequence's max id) pinned to vocab-1, pads stay 0
+    content = tokens > 0
+    eot = tokens == tokens.max(axis=1, keepdims=True)
+    mapped = np.where(content, tokens % 509 + 1, 0)
+    mapped = np.where(eot, 511, mapped).astype(np.int64)
+
+    # reference side: frame features + double-precision zero-shot math
+    ref_vis = run_reference_clip(video_33, torch_clip)
+    with torch.no_grad():
+        ref_txt = torch_clip.encode_text(torch.from_numpy(mapped)).double()
+        v = torch.from_numpy(ref_vis).double()
+        v = v / v.norm(dim=1, keepdim=True)
+        t = ref_txt / ref_txt.norm(dim=1, keepdim=True)
+        ref_logits = (torch_clip.logit_scale.exp().double() * v @ t.T)
+        ref_probs = ref_logits.softmax(dim=-1).numpy()
+
+    # our side: the real extractor end-to-end + the extractor's zero-shot ops
+    ckpt = tmp_path / 'clip_seeded.pt'
+    torch.save(torch_clip.state_dict(), str(ckpt))
+    args = load_config('clip', overrides={
+        'video_paths': video_33, 'device': 'cpu', 'precision': 'highest',
+        'decode_backend': 'cv2', 'batch_size': 16, 'model_name': 'custom',
+        'checkpoint_path': str(ckpt),
+        'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    ours_vis = ex.extract(video_33)['clip']
+    with jax.default_matmul_precision('highest'):
+        ours_txt = np.asarray(clip_model.encode_text(
+            transplant(torch_clip.state_dict(),
+                       no_transpose=set(clip_model.NO_TRANSPOSE)),
+            mapped, 'ViT-B/32'))
+        logits = np.asarray(clip_model.zero_shot_logits(
+            ex.params, jnp.asarray(ours_vis), jnp.asarray(ours_txt)))
+    ours_probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+
+    assert ours_probs.shape == ref_probs.shape == (33, len(prompts))
+    rel = np.linalg.norm(ours_probs - ref_probs) / np.linalg.norm(ref_probs)
+    print(f'[golden e2e] clip zero-shot prob table rel L2: {rel}')
+    assert rel < 1e-3, rel
