@@ -510,6 +510,66 @@ def measure_e2e_vggish(ckpt_dir):
                  real)]
 
 
+def measure_e2e_clip_zeroshot(ckpt_dir):
+    """Whole zero-shot pipeline (decode → visual tower → real-prompt BPE →
+    text tower → temperature cosine logits → softmax) vs the reference's
+    own pieces (extract_clip.py:86-105); prompts' token ids are mapped
+    into the reduced test vocab identically on both sides."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import torch
+
+    from tests.reference_pipeline import build_reference_clip, run_reference_clip
+    from video_features_tpu.config import load_config
+    from video_features_tpu.models import clip as clip_model
+    from video_features_tpu.registry import create_extractor
+    from video_features_tpu.transplant.torch2jax import transplant
+    from video_features_tpu.utils.clip_tokenizer import tokenize
+    with tempfile.TemporaryDirectory() as tmp:
+        video = _make_clip33(tmp)
+        net = build_reference_clip(seed=0)
+        prompts = [f'a photo of {c}' for c in
+                   ('archery', 'bowling', 'dancing', 'juggling balls',
+                    'playing guitar', 'surfing water')]
+        tokens = np.asarray(tokenize(prompts))
+        content = tokens > 0
+        eot = tokens == tokens.max(axis=1, keepdims=True)
+        mapped = np.where(content, tokens % 509 + 1, 0)
+        mapped = np.where(eot, 511, mapped).astype(np.int64)
+
+        ref_vis = run_reference_clip(video, net)
+        with torch.no_grad():
+            t = net.encode_text(torch.from_numpy(mapped)).double()
+            v = torch.from_numpy(ref_vis).double()
+            v = v / v.norm(dim=1, keepdim=True)
+            t = t / t.norm(dim=1, keepdim=True)
+            ref = (net.logit_scale.exp().double()
+                   * v @ t.T).softmax(dim=-1).numpy()
+
+        ckpt = Path(tmp) / 'clip.pt'
+        torch.save(net.state_dict(), str(ckpt))
+        args = load_config('clip', overrides={
+            'video_paths': video, 'device': 'cpu', 'precision': 'highest',
+            'decode_backend': 'cv2', 'batch_size': 16, 'model_name': 'custom',
+            'checkpoint_path': str(ckpt),
+            'output_path': str(Path(tmp) / 'o'),
+            'tmp_path': str(Path(tmp) / 't')})
+        ex = create_extractor(args)
+        vis = ex.extract(video)['clip']
+        with jax.default_matmul_precision('highest'):
+            txt = np.asarray(clip_model.encode_text(
+                transplant(net.state_dict(),
+                           no_transpose=set(clip_model.NO_TRANSPOSE)),
+                mapped, 'ViT-B/32'))
+            logits = clip_model.zero_shot_logits(
+                ex.params, jnp.asarray(vis), jnp.asarray(txt))
+        ours = np.asarray(jax.nn.softmax(logits, axis=-1))
+        return [('E2E clip zero-shot prob table (file→top-k)',
+                 _rel(ours, ref), False)]
+
+
 MEASURES = {
     'i3d': measure_i3d,
     'raft': measure_raft,
@@ -524,6 +584,7 @@ MEASURES = {
     'e2e_resnet': measure_e2e_resnet,
     'e2e_raft': measure_e2e_raft,
     'e2e_vggish': measure_e2e_vggish,
+    'e2e_clip_zeroshot': measure_e2e_clip_zeroshot,
 }
 
 
