@@ -57,3 +57,24 @@ def test_flow_to_image():
     # zero flow maps to (near-)white center of the wheel
     white = flow_to_image(np.zeros((4, 4, 2), np.float32))
     assert (white > 250).all()
+
+
+@pytest.mark.slow
+def test_raft_iters_knob(short_video, tmp_path):
+    """raft_iters controls refinement depth for the raft family (upstream
+    RAFT's own iters parameter, raft_src/raft.py:118): fewer iterations
+    produce a valid flow field and a different (less-refined) result."""
+    def run(iters):
+        args = load_config('raft', overrides={
+            'video_paths': short_video, 'device': 'cpu', 'batch_size': 4,
+            'extraction_total': 5, 'side_size': 128,
+            'raft_iters': iters, 'allow_random_weights': True,
+            'output_path': str(tmp_path / f'o{iters}'),
+            'tmp_path': str(tmp_path / f't{iters}'),
+        })
+        return create_extractor(args).extract(short_video)['raft']
+
+    few, full = run(2), run(20)
+    assert few.shape == full.shape
+    assert np.isfinite(few).all() and np.isfinite(full).all()
+    assert not np.allclose(few, full)      # depth changes the refinement

@@ -559,10 +559,10 @@ def measure_e2e_clip_zeroshot(ckpt_dir):
         ex = create_extractor(args)
         vis = ex.extract(video)['clip']
         with jax.default_matmul_precision('highest'):
-            txt = np.asarray(clip_model.encode_text(
-                transplant(net.state_dict(),
-                           no_transpose=set(clip_model.NO_TRANSPOSE)),
-                mapped, 'ViT-B/32'))
+            # ex.params IS the transplanted checkpoint — reuse it for the
+            # text tower so both towers come from the extractor's load path
+            txt = np.asarray(clip_model.encode_text(ex.params, mapped,
+                                                    ex.arch))
             logits = clip_model.zero_shot_logits(
                 ex.params, jnp.asarray(vis), jnp.asarray(txt))
         ours = np.asarray(jax.nn.softmax(logits, axis=-1))

@@ -149,7 +149,7 @@ class ExtractI3D(BaseExtractor):
         self.stack_size = 64 if args.stack_size is None else args.stack_size
         self.step_size = 64 if args.step_size is None else args.step_size
         # refinement-depth knob; 20 = the fork's pin = full parity
-        self.raft_iters = int(args.get('raft_iters') or raft_model.ITERS)
+        self.raft_iters = raft_model.resolve_iters(args.get('raft_iters'))
         self.extraction_fps = args.extraction_fps
         self.batch_size = args.get('batch_size', 1)
         self.decode_workers = int(args.get('decode_workers', 1))

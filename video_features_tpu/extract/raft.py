@@ -70,13 +70,16 @@ class ExtractRAFT(BaseExtractor):
         # fnet encoding between their two pairs exactly like the
         # single-device path, and no in-graph halo exchange is needed.
         self.data_parallel = args.get('data_parallel', False)
+        # refinement-depth knob; 20 = the fork's pin = full parity
+        self.raft_iters = raft_model.resolve_iters(args.get('raft_iters'))
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
         # thread the resolved device's platform so the corr-lookup dispatch
         # matches where the operands actually live, not the process default
         self._step = jax.jit(partial(self._flow_batch,
                                      platform=self._device.platform,
-                                     pins=self.precision_pins))
+                                     pins=self.precision_pins,
+                                     iters=self.raft_iters))
 
     def load_params(self, args):
         # RAFT checkpoints were saved from nn.DataParallel — prefixes are
@@ -86,10 +89,11 @@ class ExtractRAFT(BaseExtractor):
                             feature_type='raft')
 
     @staticmethod
-    def _flow_batch(params, frames, platform=None, pins=None):
+    def _flow_batch(params, frames, platform=None, pins=None,
+                    iters=raft_model.ITERS):
         """(B+1, Hp, Wp, 3) padded frames → (B, Hp, Wp, 2) flows; interior
         frames are fnet-encoded once (forward_consecutive), not twice."""
-        return raft_model.forward_consecutive(params, frames,
+        return raft_model.forward_consecutive(params, frames, iters=iters,
                                               platform=platform, pins=pins)
 
     def _build_dp_step(self):
@@ -103,6 +107,7 @@ class ExtractRAFT(BaseExtractor):
         from jax.sharding import PartitionSpec as P
         return jax.jit(shard_map(
             partial(raft_model.forward_consecutive,
+                    iters=self.raft_iters,
                     platform=self._device.platform,
                     pins=self.precision_pins),
             mesh=self._mesh, in_specs=(P(), P('data')), out_specs=P('data')))

@@ -45,6 +45,19 @@ CONTEXT_DIM = 128
 ITERS = 20
 
 
+def resolve_iters(value) -> int:
+    """Validate a config ``raft_iters`` (None → the fork's 20-iteration
+    pin). Shared by the i3d and raft extractors so 0/negative values fail
+    loudly instead of silently running full-depth or returning the
+    unrefined init flow."""
+    if value is None:
+        return ITERS
+    iters = int(value)
+    if iters < 1:
+        raise ValueError(f'raft_iters must be >= 1 (got {iters})')
+    return iters
+
+
 # -- encoders ----------------------------------------------------------------
 
 def _residual_block(p: Params, x: jax.Array, norm_fn: str, stride: int) -> jax.Array:
