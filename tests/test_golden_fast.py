@@ -16,7 +16,7 @@ from video_features_tpu.config import load_config
 from video_features_tpu.registry import create_extractor
 
 REL_L2_TARGET = 1e-3
-RAFT_ITERS = 4
+RAFT_ITERS = 2
 
 
 @pytest.fixture(scope='module')
