@@ -16,11 +16,14 @@ def test_forward_shapes_all_archs(arch):
     assert np.isfinite(feats).all()
 
 
-@pytest.mark.parametrize('arch', ['resnet18', 'resnet50'])
+@pytest.mark.parametrize(
+    'arch', ['resnet18', 'resnet50', 'resnext50_32x4d', 'wide_resnet50_2'])
 def test_parity_vs_torch_mirror(arch):
     """Numerics vs a state-dict-compatible torchvision mirror (BasicBlock
-    for 18, Bottleneck/V1.5 for 50) — the net behind reference
-    extract_resnet.py:38-40. rel L2 < 1e-3 at float32."""
+    for 18, Bottleneck/V1.5 for 50, grouped/wide bottlenecks for the
+    resnext/wide variants) — the nets behind reference
+    extract_resnet.py:40 (`models.get_model` accepts them all).
+    rel L2 < 1e-3 at float32."""
     import jax
     import torch
 

@@ -40,14 +40,19 @@ class _TVBasicBlock(nn.Module):
 class _TVBottleneck(nn.Module):
     expansion = 4
 
-    def __init__(self, in_p, planes, stride=1, downsample=None):
+    def __init__(self, in_p, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
-        self.conv1 = nn.Conv2d(in_p, planes, 1, bias=False)
-        self.bn1 = nn.BatchNorm2d(planes)
+        # torchvision Bottleneck: conv1/conv2 at width = planes *
+        # base_width/64 * groups (ResNeXt groups, wide-ResNet base_width)
+        width = int(planes * base_width / 64) * groups
+        self.conv1 = nn.Conv2d(in_p, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
         # stride on the 3x3 = torchvision's ResNet V1.5 convention
-        self.conv2 = nn.Conv2d(planes, planes, 3, stride, 1, bias=False)
-        self.bn2 = nn.BatchNorm2d(planes)
-        self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, groups=groups,
+                               bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, planes * 4, 1, bias=False)
         self.bn3 = nn.BatchNorm2d(planes * 4)
         self.downsample = downsample
 
@@ -63,16 +68,26 @@ class TorchResNet(nn.Module):
     """torchvision.models.resnet* mirror (IMAGENET1K layout)."""
 
     CFGS = {
-        'resnet18': (_TVBasicBlock, [2, 2, 2, 2]),
-        'resnet34': (_TVBasicBlock, [3, 4, 6, 3]),
-        'resnet50': (_TVBottleneck, [3, 4, 6, 3]),
-        'resnet101': (_TVBottleneck, [3, 4, 23, 3]),
-        'resnet152': (_TVBottleneck, [3, 8, 36, 3]),
+        'resnet18': (_TVBasicBlock, [2, 2, 2, 2], {}),
+        'resnet34': (_TVBasicBlock, [3, 4, 6, 3], {}),
+        'resnet50': (_TVBottleneck, [3, 4, 6, 3], {}),
+        'resnet101': (_TVBottleneck, [3, 4, 23, 3], {}),
+        'resnet152': (_TVBottleneck, [3, 8, 36, 3], {}),
+        'resnext50_32x4d': (_TVBottleneck, [3, 4, 6, 3],
+                            dict(groups=32, base_width=4)),
+        'resnext101_32x8d': (_TVBottleneck, [3, 4, 23, 3],
+                             dict(groups=32, base_width=8)),
+        'resnext101_64x4d': (_TVBottleneck, [3, 4, 23, 3],
+                             dict(groups=64, base_width=4)),
+        'wide_resnet50_2': (_TVBottleneck, [3, 4, 6, 3],
+                            dict(base_width=128)),
+        'wide_resnet101_2': (_TVBottleneck, [3, 4, 23, 3],
+                             dict(base_width=128)),
     }
 
     def __init__(self, arch='resnet50', num_classes=1000):
         super().__init__()
-        block, layers = self.CFGS[arch]
+        block, layers, bkw = self.CFGS[arch]
         self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
         self.bn1 = nn.BatchNorm2d(64)
         self.maxpool = nn.MaxPool2d(3, 2, 1)
@@ -87,7 +102,7 @@ class TorchResNet(nn.Module):
                         nn.Conv2d(in_p, planes * block.expansion, 1, stride,
                                   bias=False),
                         nn.BatchNorm2d(planes * block.expansion))
-                blocks.append(block(in_p, planes, stride, down))
+                blocks.append(block(in_p, planes, stride, down, **bkw))
                 in_p = planes * block.expansion
             setattr(self, f'layer{li}', nn.Sequential(*blocks))
         self.fc = nn.Linear(in_p, num_classes)

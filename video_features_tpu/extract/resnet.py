@@ -21,6 +21,11 @@ from video_features_tpu.utils.device import jax_device
 
 RESIZE_SIZE = 256
 CROP_SIZE = 224
+# Per-arch IMAGENET1K_V1 preset deviations (the reference takes transforms
+# straight from the torchvision weights object, extract_resnet.py:41-44;
+# resnext101_64x4d's V1 recipe is resize_size=232 — every other family
+# member's is 256)
+RESIZE_OVERRIDES = {'resnext101_64x4d': 232}
 
 
 class ExtractResNet(BaseFrameWiseExtractor):
@@ -47,7 +52,8 @@ class ExtractResNet(BaseFrameWiseExtractor):
         return resnet_model.forward(params, x, arch=arch, features=True)
 
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
-        frame = short_side_resize_pil(frame, RESIZE_SIZE)
+        frame = short_side_resize_pil(
+            frame, RESIZE_OVERRIDES.get(self.model_name, RESIZE_SIZE))
         return center_crop_host(frame, CROP_SIZE)
 
     def device_step(self, batch: np.ndarray) -> jax.Array:

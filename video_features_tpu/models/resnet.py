@@ -1,12 +1,17 @@
-"""ResNet image backbones (torchvision resnet18/34/50/101/152 layout).
+"""ResNet-family image backbones (torchvision resnet/resnext/wide layout).
 
-Functional re-implementation of the architecture behind the reference resnet
-extractor (reference models/resnet/extract_resnet.py:38-50 uses torchvision
-IMAGENET1K_V1 weights with fc → Identity). Params mirror torchvision
-state_dict names; layout NHWC.
+Functional re-implementation of the architectures behind the reference
+resnet extractor (reference models/resnet/extract_resnet.py:40 builds ANY
+torchvision classification model via ``models.get_model`` with
+IMAGENET1K_V1 weights and fc → Identity — the plain resnets its config
+names plus the grouped ResNeXt and wide variants that ride the same code
+path). Params mirror torchvision state_dict names; layout NHWC. Grouped
+3×3 convs lower to XLA ``feature_group_count`` — still an MXU op per
+group, batched in one conv call.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict
 
 import jax
@@ -28,6 +33,18 @@ ARCHS = {
     'resnet50': dict(block='bottleneck', layers=[3, 4, 6, 3], feat_dim=2048),
     'resnet101': dict(block='bottleneck', layers=[3, 4, 23, 3], feat_dim=2048),
     'resnet152': dict(block='bottleneck', layers=[3, 8, 36, 3], feat_dim=2048),
+    # grouped / wide bottlenecks (torchvision resnet.py: width =
+    # planes * base_width/64 * groups on conv1/conv2, conv2 grouped)
+    'resnext50_32x4d': dict(block='bottleneck', layers=[3, 4, 6, 3],
+                            feat_dim=2048, groups=32, base_width=4),
+    'resnext101_32x8d': dict(block='bottleneck', layers=[3, 4, 23, 3],
+                             feat_dim=2048, groups=32, base_width=8),
+    'resnext101_64x4d': dict(block='bottleneck', layers=[3, 4, 23, 3],
+                             feat_dim=2048, groups=64, base_width=4),
+    'wide_resnet50_2': dict(block='bottleneck', layers=[3, 4, 6, 3],
+                            feat_dim=2048, base_width=128),
+    'wide_resnet101_2': dict(block='bottleneck', layers=[3, 4, 23, 3],
+                             feat_dim=2048, base_width=128),
 }
 
 
@@ -41,10 +58,12 @@ def _basic_block(p: Params, x: jax.Array, stride: int) -> jax.Array:
     return relu(out + identity)
 
 
-def _bottleneck(p: Params, x: jax.Array, stride: int) -> jax.Array:
+def _bottleneck(p: Params, x: jax.Array, stride: int,
+                groups: int = 1) -> jax.Array:
     identity = x
     out = relu(batch_norm(conv(x, p['conv1']['weight']), p['bn1']))
-    out = relu(batch_norm(conv(out, p['conv2']['weight'], stride=stride, padding=1), p['bn2']))
+    out = relu(batch_norm(conv(out, p['conv2']['weight'], stride=stride,
+                               padding=1, groups=groups), p['bn2']))
     out = batch_norm(conv(out, p['conv3']['weight']), p['bn3'])
     if 'downsample' in p:
         identity = batch_norm(conv(x, p['downsample']['0']['weight'], stride=stride),
@@ -56,7 +75,10 @@ def forward(params: Params, x: jax.Array, arch: str = 'resnet50',
             features: bool = True) -> jax.Array:
     """(B, H, W, 3) normalized image → (B, feat_dim) features or logits."""
     cfg = ARCHS[arch]
-    block_fn = _basic_block if cfg['block'] == 'basic' else _bottleneck
+    if cfg['block'] == 'basic':
+        block_fn = _basic_block
+    else:
+        block_fn = partial(_bottleneck, groups=cfg.get('groups', 1))
     x = conv(x, params['conv1']['weight'], stride=2, padding=3)
     x = relu(batch_norm(x, params['bn1']))
     x = max_pool(x, 3, stride=2, padding=1)
@@ -90,8 +112,11 @@ def init_state_dict(seed: int = 0, arch: str = 'resnet50',
     conv_w('conv1.weight', 64, 3, 7); bn('bn1', 64)
     in_p = 64
     expansion = 1 if cfg['block'] == 'basic' else 4
+    groups, base_width = cfg.get('groups', 1), cfg.get('base_width', 64)
     for li, (nb, planes) in enumerate(zip(cfg['layers'], [64, 128, 256, 512]), 1):
         out_p = planes * expansion
+        # torchvision Bottleneck: conv1/conv2 run at `width` channels
+        width = int(planes * base_width / 64) * groups
         for bi in range(nb):
             base = f'layer{li}.{bi}'
             stride = 2 if (li > 1 and bi == 0) else 1
@@ -99,9 +124,9 @@ def init_state_dict(seed: int = 0, arch: str = 'resnet50',
                 conv_w(f'{base}.conv1.weight', planes, in_p, 3); bn(f'{base}.bn1', planes)
                 conv_w(f'{base}.conv2.weight', planes, planes, 3); bn(f'{base}.bn2', planes)
             else:
-                conv_w(f'{base}.conv1.weight', planes, in_p, 1); bn(f'{base}.bn1', planes)
-                conv_w(f'{base}.conv2.weight', planes, planes, 3); bn(f'{base}.bn2', planes)
-                conv_w(f'{base}.conv3.weight', out_p, planes, 1); bn(f'{base}.bn3', out_p)
+                conv_w(f'{base}.conv1.weight', width, in_p, 1); bn(f'{base}.bn1', width)
+                conv_w(f'{base}.conv2.weight', width, width // groups, 3); bn(f'{base}.bn2', width)
+                conv_w(f'{base}.conv3.weight', out_p, width, 1); bn(f'{base}.bn3', out_p)
             if stride != 1 or in_p != out_p:
                 conv_w(f'{base}.downsample.0.weight', out_p, in_p, 1)
                 bn(f'{base}.downsample.1', out_p)
