@@ -136,7 +136,7 @@ def test_e2e_extraction(short_video, tmp_path, model_name, family):
 
 def test_unknown_model_rejected(tmp_path):
     args = load_config('timm', overrides={
-        'model_name': 'mobilenetv3_large_100',
+        'model_name': 'maxvit_tiny_tf_224',
         'video_paths': '/dev/null',
         'device': 'cpu',
         'output_path': str(tmp_path / 'out'),
@@ -477,4 +477,123 @@ def test_swin_high_res_extractor(short_video, tmp_path):
     assert ex.data_cfg['crop'] == 256
     out = ex.extract(short_video)
     assert out['timm'].shape[1] == 768
+    assert np.isfinite(out['timm']).all()
+
+
+def test_regnet_parity_vs_torch_mirror():
+    """RegNetY numerics vs the timm-layout mirror: per-stage grouped 3x3
+    convs (group-width-tied feature_group_count), squeeze-excite sized
+    from the block INPUT width, no-act conv3 + post-sum ReLU, stride-2
+    projection downsample on every stage's first block."""
+    import jax
+
+    from tests.torch_mirrors import TorchRegNet, randomize_bn_stats
+    from video_features_tpu.models import regnet as regnet_model
+
+    torch.manual_seed(0)
+    mirror = TorchRegNet('regnety_008', num_classes=5).eval()
+    randomize_bn_stats(mirror)
+    params = transplant(mirror.state_dict())
+
+    x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+        ref_logits = mirror(xt).numpy()
+        mirror.head.fc = torch.nn.Identity()
+        ref = mirror(xt).numpy()
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(regnet_model.forward(params, x, arch='regnety_008'))
+        got_logits = np.asarray(regnet_model.forward(
+            params, x, arch='regnety_008', features=False))
+
+    assert got.shape == ref.shape == (2, 768)
+    for ours, theirs in ((got, ref), (got_logits, ref_logits)):
+        rel = np.linalg.norm(ours - theirs) / np.linalg.norm(theirs)
+        assert rel < 1e-3, f'rel L2 {rel}'
+
+
+def test_regnet_state_dict_keys_match_mirror():
+    from tests.torch_mirrors import TorchRegNet
+    from video_features_tpu.models import regnet as regnet_model
+
+    for arch in regnet_model.ARCHS:
+        ours = set(regnet_model.init_state_dict(arch))
+        theirs = {k for k in TorchRegNet(arch).state_dict()
+                  if not k.endswith('num_batches_tracked')}
+        assert ours == theirs, arch
+
+
+@pytest.mark.slow
+def test_regnet_extractor_e2e(short_video, tmp_path):
+    args = load_config('timm', overrides={
+        'video_paths': short_video, 'device': 'cpu', 'batch_size': 16,
+        'model_name': 'regnety_004',
+        'allow_random_weights': True, 'extraction_fps': 2,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    assert ex.data_cfg['interpolation'] == 'bicubic'
+    out = ex.extract(short_video)
+    assert out['timm'].shape[1] == 440
+    assert out['timm'].shape[0] > 0
+    assert np.isfinite(out['timm']).all()
+
+
+@pytest.mark.parametrize('arch', ['mobilenetv3_large_100',
+                                  'mobilenetv3_small_100'])
+def test_mobilenetv3_parity_vs_torch_mirror(arch):
+    """MobileNetV3 numerics vs the timm-layout mirror: per-block ReLU vs
+    hard-swish switching, hard-sigmoid-gated SE on only some stages, the
+    post-pool biased head conv, and (small_100) a stride-2 SE'd
+    depthwise-separable stage 0."""
+    import jax
+
+    from tests.torch_mirrors import TorchMobileNetV3, randomize_bn_stats
+    from video_features_tpu.models import mobilenetv3 as mnv3_model
+
+    torch.manual_seed(0)
+    mirror = TorchMobileNetV3(arch, num_classes=5).eval()
+    randomize_bn_stats(mirror)
+    params = transplant(mirror.state_dict())
+
+    x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+        ref_logits = mirror(xt).numpy()
+        mirror.classifier = torch.nn.Identity()
+        ref = mirror(xt).numpy()
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(mnv3_model.forward(params, x, arch=arch))
+        got_logits = np.asarray(mnv3_model.forward(
+            params, x, arch=arch, features=False))
+
+    assert got.shape == ref.shape == (2, mnv3_model.feat_dim(arch))
+    for ours, theirs in ((got, ref), (got_logits, ref_logits)):
+        rel = np.linalg.norm(ours - theirs) / np.linalg.norm(theirs)
+        assert rel < 1e-3, f'{arch}: rel L2 {rel}'
+
+
+def test_mobilenetv3_state_dict_keys_match_mirror():
+    from tests.torch_mirrors import TorchMobileNetV3
+    from video_features_tpu.models import mobilenetv3 as mnv3_model
+
+    for arch in mnv3_model.ARCHS:
+        ours = set(mnv3_model.init_state_dict(arch))
+        theirs = {k for k in TorchMobileNetV3(arch).state_dict()
+                  if not k.endswith('num_batches_tracked')}
+        assert ours == theirs, arch
+
+
+@pytest.mark.slow
+def test_mobilenetv3_extractor_e2e(short_video, tmp_path):
+    args = load_config('timm', overrides={
+        'video_paths': short_video, 'device': 'cpu', 'batch_size': 16,
+        'model_name': 'mobilenetv3_large_100',
+        'allow_random_weights': True, 'extraction_fps': 2,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    out = ex.extract(short_video)
+    assert out['timm'].shape[1] == 1280
+    assert out['timm'].shape[0] > 0
     assert np.isfinite(out['timm']).all()

@@ -584,3 +584,238 @@ class TorchEfficientNet(nn.Module):
                 x = blk(x)
         x = F.silu(self.bn2(self.conv_head(x)))
         return self.classifier(x.mean((2, 3)))
+
+
+# ---------------------------------------------------------------- regnet --
+
+
+class _RegConvNormAct(nn.Module):
+    """timm ConvNormAct: conv (no bias) → bn [→ relu]."""
+
+    def __init__(self, i, o, k, stride=1, padding=0, groups=1, act=True):
+        super().__init__()
+        self.conv = nn.Conv2d(i, o, k, stride, padding, groups=groups,
+                              bias=False)
+        self.bn = nn.BatchNorm2d(o)
+        self._act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return F.relu(x) if self._act else x
+
+
+class _RegSE(nn.Module):
+    """timm SEModule: mean → fc1 (1×1 conv) → relu → fc2 → sigmoid gate."""
+
+    def __init__(self, chs, rd):
+        super().__init__()
+        self.fc1 = nn.Conv2d(chs, rd, 1)
+        self.fc2 = nn.Conv2d(rd, chs, 1)
+
+    def forward(self, x):
+        s = x.mean((2, 3), keepdim=True)
+        s = self.fc2(F.relu(self.fc1(s)))
+        return x * torch.sigmoid(s)
+
+
+class _RegBottleneck(nn.Module):
+    """timm regnet Bottleneck (bottle_ratio 1.0): conv1 1×1 → conv2
+    grouped 3×3 → se (reduce width from the block INPUT channels) →
+    conv3 1×1 no-act, + shortcut, ReLU after the sum."""
+
+    def __init__(self, cin, w, stride, group_w):
+        super().__init__()
+        self.conv1 = _RegConvNormAct(cin, w, 1)
+        self.conv2 = _RegConvNormAct(w, w, 3, stride, 1,
+                                     groups=w // group_w)
+        self.se = _RegSE(w, max(1, int(round(cin * 0.25))))
+        self.conv3 = _RegConvNormAct(w, w, 1, act=False)
+        self.downsample = (_RegConvNormAct(cin, w, 1, stride, act=False)
+                           if stride != 1 or cin != w else None)
+
+    def forward(self, x):
+        sc = x if self.downsample is None else self.downsample(x)
+        h = self.conv3(self.se(self.conv2(self.conv1(x))))
+        return F.relu(h + sc)
+
+
+class _RegStage(nn.Module):
+    def __init__(self, cin, w, depth, group_w):
+        super().__init__()
+        for bi in range(1, depth + 1):
+            self.add_module(f'b{bi}', _RegBottleneck(
+                cin if bi == 1 else w, w, 2 if bi == 1 else 1, group_w))
+
+    def forward(self, x):
+        for blk in self.children():
+            x = blk(x)
+        return x
+
+
+class _RegHead(nn.Module):
+    def __init__(self, cin, num_classes):
+        super().__init__()
+        self.fc = nn.Linear(cin, num_classes) if num_classes else nn.Identity()
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class TorchRegNet(nn.Module):
+    """timm 0.9.12 RegNetY mirror (stem.{conv,bn}, s1..s4.b1..bN with
+    ConvNormAct/SEModule children, head.fc). Reference consumes it through
+    pip-timm (models/timm/extract_timm.py:48)."""
+
+    # (depths, widths, group_width) — the LITERAL published RegNetY stage
+    # tables, deliberately NOT derived from the module under test
+    CFGS = {
+        'regnety_004': ([1, 3, 6, 6], [48, 104, 208, 440], 8),
+        'regnety_008': ([1, 3, 8, 2], [64, 128, 320, 768], 16),
+        'regnety_016': ([2, 6, 17, 2], [48, 120, 336, 888], 24),
+        'regnety_032': ([2, 5, 13, 1], [72, 216, 576, 1512], 24),
+    }
+
+    def __init__(self, arch='regnety_008', num_classes=0):
+        super().__init__()
+        depths, widths, group_w = self.CFGS[arch]
+        self.stem = _RegConvNormAct(3, 32, 3, 2, 1)
+        cin = 32
+        for si, (d, w) in enumerate(zip(depths, widths), start=1):
+            self.add_module(f's{si}', _RegStage(cin, w, d, group_w))
+            cin = w
+        self.head = _RegHead(cin, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        for si in range(1, 5):
+            x = getattr(self, f's{si}')(x)
+        return self.head(x.mean((2, 3)))
+
+
+# ----------------------------------------------------------- mobilenetv3 --
+
+
+class _MnvSE(nn.Module):
+    """timm mobilenetv3 SqueezeExcite: ReLU inside, HARD-sigmoid gate."""
+
+    def __init__(self, chs, rd):
+        super().__init__()
+        self.conv_reduce = nn.Conv2d(chs, rd, 1)
+        self.conv_expand = nn.Conv2d(rd, chs, 1)
+
+    def forward(self, x):
+        s = x.mean((2, 3), keepdim=True)
+        s = self.conv_expand(F.relu(self.conv_reduce(s)))
+        return x * F.hardsigmoid(s)
+
+
+class _MnvBlock(nn.Module):
+    """One timm mobilenetv3 block: 'ds' / 'ir' / 'cn' with per-block
+    activation (relu / hard-swish) and optional SE."""
+
+    def __init__(self, cin, row):
+        super().__init__()
+        self.kind, k, self.stride, mid, out, act, se = row
+        self.cin, self.out = cin, out
+        self.act = F.relu if act == 're' else F.hardswish
+        if self.kind == 'cn':
+            self.conv = nn.Conv2d(cin, out, k, 1, 0, bias=False)
+            self.bn1 = nn.BatchNorm2d(out)
+            return
+        if self.kind == 'ds':
+            self.conv_dw = nn.Conv2d(cin, cin, k, self.stride, k // 2,
+                                     groups=cin, bias=False)
+            self.bn1 = nn.BatchNorm2d(cin)
+            if se:
+                self.se = _MnvSE(cin, se)
+            self.conv_pw = nn.Conv2d(cin, out, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(out)
+            return
+        self.conv_pw = nn.Conv2d(cin, mid, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(mid)
+        self.conv_dw = nn.Conv2d(mid, mid, k, self.stride, k // 2,
+                                 groups=mid, bias=False)
+        self.bn2 = nn.BatchNorm2d(mid)
+        if se:
+            self.se = _MnvSE(mid, se)
+        self.conv_pwl = nn.Conv2d(mid, out, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(out)
+
+    def forward(self, x):
+        if self.kind == 'cn':
+            return self.act(self.bn1(self.conv(x)))
+        if self.kind == 'ds':
+            h = self.act(self.bn1(self.conv_dw(x)))
+            if hasattr(self, 'se'):
+                h = self.se(h)
+            h = self.bn2(self.conv_pw(h))
+        else:
+            h = self.act(self.bn1(self.conv_pw(x)))
+            h = self.act(self.bn2(self.conv_dw(h)))
+            if hasattr(self, 'se'):
+                h = self.se(h)
+            h = self.bn3(self.conv_pwl(h))
+        return x + h if self.stride == 1 and self.cin == self.out else h
+
+
+class TorchMobileNetV3(nn.Module):
+    """timm 0.9.12 MobileNetV3 mirror (conv_stem/bn1, blocks.S.B with
+    efficientnet-style keys, post-pool conv_head WITH bias + hard-swish,
+    classifier). Reference consumes it through pip-timm
+    (models/timm/extract_timm.py:48)."""
+
+    # (kind, kernel, stride, mid, out, act, se) — the LITERAL MobileNetV3
+    # paper tables as timm builds them, deliberately NOT derived from the
+    # module under test
+    CFGS = {
+        'mobilenetv3_large_100': (16, 1280, [
+            [('ds', 3, 1, 16, 16, 're', 0)],
+            [('ir', 3, 2, 64, 24, 're', 0), ('ir', 3, 1, 72, 24, 're', 0)],
+            [('ir', 5, 2, 72, 40, 're', 24), ('ir', 5, 1, 120, 40, 're', 32),
+             ('ir', 5, 1, 120, 40, 're', 32)],
+            [('ir', 3, 2, 240, 80, 'hs', 0), ('ir', 3, 1, 200, 80, 'hs', 0),
+             ('ir', 3, 1, 184, 80, 'hs', 0), ('ir', 3, 1, 184, 80, 'hs', 0)],
+            [('ir', 3, 1, 480, 112, 'hs', 120),
+             ('ir', 3, 1, 672, 112, 'hs', 168)],
+            [('ir', 5, 2, 672, 160, 'hs', 168),
+             ('ir', 5, 1, 960, 160, 'hs', 240),
+             ('ir', 5, 1, 960, 160, 'hs', 240)],
+            [('cn', 1, 1, 0, 960, 'hs', 0)],
+        ]),
+        'mobilenetv3_small_100': (16, 1024, [
+            [('ds', 3, 2, 16, 16, 're', 8)],
+            [('ir', 3, 2, 72, 24, 're', 0), ('ir', 3, 1, 88, 24, 're', 0)],
+            [('ir', 5, 2, 96, 40, 'hs', 24), ('ir', 5, 1, 240, 40, 'hs', 64),
+             ('ir', 5, 1, 240, 40, 'hs', 64)],
+            [('ir', 5, 1, 120, 48, 'hs', 32), ('ir', 5, 1, 144, 48, 'hs', 40)],
+            [('ir', 5, 2, 288, 96, 'hs', 72), ('ir', 5, 1, 576, 96, 'hs', 144),
+             ('ir', 5, 1, 576, 96, 'hs', 144)],
+            [('cn', 1, 1, 0, 576, 'hs', 0)],
+        ]),
+    }
+
+    def __init__(self, arch='mobilenetv3_large_100', num_classes=0):
+        super().__init__()
+        stem, head, stages = self.CFGS[arch]
+        self.conv_stem = nn.Conv2d(3, stem, 3, 2, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(stem)
+        self.blocks = nn.ModuleList()
+        cin = stem
+        for stage in stages:
+            blocks = nn.ModuleList()
+            for row in stage:
+                blocks.append(_MnvBlock(cin, row))
+                cin = row[4]
+            self.blocks.append(blocks)
+        self.conv_head = nn.Conv2d(cin, head, 1, bias=True)
+        self.classifier = (nn.Linear(head, num_classes) if num_classes
+                           else nn.Identity())
+
+    def forward(self, x):
+        x = F.hardswish(self.bn1(self.conv_stem(x)))
+        for stage in self.blocks:
+            for blk in stage:
+                x = blk(x)
+        x = x.mean((2, 3), keepdim=True)
+        x = F.hardswish(self.conv_head(x))
+        return self.classifier(x.flatten(1))

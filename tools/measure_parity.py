@@ -248,6 +248,60 @@ def measure_mirrors(ckpt_dir):
             arch='swin_tiny_patch4_window7_224'))
     rows.append(('swin_tiny (timm mirror, shifted windows)',
                  _rel(ours, ref), False))
+
+    torch.manual_seed(0)
+    m = TorchResNet('resnext50_32x4d').eval()
+    randomize_bn_stats(m)
+    x = rng.rand(2, 112, 112, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    with _highest():
+        ours = np.asarray(resnet_model.forward(
+            transplant(m.state_dict()), x, arch='resnext50_32x4d'))
+    rows.append(('resnext50_32x4d (torchvision mirror, grouped)',
+                 _rel(ours, ref), False))
+
+    from tests.torch_mirrors import TorchEfficientNet
+    from video_features_tpu.models import efficientnet as eff_model
+    torch.manual_seed(0)
+    m = TorchEfficientNet('efficientnet_b0').eval()
+    randomize_bn_stats(m)
+    x = rng.rand(2, 128, 128, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    with _highest():
+        ours = np.asarray(eff_model.forward(
+            transplant(m.state_dict()), x, arch='efficientnet_b0'))
+    rows.append(('efficientnet_b0 (timm mirror, dw/SE)',
+                 _rel(ours, ref), False))
+
+    from tests.torch_mirrors import TorchRegNet
+    from video_features_tpu.models import regnet as regnet_model
+    torch.manual_seed(0)
+    m = TorchRegNet('regnety_008').eval()
+    randomize_bn_stats(m)
+    x = rng.rand(2, 128, 128, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    with _highest():
+        ours = np.asarray(regnet_model.forward(
+            transplant(m.state_dict()), x, arch='regnety_008'))
+    rows.append(('regnety_008 (timm mirror, grouped+SE)',
+                 _rel(ours, ref), False))
+
+    from tests.torch_mirrors import TorchMobileNetV3
+    from video_features_tpu.models import mobilenetv3 as mnv3_model
+    torch.manual_seed(0)
+    m = TorchMobileNetV3('mobilenetv3_large_100').eval()
+    randomize_bn_stats(m)
+    x = rng.rand(2, 128, 128, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    with _highest():
+        ours = np.asarray(mnv3_model.forward(
+            transplant(m.state_dict()), x, arch='mobilenetv3_large_100'))
+    rows.append(('mobilenetv3_large_100 (timm mirror, h-swish/h-sig SE)',
+                 _rel(ours, ref), False))
     return rows
 
 

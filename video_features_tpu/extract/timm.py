@@ -24,6 +24,8 @@ import numpy as np
 from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
 from video_features_tpu.models import convnext as convnext_model
 from video_features_tpu.models import efficientnet as efficientnet_model
+from video_features_tpu.models import mobilenetv3 as mobilenetv3_model
+from video_features_tpu.models import regnet as regnet_model
 from video_features_tpu.models import resnet as resnet_model
 from video_features_tpu.models import swin as swin_model
 from video_features_tpu.models import vit as vit_model
@@ -58,6 +60,12 @@ def _data_cfg(family: str, arch: str = '') -> Dict[str, Any]:
         # timm swin default_cfg: crop_pct 0.9, bicubic, ImageNet stats
         return dict(resize=248, crop=224, interpolation='bicubic',
                     mean=swin_model.MEAN, std=swin_model.STD)
+    if family == 'regnet':
+        # timm regnet _cfg: crop_pct 0.875, bicubic, ImageNet stats
+        return dict(resize=256, crop=224, interpolation='bicubic',
+                    mean=regnet_model.MEAN, std=regnet_model.STD)
+    # resnet and mobilenetv3 share the timm default recipe: crop_pct
+    # 0.875, bilinear, ImageNet stats
     return dict(resize=256, crop=224, interpolation='bilinear',
                 mean=resnet_model.MEAN, std=resnet_model.STD)
 
@@ -92,6 +100,12 @@ def _registry() -> Dict[str, Dict[str, Any]]:
     for name in efficientnet_model.ARCHS:
         reg[name] = dict(family='efficientnet', arch=name,
                          feat_dim=efficientnet_model.feat_dim(name))
+    for name in regnet_model.ARCHS:
+        reg[name] = dict(family='regnet', arch=name,
+                         feat_dim=regnet_model.feat_dim(name))
+    for name in mobilenetv3_model.ARCHS:
+        reg[name] = dict(family='mobilenetv3', arch=name,
+                         feat_dim=mobilenetv3_model.feat_dim(name))
     return reg
 
 
@@ -101,7 +115,8 @@ REGISTRY = _registry()
 # config differs — see _data_cfg)
 _MODEL_MODULES = {'vit': vit_model, 'deit': vit_model,
                   'resnet': resnet_model, 'convnext': convnext_model,
-                  'swin': swin_model, 'efficientnet': efficientnet_model}
+                  'swin': swin_model, 'efficientnet': efficientnet_model,
+                  'regnet': regnet_model, 'mobilenetv3': mobilenetv3_model}
 
 
 class ExtractTIMM(BaseFrameWiseExtractor):
@@ -252,9 +267,9 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                       'table for pooled features')
                 return
             head = self.params.get('head')
-        elif self.family in ('convnext', 'swin'):
+        elif self.family in ('convnext', 'swin', 'regnet'):
             head = (self.params.get('head') or {}).get('fc')
-        elif self.family == 'efficientnet':
+        elif self.family in ('efficientnet', 'mobilenetv3'):
             head = self.params.get('classifier')
         else:
             head = self.params.get('fc')

@@ -1,0 +1,206 @@
+"""MobileNetV3 image backbones (timm `mobilenetv3_*_100` state_dict layout).
+
+The reference's timm extractor accepts any pip-timm model (reference
+models/timm/extract_timm.py:48, timm==0.9.12 pinned); this module natively
+implements MobileNetV3 — the mobile branch of that model space the
+EfficientNet family doesn't cover: per-block activation switching
+(ReLU early, hard-swish late), hard-sigmoid-gated squeeze-excite on only
+SOME stages, and a head 1×1 conv applied AFTER global pooling (so the
+feature dim is the head width, reference extract_timm.py:59-60 keeps it
+under ``reset_classifier(0)``) — against timm 0.9.12's ``MobileNetV3``
+module tree (``conv_stem``/``bn1``, ``blocks.S.B.*`` with the
+efficientnet block key names, ``conv_head`` WITH bias, ``classifier``).
+
+Per-block (kernel, stride, mid, out, act, se) tables are the literal
+MobileNetV3 paper geometries (Howard et al. 2019, tables 1-2) as timm
+builds them, including the make-divisible-by-8 SE widths.
+
+TPU notes: depthwise convs lower to XLA ``feature_group_count=C``;
+hard-swish/hard-sigmoid are fused elementwise ops; the post-pool head
+conv is a (B,1,1,C) matmul. All shapes static.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from video_features_tpu.ops.nn import batch_norm, conv, linear
+
+Params = Dict[str, Any]
+
+# timm mobilenetv3 _cfg: bilinear, crop_pct 0.875, ImageNet stats
+MEAN = (0.485, 0.456, 0.406)
+STD = (0.229, 0.224, 0.225)
+
+# Per-block rows: (kind, kernel, stride, mid_chs, out_chs, act, se_chs)
+# kind: 'ds' (depthwise-separable, no expand conv), 'ir' (inverted
+# residual), 'cn' (plain conv-bn-act). act: 're' ReLU / 'hs' hard-swish.
+# se_chs = 0 → no squeeze-excite. SE widths are timm's
+# round_channels(mid * 0.25) values, written out literally.
+Block = Tuple[str, int, int, int, int, str, int]
+
+ARCHS: Dict[str, Dict[str, Any]] = {
+    'mobilenetv3_large_100': dict(
+        stem=16, head=1280,
+        blocks=[
+            [('ds', 3, 1, 16, 16, 're', 0)],
+            [('ir', 3, 2, 64, 24, 're', 0),
+             ('ir', 3, 1, 72, 24, 're', 0)],
+            [('ir', 5, 2, 72, 40, 're', 24),
+             ('ir', 5, 1, 120, 40, 're', 32),
+             ('ir', 5, 1, 120, 40, 're', 32)],
+            [('ir', 3, 2, 240, 80, 'hs', 0),
+             ('ir', 3, 1, 200, 80, 'hs', 0),
+             ('ir', 3, 1, 184, 80, 'hs', 0),
+             ('ir', 3, 1, 184, 80, 'hs', 0)],
+            [('ir', 3, 1, 480, 112, 'hs', 120),
+             ('ir', 3, 1, 672, 112, 'hs', 168)],
+            [('ir', 5, 2, 672, 160, 'hs', 168),
+             ('ir', 5, 1, 960, 160, 'hs', 240),
+             ('ir', 5, 1, 960, 160, 'hs', 240)],
+            [('cn', 1, 1, 0, 960, 'hs', 0)],
+        ]),
+    'mobilenetv3_small_100': dict(
+        stem=16, head=1024,
+        blocks=[
+            [('ds', 3, 2, 16, 16, 're', 8)],
+            [('ir', 3, 2, 72, 24, 're', 0),
+             ('ir', 3, 1, 88, 24, 're', 0)],
+            [('ir', 5, 2, 96, 40, 'hs', 24),
+             ('ir', 5, 1, 240, 40, 'hs', 64),
+             ('ir', 5, 1, 240, 40, 'hs', 64)],
+            [('ir', 5, 1, 120, 48, 'hs', 32),
+             ('ir', 5, 1, 144, 48, 'hs', 40)],
+            [('ir', 5, 2, 288, 96, 'hs', 72),
+             ('ir', 5, 1, 576, 96, 'hs', 144),
+             ('ir', 5, 1, 576, 96, 'hs', 144)],
+            [('cn', 1, 1, 0, 576, 'hs', 0)],
+        ]),
+}
+
+
+def feat_dim(arch: str) -> int:
+    return ARCHS[arch]['head']
+
+
+def _act(x: jax.Array, kind: str) -> jax.Array:
+    return jax.nn.relu(x) if kind == 're' else jax.nn.hard_swish(x)
+
+
+def _se(p: Params, x: jax.Array) -> jax.Array:
+    """timm mobilenetv3 SqueezeExcite: mean → 1×1 reduce → ReLU → 1×1
+    expand → HARD-sigmoid gate (the v3 paper's h-sigmoid)."""
+    s = x.mean(axis=(1, 2), keepdims=True)
+    s = jax.nn.relu(conv(s, p['conv_reduce']['weight'],
+                         bias=p['conv_reduce']['bias']))
+    s = conv(s, p['conv_expand']['weight'], bias=p['conv_expand']['bias'])
+    return x * jax.nn.hard_sigmoid(s)
+
+
+def _block(p: Params, x: jax.Array, row: Block) -> jax.Array:
+    kind, k, stride, mid, out, act, se = row
+    if kind == 'cn':
+        return _act(batch_norm(conv(x, p['conv']['weight']), p['bn1']), act)
+    cin = x.shape[-1]
+    if kind == 'ds':
+        h = conv(x, p['conv_dw']['weight'], stride=stride, padding=k // 2,
+                 groups=cin)
+        h = _act(batch_norm(h, p['bn1']), act)
+        if se:
+            h = _se(p['se'], h)
+        h = batch_norm(conv(h, p['conv_pw']['weight']), p['bn2'])
+    else:  # 'ir'
+        h = _act(batch_norm(conv(x, p['conv_pw']['weight']), p['bn1']), act)
+        h = conv(h, p['conv_dw']['weight'], stride=stride, padding=k // 2,
+                 groups=mid)
+        h = _act(batch_norm(h, p['bn2']), act)
+        if se:
+            h = _se(p['se'], h)
+        h = batch_norm(conv(h, p['conv_pwl']['weight']), p['bn3'])
+    if stride == 1 and cin == out:
+        h = h + x
+    return h
+
+
+def forward(params: Params, x: jax.Array,
+            arch: str = 'mobilenetv3_large_100',
+            features: bool = True) -> jax.Array:
+    """(B, H, W, 3) normalized frames → (B, head) features (or (B, 1000)
+    logits with ``features=False`` and a loaded classifier). Matches
+    timm's ``num_classes=0`` semantics: global pool FIRST, then the
+    biased head conv + hard-swish."""
+    cfg = ARCHS[arch]
+    x = conv(x, params['conv_stem']['weight'], stride=2, padding=1)
+    x = _act(batch_norm(x, params['bn1']), 'hs')
+    for si, stage in enumerate(cfg['blocks']):
+        sp = params['blocks'][str(si)]
+        for bi, row in enumerate(stage):
+            x = _block(sp[str(bi)], x, row)
+    x = x.mean(axis=(1, 2), keepdims=True)
+    x = conv(x, params['conv_head']['weight'],
+             bias=params['conv_head']['bias'])
+    x = jax.nn.hard_swish(x)
+    x = jnp.squeeze(x, axis=(1, 2))
+    if features:
+        return x
+    return linear(x, params['classifier'])
+
+
+def init_state_dict(arch: str = 'mobilenetv3_large_100', seed: int = 0,
+                    num_classes: int = 0) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict with timm 0.9.12 naming/shapes."""
+    rng = np.random.RandomState(seed)
+    cfg = ARCHS[arch]
+    sd: Dict[str, np.ndarray] = {}
+
+    def cw(name, o, i, k, bias=False, scale=0.1):
+        sd[f'{name}.weight'] = (rng.randn(o, i, k, k) * scale
+                                ).astype(np.float32)
+        if bias:
+            sd[f'{name}.bias'] = rng.randn(o).astype(np.float32) * 0.02
+
+    def bn(name, c):
+        sd[f'{name}.weight'] = (rng.rand(c) * 0.2 + 0.9).astype(np.float32)
+        sd[f'{name}.bias'] = rng.randn(c).astype(np.float32) * 0.02
+        sd[f'{name}.running_mean'] = (rng.randn(c) * 0.1).astype(np.float32)
+        sd[f'{name}.running_var'] = (rng.rand(c) + 0.5).astype(np.float32)
+
+    cw('conv_stem', cfg['stem'], 3, 3)
+    bn('bn1', cfg['stem'])
+    cin = cfg['stem']
+    for si, stage in enumerate(cfg['blocks']):
+        for bi, (kind, k, stride, mid, out, act, se) in enumerate(stage):
+            base = f'blocks.{si}.{bi}'
+            if kind == 'cn':
+                cw(f'{base}.conv', out, cin, k)
+                bn(f'{base}.bn1', out)
+            elif kind == 'ds':
+                sd[f'{base}.conv_dw.weight'] = (
+                    rng.randn(cin, 1, k, k) * 0.1).astype(np.float32)
+                bn(f'{base}.bn1', cin)
+                if se:
+                    cw(f'{base}.se.conv_reduce', se, cin, 1, bias=True)
+                    cw(f'{base}.se.conv_expand', cin, se, 1, bias=True)
+                cw(f'{base}.conv_pw', out, cin, 1)
+                bn(f'{base}.bn2', out)
+            else:
+                cw(f'{base}.conv_pw', mid, cin, 1)
+                bn(f'{base}.bn1', mid)
+                sd[f'{base}.conv_dw.weight'] = (
+                    rng.randn(mid, 1, k, k) * 0.1).astype(np.float32)
+                bn(f'{base}.bn2', mid)
+                if se:
+                    cw(f'{base}.se.conv_reduce', se, mid, 1, bias=True)
+                    cw(f'{base}.se.conv_expand', mid, se, 1, bias=True)
+                cw(f'{base}.conv_pwl', out, mid, 1)
+                bn(f'{base}.bn3', out)
+            cin = out
+    cw('conv_head', cfg['head'], cin, 1, bias=True)
+    if num_classes:
+        sd['classifier.weight'] = (
+            rng.randn(num_classes, cfg['head']) * 0.02).astype(np.float32)
+        sd['classifier.bias'] = np.zeros(num_classes, np.float32)
+    return sd

@@ -1,0 +1,142 @@
+"""RegNetY image backbones (timm `regnety_*` state_dict layout).
+
+The reference's timm extractor accepts any pip-timm model (reference
+models/timm/extract_timm.py:48, timm==0.9.12 pinned); this module natively
+implements the RegNetY family — the design-space-derived grouped-conv
+branch of that model space (per-stage quantized widths, group-width-tied
+grouped 3×3 convs, squeeze-excite sized from the BLOCK INPUT width) —
+against timm 0.9.12's ``RegNet`` module tree (``stem.{conv,bn}``,
+``s{1..4}.b{1..N}.{conv1,conv2,conv3}.{conv,bn}`` + ``se.{fc1,fc2}`` +
+``downsample.{conv,bn}``, ``head.fc``) so real timm checkpoints transplant
+mechanically.
+
+Per-stage (depth, width, group_width) tables are the published RegNetY
+configs (Radosavovic et al., "Designing Network Design Spaces";
+bottle_ratio 1.0 so the bottleneck width equals the stage width). Every
+stage downsamples (stride 2 on its first block); features are the global
+average pool of the last stage, dim = its width.
+
+TPU notes: grouped 3×3 convs lower to one XLA conv with
+``feature_group_count``; SE is a global mean + two 1×1 convs. All shapes
+static.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from video_features_tpu.ops.nn import batch_norm, conv, linear, relu
+
+Params = Dict[str, Any]
+
+# timm regnet _cfg: bicubic, crop_pct 0.875, ImageNet stats
+MEAN = (0.485, 0.456, 0.406)
+STD = (0.229, 0.224, 0.225)
+
+STEM_WIDTH = 32
+SE_RATIO = 0.25
+
+# name: per-stage (depths, widths, group_width)
+ARCHS: Dict[str, Tuple[List[int], List[int], int]] = {
+    'regnety_004': ([1, 3, 6, 6], [48, 104, 208, 440], 8),
+    'regnety_008': ([1, 3, 8, 2], [64, 128, 320, 768], 16),
+    'regnety_016': ([2, 6, 17, 2], [48, 120, 336, 888], 24),
+    'regnety_032': ([2, 5, 13, 1], [72, 216, 576, 1512], 24),
+}
+
+
+def feat_dim(arch: str) -> int:
+    return ARCHS[arch][1][-1]
+
+
+def _conv_bn_act(p: Params, x: jax.Array, stride: int = 1, padding: int = 0,
+                 groups: int = 1, act: bool = True) -> jax.Array:
+    x = batch_norm(conv(x, p['conv']['weight'], stride=stride,
+                        padding=padding, groups=groups), p['bn'])
+    return relu(x) if act else x
+
+
+def _se(p: Params, x: jax.Array) -> jax.Array:
+    """timm SEModule: global mean → 1×1 reduce → ReLU → 1×1 expand →
+    sigmoid gate. Reduce width comes from the checkpoint (timm sizes it
+    from the block INPUT channels × se_ratio, not the bottleneck width)."""
+    s = x.mean(axis=(1, 2), keepdims=True)
+    s = relu(conv(s, p['fc1']['weight'], bias=p['fc1']['bias']))
+    s = conv(s, p['fc2']['weight'], bias=p['fc2']['bias'])
+    return x * jax.nn.sigmoid(s)
+
+
+def _block(p: Params, x: jax.Array, stride: int, groups: int) -> jax.Array:
+    """timm regnet Bottleneck (bottle_ratio 1): 1×1 → grouped 3×3 → SE →
+    1×1 (no act) + shortcut → ReLU."""
+    shortcut = x
+    h = _conv_bn_act(p['conv1'], x)
+    h = _conv_bn_act(p['conv2'], h, stride=stride, padding=1, groups=groups)
+    h = _se(p['se'], h)
+    h = _conv_bn_act(p['conv3'], h, act=False)
+    if 'downsample' in p:
+        shortcut = _conv_bn_act(p['downsample'], x, stride=stride, act=False)
+    return relu(h + shortcut)
+
+
+def forward(params: Params, x: jax.Array, arch: str = 'regnety_008',
+            features: bool = True) -> jax.Array:
+    """(B, H, W, 3) normalized frames → (B, feat_dim) pooled features (or
+    (B, 1000) logits with ``features=False`` and a loaded head)."""
+    depths, widths, group_w = ARCHS[arch]
+    x = _conv_bn_act(params['stem'], x, stride=2, padding=1)
+    for si, (d, w) in enumerate(zip(depths, widths), start=1):
+        stage = params[f's{si}']
+        for bi in range(1, d + 1):
+            x = _block(stage[f'b{bi}'], x, stride=2 if bi == 1 else 1,
+                       groups=w // group_w)
+    x = x.mean(axis=(1, 2))
+    if features:
+        return x
+    return linear(x, params['head']['fc'])
+
+
+def init_state_dict(arch: str = 'regnety_008', seed: int = 0,
+                    num_classes: int = 0) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict with timm 0.9.12 naming/shapes."""
+    rng = np.random.RandomState(seed)
+    depths, widths, group_w = ARCHS[arch]
+    sd: Dict[str, np.ndarray] = {}
+
+    def cw(name, o, i, k, bias=False, scale=0.08):
+        sd[f'{name}.weight'] = (rng.randn(o, i, k, k) * scale
+                                ).astype(np.float32)
+        if bias:
+            sd[f'{name}.bias'] = rng.randn(o).astype(np.float32) * 0.02
+
+    def bn(name, c):
+        sd[f'{name}.weight'] = (rng.rand(c) * 0.2 + 0.9).astype(np.float32)
+        sd[f'{name}.bias'] = rng.randn(c).astype(np.float32) * 0.02
+        sd[f'{name}.running_mean'] = (rng.randn(c) * 0.1).astype(np.float32)
+        sd[f'{name}.running_var'] = (rng.rand(c) + 0.5).astype(np.float32)
+
+    cw('stem.conv', STEM_WIDTH, 3, 3)
+    bn('stem.bn', STEM_WIDTH)
+    cin = STEM_WIDTH
+    for si, (d, w) in enumerate(zip(depths, widths), start=1):
+        for bi in range(1, d + 1):
+            base = f's{si}.b{bi}'
+            groups = w // group_w
+            se_ch = max(1, int(round(cin * SE_RATIO)))
+            cw(f'{base}.conv1.conv', w, cin, 1); bn(f'{base}.conv1.bn', w)
+            cw(f'{base}.conv2.conv', w, w // groups, 3)
+            bn(f'{base}.conv2.bn', w)
+            cw(f'{base}.se.fc1', se_ch, w, 1, bias=True)
+            cw(f'{base}.se.fc2', w, se_ch, 1, bias=True)
+            cw(f'{base}.conv3.conv', w, w, 1); bn(f'{base}.conv3.bn', w)
+            if bi == 1:  # stride-2 first block always needs the projection
+                cw(f'{base}.downsample.conv', w, cin, 1)
+                bn(f'{base}.downsample.bn', w)
+            cin = w
+    if num_classes:
+        sd['head.fc.weight'] = (rng.randn(num_classes, cin) * 0.02
+                                ).astype(np.float32)
+        sd['head.fc.bias'] = np.zeros(num_classes, np.float32)
+    return sd
