@@ -1,0 +1,148 @@
+"""Cross-implementation checks against HuggingFace `transformers`.
+
+The torch mirrors in tests/torch_mirrors.py are written in THIS repo, so a
+shared misreading of an architecture could pass mirror parity. These tests
+compare against `transformers`' independently written models (available in
+the environment, config-instantiated offline with random weights): the HF
+state dict is mechanically re-keyed into the timm layout our transplant
+layer consumes, and both sides run the same input. Agreement here means
+our numerics match code we had no hand in.
+
+The reference consumes these architectures through pip-timm
+(reference models/timm/extract_timm.py:48); HF's ViT is the same
+published architecture (Dosovitskiy et al.) under a different module tree.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+from video_features_tpu.transplant.torch2jax import transplant
+
+transformers = pytest.importorskip('transformers')
+
+
+def _hf_vit_to_timm(hf_sd, depth):
+    """HF ViTModel state dict → timm VisionTransformer naming (the layout
+    models/vit.py mirrors). The only structural difference is HF's split
+    q/k/v projections vs timm's packed qkv."""
+    sd = {
+        'cls_token': hf_sd['embeddings.cls_token'],
+        'pos_embed': hf_sd['embeddings.position_embeddings'],
+        'patch_embed.proj.weight':
+            hf_sd['embeddings.patch_embeddings.projection.weight'],
+        'patch_embed.proj.bias':
+            hf_sd['embeddings.patch_embeddings.projection.bias'],
+        'norm.weight': hf_sd['layernorm.weight'],
+        'norm.bias': hf_sd['layernorm.bias'],
+    }
+    for i in range(depth):
+        h, t = f'encoder.layer.{i}.', f'blocks.{i}.'
+        for ours, theirs in [('norm1', 'layernorm_before'),
+                             ('norm2', 'layernorm_after'),
+                             ('attn.proj', 'attention.output.dense'),
+                             ('mlp.fc1', 'intermediate.dense'),
+                             ('mlp.fc2', 'output.dense')]:
+            sd[t + ours + '.weight'] = hf_sd[h + theirs + '.weight']
+            sd[t + ours + '.bias'] = hf_sd[h + theirs + '.bias']
+        sd[t + 'attn.qkv.weight'] = torch.cat(
+            [hf_sd[h + f'attention.attention.{p}.weight']
+             for p in ('query', 'key', 'value')], dim=0)
+        sd[t + 'attn.qkv.bias'] = torch.cat(
+            [hf_sd[h + f'attention.attention.{p}.bias']
+             for p in ('query', 'key', 'value')], dim=0)
+    return sd
+
+
+def test_vit_parity_vs_hf_transformers():
+    """vit_tiny geometry vs transformers.ViTModel: CLS-token feature after
+    the final LN, rel L2 < 1e-3 at float32."""
+    import jax
+
+    from video_features_tpu.models import vit as vit_model
+
+    cfg = vit_model.ARCHS['vit_tiny_patch16_224']
+    hf_cfg = transformers.ViTConfig(
+        hidden_size=cfg['width'], num_hidden_layers=cfg['layers'],
+        num_attention_heads=cfg['heads'],
+        intermediate_size=cfg['width'] * 4, image_size=224,
+        patch_size=cfg['patch'], hidden_act='gelu',
+        layer_norm_eps=1e-6,           # timm's eps (HF default is 1e-12)
+        attention_probs_dropout_prob=0.0, hidden_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = transformers.ViTModel(hf_cfg, add_pooling_layer=False).eval()
+
+    params = transplant(_hf_vit_to_timm(hf.state_dict(), cfg['layers']))
+    x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32)
+    x = x * 2 - 1
+    with torch.no_grad():
+        out = hf(torch.from_numpy(x).permute(0, 3, 1, 2))
+        ref = out.last_hidden_state[:, 0].numpy()   # CLS after final LN
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(vit_model.forward(
+            params, x, arch='vit_tiny_patch16_224', features=True))
+
+    assert got.shape == ref.shape == (2, cfg['width'])
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 1e-3, f'rel L2 vs transformers ViT: {rel}'
+
+
+def _hf_convnext_to_timm(hf_sd, depths):
+    """HF ConvNextModel state dict → timm ConvNeXt naming (the layout
+    models/convnext.py mirrors)."""
+    sd = {
+        'stem.0.weight': hf_sd['embeddings.patch_embeddings.weight'],
+        'stem.0.bias': hf_sd['embeddings.patch_embeddings.bias'],
+        'stem.1.weight': hf_sd['embeddings.layernorm.weight'],
+        'stem.1.bias': hf_sd['embeddings.layernorm.bias'],
+        'head.norm.weight': hf_sd['layernorm.weight'],
+        'head.norm.bias': hf_sd['layernorm.bias'],
+    }
+    for s, depth in enumerate(depths):
+        h, t = f'encoder.stages.{s}.', f'stages.{s}.'
+        if s > 0:
+            for idx in ('0', '1'):
+                for p in ('weight', 'bias'):
+                    sd[f'{t}downsample.{idx}.{p}'] = hf_sd[
+                        f'{h}downsampling_layer.{idx}.{p}']
+        for j in range(depth):
+            hb, tb = f'{h}layers.{j}.', f'{t}blocks.{j}.'
+            sd[tb + 'gamma'] = hf_sd[hb + 'layer_scale_parameter']
+            for ours, theirs in [('conv_dw', 'dwconv'),
+                                 ('norm', 'layernorm'),
+                                 ('mlp.fc1', 'pwconv1'),
+                                 ('mlp.fc2', 'pwconv2')]:
+                sd[tb + ours + '.weight'] = hf_sd[hb + theirs + '.weight']
+                sd[tb + ours + '.bias'] = hf_sd[hb + theirs + '.bias']
+    return sd
+
+
+def test_convnext_parity_vs_hf_transformers():
+    """convnext_tiny vs transformers.ConvNextModel: pooled feature after
+    the head LayerNorm (HF pooler_output), rel L2 < 1e-3 at float32."""
+    import jax
+
+    from video_features_tpu.models import convnext as convnext_model
+
+    cfg = convnext_model.ARCHS['convnext_tiny']
+    hf_cfg = transformers.ConvNextConfig(
+        depths=list(cfg['depths']), hidden_sizes=list(cfg['dims']),
+        layer_norm_eps=1e-6, hidden_act='gelu')
+    torch.manual_seed(0)
+    hf = transformers.ConvNextModel(hf_cfg).eval()
+
+    params = transplant(_hf_convnext_to_timm(hf.state_dict(),
+                                             cfg['depths']))
+    x = np.random.RandomState(1).rand(2, 96, 96, 3).astype(np.float32)
+    x = x * 2 - 1
+    with torch.no_grad():
+        out = hf(torch.from_numpy(x).permute(0, 3, 1, 2))
+        ref = out.pooler_output.numpy()      # LN(global mean pool)
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(convnext_model.forward(
+            params, x, arch='convnext_tiny', features=True))
+
+    assert got.shape == ref.shape == (2, cfg['dims'][-1])
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 1e-3, f'rel L2 vs transformers ConvNext: {rel}'

@@ -223,85 +223,45 @@ def measure_mirrors(ckpt_dir):
                                              arch='r2plus1d_18'))
     rows.append(('r2plus1d_18 (torchvision mirror)', _rel(ours, ref), real))
 
-    torch.manual_seed(0)
-    m = TorchConvNeXt('convnext_tiny').eval()
-    x = rng.rand(2, 96, 96, 3).astype(np.float32) * 2 - 1
-    with torch.no_grad():
-        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
-    with _highest():
-        ours = np.asarray(convnext_model.forward(
-            transplant(m.state_dict()), x, arch='convnext_tiny'))
-    rows.append(('convnext_tiny (timm mirror)', _rel(ours, ref), False))
-
-    from tests.torch_mirrors import TorchSwin
-    from video_features_tpu.models import swin as swin_model
-    torch.manual_seed(0)
-    # 192px: stage-2 runs the real shifted-window mask, stage-3 maps are
-    # smaller than the window (the window-collapse rule)
-    m = TorchSwin('swin_tiny_patch4_window7_224', img_size=192).eval()
-    x = rng.rand(2, 192, 192, 3).astype(np.float32) * 2 - 1
-    with torch.no_grad():
-        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
-    with _highest():
-        ours = np.asarray(swin_model.forward(
-            transplant(m.state_dict()), x,
-            arch='swin_tiny_patch4_window7_224'))
-    rows.append(('swin_tiny (timm mirror, shifted windows)',
-                 _rel(ours, ref), False))
-
-    torch.manual_seed(0)
-    m = TorchResNet('resnext50_32x4d').eval()
-    randomize_bn_stats(m)
-    x = rng.rand(2, 112, 112, 3).astype(np.float32) * 2 - 1
-    with torch.no_grad():
-        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
-    with _highest():
-        ours = np.asarray(resnet_model.forward(
-            transplant(m.state_dict()), x, arch='resnext50_32x4d'))
-    rows.append(('resnext50_32x4d (torchvision mirror, grouped)',
-                 _rel(ours, ref), False))
-
-    from tests.torch_mirrors import TorchEfficientNet
+    # random-weight mirror rows, one per native timm-layout family:
+    # (label, mirror class, mirror kwargs, model module, arch, input px).
+    # Each runs seed → randomize BN stats (no-op for LN-only nets) →
+    # torch forward → transplant → ours, identically.
+    from tests.torch_mirrors import (
+        TorchEfficientNet, TorchMobileNetV3, TorchRegNet, TorchSwin,
+    )
     from video_features_tpu.models import efficientnet as eff_model
-    torch.manual_seed(0)
-    m = TorchEfficientNet('efficientnet_b0').eval()
-    randomize_bn_stats(m)
-    x = rng.rand(2, 128, 128, 3).astype(np.float32) * 2 - 1
-    with torch.no_grad():
-        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
-    with _highest():
-        ours = np.asarray(eff_model.forward(
-            transplant(m.state_dict()), x, arch='efficientnet_b0'))
-    rows.append(('efficientnet_b0 (timm mirror, dw/SE)',
-                 _rel(ours, ref), False))
-
-    from tests.torch_mirrors import TorchRegNet
-    from video_features_tpu.models import regnet as regnet_model
-    torch.manual_seed(0)
-    m = TorchRegNet('regnety_008').eval()
-    randomize_bn_stats(m)
-    x = rng.rand(2, 128, 128, 3).astype(np.float32) * 2 - 1
-    with torch.no_grad():
-        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
-    with _highest():
-        ours = np.asarray(regnet_model.forward(
-            transplant(m.state_dict()), x, arch='regnety_008'))
-    rows.append(('regnety_008 (timm mirror, grouped+SE)',
-                 _rel(ours, ref), False))
-
-    from tests.torch_mirrors import TorchMobileNetV3
     from video_features_tpu.models import mobilenetv3 as mnv3_model
-    torch.manual_seed(0)
-    m = TorchMobileNetV3('mobilenetv3_large_100').eval()
-    randomize_bn_stats(m)
-    x = rng.rand(2, 128, 128, 3).astype(np.float32) * 2 - 1
-    with torch.no_grad():
-        ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
-    with _highest():
-        ours = np.asarray(mnv3_model.forward(
-            transplant(m.state_dict()), x, arch='mobilenetv3_large_100'))
-    rows.append(('mobilenetv3_large_100 (timm mirror, h-swish/h-sig SE)',
-                 _rel(ours, ref), False))
+    from video_features_tpu.models import regnet as regnet_model
+    from video_features_tpu.models import swin as swin_model
+    mirror_specs = [
+        ('convnext_tiny (timm mirror)',
+         TorchConvNeXt, {}, convnext_model, 'convnext_tiny', 96),
+        # 192px: stage-2 runs the real shifted-window mask, stage-3 maps
+        # are smaller than the window (the window-collapse rule)
+        ('swin_tiny (timm mirror, shifted windows)',
+         TorchSwin, dict(img_size=192), swin_model,
+         'swin_tiny_patch4_window7_224', 192),
+        ('resnext50_32x4d (torchvision mirror, grouped)',
+         TorchResNet, {}, resnet_model, 'resnext50_32x4d', 112),
+        ('efficientnet_b0 (timm mirror, dw/SE)',
+         TorchEfficientNet, {}, eff_model, 'efficientnet_b0', 128),
+        ('regnety_008 (timm mirror, grouped+SE)',
+         TorchRegNet, {}, regnet_model, 'regnety_008', 128),
+        ('mobilenetv3_large_100 (timm mirror, h-swish/h-sig SE)',
+         TorchMobileNetV3, {}, mnv3_model, 'mobilenetv3_large_100', 128),
+    ]
+    for label, mirror_cls, kwargs, module, arch, px in mirror_specs:
+        torch.manual_seed(0)
+        m = mirror_cls(arch, **kwargs).eval()
+        randomize_bn_stats(m)
+        x = rng.rand(2, px, px, 3).astype(np.float32) * 2 - 1
+        with torch.no_grad():
+            ref = m(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+        with _highest():
+            ours = np.asarray(module.forward(
+                transplant(m.state_dict()), x, arch=arch))
+        rows.append((label, _rel(ours, ref), False))
     return rows
 
 

@@ -164,20 +164,11 @@ def forward(params: Params, x: jax.Array, arch: str = 'efficientnet_b0',
 def init_state_dict(arch: str = 'efficientnet_b0', seed: int = 0,
                     num_classes: int = 0) -> Dict[str, np.ndarray]:
     """Random torch-layout state_dict with timm 0.9.12 naming/shapes."""
+    from video_features_tpu.models._seed import SeedWriter
     rng = np.random.RandomState(seed)
     sd: Dict[str, np.ndarray] = {}
-
-    def cw(name, o, i, k, bias=False, scale=0.1):
-        sd[f'{name}.weight'] = (rng.randn(o, i, k, k) * scale
-                                ).astype(np.float32)
-        if bias:
-            sd[f'{name}.bias'] = rng.randn(o).astype(np.float32) * 0.02
-
-    def bn(name, c):
-        sd[f'{name}.weight'] = (rng.rand(c) * 0.2 + 0.9).astype(np.float32)
-        sd[f'{name}.bias'] = rng.randn(c).astype(np.float32) * 0.02
-        sd[f'{name}.running_mean'] = (rng.randn(c) * 0.1).astype(np.float32)
-        sd[f'{name}.running_var'] = (rng.rand(c) + 0.5).astype(np.float32)
+    w_ = SeedWriter(sd, rng)
+    cw, bn = w_.conv, w_.bn
 
     stem, head = stem_head_channels(arch)
     cw('conv_stem', stem, 3, 3)
@@ -189,8 +180,7 @@ def init_state_dict(arch: str = 'efficientnet_b0', seed: int = 0,
             block_in = cin if bi == 0 else c
             rd = max(1, int(block_in * SE_RATIO))
             if si == 0:
-                sd[f'{base}.conv_dw.weight'] = (
-                    rng.randn(block_in, 1, k, k) * 0.1).astype(np.float32)
+                w_.dwconv(f'{base}.conv_dw', block_in, k)
                 bn(f'{base}.bn1', block_in)
                 cw(f'{base}.se.conv_reduce', rd, block_in, 1, bias=True)
                 cw(f'{base}.se.conv_expand', block_in, rd, 1, bias=True)
@@ -200,8 +190,7 @@ def init_state_dict(arch: str = 'efficientnet_b0', seed: int = 0,
                 ce = block_in * e
                 cw(f'{base}.conv_pw', ce, block_in, 1)
                 bn(f'{base}.bn1', ce)
-                sd[f'{base}.conv_dw.weight'] = (
-                    rng.randn(ce, 1, k, k) * 0.1).astype(np.float32)
+                w_.dwconv(f'{base}.conv_dw', ce, k)
                 bn(f'{base}.bn2', ce)
                 cw(f'{base}.se.conv_reduce', rd, ce, 1, bias=True)
                 cw(f'{base}.se.conv_expand', ce, rd, 1, bias=True)
@@ -211,7 +200,5 @@ def init_state_dict(arch: str = 'efficientnet_b0', seed: int = 0,
     cw('conv_head', head, cin, 1)
     bn('bn2', head)
     if num_classes:
-        sd['classifier.weight'] = (
-            rng.randn(num_classes, head) * 0.02).astype(np.float32)
-        sd['classifier.bias'] = np.zeros(num_classes, np.float32)
+        w_.linear('classifier', num_classes, head)
     return sd

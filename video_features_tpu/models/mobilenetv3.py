@@ -21,7 +21,7 @@ conv is a (B,1,1,C) matmul. All shapes static.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -152,21 +152,12 @@ def forward(params: Params, x: jax.Array,
 def init_state_dict(arch: str = 'mobilenetv3_large_100', seed: int = 0,
                     num_classes: int = 0) -> Dict[str, np.ndarray]:
     """Random torch-layout state_dict with timm 0.9.12 naming/shapes."""
+    from video_features_tpu.models._seed import SeedWriter
     rng = np.random.RandomState(seed)
     cfg = ARCHS[arch]
     sd: Dict[str, np.ndarray] = {}
-
-    def cw(name, o, i, k, bias=False, scale=0.1):
-        sd[f'{name}.weight'] = (rng.randn(o, i, k, k) * scale
-                                ).astype(np.float32)
-        if bias:
-            sd[f'{name}.bias'] = rng.randn(o).astype(np.float32) * 0.02
-
-    def bn(name, c):
-        sd[f'{name}.weight'] = (rng.rand(c) * 0.2 + 0.9).astype(np.float32)
-        sd[f'{name}.bias'] = rng.randn(c).astype(np.float32) * 0.02
-        sd[f'{name}.running_mean'] = (rng.randn(c) * 0.1).astype(np.float32)
-        sd[f'{name}.running_var'] = (rng.rand(c) + 0.5).astype(np.float32)
+    w_ = SeedWriter(sd, rng)
+    cw, bn = w_.conv, w_.bn
 
     cw('conv_stem', cfg['stem'], 3, 3)
     bn('bn1', cfg['stem'])
@@ -178,8 +169,7 @@ def init_state_dict(arch: str = 'mobilenetv3_large_100', seed: int = 0,
                 cw(f'{base}.conv', out, cin, k)
                 bn(f'{base}.bn1', out)
             elif kind == 'ds':
-                sd[f'{base}.conv_dw.weight'] = (
-                    rng.randn(cin, 1, k, k) * 0.1).astype(np.float32)
+                w_.dwconv(f'{base}.conv_dw', cin, k)
                 bn(f'{base}.bn1', cin)
                 if se:
                     cw(f'{base}.se.conv_reduce', se, cin, 1, bias=True)
@@ -189,8 +179,7 @@ def init_state_dict(arch: str = 'mobilenetv3_large_100', seed: int = 0,
             else:
                 cw(f'{base}.conv_pw', mid, cin, 1)
                 bn(f'{base}.bn1', mid)
-                sd[f'{base}.conv_dw.weight'] = (
-                    rng.randn(mid, 1, k, k) * 0.1).astype(np.float32)
+                w_.dwconv(f'{base}.conv_dw', mid, k)
                 bn(f'{base}.bn2', mid)
                 if se:
                     cw(f'{base}.se.conv_reduce', se, mid, 1, bias=True)
@@ -200,7 +189,5 @@ def init_state_dict(arch: str = 'mobilenetv3_large_100', seed: int = 0,
             cin = out
     cw('conv_head', cfg['head'], cin, 1, bias=True)
     if num_classes:
-        sd['classifier.weight'] = (
-            rng.randn(num_classes, cfg['head']) * 0.02).astype(np.float32)
-        sd['classifier.bias'] = np.zeros(num_classes, np.float32)
+        w_.linear('classifier', num_classes, cfg['head'])
     return sd

@@ -101,21 +101,12 @@ def forward(params: Params, x: jax.Array, arch: str = 'regnety_008',
 def init_state_dict(arch: str = 'regnety_008', seed: int = 0,
                     num_classes: int = 0) -> Dict[str, np.ndarray]:
     """Random torch-layout state_dict with timm 0.9.12 naming/shapes."""
+    from video_features_tpu.models._seed import SeedWriter
     rng = np.random.RandomState(seed)
     depths, widths, group_w = ARCHS[arch]
     sd: Dict[str, np.ndarray] = {}
-
-    def cw(name, o, i, k, bias=False, scale=0.08):
-        sd[f'{name}.weight'] = (rng.randn(o, i, k, k) * scale
-                                ).astype(np.float32)
-        if bias:
-            sd[f'{name}.bias'] = rng.randn(o).astype(np.float32) * 0.02
-
-    def bn(name, c):
-        sd[f'{name}.weight'] = (rng.rand(c) * 0.2 + 0.9).astype(np.float32)
-        sd[f'{name}.bias'] = rng.randn(c).astype(np.float32) * 0.02
-        sd[f'{name}.running_mean'] = (rng.randn(c) * 0.1).astype(np.float32)
-        sd[f'{name}.running_var'] = (rng.rand(c) + 0.5).astype(np.float32)
+    w_ = SeedWriter(sd, rng, conv_scale=0.08)
+    cw, bn = w_.conv, w_.bn
 
     cw('stem.conv', STEM_WIDTH, 3, 3)
     bn('stem.bn', STEM_WIDTH)
@@ -136,7 +127,5 @@ def init_state_dict(arch: str = 'regnety_008', seed: int = 0,
                 bn(f'{base}.downsample.bn', w)
             cin = w
     if num_classes:
-        sd['head.fc.weight'] = (rng.randn(num_classes, cin) * 0.02
-                                ).astype(np.float32)
-        sd['head.fc.bias'] = np.zeros(num_classes, np.float32)
+        w_.linear('head.fc', num_classes, cin)
     return sd
