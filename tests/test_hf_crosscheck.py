@@ -146,3 +146,155 @@ def test_convnext_parity_vs_hf_transformers():
     assert got.shape == ref.shape == (2, cfg['dims'][-1])
     rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
     assert rel < 1e-3, f'rel L2 vs transformers ConvNext: {rel}'
+
+
+def _hf_swin_to_timm(hf_sd, depths):
+    """HF SwinModel state dict → timm 0.9.12 Swin naming (the layout
+    models/swin.py mirrors). Structural differences: HF splits q/k/v
+    (timm packs qkv), and HF hangs each PatchMerging off the END of
+    stage L where timm 0.9.12 puts it at the START of stage L+1 —
+    identical math, shifted key prefix."""
+    sd = {
+        'patch_embed.proj.weight':
+            hf_sd['embeddings.patch_embeddings.projection.weight'],
+        'patch_embed.proj.bias':
+            hf_sd['embeddings.patch_embeddings.projection.bias'],
+        'patch_embed.norm.weight': hf_sd['embeddings.norm.weight'],
+        'patch_embed.norm.bias': hf_sd['embeddings.norm.bias'],
+        'norm.weight': hf_sd['layernorm.weight'],
+        'norm.bias': hf_sd['layernorm.bias'],
+    }
+    for li, depth in enumerate(depths):
+        if li > 0:   # HF stage li-1's tail merge == timm stage li's head
+            for ours, theirs in [('norm', 'norm'),
+                                 ('reduction', 'reduction')]:
+                for p in ('weight', 'bias'):
+                    key = f'encoder.layers.{li - 1}.downsample.{theirs}.{p}'
+                    if key in hf_sd:   # reduction has no bias
+                        sd[f'layers.{li}.downsample.{ours}.{p}'] = hf_sd[key]
+        for b in range(depth):
+            h = f'encoder.layers.{li}.blocks.{b}.'
+            t = f'layers.{li}.blocks.{b}.'
+            sd[t + 'attn.relative_position_bias_table'] = hf_sd[
+                h + 'attention.self.relative_position_bias_table']
+            sd[t + 'attn.qkv.weight'] = torch.cat(
+                [hf_sd[h + f'attention.self.{p}.weight']
+                 for p in ('query', 'key', 'value')], dim=0)
+            sd[t + 'attn.qkv.bias'] = torch.cat(
+                [hf_sd[h + f'attention.self.{p}.bias']
+                 for p in ('query', 'key', 'value')], dim=0)
+            for ours, theirs in [('norm1', 'layernorm_before'),
+                                 ('norm2', 'layernorm_after'),
+                                 ('attn.proj', 'attention.output.dense'),
+                                 ('mlp.fc1', 'intermediate.dense'),
+                                 ('mlp.fc2', 'output.dense')]:
+                sd[t + ours + '.weight'] = hf_sd[h + theirs + '.weight']
+                sd[t + ours + '.bias'] = hf_sd[h + theirs + '.bias']
+    return sd
+
+
+def test_swin_parity_vs_hf_transformers():
+    """swin_tiny vs transformers.SwinModel at full 224 geometry (stage
+    maps 56/28/14/7: real shift masks in stages 0-2, window-collapse in
+    stage 3): mean-pooled feature after the final LN (HF pooler_output),
+    rel L2 < 1e-3 at float32."""
+    import jax
+
+    from video_features_tpu.models import swin as swin_model
+
+    depths = [2, 2, 6, 2]
+    hf_cfg = transformers.SwinConfig(
+        image_size=224, patch_size=4, embed_dim=96, depths=depths,
+        num_heads=[3, 6, 12, 24], window_size=7, hidden_act='gelu',
+        use_absolute_embeddings=False, layer_norm_eps=1e-5,
+        drop_path_rate=0.0, attention_probs_dropout_prob=0.0,
+        hidden_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = transformers.SwinModel(hf_cfg, add_pooling_layer=True).eval()
+
+    params = transplant(_hf_swin_to_timm(hf.state_dict(), depths))
+    x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32)
+    x = x * 2 - 1
+    with torch.no_grad():
+        out = hf(torch.from_numpy(x).permute(0, 3, 1, 2))
+        ref = out.pooler_output.numpy()      # mean over tokens after LN
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(swin_model.forward(
+            params, x, arch='swin_tiny_patch4_window7_224'))
+
+    assert got.shape == ref.shape == (2, 768)
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 1e-3, f'rel L2 vs transformers Swin: {rel}'
+
+
+def _hf_regnet_to_timm(hf_sd, depths):
+    """HF RegNetModel ('y' layer type) state dict → timm 0.9.12 RegNet
+    naming (the layout models/regnet.py mirrors). HF nests each block's
+    conv stack in a Sequential (layer.0/1/3 = conv1/conv2/conv3, layer.2
+    = SE with attention.0/attention.2 as reduce/expand) and calls the
+    projection 'shortcut'."""
+    sd = {}
+
+    def cna(t, h):
+        sd[f'{t}.conv.weight'] = hf_sd[f'{h}.convolution.weight']
+        for p in ('weight', 'bias', 'running_mean', 'running_var'):
+            sd[f'{t}.bn.{p}'] = hf_sd[f'{h}.normalization.{p}']
+
+    cna('stem', 'embedder.embedder')
+    for si, depth in enumerate(depths):
+        for j in range(depth):
+            h = f'encoder.stages.{si}.layers.{j}'
+            t = f's{si + 1}.b{j + 1}'
+            cna(f'{t}.conv1', f'{h}.layer.0')
+            cna(f'{t}.conv2', f'{h}.layer.1')
+            cna(f'{t}.conv3', f'{h}.layer.3')
+            for ours, theirs in [('fc1', 'attention.0'),
+                                 ('fc2', 'attention.2')]:
+                for p in ('weight', 'bias'):
+                    sd[f'{t}.se.{ours}.{p}'] = hf_sd[
+                        f'{h}.layer.2.{theirs}.{p}']
+            if f'{h}.shortcut.convolution.weight' in hf_sd:
+                cna(f'{t}.downsample', f'{h}.shortcut')
+    return sd
+
+
+def test_regnet_parity_vs_hf_transformers():
+    """regnety_008 vs transformers.RegNetModel: pooled feature (HF
+    pooler_output), rel L2 < 1e-3 at float32. BN running stats and affine
+    params are randomized so the transplant of those tensors is actually
+    exercised (fresh BN is mean=0/var=1/γ=1/β=0, which would hide
+    weight↔bias swaps)."""
+    import jax
+
+    from video_features_tpu.models import regnet as regnet_model
+
+    depths, widths, group_w = regnet_model.ARCHS['regnety_008']
+    hf_cfg = transformers.RegNetConfig(
+        embedding_size=32, hidden_sizes=list(widths), depths=list(depths),
+        groups_width=group_w, layer_type='y', hidden_act='relu')
+    torch.manual_seed(0)
+    hf = transformers.RegNetModel(hf_cfg).eval()
+    gen = torch.Generator().manual_seed(3)
+    for m in hf.modules():
+        if isinstance(m, torch.nn.BatchNorm2d):
+            m.running_mean = torch.randn(m.num_features, generator=gen) * 0.1
+            m.running_var = torch.rand(m.num_features, generator=gen) + 0.5
+            with torch.no_grad():
+                m.weight.copy_(torch.rand(m.num_features, generator=gen)
+                               * 0.2 + 0.9)
+                m.bias.copy_(torch.randn(m.num_features, generator=gen)
+                             * 0.02)
+
+    params = transplant(_hf_regnet_to_timm(hf.state_dict(), depths))
+    x = np.random.RandomState(1).rand(2, 128, 128, 3).astype(np.float32)
+    x = x * 2 - 1
+    with torch.no_grad():
+        out = hf(torch.from_numpy(x).permute(0, 3, 1, 2))
+        ref = out.pooler_output.numpy().reshape(2, -1)
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(regnet_model.forward(
+            params, x, arch='regnety_008'))
+
+    assert got.shape == ref.shape == (2, widths[-1])
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 1e-3, f'rel L2 vs transformers RegNet: {rel}'
