@@ -1,14 +1,31 @@
 """Test env: force JAX onto CPU with 8 virtual devices so sharding tests run
-without TPU hardware. Must run before jax is imported anywhere."""
+without TPU hardware. Must run before jax is imported anywhere.
+
+``VFT_TEST_PLATFORM=native`` leaves the process's real backend alone —
+required for the ``tpu``-marked hardware lane (``VFT_TEST_PLATFORM=native
+pytest -m tpu``), which would otherwise see the forced-CPU backend and
+skip itself on every host."""
 import os
 import sys
 from pathlib import Path
 
-os.environ['JAX_PLATFORMS'] = 'cpu'
-xla_flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in xla_flags:
-    os.environ['XLA_FLAGS'] = (
-        xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+_PLAT = os.environ.get('VFT_TEST_PLATFORM', 'cpu')
+if _PLAT not in ('cpu', 'native'):
+    raise SystemExit(
+        f'VFT_TEST_PLATFORM={_PLAT!r} is not recognized: use "cpu" (the '
+        f'default hermetic 8-virtual-device environment) or "native" '
+        f'(real hardware, for the `-m tpu` lane)')
+_NATIVE = _PLAT == 'native'
+if _NATIVE:
+    print('conftest: VFT_TEST_PLATFORM=native — running on the REAL '
+          'backend (no CPU pin, no 8-device virtual mesh); intended for '
+          'the `-m tpu` hardware lane only', file=sys.stderr)
+if not _NATIVE:
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    xla_flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in xla_flags:
+        os.environ['XLA_FLAGS'] = (
+            xla_flags + ' --xla_force_host_platform_device_count=8').strip()
 
 # A site hook may have pre-imported jax with JAX_PLATFORMS pointed at a
 # remote TPU backend; the env var above is then too late (the config read
@@ -16,7 +33,8 @@ if '--xla_force_host_platform_device_count' not in xla_flags:
 # so tests never try to dial real hardware.
 import jax  # noqa: E402
 
-jax.config.update('jax_platforms', 'cpu')
+if not _NATIVE:
+    jax.config.update('jax_platforms', 'cpu')
 
 # Pretrained blobs are not bundled: the suite intentionally runs random
 # weights (parity tests transplant seeded torch modules instead). The
@@ -31,6 +49,19 @@ if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
 
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Scope native mode to the hardware lane: everything NOT tpu-marked
+    assumes the hermetic 8-virtual-device CPU backend and would hard-fail
+    (mesh size) or silently compile against real hardware."""
+    if not _NATIVE:
+        return
+    skip = pytest.mark.skip(
+        reason='VFT_TEST_PLATFORM=native runs only the `-m tpu` lane')
+    for item in items:
+        if 'tpu' not in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope='session')

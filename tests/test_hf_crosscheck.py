@@ -55,6 +55,7 @@ def _hf_vit_to_timm(hf_sd, depth):
     return sd
 
 
+@pytest.mark.slow
 def test_vit_parity_vs_hf_transformers():
     """vit_tiny geometry vs transformers.ViTModel: CLS-token feature after
     the final LN, rel L2 < 1e-3 at float32."""
@@ -193,6 +194,7 @@ def _hf_swin_to_timm(hf_sd, depths):
     return sd
 
 
+@pytest.mark.slow
 def test_swin_parity_vs_hf_transformers():
     """swin_tiny vs transformers.SwinModel at full 224 geometry (stage
     maps 56/28/14/7: real shift masks in stages 0-2, window-collapse in

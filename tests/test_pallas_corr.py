@@ -164,7 +164,8 @@ def test_lanes_full_depth_interpret():
 @pytest.mark.tpu
 def test_lanes_full_depth_tpu():
     """The same full-depth validation on real TPU hardware at CLI geometry
-    (the compiled Mosaic kernel, not interpret mode): `pytest -m tpu`."""
+    (the compiled Mosaic kernel, not interpret mode):
+    `VFT_TEST_PLATFORM=native pytest -m tpu`."""
     if jax.devices()[0].platform != 'tpu':
         pytest.skip('no TPU attached')
     vl = _load_validate_lanes()
