@@ -4,9 +4,10 @@ The torch mirrors in tests/torch_mirrors.py are written in THIS repo, so a
 shared misreading of an architecture could pass mirror parity. These tests
 compare against `transformers`' independently written models (available in
 the environment, config-instantiated offline with random weights): the HF
-state dict is mechanically re-keyed into the timm layout our transplant
-layer consumes, and both sides run the same input. Agreement here means
-our numerics match code we had no hand in.
+state dict is re-keyed into the timm layout by the PRODUCTION converters
+(`transplant/hf.py`, the `tools/convert_checkpoint.py --hf-family` path),
+and both sides run the same input. Agreement here means our numerics match
+code we had no hand in — and validates the converter end-to-end.
 
 The reference consumes these architectures through pip-timm
 (reference models/timm/extract_timm.py:48); HF's ViT is the same
@@ -18,41 +19,12 @@ import numpy as np
 import pytest
 import torch
 
+from video_features_tpu.transplant.hf import (
+    convnext_to_timm, regnet_to_timm, swin_to_timm, vit_to_timm,
+)
 from video_features_tpu.transplant.torch2jax import transplant
 
 transformers = pytest.importorskip('transformers')
-
-
-def _hf_vit_to_timm(hf_sd, depth):
-    """HF ViTModel state dict → timm VisionTransformer naming (the layout
-    models/vit.py mirrors). The only structural difference is HF's split
-    q/k/v projections vs timm's packed qkv."""
-    sd = {
-        'cls_token': hf_sd['embeddings.cls_token'],
-        'pos_embed': hf_sd['embeddings.position_embeddings'],
-        'patch_embed.proj.weight':
-            hf_sd['embeddings.patch_embeddings.projection.weight'],
-        'patch_embed.proj.bias':
-            hf_sd['embeddings.patch_embeddings.projection.bias'],
-        'norm.weight': hf_sd['layernorm.weight'],
-        'norm.bias': hf_sd['layernorm.bias'],
-    }
-    for i in range(depth):
-        h, t = f'encoder.layer.{i}.', f'blocks.{i}.'
-        for ours, theirs in [('norm1', 'layernorm_before'),
-                             ('norm2', 'layernorm_after'),
-                             ('attn.proj', 'attention.output.dense'),
-                             ('mlp.fc1', 'intermediate.dense'),
-                             ('mlp.fc2', 'output.dense')]:
-            sd[t + ours + '.weight'] = hf_sd[h + theirs + '.weight']
-            sd[t + ours + '.bias'] = hf_sd[h + theirs + '.bias']
-        sd[t + 'attn.qkv.weight'] = torch.cat(
-            [hf_sd[h + f'attention.attention.{p}.weight']
-             for p in ('query', 'key', 'value')], dim=0)
-        sd[t + 'attn.qkv.bias'] = torch.cat(
-            [hf_sd[h + f'attention.attention.{p}.bias']
-             for p in ('query', 'key', 'value')], dim=0)
-    return sd
 
 
 @pytest.mark.slow
@@ -74,7 +46,7 @@ def test_vit_parity_vs_hf_transformers():
     torch.manual_seed(0)
     hf = transformers.ViTModel(hf_cfg, add_pooling_layer=False).eval()
 
-    params = transplant(_hf_vit_to_timm(hf.state_dict(), cfg['layers']))
+    params = transplant(vit_to_timm(hf.state_dict(), 'vit_tiny_patch16_224'))
     x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32)
     x = x * 2 - 1
     with torch.no_grad():
@@ -87,36 +59,6 @@ def test_vit_parity_vs_hf_transformers():
     assert got.shape == ref.shape == (2, cfg['width'])
     rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
     assert rel < 1e-3, f'rel L2 vs transformers ViT: {rel}'
-
-
-def _hf_convnext_to_timm(hf_sd, depths):
-    """HF ConvNextModel state dict → timm ConvNeXt naming (the layout
-    models/convnext.py mirrors)."""
-    sd = {
-        'stem.0.weight': hf_sd['embeddings.patch_embeddings.weight'],
-        'stem.0.bias': hf_sd['embeddings.patch_embeddings.bias'],
-        'stem.1.weight': hf_sd['embeddings.layernorm.weight'],
-        'stem.1.bias': hf_sd['embeddings.layernorm.bias'],
-        'head.norm.weight': hf_sd['layernorm.weight'],
-        'head.norm.bias': hf_sd['layernorm.bias'],
-    }
-    for s, depth in enumerate(depths):
-        h, t = f'encoder.stages.{s}.', f'stages.{s}.'
-        if s > 0:
-            for idx in ('0', '1'):
-                for p in ('weight', 'bias'):
-                    sd[f'{t}downsample.{idx}.{p}'] = hf_sd[
-                        f'{h}downsampling_layer.{idx}.{p}']
-        for j in range(depth):
-            hb, tb = f'{h}layers.{j}.', f'{t}blocks.{j}.'
-            sd[tb + 'gamma'] = hf_sd[hb + 'layer_scale_parameter']
-            for ours, theirs in [('conv_dw', 'dwconv'),
-                                 ('norm', 'layernorm'),
-                                 ('mlp.fc1', 'pwconv1'),
-                                 ('mlp.fc2', 'pwconv2')]:
-                sd[tb + ours + '.weight'] = hf_sd[hb + theirs + '.weight']
-                sd[tb + ours + '.bias'] = hf_sd[hb + theirs + '.bias']
-    return sd
 
 
 def test_convnext_parity_vs_hf_transformers():
@@ -133,8 +75,7 @@ def test_convnext_parity_vs_hf_transformers():
     torch.manual_seed(0)
     hf = transformers.ConvNextModel(hf_cfg).eval()
 
-    params = transplant(_hf_convnext_to_timm(hf.state_dict(),
-                                             cfg['depths']))
+    params = transplant(convnext_to_timm(hf.state_dict(), 'convnext_tiny'))
     x = np.random.RandomState(1).rand(2, 96, 96, 3).astype(np.float32)
     x = x * 2 - 1
     with torch.no_grad():
@@ -147,51 +88,6 @@ def test_convnext_parity_vs_hf_transformers():
     assert got.shape == ref.shape == (2, cfg['dims'][-1])
     rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
     assert rel < 1e-3, f'rel L2 vs transformers ConvNext: {rel}'
-
-
-def _hf_swin_to_timm(hf_sd, depths):
-    """HF SwinModel state dict → timm 0.9.12 Swin naming (the layout
-    models/swin.py mirrors). Structural differences: HF splits q/k/v
-    (timm packs qkv), and HF hangs each PatchMerging off the END of
-    stage L where timm 0.9.12 puts it at the START of stage L+1 —
-    identical math, shifted key prefix."""
-    sd = {
-        'patch_embed.proj.weight':
-            hf_sd['embeddings.patch_embeddings.projection.weight'],
-        'patch_embed.proj.bias':
-            hf_sd['embeddings.patch_embeddings.projection.bias'],
-        'patch_embed.norm.weight': hf_sd['embeddings.norm.weight'],
-        'patch_embed.norm.bias': hf_sd['embeddings.norm.bias'],
-        'norm.weight': hf_sd['layernorm.weight'],
-        'norm.bias': hf_sd['layernorm.bias'],
-    }
-    for li, depth in enumerate(depths):
-        if li > 0:   # HF stage li-1's tail merge == timm stage li's head
-            for ours, theirs in [('norm', 'norm'),
-                                 ('reduction', 'reduction')]:
-                for p in ('weight', 'bias'):
-                    key = f'encoder.layers.{li - 1}.downsample.{theirs}.{p}'
-                    if key in hf_sd:   # reduction has no bias
-                        sd[f'layers.{li}.downsample.{ours}.{p}'] = hf_sd[key]
-        for b in range(depth):
-            h = f'encoder.layers.{li}.blocks.{b}.'
-            t = f'layers.{li}.blocks.{b}.'
-            sd[t + 'attn.relative_position_bias_table'] = hf_sd[
-                h + 'attention.self.relative_position_bias_table']
-            sd[t + 'attn.qkv.weight'] = torch.cat(
-                [hf_sd[h + f'attention.self.{p}.weight']
-                 for p in ('query', 'key', 'value')], dim=0)
-            sd[t + 'attn.qkv.bias'] = torch.cat(
-                [hf_sd[h + f'attention.self.{p}.bias']
-                 for p in ('query', 'key', 'value')], dim=0)
-            for ours, theirs in [('norm1', 'layernorm_before'),
-                                 ('norm2', 'layernorm_after'),
-                                 ('attn.proj', 'attention.output.dense'),
-                                 ('mlp.fc1', 'intermediate.dense'),
-                                 ('mlp.fc2', 'output.dense')]:
-                sd[t + ours + '.weight'] = hf_sd[h + theirs + '.weight']
-                sd[t + ours + '.bias'] = hf_sd[h + theirs + '.bias']
-    return sd
 
 
 @pytest.mark.slow
@@ -214,7 +110,8 @@ def test_swin_parity_vs_hf_transformers():
     torch.manual_seed(0)
     hf = transformers.SwinModel(hf_cfg, add_pooling_layer=True).eval()
 
-    params = transplant(_hf_swin_to_timm(hf.state_dict(), depths))
+    params = transplant(swin_to_timm(hf.state_dict(),
+                                     'swin_tiny_patch4_window7_224'))
     x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32)
     x = x * 2 - 1
     with torch.no_grad():
@@ -227,37 +124,6 @@ def test_swin_parity_vs_hf_transformers():
     assert got.shape == ref.shape == (2, 768)
     rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
     assert rel < 1e-3, f'rel L2 vs transformers Swin: {rel}'
-
-
-def _hf_regnet_to_timm(hf_sd, depths):
-    """HF RegNetModel ('y' layer type) state dict → timm 0.9.12 RegNet
-    naming (the layout models/regnet.py mirrors). HF nests each block's
-    conv stack in a Sequential (layer.0/1/3 = conv1/conv2/conv3, layer.2
-    = SE with attention.0/attention.2 as reduce/expand) and calls the
-    projection 'shortcut'."""
-    sd = {}
-
-    def cna(t, h):
-        sd[f'{t}.conv.weight'] = hf_sd[f'{h}.convolution.weight']
-        for p in ('weight', 'bias', 'running_mean', 'running_var'):
-            sd[f'{t}.bn.{p}'] = hf_sd[f'{h}.normalization.{p}']
-
-    cna('stem', 'embedder.embedder')
-    for si, depth in enumerate(depths):
-        for j in range(depth):
-            h = f'encoder.stages.{si}.layers.{j}'
-            t = f's{si + 1}.b{j + 1}'
-            cna(f'{t}.conv1', f'{h}.layer.0')
-            cna(f'{t}.conv2', f'{h}.layer.1')
-            cna(f'{t}.conv3', f'{h}.layer.3')
-            for ours, theirs in [('fc1', 'attention.0'),
-                                 ('fc2', 'attention.2')]:
-                for p in ('weight', 'bias'):
-                    sd[f'{t}.se.{ours}.{p}'] = hf_sd[
-                        f'{h}.layer.2.{theirs}.{p}']
-            if f'{h}.shortcut.convolution.weight' in hf_sd:
-                cna(f'{t}.downsample', f'{h}.shortcut')
-    return sd
 
 
 def test_regnet_parity_vs_hf_transformers():
@@ -287,7 +153,7 @@ def test_regnet_parity_vs_hf_transformers():
                 m.bias.copy_(torch.randn(m.num_features, generator=gen)
                              * 0.02)
 
-    params = transplant(_hf_regnet_to_timm(hf.state_dict(), depths))
+    params = transplant(regnet_to_timm(hf.state_dict(), 'regnety_008'))
     x = np.random.RandomState(1).rand(2, 128, 128, 3).astype(np.float32)
     x = x * 2 - 1
     with torch.no_grad():
@@ -300,3 +166,48 @@ def test_regnet_parity_vs_hf_transformers():
     assert got.shape == ref.shape == (2, widths[-1])
     rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
     assert rel < 1e-3, f'rel L2 vs transformers RegNet: {rel}'
+
+
+def test_convert_checkpoint_hf_family_cli(tmp_path):
+    """tools/convert_checkpoint.py --hf-family: a (task-prefixed) HF ViT
+    checkpoint converts to a torch-free .npz whose pytree loads into our
+    forward — the no-pip-timm weights-provisioning path end-to-end."""
+    import subprocess
+    import sys
+
+    import jax
+
+    from tests.conftest import REPO_ROOT
+    from video_features_tpu.models import vit as vit_model
+    from video_features_tpu.transplant.torch2jax import load_torch_checkpoint
+
+    cfg = vit_model.ARCHS['vit_tiny_patch16_224']
+    hf_cfg = transformers.ViTConfig(
+        hidden_size=cfg['width'], num_hidden_layers=cfg['layers'],
+        num_attention_heads=cfg['heads'],
+        intermediate_size=cfg['width'] * 4, image_size=224,
+        patch_size=cfg['patch'], layer_norm_eps=1e-6)
+    torch.manual_seed(0)
+    hf = transformers.ViTModel(hf_cfg, add_pooling_layer=False).eval()
+    # simulate a *ForImageClassification checkpoint: vit.-prefixed keys
+    src = tmp_path / 'pytorch_model.bin'
+    torch.save({f'vit.{k}': v for k, v in hf.state_dict().items()}, src)
+
+    dst = tmp_path / 'vit_tiny.npz'
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / 'tools' / 'convert_checkpoint.py'),
+         str(src), str(dst),
+         '--hf-family', 'vit', '--arch', 'vit_tiny_patch16_224'],
+        cwd=str(REPO_ROOT), capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+    params = load_torch_checkpoint(str(dst))
+    x = np.random.RandomState(2).rand(1, 224, 224, 3).astype(np.float32)
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(vit_model.forward(
+            params, x, arch='vit_tiny_patch16_224', features=True))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(x).permute(0, 3, 1, 2)
+                 ).last_hidden_state[:, 0].numpy()
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 1e-3, f'converted-checkpoint rel L2: {rel}'

@@ -12,6 +12,15 @@ no torch:
 
 ``--key`` selects a sub-dict for wrapped checkpoints; ``--no-transpose``
 names 2-D weights that must keep torch layout (embedding tables).
+
+``--hf-family {vit,convnext,swin,regnet} --arch <timm-name>`` converts a
+HuggingFace `transformers` checkpoint instead: the HF state dict is
+re-keyed into the timm layout (transplant/hf.py) before the transplant —
+a weights-provisioning path for the native timm families that needs no
+pip-timm:
+
+    python tools/convert_checkpoint.py pytorch_model.bin swin_tiny.npz \
+        --hf-family swin --arch swin_tiny_patch4_window7_224
 """
 from __future__ import annotations
 
@@ -31,15 +40,36 @@ def main() -> int:
                     help="sub-dict key (e.g. 'state_dict') for wrapped ckpts")
     ap.add_argument('--no-transpose', nargs='*', default=None,
                     help='weight names to keep in torch layout')
+    ap.add_argument('--hf-family', default=None,
+                    help='re-key a transformers checkpoint for this native '
+                         'family (vit/convnext/swin/regnet) before '
+                         'transplanting; requires --arch')
+    ap.add_argument('--arch', default=None,
+                    help='timm arch name the checkpoint targets '
+                         '(with --hf-family)')
     ns = ap.parse_args()
 
     from video_features_tpu.transplant.torch2jax import (
-        _flatten, load_torch_checkpoint, save_transplanted,
+        _flatten, load_torch_checkpoint, save_transplanted, transplant,
     )
 
-    params = load_torch_checkpoint(
-        ns.src, key=ns.key,
-        no_transpose=set(ns.no_transpose) if ns.no_transpose else None)
+    if ns.hf_family:
+        if not ns.arch:
+            raise SystemExit('--hf-family requires --arch (the timm name '
+                             'whose layout to produce)')
+        import torch
+
+        from video_features_tpu.transplant.hf import hf_to_timm
+        raw = torch.load(ns.src, map_location='cpu', weights_only=True)
+        if ns.key:
+            raw = raw[ns.key]
+        params = transplant(
+            hf_to_timm(ns.hf_family, raw, ns.arch),
+            no_transpose=set(ns.no_transpose) if ns.no_transpose else None)
+    else:
+        params = load_torch_checkpoint(
+            ns.src, key=ns.key,
+            no_transpose=set(ns.no_transpose) if ns.no_transpose else None)
     flat = _flatten(params)
     if not flat:
         raise SystemExit(f'no arrays found in {ns.src} (wrong --key?)')

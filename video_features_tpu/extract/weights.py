@@ -70,10 +70,11 @@ def require_checkpoint(args: Any, key: str, *, feature_type: str,
             # not from the fetch tool
             provision = (f'`{feature_type}` weights are not served by '
                          f'tools/fetch_checkpoints.py — export them from a '
-                         f'host with pip timm installed (`python '
-                         f'tools/convert_checkpoint.py`) or pass a '
-                         f'converted .npz via `{key}` '
-                         f'(see docs/checkpoints.md).')
+                         f'host with pip timm installed, or convert a '
+                         f'HuggingFace checkpoint for the native families '
+                         f'(`python tools/convert_checkpoint.py '
+                         f'--hf-family ...`), then pass the converted .npz '
+                         f'via `{key}` (see docs/checkpoints.md).')
         raise MissingCheckpointError(
             f'No checkpoint configured for {what}: set `{key}=<path to a '
             f'.pt/.pth/.npz checkpoint>` (feature_type={feature_type}). '

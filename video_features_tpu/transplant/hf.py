@@ -1,0 +1,199 @@
+"""HuggingFace `transformers` checkpoint → timm-layout state dicts.
+
+The native timm-layout families (models/{vit,convnext,swin,regnet}.py)
+load torch checkpoints in timm naming. pip-timm is one provisioning path
+(extract/timm.py bridge); this module is another that needs only
+`transformers`-layout checkpoints — HF hosts the same published
+architectures under a different module tree, and the re-keying is
+mechanical. Used by ``tools/convert_checkpoint.py --hf-family`` and
+validated end-to-end against `transformers`' own forward passes in
+``tests/test_hf_crosscheck.py`` (4–9e-7 rel L2).
+
+Functions take a flat HF state dict (torch tensors or numpy arrays) and
+return a timm-named dict ready for ``transplant()``. Structural deltas
+handled per family:
+
+  * vit: HF splits q/k/v projections; timm packs ``qkv``.
+  * convnext: HF calls blocks ``layers`` and the timm ``gamma`` layer
+    scale ``layer_scale_parameter``; the head LN is HF's pooler norm.
+  * swin: q/k/v packing as vit, plus HF hangs each PatchMerging off the
+    END of stage L where timm 0.9.12 puts it at the START of stage L+1.
+  * regnet: HF nests each block's conv stack in a Sequential
+    (layer.0/1/3 = conv1/conv2/conv3, layer.2 = SE) and calls the
+    projection ``shortcut``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+Sd = Dict[str, Any]
+
+
+def _cat0(parts):
+    first = parts[0]
+    if hasattr(first, 'detach'):     # torch tensor
+        import torch
+        return torch.cat(list(parts), dim=0)
+    import numpy as np
+    return np.concatenate(list(parts), axis=0)
+
+
+def strip_task_prefix(hf_sd: Sd) -> Sd:
+    """Drop a task-model wrapper: ``vit.``/``swin.``/... key prefixes from
+    *ForImageClassification checkpoints (and their classifier head)."""
+    prefixes = {k.split('.', 1)[0] for k in hf_sd if '.' in k}
+    for p in ('vit', 'swin', 'convnext', 'regnet', 'model'):
+        if p in prefixes:
+            return {k[len(p) + 1:]: v for k, v in hf_sd.items()
+                    if k.startswith(p + '.')}
+    return hf_sd
+
+
+def vit_to_timm(hf_sd: Sd, arch: str) -> Sd:
+    """transformers.ViTModel → timm VisionTransformer naming."""
+    from video_features_tpu.models.vit import ARCHS
+    depth = ARCHS[arch]['layers']
+    sd = {
+        'cls_token': hf_sd['embeddings.cls_token'],
+        'pos_embed': hf_sd['embeddings.position_embeddings'],
+        'patch_embed.proj.weight':
+            hf_sd['embeddings.patch_embeddings.projection.weight'],
+        'patch_embed.proj.bias':
+            hf_sd['embeddings.patch_embeddings.projection.bias'],
+        'norm.weight': hf_sd['layernorm.weight'],
+        'norm.bias': hf_sd['layernorm.bias'],
+    }
+    for i in range(depth):
+        h, t = f'encoder.layer.{i}.', f'blocks.{i}.'
+        for ours, theirs in [('norm1', 'layernorm_before'),
+                             ('norm2', 'layernorm_after'),
+                             ('attn.proj', 'attention.output.dense'),
+                             ('mlp.fc1', 'intermediate.dense'),
+                             ('mlp.fc2', 'output.dense')]:
+            sd[t + ours + '.weight'] = hf_sd[h + theirs + '.weight']
+            sd[t + ours + '.bias'] = hf_sd[h + theirs + '.bias']
+        for p in ('weight', 'bias'):
+            sd[t + f'attn.qkv.{p}'] = _cat0(
+                [hf_sd[h + f'attention.attention.{proj}.{p}']
+                 for proj in ('query', 'key', 'value')])
+    return sd
+
+
+def convnext_to_timm(hf_sd: Sd, arch: str) -> Sd:
+    """transformers.ConvNextModel → timm ConvNeXt naming."""
+    from video_features_tpu.models.convnext import ARCHS
+    depths = ARCHS[arch]['depths']
+    sd = {
+        'stem.0.weight': hf_sd['embeddings.patch_embeddings.weight'],
+        'stem.0.bias': hf_sd['embeddings.patch_embeddings.bias'],
+        'stem.1.weight': hf_sd['embeddings.layernorm.weight'],
+        'stem.1.bias': hf_sd['embeddings.layernorm.bias'],
+        'head.norm.weight': hf_sd['layernorm.weight'],
+        'head.norm.bias': hf_sd['layernorm.bias'],
+    }
+    for s, depth in enumerate(depths):
+        h, t = f'encoder.stages.{s}.', f'stages.{s}.'
+        if s > 0:
+            for idx in ('0', '1'):
+                for p in ('weight', 'bias'):
+                    sd[f'{t}downsample.{idx}.{p}'] = hf_sd[
+                        f'{h}downsampling_layer.{idx}.{p}']
+        for j in range(depth):
+            hb, tb = f'{h}layers.{j}.', f'{t}blocks.{j}.'
+            sd[tb + 'gamma'] = hf_sd[hb + 'layer_scale_parameter']
+            for ours, theirs in [('conv_dw', 'dwconv'),
+                                 ('norm', 'layernorm'),
+                                 ('mlp.fc1', 'pwconv1'),
+                                 ('mlp.fc2', 'pwconv2')]:
+                sd[tb + ours + '.weight'] = hf_sd[hb + theirs + '.weight']
+                sd[tb + ours + '.bias'] = hf_sd[hb + theirs + '.bias']
+    return sd
+
+
+def swin_to_timm(hf_sd: Sd, arch: str) -> Sd:
+    """transformers.SwinModel → timm 0.9.12 Swin naming."""
+    from video_features_tpu.models.swin import ARCHS
+    depths = ARCHS[arch]['depths']
+    sd = {
+        'patch_embed.proj.weight':
+            hf_sd['embeddings.patch_embeddings.projection.weight'],
+        'patch_embed.proj.bias':
+            hf_sd['embeddings.patch_embeddings.projection.bias'],
+        'patch_embed.norm.weight': hf_sd['embeddings.norm.weight'],
+        'patch_embed.norm.bias': hf_sd['embeddings.norm.bias'],
+        'norm.weight': hf_sd['layernorm.weight'],
+        'norm.bias': hf_sd['layernorm.bias'],
+    }
+    for li, depth in enumerate(depths):
+        if li > 0:   # HF stage li-1's tail merge == timm stage li's head
+            for name in ('norm', 'reduction'):
+                for p in ('weight', 'bias'):
+                    key = f'encoder.layers.{li - 1}.downsample.{name}.{p}'
+                    if key in hf_sd:   # reduction has no bias
+                        sd[f'layers.{li}.downsample.{name}.{p}'] = hf_sd[key]
+        for b in range(depth):
+            h = f'encoder.layers.{li}.blocks.{b}.'
+            t = f'layers.{li}.blocks.{b}.'
+            sd[t + 'attn.relative_position_bias_table'] = hf_sd[
+                h + 'attention.self.relative_position_bias_table']
+            for p in ('weight', 'bias'):
+                sd[t + f'attn.qkv.{p}'] = _cat0(
+                    [hf_sd[h + f'attention.self.{proj}.{p}']
+                     for proj in ('query', 'key', 'value')])
+            for ours, theirs in [('norm1', 'layernorm_before'),
+                                 ('norm2', 'layernorm_after'),
+                                 ('attn.proj', 'attention.output.dense'),
+                                 ('mlp.fc1', 'intermediate.dense'),
+                                 ('mlp.fc2', 'output.dense')]:
+                sd[t + ours + '.weight'] = hf_sd[h + theirs + '.weight']
+                sd[t + ours + '.bias'] = hf_sd[h + theirs + '.bias']
+    return sd
+
+
+def regnet_to_timm(hf_sd: Sd, arch: str) -> Sd:
+    """transformers.RegNetModel ('y' layer type) → timm RegNet naming."""
+    from video_features_tpu.models.regnet import ARCHS
+    depths = ARCHS[arch][0]
+    sd: Sd = {}
+
+    def cna(t, h):
+        sd[f'{t}.conv.weight'] = hf_sd[f'{h}.convolution.weight']
+        for p in ('weight', 'bias', 'running_mean', 'running_var'):
+            sd[f'{t}.bn.{p}'] = hf_sd[f'{h}.normalization.{p}']
+
+    cna('stem', 'embedder.embedder')
+    for si, depth in enumerate(depths):
+        for j in range(depth):
+            h = f'encoder.stages.{si}.layers.{j}'
+            t = f's{si + 1}.b{j + 1}'
+            cna(f'{t}.conv1', f'{h}.layer.0')
+            cna(f'{t}.conv2', f'{h}.layer.1')
+            cna(f'{t}.conv3', f'{h}.layer.3')
+            for ours, theirs in [('fc1', 'attention.0'),
+                                 ('fc2', 'attention.2')]:
+                for p in ('weight', 'bias'):
+                    sd[f'{t}.se.{ours}.{p}'] = hf_sd[
+                        f'{h}.layer.2.{theirs}.{p}']
+            if f'{h}.shortcut.convolution.weight' in hf_sd:
+                cna(f'{t}.downsample', f'{h}.shortcut')
+    return sd
+
+
+CONVERTERS = {
+    'vit': vit_to_timm,
+    'convnext': convnext_to_timm,
+    'swin': swin_to_timm,
+    'regnet': regnet_to_timm,
+}
+
+
+def hf_to_timm(family: str, hf_sd: Sd, arch: str) -> Sd:
+    """Re-key a `transformers` state dict into timm naming for ``arch``.
+
+    ``family`` is one of CONVERTERS; task-model prefixes (e.g.
+    ``vit.encoder...`` from *ForImageClassification) are stripped first.
+    """
+    if family not in CONVERTERS:
+        raise ValueError(
+            f'hf-family {family!r} not supported: {sorted(CONVERTERS)}')
+    return CONVERTERS[family](strip_task_prefix(hf_sd), arch)
