@@ -211,3 +211,39 @@ def test_convert_checkpoint_hf_family_cli(tmp_path):
                  ).last_hidden_state[:, 0].numpy()
     rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
     assert rel < 1e-3, f'converted-checkpoint rel L2: {rel}'
+
+
+@pytest.mark.slow
+def test_deit_distilled_parity_vs_hf_transformers():
+    """Distilled DeiT (vit_tiny geometry) vs transformers.DeiTModel: the
+    dist_token dispatch against code we didn't write — feature = mean of
+    the cls and distillation tokens after the final LN."""
+    import jax
+
+    from video_features_tpu.transplant.hf import deit_to_timm
+    from video_features_tpu.models import vit as vit_model
+
+    cfg = vit_model.ARCHS['vit_tiny_patch16_224']
+    hf_cfg = transformers.DeiTConfig(
+        hidden_size=cfg['width'], num_hidden_layers=cfg['layers'],
+        num_attention_heads=cfg['heads'],
+        intermediate_size=cfg['width'] * 4, image_size=224,
+        patch_size=cfg['patch'], hidden_act='gelu', layer_norm_eps=1e-6)
+    torch.manual_seed(0)
+    hf = transformers.DeiTModel(hf_cfg, add_pooling_layer=False).eval()
+
+    params = transplant(deit_to_timm(hf.state_dict(),
+                                     'vit_tiny_patch16_224'))
+    assert 'dist_token' in params
+    x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32)
+    x = x * 2 - 1
+    with torch.no_grad():
+        out = hf(torch.from_numpy(x).permute(0, 3, 1, 2)).last_hidden_state
+        ref = ((out[:, 0] + out[:, 1]) / 2).numpy()   # timm deit feature
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(vit_model.forward(
+            params, x, arch='vit_tiny_patch16_224', features=True))
+
+    assert got.shape == ref.shape == (2, cfg['width'])
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 1e-3, f'rel L2 vs transformers DeiT: {rel}'

@@ -13,7 +13,7 @@ no torch:
 ``--key`` selects a sub-dict for wrapped checkpoints; ``--no-transpose``
 names 2-D weights that must keep torch layout (embedding tables).
 
-``--hf-family {vit,convnext,swin,regnet} --arch <timm-name>`` converts a
+``--hf-family {vit,deit,convnext,swin,regnet} --arch <timm-name>`` converts a
 HuggingFace `transformers` checkpoint instead: the HF state dict is
 re-keyed into the timm layout (transplant/hf.py) before the transplant —
 a weights-provisioning path for the native timm families that needs no
@@ -42,7 +42,7 @@ def main() -> int:
                     help='weight names to keep in torch layout')
     ap.add_argument('--hf-family', default=None,
                     help='re-key a transformers checkpoint for this native '
-                         'family (vit/convnext/swin/regnet) before '
+                         'family (vit/deit/convnext/swin/regnet) before '
                          'transplanting; requires --arch')
     ap.add_argument('--arch', default=None,
                     help='timm arch name the checkpoint targets '
@@ -63,8 +63,9 @@ def main() -> int:
         raw = torch.load(ns.src, map_location='cpu', weights_only=True)
         if ns.key:
             raw = raw[ns.key]
+        import numpy as np
         params = transplant(
-            hf_to_timm(ns.hf_family, raw, ns.arch),
+            hf_to_timm(ns.hf_family, raw, ns.arch), dtype=np.float32,
             no_transpose=set(ns.no_transpose) if ns.no_transpose else None)
     else:
         params = load_torch_checkpoint(

@@ -42,7 +42,7 @@ def strip_task_prefix(hf_sd: Sd) -> Sd:
     """Drop a task-model wrapper: ``vit.``/``swin.``/... key prefixes from
     *ForImageClassification checkpoints (and their classifier head)."""
     prefixes = {k.split('.', 1)[0] for k in hf_sd if '.' in k}
-    for p in ('vit', 'swin', 'convnext', 'regnet', 'model'):
+    for p in ('vit', 'deit', 'swin', 'convnext', 'regnet', 'model'):
         if p in prefixes:
             return {k[len(p) + 1:]: v for k, v in hf_sd.items()
                     if k.startswith(p + '.')}
@@ -76,6 +76,16 @@ def vit_to_timm(hf_sd: Sd, arch: str) -> Sd:
             sd[t + f'attn.qkv.{p}'] = _cat0(
                 [hf_sd[h + f'attention.attention.{proj}.{p}']
                  for proj in ('query', 'key', 'value')])
+    return sd
+
+
+def deit_to_timm(hf_sd: Sd, arch: str) -> Sd:
+    """transformers.DeiTModel (distilled) → timm
+    VisionTransformerDistilled naming: the ViT mapping plus the
+    distillation token (timm ``dist_token``); the 2-slot prefix rides
+    ``position_embeddings`` unchanged."""
+    sd = vit_to_timm(hf_sd, arch)
+    sd['dist_token'] = hf_sd['embeddings.distillation_token']
     return sd
 
 
@@ -181,6 +191,7 @@ def regnet_to_timm(hf_sd: Sd, arch: str) -> Sd:
 
 CONVERTERS = {
     'vit': vit_to_timm,
+    'deit': deit_to_timm,
     'convnext': convnext_to_timm,
     'swin': swin_to_timm,
     'regnet': regnet_to_timm,
