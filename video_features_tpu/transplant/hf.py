@@ -11,9 +11,12 @@ validated end-to-end against `transformers`' own forward passes in
 
 Functions take a flat HF state dict (torch tensors or numpy arrays) and
 return a timm-named dict ready for ``transplant()``. Structural deltas
-handled per family:
+handled per family (five: vit, deit, convnext, swin, regnet):
 
   * vit: HF splits q/k/v projections; timm packs ``qkv``.
+  * deit: the vit mapping plus HF's ``distillation_token`` → timm
+    ``dist_token`` (timm DeiT names like ``deit_tiny_distilled_patch16_224``
+    resolve to their underlying vit geometry automatically).
   * convnext: HF calls blocks ``layers`` and the timm ``gamma`` layer
     scale ``layer_scale_parameter``; the head LN is HF's pooler norm.
   * swin: q/k/v packing as vit, plus HF hangs each PatchMerging off the
@@ -83,7 +86,11 @@ def deit_to_timm(hf_sd: Sd, arch: str) -> Sd:
     """transformers.DeiTModel (distilled) → timm
     VisionTransformerDistilled naming: the ViT mapping plus the
     distillation token (timm ``dist_token``); the 2-slot prefix rides
-    ``position_embeddings`` unchanged."""
+    ``position_embeddings`` unchanged. ``arch`` may be the timm DeiT name
+    (``deit_tiny_distilled_patch16_224``) or its underlying vit geometry —
+    DeiT IS timm's VisionTransformer (extract/timm.py aliases them)."""
+    if arch.startswith('deit'):
+        arch = arch.replace('deit', 'vit', 1).replace('_distilled', '')
     sd = vit_to_timm(hf_sd, arch)
     sd['dist_token'] = hf_sd['embeddings.distillation_token']
     return sd
