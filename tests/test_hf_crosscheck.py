@@ -247,3 +247,47 @@ def test_deit_distilled_parity_vs_hf_transformers():
     assert got.shape == ref.shape == (2, cfg['width'])
     rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
     assert rel < 1e-3, f'rel L2 vs transformers DeiT: {rel}'
+
+
+@pytest.mark.slow
+def test_beit_parity_vs_hf_transformers():
+    """beit_base vs transformers.BeitModel at full 224 geometry: per-block
+    relative position bias (index taken from the HF buffers), q/v-only
+    biases, lambda→gamma layer scale, mean-pooled patch tokens through the
+    pooler LN — the structurally richest mapping, against code we didn't
+    write."""
+    import jax
+
+    from video_features_tpu.models import beit as beit_model
+    from video_features_tpu.transplant.hf import beit_to_timm
+
+    hf_cfg = transformers.BeitConfig(
+        hidden_size=768, num_hidden_layers=12, num_attention_heads=12,
+        intermediate_size=3072, image_size=224, patch_size=16,
+        use_relative_position_bias=True,
+        use_absolute_position_embeddings=False, use_mean_pooling=True,
+        layer_scale_init_value=0.1, layer_norm_eps=1e-6,
+        hidden_act='gelu')
+    torch.manual_seed(0)
+    hf = transformers.BeitModel(hf_cfg, add_pooling_layer=True).eval()
+    # HF zero-inits the bias tables; randomize so the lookup is exercised
+    gen = torch.Generator().manual_seed(5)
+    with torch.no_grad():
+        for layer in hf.encoder.layer:
+            layer.attention.attention.relative_position_bias \
+                .relative_position_bias_table.normal_(0, 0.05, generator=gen)
+
+    params = transplant(beit_to_timm(hf.state_dict(),
+                                     'beit_base_patch16_224'))
+    x = np.random.RandomState(1).rand(1, 224, 224, 3).astype(np.float32)
+    x = x * 2 - 1
+    with torch.no_grad():
+        out = hf(torch.from_numpy(x).permute(0, 3, 1, 2))
+        ref = out.pooler_output.numpy()
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(beit_model.forward(
+            params, x, arch='beit_base_patch16_224'))
+
+    assert got.shape == ref.shape == (1, 768)
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 1e-3, f'rel L2 vs transformers Beit: {rel}'

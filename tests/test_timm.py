@@ -597,3 +597,70 @@ def test_mobilenetv3_extractor_e2e(short_video, tmp_path):
     assert out['timm'].shape[1] == 1280
     assert out['timm'].shape[0] > 0
     assert np.isfinite(out['timm']).all()
+
+
+def test_beit_parity_vs_torch_mirror():
+    """BEiT numerics vs the timm-layout mirror: per-block relative
+    position bias (732-row table + cls rows), q/v-only qkv biases, gamma
+    layer scale, no absolute pos embed, fc_norm mean pooling."""
+    import jax
+
+    from tests.torch_mirrors import TorchBeit
+    from video_features_tpu.models import beit as beit_model
+
+    torch.manual_seed(0)
+    mirror = TorchBeit('beit_base_patch16_224', num_classes=5).eval()
+    params = transplant(mirror.state_dict())
+
+    x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+        ref_logits = mirror(xt).numpy()
+        mirror.head = torch.nn.Identity()
+        ref = mirror(xt).numpy()
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(beit_model.forward(
+            params, x, arch='beit_base_patch16_224'))
+        got_logits = np.asarray(beit_model.forward(
+            params, x, arch='beit_base_patch16_224', features=False))
+
+    assert got.shape == ref.shape == (2, 768)
+    for ours, theirs in ((got, ref), (got_logits, ref_logits)):
+        rel = np.linalg.norm(ours - theirs) / np.linalg.norm(theirs)
+        assert rel < 1e-3, f'rel L2 {rel}'
+
+
+def test_beit_state_dict_keys_match_mirror():
+    from tests.torch_mirrors import TorchBeit
+    from video_features_tpu.models import beit as beit_model
+
+    for arch in beit_model.ARCHS:
+        ours = set(beit_model.init_state_dict(arch))
+        theirs = set(TorchBeit(arch).state_dict())
+        assert ours == theirs, arch
+
+
+def test_beit_rejects_image_size(tmp_path):
+    args = load_config('timm', overrides={
+        'video_paths': '/dev/null', 'device': 'cpu',
+        'model_name': 'beit_base_patch16_224', 'image_size': 384,
+        'allow_random_weights': True,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    with pytest.raises(NotImplementedError, match='relative-position'):
+        create_extractor(args)
+
+
+@pytest.mark.slow
+def test_beit_extractor_e2e(short_video, tmp_path):
+    args = load_config('timm', overrides={
+        'video_paths': short_video, 'device': 'cpu', 'batch_size': 8,
+        'model_name': 'beit_base_patch16_224',
+        'allow_random_weights': True, 'extraction_fps': 2,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    out = ex.extract(short_video)
+    assert out['timm'].shape[1] == 768
+    assert out['timm'].shape[0] > 0
+    assert np.isfinite(out['timm']).all()

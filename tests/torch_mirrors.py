@@ -819,3 +819,121 @@ class TorchMobileNetV3(nn.Module):
         x = x.mean((2, 3), keepdim=True)
         x = F.hardswish(self.conv_head(x))
         return self.classifier(x.flatten(1))
+
+
+# ------------------------------------------------------------------ beit --
+
+
+def _beit_rel_pos_index(wh, ww):
+    coords = torch.stack(torch.meshgrid(
+        torch.arange(wh), torch.arange(ww), indexing='ij'))
+    flat = coords.flatten(1)
+    rel = (flat[:, :, None] - flat[:, None, :]).permute(1, 2, 0).contiguous()
+    rel[:, :, 0] += wh - 1
+    rel[:, :, 1] += ww - 1
+    rel[:, :, 0] *= 2 * ww - 1
+    nrd = (2 * wh - 1) * (2 * ww - 1) + 3
+    n = wh * ww
+    index = torch.zeros((n + 1, n + 1), dtype=torch.long)
+    index[1:, 1:] = rel.sum(-1)
+    index[0, 0:] = nrd - 3
+    index[0:, 0] = nrd - 2
+    index[0, 0] = nrd - 1
+    return index, nrd
+
+
+class _BeitAttention(nn.Module):
+    """timm beit Attention: packed qkv weight, q/v-only biases, per-block
+    relative position bias table over a (N+1)² index."""
+
+    def __init__(self, dim, heads, window):
+        super().__init__()
+        self.heads = heads
+        self.qkv = nn.Linear(dim, dim * 3, bias=False)
+        # random (not timm's zeros) so bias packing / table lookup bugs
+        # are visible to every consumer of this mirror
+        self.q_bias = nn.Parameter(torch.randn(dim) * 0.02)
+        self.v_bias = nn.Parameter(torch.randn(dim) * 0.02)
+        index, nrd = _beit_rel_pos_index(*window)
+        self.relative_position_bias_table = nn.Parameter(
+            torch.randn(nrd, heads) * 0.05)
+        self.register_buffer('relative_position_index', index)
+        self.proj = nn.Linear(dim, dim)
+
+    def forward(self, x):
+        B, N, D = x.shape
+        hd = D // self.heads
+        qkv_bias = torch.cat(
+            [self.q_bias, torch.zeros_like(self.q_bias), self.v_bias])
+        qkv = F.linear(x, self.qkv.weight, qkv_bias)
+        qkv = qkv.reshape(B, N, 3, self.heads, hd).permute(2, 0, 3, 1, 4)
+        q, k, v = qkv.unbind(0)                       # (B, H, N, hd)
+        attn = (q * hd ** -0.5) @ k.transpose(-2, -1)
+        bias = self.relative_position_bias_table[
+            self.relative_position_index.view(-1)].view(N, N, -1)
+        attn = attn + bias.permute(2, 0, 1).unsqueeze(0)
+        attn = attn.softmax(dim=-1)
+        out = (attn @ v).transpose(1, 2).reshape(B, N, D)
+        return self.proj(out)
+
+
+class _BeitBlock(nn.Module):
+    def __init__(self, dim, heads, window):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, eps=1e-6)
+        self.attn = _BeitAttention(dim, heads, window)
+        self.gamma_1 = nn.Parameter(torch.ones(dim) * 0.1)
+        self.norm2 = nn.LayerNorm(dim, eps=1e-6)
+        self.mlp = nn.Sequential()
+        self.mlp.fc1 = nn.Linear(dim, dim * 4)
+        self.mlp.fc2 = nn.Linear(dim * 4, dim)
+        self.gamma_2 = nn.Parameter(torch.ones(dim) * 0.1)
+
+    def forward(self, x):
+        x = x + self.gamma_1 * self.attn(self.norm1(x))
+        h = self.mlp.fc2(F.gelu(self.mlp.fc1(self.norm2(x))))
+        return x + self.gamma_2 * h
+
+
+class _BeitPatchEmbed(nn.Module):
+    def __init__(self, dim, patch):
+        super().__init__()
+        self.proj = nn.Conv2d(3, dim, patch, patch)
+
+    def forward(self, x):
+        return self.proj(x).flatten(2).transpose(1, 2)
+
+
+class TorchBeit(nn.Module):
+    """timm 0.9.12 Beit mirror: no absolute pos embed, per-block relative
+    position bias, q/v-only qkv biases, gamma layer scale, mean-pooled
+    patch tokens through fc_norm. Reference consumes it through pip-timm
+    (models/timm/extract_timm.py:48)."""
+
+    # (width, layers, heads, patch) — LITERAL beit geometries, deliberately
+    # NOT derived from the module under test
+    CFGS = {
+        'beit_base_patch16_224': (768, 12, 12, 16),
+        'beit_large_patch16_224': (1024, 24, 16, 16),
+    }
+
+    def __init__(self, arch='beit_base_patch16_224', num_classes=0,
+                 img_size=224):
+        super().__init__()
+        width, layers, heads, patch = self.CFGS[arch]
+        side = img_size // patch
+        self.patch_embed = _BeitPatchEmbed(width, patch)
+        self.cls_token = nn.Parameter(torch.randn(1, 1, width) * 0.02)
+        self.blocks = nn.ModuleList(
+            [_BeitBlock(width, heads, (side, side)) for _ in range(layers)])
+        self.fc_norm = nn.LayerNorm(width, eps=1e-6)
+        self.head = (nn.Linear(width, num_classes) if num_classes
+                     else nn.Identity())
+
+    def forward(self, x):
+        x = self.patch_embed(x)
+        cls = self.cls_token.expand(x.shape[0], -1, -1)
+        x = torch.cat([cls, x], dim=1)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(self.fc_norm(x[:, 1:].mean(dim=1)))

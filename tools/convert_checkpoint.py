@@ -13,7 +13,7 @@ no torch:
 ``--key`` selects a sub-dict for wrapped checkpoints; ``--no-transpose``
 names 2-D weights that must keep torch layout (embedding tables).
 
-``--hf-family {vit,deit,convnext,swin,regnet} --arch <timm-name>`` converts a
+``--hf-family {vit,deit,beit,convnext,swin,regnet} --arch <timm-name>`` converts a
 HuggingFace `transformers` checkpoint instead: the HF state dict is
 re-keyed into the timm layout (transplant/hf.py) before the transplant —
 a weights-provisioning path for the native timm families that needs no
@@ -42,7 +42,7 @@ def main() -> int:
                     help='weight names to keep in torch layout')
     ap.add_argument('--hf-family', default=None,
                     help='re-key a transformers checkpoint for this native '
-                         'family (vit/deit/convnext/swin/regnet) before '
+                         'family (vit/deit/beit/convnext/swin/regnet) before '
                          'transplanting; requires --arch')
     ap.add_argument('--arch', default=None,
                     help='timm arch name the checkpoint targets '

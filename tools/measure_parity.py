@@ -228,8 +228,10 @@ def measure_mirrors(ckpt_dir):
     # Each runs seed → randomize BN stats (no-op for LN-only nets) →
     # torch forward → transplant → ours, identically.
     from tests.torch_mirrors import (
-        TorchEfficientNet, TorchMobileNetV3, TorchRegNet, TorchSwin,
+        TorchBeit, TorchEfficientNet, TorchMobileNetV3, TorchRegNet,
+        TorchSwin,
     )
+    from video_features_tpu.models import beit as beit_model
     from video_features_tpu.models import efficientnet as eff_model
     from video_features_tpu.models import mobilenetv3 as mnv3_model
     from video_features_tpu.models import regnet as regnet_model
@@ -250,6 +252,9 @@ def measure_mirrors(ckpt_dir):
          TorchRegNet, {}, regnet_model, 'regnety_008', 128),
         ('mobilenetv3_large_100 (timm mirror, h-swish/h-sig SE)',
          TorchMobileNetV3, {}, mnv3_model, 'mobilenetv3_large_100', 128),
+        # full 224: the rel-pos window (14²) is resolution-tied
+        ('beit_base (timm mirror, rel-pos bias + layer scale)',
+         TorchBeit, {}, beit_model, 'beit_base_patch16_224', 224),
     ]
     for label, mirror_cls, kwargs, module, arch, px in mirror_specs:
         torch.manual_seed(0)

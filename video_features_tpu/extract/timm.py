@@ -22,6 +22,7 @@ import jax
 import numpy as np
 
 from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
+from video_features_tpu.models import beit as beit_model
 from video_features_tpu.models import convnext as convnext_model
 from video_features_tpu.models import efficientnet as efficientnet_model
 from video_features_tpu.models import mobilenetv3 as mobilenetv3_model
@@ -48,6 +49,10 @@ def _data_cfg(family: str, arch: str = '') -> Dict[str, Any]:
         # timm vit: crop_pct 0.9, bicubic, 0.5 "inception" stats
         return dict(resize=248, crop=224, interpolation='bicubic',
                     mean=vit_model.MEAN, std=vit_model.STD)
+    if family == 'beit':
+        # timm beit: same recipe as vit (crop_pct 0.9, bicubic, 0.5 stats)
+        return dict(resize=248, crop=224, interpolation='bicubic',
+                    mean=beit_model.MEAN, std=beit_model.STD)
     if family == 'deit':
         # timm deit _cfg: crop_pct 0.9, bicubic, ImageNet stats
         return dict(resize=248, crop=224, interpolation='bicubic',
@@ -106,6 +111,9 @@ def _registry() -> Dict[str, Dict[str, Any]]:
     for name in mobilenetv3_model.ARCHS:
         reg[name] = dict(family='mobilenetv3', arch=name,
                          feat_dim=mobilenetv3_model.feat_dim(name))
+    for name in beit_model.ARCHS:
+        reg[name] = dict(family='beit', arch=name,
+                         feat_dim=beit_model.feat_dim(name))
     return reg
 
 
@@ -116,7 +124,8 @@ REGISTRY = _registry()
 _MODEL_MODULES = {'vit': vit_model, 'deit': vit_model,
                   'resnet': resnet_model, 'convnext': convnext_model,
                   'swin': swin_model, 'efficientnet': efficientnet_model,
-                  'regnet': regnet_model, 'mobilenetv3': mobilenetv3_model}
+                  'regnet': regnet_model, 'mobilenetv3': mobilenetv3_model,
+                  'beit': beit_model}
 
 
 class ExtractTIMM(BaseFrameWiseExtractor):
@@ -134,6 +143,13 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                 f'architectures transplant via checkpoint_path.)')
         spec = REGISTRY[name]
         self.family, self.arch = spec['family'], spec['arch']
+        if self.family == 'beit' and args.get('image_size'):
+            # checked before any checkpoint loads: nothing loaded changes it
+            raise NotImplementedError(
+                'image_size override is not supported for BEiT: its '
+                'relative-position-bias tables are tied to the checkpoint '
+                'resolution (224). Use a ViT/DeiT model for '
+                'high-resolution inputs.')
         self._init_kwargs = spec.get('init', {})
         super().__init__(args, feat_dim=spec['feat_dim'])
         self.data_cfg = _data_cfg(self.family, self.arch)
@@ -256,7 +272,7 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         return self._step(self.params, batch)
 
     def maybe_show_pred(self, feats: np.ndarray) -> None:
-        if self.family in ('vit', 'deit'):
+        if self.family in ('vit', 'deit', 'beit'):
             if 'dist_token' in self.params:
                 # timm's distilled inference scores the cls and dist tokens
                 # with SEPARATE heads ((head(cls)+head_dist(dist))/2); the

@@ -1,0 +1,181 @@
+"""BEiT image backbones (timm `beit_*` state_dict layout).
+
+The reference's timm extractor accepts any pip-timm model (reference
+models/timm/extract_timm.py:48, timm==0.9.12 pinned); this module natively
+implements BEiT — the self-supervised ViT branch of that model space with
+structure plain ViT doesn't have: NO absolute position embedding, a
+PER-BLOCK relative position bias table (with 3 extra cls rows), a packed
+qkv projection whose bias exists only for q and v (k bias is identically
+zero), layer-scale residuals (``gamma_1``/``gamma_2``), and mean-pooled
+patch tokens through a ``fc_norm`` instead of cls pooling — against timm
+0.9.12's ``Beit`` module tree so real timm checkpoints transplant
+mechanically.
+
+TPU notes: the bias-table lookup is a (N+1)² gather over a ≤732-row
+table — an embedding lookup XLA handles natively, computed once per
+forward outside the per-head matmuls. Everything else is the standard
+MXU transformer stack.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from video_features_tpu.models.vit import layer_norm
+
+Params = Dict[str, Any]
+
+# timm beit _cfg: bicubic, crop_pct 0.9, "inception" 0.5 stats
+MEAN = (0.5, 0.5, 0.5)
+STD = (0.5, 0.5, 0.5)
+
+ARCHS = {
+    'beit_base_patch16_224': dict(width=768, layers=12, heads=12, patch=16),
+    'beit_large_patch16_224': dict(width=1024, layers=24, heads=16,
+                                   patch=16),
+}
+INPUT_RESOLUTION = 224
+
+
+def num_relative_distance(window: Tuple[int, int]) -> int:
+    return (2 * window[0] - 1) * (2 * window[1] - 1) + 3
+
+
+def gen_relative_position_index(window: Tuple[int, int]) -> np.ndarray:
+    """timm beit.py gen_relative_position_index: (N+1, N+1) int index into
+    the bias table; the last 3 rows serve cls↔token and cls↔cls."""
+    wh, ww = window
+    n = wh * ww
+    coords = np.stack(np.meshgrid(np.arange(wh), np.arange(ww),
+                                  indexing='ij'))          # (2, wh, ww)
+    flat = coords.reshape(2, -1)                           # (2, n)
+    rel = flat[:, :, None] - flat[:, None, :]              # (2, n, n)
+    rel = rel.transpose(1, 2, 0).astype(np.int64)          # (n, n, 2)
+    rel[:, :, 0] += wh - 1
+    rel[:, :, 1] += ww - 1
+    rel[:, :, 0] *= 2 * ww - 1
+    nrd = num_relative_distance(window)
+    index = np.zeros((n + 1, n + 1), dtype=np.int64)
+    index[1:, 1:] = rel.sum(-1)
+    index[0, 0:] = nrd - 3
+    index[0:, 0] = nrd - 2
+    index[0, 0] = nrd - 1
+    return index
+
+
+def _rel_pos_bias(p: Params, index: jax.Array, heads: int) -> jax.Array:
+    """(heads, N+1, N+1) additive attention bias from the block's table."""
+    n = index.shape[0]
+    bias = p['relative_position_bias_table'][index.reshape(-1)]
+    return bias.reshape(n, n, heads).transpose(2, 0, 1)
+
+
+def _attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
+    """timm beit Attention: packed qkv weight, q/v-only biases (k bias is
+    zero by construction), per-head scaled dot product + the block's
+    relative position bias added to the scores."""
+    B, N, D = x.shape
+    head_dim = D // num_heads
+    qkv_bias = jnp.concatenate(
+        [p['q_bias'], jnp.zeros_like(p['q_bias']), p['v_bias']])
+    qkv = x @ p['qkv']['weight'] + qkv_bias
+    qkv = qkv.reshape(B, N, 3, num_heads, head_dim)
+    q, k, v = jnp.moveaxis(qkv, 2, 0)                      # (B, N, H, hd)
+    q = q * (head_dim ** -0.5)
+    scores = jnp.einsum('bnhd,bmhd->bhnm', q, k)
+    scores = scores + _rel_pos_bias(p, p['relative_position_index'],
+                                    num_heads)[None]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bhnm,bmhd->bnhd', probs, v).reshape(B, N, D)
+    return out @ p['proj']['weight'] + p['proj']['bias']
+
+
+def _block(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
+    """Pre-norm block with layer-scale residuals (gamma_1/gamma_2)."""
+    x = x + p['gamma_1'] * _attention(p['attn'], layer_norm(x, p['norm1']),
+                                      num_heads)
+    h = layer_norm(x, p['norm2'])
+    h = h @ p['mlp']['fc1']['weight'] + p['mlp']['fc1']['bias']
+    h = jax.nn.gelu(h, approximate=False)
+    h = h @ p['mlp']['fc2']['weight'] + p['mlp']['fc2']['bias']
+    return x + p['gamma_2'] * h
+
+
+def forward(params: Params, x: jax.Array,
+            arch: str = 'beit_base_patch16_224',
+            features: bool = True) -> jax.Array:
+    """(B, 224, 224, 3) normalized frames → (B, width) features: mean of
+    the patch tokens (cls excluded) through ``fc_norm`` — timm's
+    ``use_mean_pooling`` head with ``num_classes=0``. ``features=False``
+    applies a loaded ``head``."""
+    cfg = ARCHS[arch]
+    width, patch = cfg['width'], cfg['patch']
+    B = x.shape[0]
+    k = params['patch_embed']['proj']
+    x = jax.lax.conv_general_dilated(
+        x, k['weight'], window_strides=(patch, patch), padding='VALID',
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC')) + k['bias']
+    x = x.reshape(B, -1, width)
+    cls = jnp.broadcast_to(params['cls_token'], (B, 1, width))
+    x = jnp.concatenate([cls, x], axis=1)    # no absolute pos embed
+    for i in range(cfg['layers']):
+        x = _block(params['blocks'][str(i)], x, cfg['heads'])
+    feats = layer_norm(x[:, 1:].mean(axis=1), params['fc_norm'])
+    if features:
+        return feats
+    return feats @ params['head']['weight'] + params['head']['bias']
+
+
+def feat_dim(arch: str) -> int:
+    return ARCHS[arch]['width']
+
+
+def init_state_dict(arch: str = 'beit_base_patch16_224', seed: int = 0,
+                    num_classes: int = 0) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict with timm 0.9.12 naming/shapes
+    (incl. the integer ``relative_position_index`` buffers timm saves)."""
+    cfg = ARCHS[arch]
+    width, layers = cfg['width'], cfg['layers']
+    side = INPUT_RESOLUTION // cfg['patch']
+    window = (side, side)
+    nrd = num_relative_distance(window)
+    index = gen_relative_position_index(window)
+    rng = np.random.RandomState(seed)
+
+    def f32(*shape, scale=0.02):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    sd: Dict[str, np.ndarray] = {
+        'cls_token': f32(1, 1, width),
+        'patch_embed.proj.weight': f32(width, 3, cfg['patch'], cfg['patch']),
+        'patch_embed.proj.bias': f32(width),
+        'fc_norm.weight': np.ones(width, np.float32),
+        'fc_norm.bias': np.zeros(width, np.float32),
+    }
+    for i in range(layers):
+        b = f'blocks.{i}.'
+        sd[b + 'norm1.weight'] = np.ones(width, np.float32)
+        sd[b + 'norm1.bias'] = np.zeros(width, np.float32)
+        sd[b + 'gamma_1'] = np.full(width, 0.1, np.float32)
+        sd[b + 'gamma_2'] = np.full(width, 0.1, np.float32)
+        sd[b + 'attn.qkv.weight'] = f32(3 * width, width)
+        sd[b + 'attn.q_bias'] = f32(width)
+        sd[b + 'attn.v_bias'] = f32(width)
+        sd[b + 'attn.relative_position_bias_table'] = f32(
+            nrd, cfg['heads'])
+        sd[b + 'attn.relative_position_index'] = index
+        sd[b + 'attn.proj.weight'] = f32(width, width)
+        sd[b + 'attn.proj.bias'] = np.zeros(width, np.float32)
+        sd[b + 'norm2.weight'] = np.ones(width, np.float32)
+        sd[b + 'norm2.bias'] = np.zeros(width, np.float32)
+        sd[b + 'mlp.fc1.weight'] = f32(4 * width, width)
+        sd[b + 'mlp.fc1.bias'] = np.zeros(4 * width, np.float32)
+        sd[b + 'mlp.fc2.weight'] = f32(width, 4 * width)
+        sd[b + 'mlp.fc2.bias'] = np.zeros(width, np.float32)
+    if num_classes:
+        sd['head.weight'] = f32(num_classes, width)
+        sd['head.bias'] = np.zeros(num_classes, np.float32)
+    return sd

@@ -7,11 +7,11 @@ load torch checkpoints in timm naming. pip-timm is one provisioning path
 architectures under a different module tree, and the re-keying is
 mechanical. Used by ``tools/convert_checkpoint.py --hf-family`` and
 validated end-to-end against `transformers`' own forward passes in
-``tests/test_hf_crosscheck.py`` (4–9e-7 rel L2).
+``tests/test_hf_crosscheck.py`` (~1e-7-class rel L2).
 
 Functions take a flat HF state dict (torch tensors or numpy arrays) and
 return a timm-named dict ready for ``transplant()``. Structural deltas
-handled per family (five: vit, deit, convnext, swin, regnet):
+handled per family (six: vit, deit, beit, convnext, swin, regnet):
 
   * vit: HF splits q/k/v projections; timm packs ``qkv``.
   * deit: the vit mapping plus HF's ``distillation_token`` → timm
@@ -24,6 +24,12 @@ handled per family (five: vit, deit, convnext, swin, regnet):
   * regnet: HF nests each block's conv stack in a Sequential
     (layer.0/1/3 = conv1/conv2/conv3, layer.2 = SE) and calls the
     projection ``shortcut``.
+  * beit: q/k/v split as vit but k carries NO bias (timm packs
+    ``q_bias``/``v_bias``); HF names the layer scales
+    ``lambda_1``/``lambda_2`` (timm ``gamma_1``/``gamma_2``), hangs the
+    relative position bias table under
+    ``attention.attention.relative_position_bias``, and the timm
+    ``fc_norm`` is HF's pooler layernorm.
 """
 from __future__ import annotations
 
@@ -45,7 +51,7 @@ def strip_task_prefix(hf_sd: Sd) -> Sd:
     """Drop a task-model wrapper: ``vit.``/``swin.``/... key prefixes from
     *ForImageClassification checkpoints (and their classifier head)."""
     prefixes = {k.split('.', 1)[0] for k in hf_sd if '.' in k}
-    for p in ('vit', 'deit', 'swin', 'convnext', 'regnet', 'model'):
+    for p in ('vit', 'deit', 'beit', 'swin', 'convnext', 'regnet', 'model'):
         if p in prefixes:
             return {k[len(p) + 1:]: v for k, v in hf_sd.items()
                     if k.startswith(p + '.')}
@@ -93,6 +99,50 @@ def deit_to_timm(hf_sd: Sd, arch: str) -> Sd:
         arch = arch.replace('deit', 'vit', 1).replace('_distilled', '')
     sd = vit_to_timm(hf_sd, arch)
     sd['dist_token'] = hf_sd['embeddings.distillation_token']
+    return sd
+
+
+def beit_to_timm(hf_sd: Sd, arch: str) -> Sd:
+    """transformers.BeitModel → timm Beit naming. HF registers the
+    ``relative_position_index`` buffers non-persistent, so they are
+    regenerated here from the arch geometry (the published BEiT formula —
+    identical in timm, HF, and models/beit.py)."""
+    from video_features_tpu.models.beit import (
+        ARCHS, INPUT_RESOLUTION, gen_relative_position_index,
+    )
+    depth = ARCHS[arch]['layers']
+    side = INPUT_RESOLUTION // ARCHS[arch]['patch']
+    index = gen_relative_position_index((side, side))
+    sd = {
+        'cls_token': hf_sd['embeddings.cls_token'],
+        'patch_embed.proj.weight':
+            hf_sd['embeddings.patch_embeddings.projection.weight'],
+        'patch_embed.proj.bias':
+            hf_sd['embeddings.patch_embeddings.projection.bias'],
+        'fc_norm.weight': hf_sd['pooler.layernorm.weight'],
+        'fc_norm.bias': hf_sd['pooler.layernorm.bias'],
+    }
+    for i in range(depth):
+        h, t = f'encoder.layer.{i}.', f'blocks.{i}.'
+        a = h + 'attention.attention.'
+        sd[t + 'attn.qkv.weight'] = _cat0(
+            [hf_sd[a + f'{proj}.weight']
+             for proj in ('query', 'key', 'value')])
+        sd[t + 'attn.q_bias'] = hf_sd[a + 'query.bias']
+        sd[t + 'attn.v_bias'] = hf_sd[a + 'value.bias']
+        rb = a + 'relative_position_bias.'
+        sd[t + 'attn.relative_position_bias_table'] = hf_sd[
+            rb + 'relative_position_bias_table']
+        sd[t + 'attn.relative_position_index'] = index
+        sd[t + 'gamma_1'] = hf_sd[h + 'lambda_1']
+        sd[t + 'gamma_2'] = hf_sd[h + 'lambda_2']
+        for ours, theirs in [('norm1', 'layernorm_before'),
+                             ('norm2', 'layernorm_after'),
+                             ('attn.proj', 'attention.output.dense'),
+                             ('mlp.fc1', 'intermediate.dense'),
+                             ('mlp.fc2', 'output.dense')]:
+            sd[t + ours + '.weight'] = hf_sd[h + theirs + '.weight']
+            sd[t + ours + '.bias'] = hf_sd[h + theirs + '.bias']
     return sd
 
 
@@ -199,6 +249,7 @@ def regnet_to_timm(hf_sd: Sd, arch: str) -> Sd:
 CONVERTERS = {
     'vit': vit_to_timm,
     'deit': deit_to_timm,
+    'beit': beit_to_timm,
     'convnext': convnext_to_timm,
     'swin': swin_to_timm,
     'regnet': regnet_to_timm,
