@@ -291,3 +291,30 @@ def test_beit_parity_vs_hf_transformers():
     assert got.shape == ref.shape == (1, 768)
     rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
     assert rel < 1e-3, f'rel L2 vs transformers Beit: {rel}'
+
+
+def test_regnetx_parity_vs_hf_transformers():
+    """SE-free regnetx_008 vs transformers.RegNetModel layer_type='x':
+    the converter's checkpoint-driven SE dispatch (layer.2 = conv3, no
+    attention keys) against HF's own x-branch implementation."""
+    import jax
+
+    from video_features_tpu.models import regnet as regnet_model
+
+    depths, widths, group_w = regnet_model.ARCHS['regnetx_008']
+    hf_cfg = transformers.RegNetConfig(
+        embedding_size=32, hidden_sizes=list(widths), depths=list(depths),
+        groups_width=group_w, layer_type='x', hidden_act='relu')
+    torch.manual_seed(0)
+    hf = transformers.RegNetModel(hf_cfg).eval()
+
+    params = transplant(regnet_to_timm(hf.state_dict(), 'regnetx_008'))
+    x = np.random.RandomState(1).rand(1, 96, 96, 3).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(x).permute(0, 3, 1, 2)
+                 ).pooler_output.numpy().reshape(1, -1)
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(regnet_model.forward(
+            params, x, arch='regnetx_008'))
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 1e-3, f'rel L2 vs transformers RegNetX: {rel}'

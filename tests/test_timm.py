@@ -480,18 +480,21 @@ def test_swin_high_res_extractor(short_video, tmp_path):
     assert np.isfinite(out['timm']).all()
 
 
-def test_regnet_parity_vs_torch_mirror():
-    """RegNetY numerics vs the timm-layout mirror: per-stage grouped 3x3
+@pytest.mark.parametrize('arch,width', [('regnety_008', 768),
+                                        ('regnetx_008', 672)])
+def test_regnet_parity_vs_torch_mirror(arch, width):
+    """RegNet numerics vs the timm-layout mirror: per-stage grouped 3x3
     convs (group-width-tied feature_group_count), squeeze-excite sized
-    from the block INPUT width, no-act conv3 + post-sum ReLU, stride-2
-    projection downsample on every stage's first block."""
+    from the block INPUT width (y variants; x variants dispatch SE off the
+    checkpoint), no-act conv3 + post-sum ReLU, stride-2 projection
+    downsample on every stage's first block."""
     import jax
 
     from tests.torch_mirrors import TorchRegNet, randomize_bn_stats
     from video_features_tpu.models import regnet as regnet_model
 
     torch.manual_seed(0)
-    mirror = TorchRegNet('regnety_008', num_classes=5).eval()
+    mirror = TorchRegNet(arch, num_classes=5).eval()
     randomize_bn_stats(mirror)
     params = transplant(mirror.state_dict())
 
@@ -502,11 +505,11 @@ def test_regnet_parity_vs_torch_mirror():
         mirror.head.fc = torch.nn.Identity()
         ref = mirror(xt).numpy()
     with jax.default_matmul_precision('highest'):
-        got = np.asarray(regnet_model.forward(params, x, arch='regnety_008'))
+        got = np.asarray(regnet_model.forward(params, x, arch=arch))
         got_logits = np.asarray(regnet_model.forward(
-            params, x, arch='regnety_008', features=False))
+            params, x, arch=arch, features=False))
 
-    assert got.shape == ref.shape == (2, 768)
+    assert got.shape == ref.shape == (2, width)
     for ours, theirs in ((got, ref), (got_logits, ref_logits)):
         rel = np.linalg.norm(ours - theirs) / np.linalg.norm(theirs)
         assert rel < 1e-3, f'rel L2 {rel}'

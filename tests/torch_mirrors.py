@@ -623,28 +623,33 @@ class _RegBottleneck(nn.Module):
     grouped 3×3 → se (reduce width from the block INPUT channels) →
     conv3 1×1 no-act, + shortcut, ReLU after the sum."""
 
-    def __init__(self, cin, w, stride, group_w):
+    def __init__(self, cin, w, stride, group_w, se=True):
         super().__init__()
         self.conv1 = _RegConvNormAct(cin, w, 1)
         self.conv2 = _RegConvNormAct(w, w, 3, stride, 1,
                                      groups=w // group_w)
-        self.se = _RegSE(w, max(1, int(round(cin * 0.25))))
+        if se:   # RegNetY; the x variants carry no SE
+            self.se = _RegSE(w, max(1, int(round(cin * 0.25))))
         self.conv3 = _RegConvNormAct(w, w, 1, act=False)
         self.downsample = (_RegConvNormAct(cin, w, 1, stride, act=False)
                            if stride != 1 or cin != w else None)
 
     def forward(self, x):
         sc = x if self.downsample is None else self.downsample(x)
-        h = self.conv3(self.se(self.conv2(self.conv1(x))))
+        h = self.conv2(self.conv1(x))
+        if hasattr(self, 'se'):
+            h = self.se(h)
+        h = self.conv3(h)
         return F.relu(h + sc)
 
 
 class _RegStage(nn.Module):
-    def __init__(self, cin, w, depth, group_w):
+    def __init__(self, cin, w, depth, group_w, se=True):
         super().__init__()
         for bi in range(1, depth + 1):
             self.add_module(f'b{bi}', _RegBottleneck(
-                cin if bi == 1 else w, w, 2 if bi == 1 else 1, group_w))
+                cin if bi == 1 else w, w, 2 if bi == 1 else 1, group_w,
+                se=se))
 
     def forward(self, x):
         for blk in self.children():
@@ -673,6 +678,9 @@ class TorchRegNet(nn.Module):
         'regnety_008': ([1, 3, 8, 2], [64, 128, 320, 768], 16),
         'regnety_016': ([2, 6, 17, 2], [48, 120, 336, 888], 24),
         'regnety_032': ([2, 5, 13, 1], [72, 216, 576, 1512], 24),
+        'regnetx_008': ([1, 3, 7, 5], [64, 128, 288, 672], 16),
+        'regnetx_016': ([2, 4, 10, 2], [72, 168, 408, 912], 24),
+        'regnetx_032': ([2, 6, 15, 2], [96, 192, 432, 1008], 48),
     }
 
     def __init__(self, arch='regnety_008', num_classes=0):
@@ -680,8 +688,9 @@ class TorchRegNet(nn.Module):
         depths, widths, group_w = self.CFGS[arch]
         self.stem = _RegConvNormAct(3, 32, 3, 2, 1)
         cin = 32
+        se = arch.startswith('regnety')
         for si, (d, w) in enumerate(zip(depths, widths), start=1):
-            self.add_module(f's{si}', _RegStage(cin, w, d, group_w))
+            self.add_module(f's{si}', _RegStage(cin, w, d, group_w, se=se))
             cin = w
         self.head = _RegHead(cin, num_classes)
 

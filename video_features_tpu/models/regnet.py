@@ -1,16 +1,17 @@
-"""RegNetY image backbones (timm `regnety_*` state_dict layout).
+"""RegNet image backbones (timm `regnety_*`/`regnetx_*` state_dict layout).
 
 The reference's timm extractor accepts any pip-timm model (reference
 models/timm/extract_timm.py:48, timm==0.9.12 pinned); this module natively
-implements the RegNetY family — the design-space-derived grouped-conv
+implements the RegNet family — the design-space-derived grouped-conv
 branch of that model space (per-stage quantized widths, group-width-tied
-grouped 3×3 convs, squeeze-excite sized from the BLOCK INPUT width) —
+grouped 3×3 convs; the Y branch adds squeeze-excite sized from the BLOCK
+INPUT width, the X branch is SE-free and dispatched off the checkpoint) —
 against timm 0.9.12's ``RegNet`` module tree (``stem.{conv,bn}``,
 ``s{1..4}.b{1..N}.{conv1,conv2,conv3}.{conv,bn}`` + ``se.{fc1,fc2}`` +
 ``downsample.{conv,bn}``, ``head.fc``) so real timm checkpoints transplant
 mechanically.
 
-Per-stage (depth, width, group_width) tables are the published RegNetY
+Per-stage (depth, width, group_width) tables are the published RegNet
 configs (Radosavovic et al., "Designing Network Design Spaces";
 bottle_ratio 1.0 so the bottleneck width equals the stage width). Every
 stage downsamples (stride 2 on its first block); features are the global
@@ -38,12 +39,18 @@ STD = (0.229, 0.224, 0.225)
 STEM_WIDTH = 32
 SE_RATIO = 0.25
 
-# name: per-stage (depths, widths, group_width)
+# name: per-stage (depths, widths, group_width). The y variants carry
+# squeeze-excite; the x variants are the published SE-free branch (the
+# forward dispatches on the checkpoint's 'se' keys, so one graph serves
+# both).
 ARCHS: Dict[str, Tuple[List[int], List[int], int]] = {
     'regnety_004': ([1, 3, 6, 6], [48, 104, 208, 440], 8),
     'regnety_008': ([1, 3, 8, 2], [64, 128, 320, 768], 16),
     'regnety_016': ([2, 6, 17, 2], [48, 120, 336, 888], 24),
     'regnety_032': ([2, 5, 13, 1], [72, 216, 576, 1512], 24),
+    'regnetx_008': ([1, 3, 7, 5], [64, 128, 288, 672], 16),
+    'regnetx_016': ([2, 4, 10, 2], [72, 168, 408, 912], 24),
+    'regnetx_032': ([2, 6, 15, 2], [96, 192, 432, 1008], 48),
 }
 
 
@@ -69,12 +76,14 @@ def _se(p: Params, x: jax.Array) -> jax.Array:
 
 
 def _block(p: Params, x: jax.Array, stride: int, groups: int) -> jax.Array:
-    """timm regnet Bottleneck (bottle_ratio 1): 1×1 → grouped 3×3 → SE →
-    1×1 (no act) + shortcut → ReLU."""
+    """timm regnet Bottleneck (bottle_ratio 1): 1×1 → grouped 3×3 →
+    [SE when the checkpoint carries one — RegNetY] → 1×1 (no act) +
+    shortcut → ReLU."""
     shortcut = x
     h = _conv_bn_act(p['conv1'], x)
     h = _conv_bn_act(p['conv2'], h, stride=stride, padding=1, groups=groups)
-    h = _se(p['se'], h)
+    if 'se' in p:
+        h = _se(p['se'], h)
     h = _conv_bn_act(p['conv3'], h, act=False)
     if 'downsample' in p:
         shortcut = _conv_bn_act(p['downsample'], x, stride=stride, act=False)
@@ -119,8 +128,9 @@ def init_state_dict(arch: str = 'regnety_008', seed: int = 0,
             cw(f'{base}.conv1.conv', w, cin, 1); bn(f'{base}.conv1.bn', w)
             cw(f'{base}.conv2.conv', w, w // groups, 3)
             bn(f'{base}.conv2.bn', w)
-            cw(f'{base}.se.fc1', se_ch, w, 1, bias=True)
-            cw(f'{base}.se.fc2', w, se_ch, 1, bias=True)
+            if arch.startswith('regnety'):   # x variants carry no SE
+                cw(f'{base}.se.fc1', se_ch, w, 1, bias=True)
+                cw(f'{base}.se.fc2', w, se_ch, 1, bias=True)
             cw(f'{base}.conv3.conv', w, w, 1); bn(f'{base}.conv3.bn', w)
             if bi == 1:  # stride-2 first block always needs the projection
                 cw(f'{base}.downsample.conv', w, cin, 1)

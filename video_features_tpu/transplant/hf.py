@@ -218,7 +218,9 @@ def swin_to_timm(hf_sd: Sd, arch: str) -> Sd:
 
 
 def regnet_to_timm(hf_sd: Sd, arch: str) -> Sd:
-    """transformers.RegNetModel ('y' layer type) → timm RegNet naming."""
+    """transformers.RegNetModel → timm RegNet naming. Handles both layer
+    types the way the checkpoint dictates: 'y' blocks nest conv1/conv2/
+    SE/conv3 as layer.0/1/2/3, SE-free 'x' blocks as layer.0/1/2."""
     from video_features_tpu.models.regnet import ARCHS
     depths = ARCHS[arch][0]
     sd: Sd = {}
@@ -235,12 +237,14 @@ def regnet_to_timm(hf_sd: Sd, arch: str) -> Sd:
             t = f's{si + 1}.b{j + 1}'
             cna(f'{t}.conv1', f'{h}.layer.0')
             cna(f'{t}.conv2', f'{h}.layer.1')
-            cna(f'{t}.conv3', f'{h}.layer.3')
-            for ours, theirs in [('fc1', 'attention.0'),
-                                 ('fc2', 'attention.2')]:
-                for p in ('weight', 'bias'):
-                    sd[f'{t}.se.{ours}.{p}'] = hf_sd[
-                        f'{h}.layer.2.{theirs}.{p}']
+            has_se = f'{h}.layer.2.attention.0.weight' in hf_sd
+            cna(f'{t}.conv3', f'{h}.layer.{3 if has_se else 2}')
+            if has_se:
+                for ours, theirs in [('fc1', 'attention.0'),
+                                     ('fc2', 'attention.2')]:
+                    for p in ('weight', 'bias'):
+                        sd[f'{t}.se.{ours}.{p}'] = hf_sd[
+                            f'{h}.layer.2.{theirs}.{p}']
             if f'{h}.shortcut.convolution.weight' in hf_sd:
                 cna(f'{t}.downsample', f'{h}.shortcut')
     return sd
