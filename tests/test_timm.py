@@ -667,3 +667,58 @@ def test_beit_extractor_e2e(short_video, tmp_path):
     assert out['timm'].shape[1] == 768
     assert out['timm'].shape[0] > 0
     assert np.isfinite(out['timm']).all()
+
+
+def test_mixer_parity_vs_torch_mirror():
+    """MLP-Mixer numerics vs the timm-layout mirror: token-mixing MLP over
+    the transposed patch axis (attention-free), channel MLP, mean-token
+    pooling after the final norm."""
+    import jax
+
+    from tests.torch_mirrors import TorchMixer
+    from video_features_tpu.models import mixer as mixer_model
+
+    torch.manual_seed(0)
+    mirror = TorchMixer('mixer_b16_224', num_classes=5).eval()
+    params = transplant(mirror.state_dict())
+
+    x = np.random.RandomState(1).rand(2, 224, 224, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+        ref_logits = mirror(xt).numpy()
+        mirror.head = torch.nn.Identity()
+        ref = mirror(xt).numpy()
+    with jax.default_matmul_precision('highest'):
+        got = np.asarray(mixer_model.forward(params, x, arch='mixer_b16_224'))
+        got_logits = np.asarray(mixer_model.forward(
+            params, x, arch='mixer_b16_224', features=False))
+
+    assert got.shape == ref.shape == (2, 768)
+    for ours, theirs in ((got, ref), (got_logits, ref_logits)):
+        rel = np.linalg.norm(ours - theirs) / np.linalg.norm(theirs)
+        assert rel < 1e-3, f'rel L2 {rel}'
+
+
+def test_mixer_state_dict_keys_match_mirror():
+    from tests.torch_mirrors import TorchMixer
+    from video_features_tpu.models import mixer as mixer_model
+
+    for arch in mixer_model.ARCHS:
+        ours = set(mixer_model.init_state_dict(arch))
+        theirs = set(TorchMixer(arch).state_dict())
+        assert ours == theirs, arch
+
+
+@pytest.mark.slow
+def test_mixer_extractor_e2e(short_video, tmp_path):
+    args = load_config('timm', overrides={
+        'video_paths': short_video, 'device': 'cpu', 'batch_size': 8,
+        'model_name': 'mixer_b16_224',
+        'allow_random_weights': True, 'extraction_fps': 2,
+        'output_path': str(tmp_path / 'o'), 'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    out = ex.extract(short_video)
+    assert out['timm'].shape[1] == 768
+    assert out['timm'].shape[0] > 0
+    assert np.isfinite(out['timm']).all()

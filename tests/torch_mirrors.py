@@ -946,3 +946,70 @@ class TorchBeit(nn.Module):
         for blk in self.blocks:
             x = blk(x)
         return self.head(self.fc_norm(x[:, 1:].mean(dim=1)))
+
+
+# ----------------------------------------------------------------- mixer --
+
+
+class _MixerMlp(nn.Module):
+    def __init__(self, i, o):
+        super().__init__()
+        self.fc1 = nn.Linear(i, o)
+        self.fc2 = nn.Linear(o, i)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+class _MixerBlock(nn.Module):
+    def __init__(self, dim, tokens, tok_dim, ch_dim):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, eps=1e-6)
+        self.mlp_tokens = _MixerMlp(tokens, tok_dim)
+        self.norm2 = nn.LayerNorm(dim, eps=1e-6)
+        self.mlp_channels = _MixerMlp(dim, ch_dim)
+
+    def forward(self, x):
+        x = x + self.mlp_tokens(self.norm1(x).transpose(1, 2)).transpose(1, 2)
+        return x + self.mlp_channels(self.norm2(x))
+
+
+class _MixerStem(nn.Module):
+    def __init__(self, dim, patch):
+        super().__init__()
+        self.proj = nn.Conv2d(3, dim, patch, patch)
+
+    def forward(self, x):
+        return self.proj(x).flatten(2).transpose(1, 2)
+
+
+class TorchMixer(nn.Module):
+    """timm 0.9.12 MlpMixer mirror (stem.proj, blocks.N.{norm1,mlp_tokens,
+    norm2,mlp_channels}, norm; mean-token pooling). Reference consumes it
+    through pip-timm (models/timm/extract_timm.py:48)."""
+
+    # (width, layers, patch) — LITERAL mixer geometries, deliberately NOT
+    # derived from the module under test; token MLP = width/2, channel
+    # MLP = width*4 (timm MlpMixer mlp_ratio=(0.5, 4.0))
+    CFGS = {
+        'mixer_b16_224': (768, 12, 16),
+        'mixer_l16_224': (1024, 24, 16),
+    }
+
+    def __init__(self, arch='mixer_b16_224', num_classes=0, img_size=224):
+        super().__init__()
+        width, layers, patch = self.CFGS[arch]
+        tokens = (img_size // patch) ** 2
+        self.stem = _MixerStem(width, patch)
+        self.blocks = nn.ModuleList(
+            [_MixerBlock(width, tokens, width // 2, width * 4)
+             for _ in range(layers)])
+        self.norm = nn.LayerNorm(width, eps=1e-6)
+        self.head = (nn.Linear(width, num_classes) if num_classes
+                     else nn.Identity())
+
+    def forward(self, x):
+        x = self.stem(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(self.norm(x).mean(dim=1))

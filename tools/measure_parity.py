@@ -228,11 +228,12 @@ def measure_mirrors(ckpt_dir):
     # Each runs seed → randomize BN stats (no-op for LN-only nets) →
     # torch forward → transplant → ours, identically.
     from tests.torch_mirrors import (
-        TorchBeit, TorchEfficientNet, TorchMobileNetV3, TorchRegNet,
-        TorchSwin,
+        TorchBeit, TorchEfficientNet, TorchMixer, TorchMobileNetV3,
+        TorchRegNet, TorchSwin,
     )
     from video_features_tpu.models import beit as beit_model
     from video_features_tpu.models import efficientnet as eff_model
+    from video_features_tpu.models import mixer as mixer_model
     from video_features_tpu.models import mobilenetv3 as mnv3_model
     from video_features_tpu.models import regnet as regnet_model
     from video_features_tpu.models import swin as swin_model
@@ -255,6 +256,9 @@ def measure_mirrors(ckpt_dir):
         # full 224: the rel-pos window (14²) is resolution-tied
         ('beit_base (timm mirror, rel-pos bias + layer scale)',
          TorchBeit, {}, beit_model, 'beit_base_patch16_224', 224),
+        # full 224: the token-mix MLP width (196) is resolution-tied
+        ('mixer_b16 (timm mirror, token-mixing MLP)',
+         TorchMixer, {}, mixer_model, 'mixer_b16_224', 224),
     ]
     for label, mirror_cls, kwargs, module, arch, px in mirror_specs:
         torch.manual_seed(0)

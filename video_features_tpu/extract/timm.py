@@ -25,6 +25,7 @@ from video_features_tpu.extract.framewise import BaseFrameWiseExtractor
 from video_features_tpu.models import beit as beit_model
 from video_features_tpu.models import convnext as convnext_model
 from video_features_tpu.models import efficientnet as efficientnet_model
+from video_features_tpu.models import mixer as mixer_model
 from video_features_tpu.models import mobilenetv3 as mobilenetv3_model
 from video_features_tpu.models import regnet as regnet_model
 from video_features_tpu.models import resnet as resnet_model
@@ -53,6 +54,10 @@ def _data_cfg(family: str, arch: str = '') -> Dict[str, Any]:
         # timm beit: same recipe as vit (crop_pct 0.9, bicubic, 0.5 stats)
         return dict(resize=248, crop=224, interpolation='bicubic',
                     mean=beit_model.MEAN, std=beit_model.STD)
+    if family == 'mixer':
+        # timm mixer _cfg: crop_pct 0.875, bicubic, 0.5 stats
+        return dict(resize=256, crop=224, interpolation='bicubic',
+                    mean=mixer_model.MEAN, std=mixer_model.STD)
     if family == 'deit':
         # timm deit _cfg: crop_pct 0.9, bicubic, ImageNet stats
         return dict(resize=248, crop=224, interpolation='bicubic',
@@ -114,6 +119,9 @@ def _registry() -> Dict[str, Dict[str, Any]]:
     for name in beit_model.ARCHS:
         reg[name] = dict(family='beit', arch=name,
                          feat_dim=beit_model.feat_dim(name))
+    for name in mixer_model.ARCHS:
+        reg[name] = dict(family='mixer', arch=name,
+                         feat_dim=mixer_model.feat_dim(name))
     return reg
 
 
@@ -125,7 +133,7 @@ _MODEL_MODULES = {'vit': vit_model, 'deit': vit_model,
                   'resnet': resnet_model, 'convnext': convnext_model,
                   'swin': swin_model, 'efficientnet': efficientnet_model,
                   'regnet': regnet_model, 'mobilenetv3': mobilenetv3_model,
-                  'beit': beit_model}
+                  'beit': beit_model, 'mixer': mixer_model}
 
 
 class ExtractTIMM(BaseFrameWiseExtractor):
@@ -143,13 +151,14 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                 f'architectures transplant via checkpoint_path.)')
         spec = REGISTRY[name]
         self.family, self.arch = spec['family'], spec['arch']
-        if self.family == 'beit' and args.get('image_size'):
+        if self.family in ('beit', 'mixer') and args.get('image_size'):
             # checked before any checkpoint loads: nothing loaded changes it
             raise NotImplementedError(
-                'image_size override is not supported for BEiT: its '
-                'relative-position-bias tables are tied to the checkpoint '
-                'resolution (224). Use a ViT/DeiT model for '
-                'high-resolution inputs.')
+                f'image_size override is not supported for '
+                f'{self.family}: its weights are tied to the checkpoint '
+                f'resolution (224) — BEiT via the relative-position-bias '
+                f'tables, Mixer via the token-mix MLP width. Use a '
+                f'ViT/DeiT model for high-resolution inputs.')
         self._init_kwargs = spec.get('init', {})
         super().__init__(args, feat_dim=spec['feat_dim'])
         self.data_cfg = _data_cfg(self.family, self.arch)
@@ -272,7 +281,7 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         return self._step(self.params, batch)
 
     def maybe_show_pred(self, feats: np.ndarray) -> None:
-        if self.family in ('vit', 'deit', 'beit'):
+        if self.family in ('vit', 'deit', 'beit', 'mixer'):
             if 'dist_token' in self.params:
                 # timm's distilled inference scores the cls and dist tokens
                 # with SEPARATE heads ((head(cls)+head_dist(dist))/2); the

@@ -1,0 +1,118 @@
+"""MLP-Mixer image backbones (timm `mixer_*` state_dict layout).
+
+The reference's timm extractor accepts any pip-timm model (reference
+models/timm/extract_timm.py:48, timm==0.9.12 pinned); this module natively
+implements MLP-Mixer — the attention-free branch of that model space:
+each block mixes TOKENS with an MLP applied across the patch axis
+(weights shaped by the 196-token grid), then channels with an ordinary
+MLP — against timm 0.9.12's ``MlpMixer`` tree (``stem.proj``,
+``blocks.N.{norm1,mlp_tokens,norm2,mlp_channels}``, ``norm``) so real
+timm checkpoints transplant mechanically.
+
+Token mixing is resolution-tied (fc weights are (tokens_dim, 196)), so
+no ``image_size`` override — like BEiT, inputs are the checkpoint's
+224 px.
+
+TPU notes: both mixings are plain matmuls (the token mix contracts the
+PATCH axis — one transpose, MXU-friendly at these shapes); no gathers,
+no attention, static shapes throughout.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from video_features_tpu.models.vit import layer_norm
+
+Params = Dict[str, Any]
+
+# timm mixer _cfg: bicubic, crop_pct 0.875, 0.5 "inception" stats
+MEAN = (0.5, 0.5, 0.5)
+STD = (0.5, 0.5, 0.5)
+
+ARCHS = {
+    'mixer_b16_224': dict(width=768, layers=12, patch=16),
+    'mixer_l16_224': dict(width=1024, layers=24, patch=16),
+}
+INPUT_RESOLUTION = 224
+
+
+def feat_dim(arch: str) -> int:
+    return ARCHS[arch]['width']
+
+
+def _mlp(p: Params, x: jax.Array) -> jax.Array:
+    h = x @ p['fc1']['weight'] + p['fc1']['bias']
+    h = jax.nn.gelu(h, approximate=False)
+    return h @ p['fc2']['weight'] + p['fc2']['bias']
+
+
+def _block(p: Params, x: jax.Array) -> jax.Array:
+    """timm MixerBlock: token-mix MLP over the transposed (B, C, N)
+    view, then channel-mix MLP — both residual."""
+    h = layer_norm(x, p['norm1'])
+    h = _mlp(p['mlp_tokens'], h.swapaxes(1, 2)).swapaxes(1, 2)
+    x = x + h
+    return x + _mlp(p['mlp_channels'], layer_norm(x, p['norm2']))
+
+
+def forward(params: Params, x: jax.Array, arch: str = 'mixer_b16_224',
+            features: bool = True) -> jax.Array:
+    """(B, 224, 224, 3) normalized frames → (B, width) features: mean
+    over tokens after the final norm (timm global_pool='avg',
+    ``num_classes=0``). ``features=False`` applies a loaded ``head``."""
+    cfg = ARCHS[arch]
+    width, patch = cfg['width'], cfg['patch']
+    B = x.shape[0]
+    k = params['stem']['proj']
+    x = jax.lax.conv_general_dilated(
+        x, k['weight'], window_strides=(patch, patch), padding='VALID',
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC')) + k['bias']
+    x = x.reshape(B, -1, width)
+    for i in range(cfg['layers']):
+        x = _block(params['blocks'][str(i)], x)
+    feats = layer_norm(x, params['norm']).mean(axis=1)
+    if features:
+        return feats
+    return feats @ params['head']['weight'] + params['head']['bias']
+
+
+def init_state_dict(arch: str = 'mixer_b16_224', seed: int = 0,
+                    num_classes: int = 0) -> Dict[str, np.ndarray]:
+    """Random torch-layout state_dict with timm 0.9.12 naming/shapes."""
+    cfg = ARCHS[arch]
+    width, layers = cfg['width'], cfg['layers']
+    tokens = (INPUT_RESOLUTION // cfg['patch']) ** 2
+    # timm mixer dims: tokens MLP = width/2, channels MLP = width*4
+    tok_dim, ch_dim = width // 2, width * 4
+    rng = np.random.RandomState(seed)
+
+    def f32(*shape, scale=0.02):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    sd: Dict[str, np.ndarray] = {
+        'stem.proj.weight': f32(width, 3, cfg['patch'], cfg['patch']),
+        'stem.proj.bias': f32(width),
+        'norm.weight': np.ones(width, np.float32),
+        'norm.bias': np.zeros(width, np.float32),
+    }
+    for i in range(layers):
+        b = f'blocks.{i}.'
+        for n in ('norm1', 'norm2'):
+            sd[b + n + '.weight'] = np.ones(width, np.float32)
+            sd[b + n + '.bias'] = np.zeros(width, np.float32)
+        sd[b + 'mlp_tokens.fc1.weight'] = f32(tok_dim, tokens)
+        sd[b + 'mlp_tokens.fc1.bias'] = np.zeros(tok_dim, np.float32)
+        sd[b + 'mlp_tokens.fc2.weight'] = f32(tokens, tok_dim)
+        sd[b + 'mlp_tokens.fc2.bias'] = np.zeros(tokens, np.float32)
+        sd[b + 'mlp_channels.fc1.weight'] = f32(ch_dim, width)
+        sd[b + 'mlp_channels.fc1.bias'] = np.zeros(ch_dim, np.float32)
+        sd[b + 'mlp_channels.fc2.weight'] = f32(width, ch_dim)
+        sd[b + 'mlp_channels.fc2.bias'] = np.zeros(width, np.float32)
+    if num_classes:
+        sd['head.weight'] = f32(num_classes, width)
+        sd['head.bias'] = np.zeros(num_classes, np.float32)
+    return sd
