@@ -5,7 +5,7 @@ only in the slow lane (~10 CPU-minutes); this variant guards the SAME
 composition — decode → resize 256 → 17-frame window → RAFT → crop → clamp →
 uint8 quantize → both I3D towers → concat → .npy — against the reference
 pipeline on every fast-lane run, cut down where the reference's own knobs
-allow: one stack (17 frames) and raft_iters=4 (reference
+allow: one stack (17 frames) and raft_iters=2 (reference
 raft_src/raft.py:118 `iters` parameter; spatial geometry cannot shrink —
 the reference I3D's fixed avg_pool3d(2,7,7) needs the 224 crop).
 """

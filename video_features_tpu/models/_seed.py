@@ -8,7 +8,7 @@ families seed the same numeric regime (BN stats deliberately non-trivial
 """
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -21,7 +21,7 @@ class SeedWriter:
         self.sd, self.rng, self.conv_scale = sd, rng, conv_scale
 
     def conv(self, name: str, o: int, i: int, k: int,
-             bias: bool = False, scale: float = None) -> None:
+             bias: bool = False, scale: Optional[float] = None) -> None:
         scale = self.conv_scale if scale is None else scale
         self.sd[f'{name}.weight'] = (
             self.rng.randn(o, i, k, k) * scale).astype(np.float32)

@@ -113,6 +113,11 @@ def forward(params: Params, x: jax.Array,
     applies a loaded ``head``."""
     cfg = ARCHS[arch]
     width, patch = cfg['width'], cfg['patch']
+    # the relative-position bias tables are sized for the 224 grid; any
+    # other input would fail deep inside a gather with an opaque error
+    assert x.shape[1:3] == (INPUT_RESOLUTION, INPUT_RESOLUTION), (
+        f'beit runs at {INPUT_RESOLUTION}px (rel-pos bias geometry); '
+        f'got {x.shape}')
     B = x.shape[0]
     k = params['patch_embed']['proj']
     x = jax.lax.conv_general_dilated(

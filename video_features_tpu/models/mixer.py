@@ -66,6 +66,11 @@ def forward(params: Params, x: jax.Array, arch: str = 'mixer_b16_224',
     ``num_classes=0``). ``features=False`` applies a loaded ``head``."""
     cfg = ARCHS[arch]
     width, patch = cfg['width'], cfg['patch']
+    # token-mixing MLP weights are sized for the 224 token grid; any other
+    # input would fail as an opaque matmul shape error
+    assert x.shape[1:3] == (INPUT_RESOLUTION, INPUT_RESOLUTION), (
+        f'mixer runs at {INPUT_RESOLUTION}px (token-MLP geometry); '
+        f'got {x.shape}')
     B = x.shape[0]
     k = params['stem']['proj']
     x = jax.lax.conv_general_dilated(
