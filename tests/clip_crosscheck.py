@@ -1,0 +1,69 @@
+"""Shared CLIP ViT-B/32 full-geometry cross-check harness.
+
+One implementation for both consumers — the pytest cross-check
+(tests/test_hf_crosscheck.py) and the PARITY.md row generator
+(tools/measure_parity.py:measure_hf_clip) — so the two can never drift
+into validating different things.
+
+transformers' default CLIPConfig IS OpenAI ViT-B/32 (vision width 768 /
+12 layers / patch 32 / 224 px → 512-d; text width 512 / 12 layers /
+8 heads / vocab 49408 / ctx 77; quick_gelu). eos_token_id is pinned to
+the OpenAI EOT id (49407) so HF's eos-based pooling and our argmax
+pooling provably select the same token.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def run_clip_vitb32_crosscheck() -> Dict[str, np.ndarray]:
+    """Returns {ref,got} × {img,txt,logits}: transformers.CLIPModel vs our
+    tower through the production converter (transplant/hf.py:
+    clip_to_openai), identical inputs, float32/highest."""
+    import jax
+    import torch
+    import transformers
+
+    from video_features_tpu.models import clip as clip_model
+    from video_features_tpu.transplant.hf import clip_to_openai
+    from video_features_tpu.transplant.torch2jax import transplant
+
+    hf_cfg = transformers.CLIPConfig()
+    assert hf_cfg.vision_config.hidden_size == 768
+    assert hf_cfg.vision_config.patch_size == 32
+    assert hf_cfg.text_config.hidden_size == 512
+    assert hf_cfg.projection_dim == 512
+    hf_cfg.text_config.eos_token_id = 49407
+    torch.manual_seed(0)
+    hf = transformers.CLIPModel(hf_cfg).eval()
+
+    params = transplant(clip_to_openai(hf.state_dict()),
+                        no_transpose=set(clip_model.NO_TRANSPOSE),
+                        dtype=np.float32)
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 224, 224, 3).astype(np.float32) * 2 - 1
+    # tokens: ids < EOT, then EOT (=vocab max id), zero padding after —
+    # argmax and ==eos pooling agree by construction
+    tokens = np.zeros((2, 77), np.int64)
+    tokens[0, :9] = list(rng.randint(1, 49406, size=8)) + [49407]
+    tokens[1, :15] = list(rng.randint(1, 49406, size=14)) + [49407]
+
+    with torch.no_grad():
+        pixel = torch.from_numpy(x).permute(0, 3, 1, 2)
+        ref_img = hf.get_image_features(pixel).numpy()
+        ref_txt = hf.get_text_features(torch.from_numpy(tokens)).numpy()
+        ref_logits = hf(input_ids=torch.from_numpy(tokens),
+                        pixel_values=pixel).logits_per_image.numpy()
+    with jax.default_matmul_precision('highest'):
+        got_img = np.asarray(clip_model.encode_image(params, x, 'ViT-B/32'))
+        got_txt = np.asarray(clip_model.encode_text(params, tokens,
+                                                    'ViT-B/32'))
+        got_logits = np.asarray(clip_model.zero_shot_logits(
+            params, got_img, got_txt))
+
+    return {'ref_img': ref_img, 'got_img': got_img,
+            'ref_txt': ref_txt, 'got_txt': got_txt,
+            'ref_logits': ref_logits, 'got_logits': got_logits}

@@ -293,6 +293,26 @@ def test_beit_parity_vs_hf_transformers():
     assert rel < 1e-3, f'rel L2 vs transformers Beit: {rel}'
 
 
+@pytest.mark.slow
+def test_clip_vitb32_full_geometry_vs_hf_transformers():
+    """CLIP ViT-B/32 at FULL geometry vs transformers.CLIPModel — image
+    tower, text tower, and logit_scale, against code we didn't write —
+    replacing the reduced-geometry caveat on the in-repo CLIP parity row.
+    The harness is shared with the PARITY.md row generator
+    (tests/clip_crosscheck.py); the HF state dict goes through the
+    PRODUCTION converter (transplant/hf.py:clip_to_openai, the
+    --hf-family clip path)."""
+    from tests.clip_crosscheck import run_clip_vitb32_crosscheck
+
+    r = run_clip_vitb32_crosscheck()
+    assert r['got_img'].shape == r['ref_img'].shape == (2, 512)
+    assert r['got_txt'].shape == r['ref_txt'].shape == (2, 512)
+    for part in ('img', 'txt', 'logits'):
+        rel = (np.linalg.norm(r[f'got_{part}'] - r[f'ref_{part}'])
+               / np.linalg.norm(r[f'ref_{part}']))
+        assert rel < 1e-3, f'{part} rel L2 vs transformers: {rel}'
+
+
 def test_regnetx_parity_vs_hf_transformers():
     """SE-free regnetx_008 vs transformers.RegNetModel layer_type='x':
     the converter's checkpoint-driven SE dispatch (layer.2 = conv3, no

@@ -21,6 +21,14 @@ pip-timm:
 
     python tools/convert_checkpoint.py pytorch_model.bin swin_tiny.npz \
         --hf-family swin --arch swin_tiny_patch4_window7_224
+
+``--hf-family clip`` re-keys a transformers CLIPModel checkpoint into the
+OpenAI layout (both towers + logit_scale) and applies CLIP's embedding-
+table transpose exemptions automatically; no --arch needed (geometry is
+read off the keys, and extract/clip.py re-infers it at load):
+
+    python tools/convert_checkpoint.py clip_pytorch_model.bin vitb32.npz \
+        --hf-family clip
 """
 from __future__ import annotations
 
@@ -54,19 +62,25 @@ def main() -> int:
     )
 
     if ns.hf_family:
-        if not ns.arch:
+        if not ns.arch and ns.hf_family != 'clip':
             raise SystemExit('--hf-family requires --arch (the timm name '
                              'whose layout to produce)')
         import torch
-
-        from video_features_tpu.transplant.hf import hf_to_timm
         raw = torch.load(ns.src, map_location='cpu', weights_only=True)
         if ns.key:
             raw = raw[ns.key]
         import numpy as np
-        params = transplant(
-            hf_to_timm(ns.hf_family, raw, ns.arch), dtype=np.float32,
-            no_transpose=set(ns.no_transpose) if ns.no_transpose else None)
+        no_t = set(ns.no_transpose) if ns.no_transpose else set()
+        if ns.hf_family == 'clip':
+            from video_features_tpu.models.clip import NO_TRANSPOSE
+            from video_features_tpu.transplant.hf import clip_to_openai
+            rekeyed = clip_to_openai(raw)
+            no_t |= set(NO_TRANSPOSE)
+        else:
+            from video_features_tpu.transplant.hf import hf_to_timm
+            rekeyed = hf_to_timm(ns.hf_family, raw, ns.arch)
+        params = transplant(rekeyed, dtype=np.float32,
+                            no_transpose=no_t or None)
     else:
         params = load_torch_checkpoint(
             ns.src, key=ns.key,

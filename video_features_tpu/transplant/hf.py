@@ -47,14 +47,49 @@ def _cat0(parts):
     return np.concatenate(list(parts), axis=0)
 
 
+def _t2(x):
+    """2-D transpose for a torch tensor or numpy array."""
+    if hasattr(x, 'detach'):
+        return x.detach().t().contiguous()
+    import numpy as np
+    return np.ascontiguousarray(np.asarray(x).T)
+
+
+# key stems every supported HF backbone subtree contains at top level —
+# the guard that a candidate prefix really wraps a backbone, not some
+# unrelated module that happens to be named e.g. 'model'
+_BACKBONE_MARKERS = ('embeddings.', 'encoder.', 'embedder.')
+# keys legitimately discarded when unwrapping a *ForImageClassification
+# checkpoint (the task head the feature path never uses)
+_EXPECTED_DISCARDS = ('classifier.',)
+
+
 def strip_task_prefix(hf_sd: Sd) -> Sd:
     """Drop a task-model wrapper: ``vit.``/``swin.``/... key prefixes from
-    *ForImageClassification checkpoints (and their classifier head)."""
+    *ForImageClassification checkpoints (and their classifier head).
+
+    Only strips when the prefixed subtree actually looks like a backbone
+    (contains an ``embeddings.``/``encoder.`` stem), and refuses to
+    silently discard keys outside the prefix other than the classifier
+    head — a mixed or unexpectedly-named checkpoint errors instead of
+    being mangled."""
     prefixes = {k.split('.', 1)[0] for k in hf_sd if '.' in k}
     for p in ('vit', 'deit', 'beit', 'swin', 'convnext', 'regnet', 'model'):
-        if p in prefixes:
-            return {k[len(p) + 1:]: v for k, v in hf_sd.items()
-                    if k.startswith(p + '.')}
+        if p not in prefixes:
+            continue
+        sub = {k[len(p) + 1:]: v for k, v in hf_sd.items()
+               if k.startswith(p + '.')}
+        if not any(k.startswith(_BACKBONE_MARKERS) for k in sub):
+            continue  # a coincidental module name, not the backbone wrapper
+        dropped = [k for k in hf_sd
+                   if not k.startswith(p + '.')
+                   and not k.startswith(_EXPECTED_DISCARDS)]
+        if dropped:
+            raise ValueError(
+                f'checkpoint mixes {p}.*-prefixed backbone keys with '
+                f'unprefixed keys that are not a classifier head '
+                f'(e.g. {dropped[:3]}); refusing to silently discard them')
+        return sub
     return hf_sd
 
 
@@ -247,6 +282,64 @@ def regnet_to_timm(hf_sd: Sd, arch: str) -> Sd:
                             f'{h}.layer.2.{theirs}.{p}']
             if f'{h}.shortcut.convolution.weight' in hf_sd:
                 cna(f'{t}.downsample', f'{h}.shortcut')
+    return sd
+
+
+def clip_to_openai(hf_sd: Sd, arch: str = '') -> Sd:
+    """transformers.CLIPModel → OpenAI CLIP state-dict naming (the layout
+    models/clip.py consumes; reference models/clip/clip_src/model.py).
+
+    HF splits q/k/v where OpenAI fuses ``attn.in_proj_*``; HF's projection
+    heads are F.linear weights (out, in) where OpenAI's ``visual.proj`` /
+    ``text_projection`` are raw right-operands (in, out) — transposed here.
+    Transplant the result with ``no_transpose=clip.NO_TRANSPOSE`` exactly
+    like an OpenAI checkpoint. ``arch`` is unused (geometry is read off the
+    keys); accepted for CONVERTERS signature uniformity."""
+    del arch
+    sd: Sd = {'logit_scale': hf_sd['logit_scale']}
+
+    def block(dst: str, src: str) -> None:
+        sd[f'{dst}.attn.in_proj_weight'] = _cat0(
+            [hf_sd[f'{src}.self_attn.{p}_proj.weight'] for p in 'qkv'])
+        sd[f'{dst}.attn.in_proj_bias'] = _cat0(
+            [hf_sd[f'{src}.self_attn.{p}_proj.bias'] for p in 'qkv'])
+        for ours, theirs in [('attn.out_proj', 'self_attn.out_proj'),
+                             ('ln_1', 'layer_norm1'), ('ln_2', 'layer_norm2'),
+                             ('mlp.c_fc', 'mlp.fc1'),
+                             ('mlp.c_proj', 'mlp.fc2')]:
+            for p in ('weight', 'bias'):
+                sd[f'{dst}.{ours}.{p}'] = hf_sd[f'{src}.{theirs}.{p}']
+
+    def depth(tower: str) -> int:
+        return 1 + max(int(k.split('.')[3]) for k in hf_sd
+                       if k.startswith(f'{tower}.encoder.layers.'))
+
+    # visual tower (HF spells the pre-LN 'pre_layrnorm' historically)
+    v = 'vision_model.'
+    pre = v + ('pre_layrnorm' if v + 'pre_layrnorm.weight' in hf_sd
+               else 'pre_layernorm')
+    sd['visual.conv1.weight'] = hf_sd[v + 'embeddings.patch_embedding.weight']
+    sd['visual.class_embedding'] = hf_sd[v + 'embeddings.class_embedding']
+    sd['visual.positional_embedding'] = hf_sd[
+        v + 'embeddings.position_embedding.weight']
+    for p in ('weight', 'bias'):
+        sd[f'visual.ln_pre.{p}'] = hf_sd[f'{pre}.{p}']
+        sd[f'visual.ln_post.{p}'] = hf_sd[f'{v}post_layernorm.{p}']
+    for i in range(depth('vision_model')):
+        block(f'visual.transformer.resblocks.{i}', f'{v}encoder.layers.{i}')
+    sd['visual.proj'] = _t2(hf_sd['visual_projection.weight'])
+
+    # text tower
+    t = 'text_model.'
+    sd['token_embedding.weight'] = hf_sd[
+        t + 'embeddings.token_embedding.weight']
+    sd['positional_embedding'] = hf_sd[
+        t + 'embeddings.position_embedding.weight']
+    for p in ('weight', 'bias'):
+        sd[f'ln_final.{p}'] = hf_sd[f'{t}final_layer_norm.{p}']
+    for i in range(depth('text_model')):
+        block(f'transformer.resblocks.{i}', f'{t}encoder.layers.{i}')
+    sd['text_projection'] = _t2(hf_sd['text_projection.weight'])
     return sd
 
 
