@@ -367,19 +367,84 @@ def write_real_audio_wav(path: str, sr: int = 16000,
     return str(path)
 
 
+def resample_reference_literal(x: np.ndarray, sr_orig: int,
+                               sr_new: int) -> np.ndarray:
+    """Straight-line transcription of resampy 0.4.2's resample_f loop
+    (resampy/interpn.py) + core.resample setup with the kaiser_best
+    filter — the resample the reference's vggish_input.py:47-49 performs.
+    resampy is not installable here, so this literal per-sample loop
+    stands in for it on the reference side; the production vectorized
+    implementation (ops/audio.py:resample_kaiser) is pinned against THIS
+    function in tests/test_audio_resample.py.
+
+    Everything here — filter table construction included — is written
+    from resampy's published code with literal constants, sharing NO code
+    with the production module, so a misreading in ops/audio.py cannot
+    cancel out."""
+    from fractions import Fraction
+
+    from scipy.signal.windows import kaiser
+
+    # resampy/filters.py sinc_window with the kaiser_best constants:
+    # 64 zero crossings, 2^9 table entries per crossing,
+    # beta 14.769656459379492, rolloff 0.9475937167399596
+    num_table = 512
+    n = num_table * 64
+    rolloff = 0.9475937167399596
+    sinc_right = rolloff * np.sinc(
+        rolloff * np.linspace(0, 64, num=n + 1, endpoint=True))
+    interp_win = kaiser(2 * n + 1, 14.769656459379492)[n:] * sinc_right
+
+    ratio = Fraction(int(sr_new), int(sr_orig))
+    sample_ratio = float(ratio)
+    if sample_ratio < 1:
+        interp_win = interp_win * sample_ratio
+    interp_delta = np.zeros_like(interp_win)
+    interp_delta[:-1] = np.diff(interp_win)
+    scale = min(1.0, sample_ratio)
+    index_step = int(scale * num_table)
+    nwin = interp_win.shape[0]
+    n_orig = x.shape[0]
+    n_out = int(np.ceil(n_orig * sample_ratio))
+    y = np.zeros(n_out, dtype=np.float64)
+    for t in range(n_out):
+        time_register = t / sample_ratio
+        n = int(time_register)
+        frac = scale * (time_register - n)
+        index_frac = frac * num_table
+        offset = int(index_frac)
+        eta = index_frac - offset
+        i_max = min(n + 1, (nwin - offset) // index_step)
+        for i in range(i_max):
+            weight = (interp_win[offset + i * index_step]
+                      + eta * interp_delta[offset + i * index_step])
+            y[t] += weight * x[n - i]
+        frac = scale - frac
+        index_frac = frac * num_table
+        offset = int(index_frac)
+        eta = index_frac - offset
+        k_max = min(n_orig - n - 1, (nwin - offset) // index_step)
+        for k in range(k_max):
+            weight = (interp_win[offset + k * index_step]
+                      + eta * interp_delta[offset + k * index_step])
+            y[t] += weight * x[n + k + 1]
+    return y
+
+
 def run_reference_vggish(wav_path: str, net) -> np.ndarray:
     """The reference vggish extraction, verbatim semantics, composed from
     the reference's own importable pieces.
 
     Mirrors reference models/vggish/extract_vggish.py:31-62 +
-    vggish_src/vggish_input.py:75-99 at a 16 kHz wav input (the rate its
-    ffmpeg stage produces, so the resampy branch — whose import is the only
-    un-importable dependency here — is a no-op): int16 wav → /32768 → mono
-    → the reference's OWN mel_features.log_mel_spectrogram with
-    vggish_params constants → mel_features.frame into (N, 96, 64) examples
-    → the VGG net (postprocess is a no-op by default: the vendored
-    Postprocessor.forward returns its input unless post_process=True,
-    vggish_slim.py:150-156). ``net`` is the state-dict-matched torch mirror
+    vggish_src/vggish_input.py:75-99: int16 wav → /32768 → mono →
+    resample to 16 kHz when needed (the reference calls resampy, which is
+    not importable here — :func:`resample_reference_literal` is its
+    literal transcription) → the reference's OWN
+    mel_features.log_mel_spectrogram with vggish_params constants →
+    mel_features.frame into (N, 96, 64) examples → the VGG net
+    (postprocess is a no-op by default: the vendored Postprocessor.forward
+    returns its input unless post_process=True, vggish_slim.py:150-156).
+    ``net`` is the state-dict-matched torch mirror
     (tests/torch_mirrors.TorchVGGish) or the real checkpoint loaded into it.
     """
     import wave
@@ -394,12 +459,12 @@ def run_reference_vggish(wav_path: str, net) -> np.ndarray:
         raw = np.frombuffer(f.readframes(f.getnframes()), dtype='<i2')
         if f.getnchannels() > 1:
             raw = raw.reshape(-1, f.getnchannels())
-    assert sr == vggish_params.SAMPLE_RATE, (
-        f'run_reference_vggish needs a {vggish_params.SAMPLE_RATE} Hz wav '
-        f'(got {sr}); the resampy path is not importable here')
     samples = raw / 32768.0                      # sf.read int16 convention
     if samples.ndim > 1:
         samples = np.mean(samples, axis=1)
+    if sr != vggish_params.SAMPLE_RATE:          # vggish_input.py:47-49
+        samples = resample_reference_literal(samples, sr,
+                                             vggish_params.SAMPLE_RATE)
 
     log_mel = mel_features.log_mel_spectrogram(
         samples,

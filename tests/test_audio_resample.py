@@ -1,0 +1,106 @@
+"""Kaiser-best resampler: mirror equivalence, fidelity, and the measured
+scipy-path divergence.
+
+The production resampler (ops/audio.py:resample_kaiser) is a vectorized
+implementation of resampy 0.4.2's windowed-sinc interpolation (the
+algorithm behind the reference's ``resampy.resample(data, sr, 16000)``,
+reference models/vggish/vggish_src/vggish_input.py:47-49; resampy itself
+is not installable here). The first test pins it against a LITERAL
+per-sample transcription of resampy's interpn.py loop — deliberately
+written with explicit python loops and no shared code with the
+vectorized version, so a vectorization bug cannot cancel out.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from video_features_tpu.ops.audio import (
+    SAMPLE_RATE, resample, resample_kaiser, waveform_to_examples,
+)
+
+
+def _resampy_literal(x: np.ndarray, sr_orig: int, sr_new: int) -> np.ndarray:
+    """The literal per-sample transcription of resampy's loop, shared
+    with the reference-side vggish composition
+    (tests/reference_pipeline.py:resample_reference_literal)."""
+    from tests.reference_pipeline import resample_reference_literal
+
+    return resample_reference_literal(x, sr_orig, sr_new)
+
+
+@pytest.mark.parametrize('sr', [44100, 48000, 22050, 8000])
+def test_kaiser_matches_literal_transcription(sr):
+    """Vectorized production path ≡ the literal loop, all common rates
+    (44.1k/48k real mp4 audio, 22.05k, and UPsampling from 8k)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(sr // 5).astype(np.float64)     # 200 ms
+    got = resample_kaiser(x, sr, SAMPLE_RATE)
+    ref = _resampy_literal(x, sr, SAMPLE_RATE)
+    assert got.shape == ref.shape
+    err = np.max(np.abs(got - ref)) / max(np.max(np.abs(ref)), 1e-30)
+    assert err < 1e-12, f'vectorized vs literal at {sr} Hz: {err}'
+
+
+def test_kaiser_sine_fidelity():
+    """A pure in-band tone survives 44.1k→16k essentially intact: the
+    kaiser_best filter has a small flat passband gain (~1.003 at this
+    ratio — a property of resampy's window normalization, identical in
+    the literal transcription), so fit the gain and bound the residual
+    distortion, which is what actually corrupts features."""
+    sr, f0 = 44100, 440.0
+    t = np.arange(sr) / sr                        # 1 s
+    x = np.sin(2 * np.pi * f0 * t)
+    y = resample_kaiser(x, sr, SAMPLE_RATE)
+    t_out = np.arange(y.shape[0]) / SAMPLE_RATE
+    mid = slice(2048, -2048)                      # away from edge decay
+    basis = np.stack([np.sin(2 * np.pi * f0 * t_out[mid]),
+                      np.cos(2 * np.pi * f0 * t_out[mid])], axis=1)
+    coef, *_ = np.linalg.lstsq(basis, y[mid], rcond=None)
+    gain = float(np.hypot(*coef))
+    resid = np.max(np.abs(y[mid] - basis @ coef))
+    assert abs(gain - 1) < 5e-3, f'passband gain off: {gain}'
+    assert resid < 5e-4, f'in-band distortion: {resid}'
+
+
+def test_kaiser_length_contract():
+    """n_out = ceil(n_in * ratio) — and exact-second inputs hit the exact
+    sample count."""
+    assert resample_kaiser(np.zeros(44100), 44100, 16000).shape == (16000,)
+    assert resample_kaiser(np.zeros(44101), 44100, 16000).shape == (16001,)
+    assert resample_kaiser(np.zeros(8000), 8000, 16000).shape == (16000,)
+
+
+def test_resample_default_is_kaiser():
+    """ops.audio.resample routes to the Kaiser path by default (the
+    reference-parity resampler is what extraction actually runs)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(4410)
+    assert np.array_equal(resample(x, 44100), resample_kaiser(x, 44100))
+
+
+def test_scipy_polyphase_divergence_quantified():
+    """The old scipy path differs from the Kaiser path — measured here at
+    the FEATURE level (log-mel examples on real-ish audio), so the
+    divergence the default path no longer has is a number, not a guess.
+    Both resamplers are fed the same 44.1 kHz signal; the examples are
+    compared as rel L2. This is documentation-by-test: the assert bounds
+    the divergence band (non-zero, sub-percent) rather than a parity bar."""
+    rng = np.random.RandomState(2)
+    sr = 44100
+    t = np.arange(sr * 2) / sr
+    x = (0.4 * np.sin(2 * np.pi * (200 + 40 * t) * t)
+         + 0.1 * rng.randn(t.shape[0]))
+    ex_kaiser = waveform_to_examples(x, sr)
+    from video_features_tpu.ops import audio
+
+    data = audio.resample(x, sr, method='polyphase')
+    log_mel = audio.log_mel_spectrogram(data, SAMPLE_RATE)
+    ex_scipy = audio.frame(
+        log_mel, int(round(0.96 * 100)), int(round(0.96 * 100))
+    ).astype(np.float32)
+    assert ex_kaiser.shape == ex_scipy.shape
+    rel = (np.linalg.norm(ex_kaiser - ex_scipy)
+           / np.linalg.norm(ex_kaiser))
+    print(f'[resample] scipy-vs-kaiser log-mel rel L2: {rel:.3e}')
+    assert 0 < rel < 0.05, rel

@@ -8,12 +8,17 @@ triangular mel filterbank with a zeroed DC bin, log with offset 0.01, and
 0.96 s non-overlapping 96×64 examples.
 
 This runs on the host (float64, exactly like the reference's numpy) — the
-DSP is microseconds per clip; the VGG net is the device work. One
-divergence: the reference resamples with ``resampy`` (Kaiser polyphase);
-here non-16 kHz input is resampled with scipy's polyphase resampler
-(`scipy.signal.resample_poly`) — same class of filter, not bit-identical.
-Feeding 16 kHz wavs (e.g. asking ffmpeg for ``-ar 16000``) avoids any
-resampling difference entirely.
+DSP is microseconds per clip; the VGG net is the device work.
+
+Resampling parity: the reference resamples any non-16 kHz wav with
+``resampy.resample`` (kaiser_best) — reference
+models/vggish/vggish_src/vggish_input.py:47-49, resampy pinned 0.4.2 in
+its conda_env.yml. :func:`resample` here implements that exact algorithm
+(windowed-sinc interpolation with resampy's published kaiser_best filter
+parameters) in vectorized numpy — see :func:`resample_kaiser`. The
+previous scipy ``resample_poly`` substitute is kept as
+``method='polyphase'`` for comparison; its feature-level divergence is
+quantified in tests/test_audio_resample.py.
 """
 from __future__ import annotations
 
@@ -93,7 +98,108 @@ def log_mel_spectrogram(data: np.ndarray,
     return np.log(mel + LOG_OFFSET)
 
 
-def resample(data: np.ndarray, sr: int, target_sr: int = SAMPLE_RATE) -> np.ndarray:
+# resampy 0.4.2 kaiser_best filter parameters (resampy/filters.py
+# sinc_window + the shipped kaiser_best.npz generation constants): 64
+# zero-crossings, 2^9 table entries per crossing, Kaiser window
+# beta 14.769656459379492, roll-off 0.9475937167399596.
+KAISER_BEST = dict(num_zeros=64, precision=9,
+                   beta=14.769656459379492, rolloff=0.9475937167399596)
+
+_FILTER_CACHE: dict = {}
+
+
+def sinc_window(num_zeros: int, precision: int, beta: float,
+                rolloff: float) -> tuple:
+    """Right wing of resampy's interpolation filter (filters.sinc_window):
+    a roll-off-scaled sinc sampled at 2^precision points per zero
+    crossing, tapered by the right half of a Kaiser window. Returns
+    (interp_win, num_table)."""
+    from scipy.signal.windows import kaiser
+    num_table = 2 ** precision
+    n = num_table * num_zeros
+    sinc_win = rolloff * np.sinc(
+        rolloff * np.linspace(0, num_zeros, num=n + 1, endpoint=True))
+    taper = kaiser(2 * n + 1, beta)[n:]
+    return taper * sinc_win, num_table
+
+
+def _interp_tables(sample_ratio: float) -> tuple:
+    """(interp_win, interp_delta, num_table) for one ratio — the filter is
+    pre-scaled by the ratio when downsampling (anti-aliasing), and
+    interp_delta holds first differences for linear interpolation between
+    table entries (resampy core.resample)."""
+    if 'kaiser_best' not in _FILTER_CACHE:
+        _FILTER_CACHE['kaiser_best'] = sinc_window(**KAISER_BEST)
+    win, num_table = _FILTER_CACHE['kaiser_best']
+    if sample_ratio < 1:
+        win = win * sample_ratio
+    delta = np.zeros_like(win)
+    delta[:-1] = np.diff(win)
+    return win, delta, num_table
+
+
+def resample_kaiser(data: np.ndarray, sr: int,
+                    target_sr: int = SAMPLE_RATE) -> np.ndarray:
+    """resampy-parity resampling (resampy 0.4.2 resample_f semantics,
+    kaiser_best filter), vectorized over output samples in chunks.
+
+    For each output time t (in input-sample units) the two filter wings
+    accumulate ``win[offset + i*step] + eta*delta[...]`` against the
+    input samples left/right of t — the exact windowed-sinc interpolation
+    loop of resampy/interpn.py, with the per-output-sample inner loops
+    turned into masked (chunk, taps) gathers. The literal-transcription
+    mirror in tests/test_audio_resample.py pins equivalence."""
+    ratio = Fraction(int(target_sr), int(sr))   # gcd-reduced, exact
+    sample_ratio = float(ratio)
+    n_in = data.shape[0]
+    n_out = int(np.ceil(n_in * sample_ratio))
+    win, delta, num_table = _interp_tables(sample_ratio)
+    scale = min(1.0, sample_ratio)
+    index_step = int(scale * num_table)
+    nwin = win.shape[0]
+    max_taps = nwin // index_step + 1
+    out = np.zeros(n_out, dtype=np.float64)
+    x = np.asarray(data, dtype=np.float64)
+    taps = np.arange(max_taps)
+
+    def wing(n, offset, eta, limit):
+        """Masked gather-accumulate of one filter wing for a chunk:
+        sum_i (win[offset + i*step] + eta*delta[...]) * x[n ± i]."""
+        idx = offset[:, None] + taps[None, :] * index_step
+        valid = taps[None, :] < limit[:, None]
+        idx = np.minimum(idx, nwin - 1)
+        w = (win[idx] + eta[:, None] * delta[idx]) * valid
+        src = np.clip(n, 0, n_in - 1)
+        return np.einsum('ct,ct->c', w, x[src])
+
+    chunk = 1 << 15
+    for start in range(0, n_out, chunk):
+        t_idx = np.arange(start, min(start + chunk, n_out))
+        time_register = t_idx / sample_ratio
+        n = time_register.astype(np.int64)
+        frac = scale * (time_register - n)
+        index_frac = frac * num_table
+        offset = index_frac.astype(np.int64)
+        eta = index_frac - offset
+        i_max = np.minimum(n + 1, (nwin - offset) // index_step)
+        left = wing(n[:, None] - taps[None, :], offset, eta, i_max)
+        frac_r = scale - frac
+        index_frac = frac_r * num_table
+        offset = index_frac.astype(np.int64)
+        eta = index_frac - offset
+        k_max = np.minimum(n_in - n - 1, (nwin - offset) // index_step)
+        right = wing(n[:, None] + 1 + taps[None, :], offset, eta, k_max)
+        out[t_idx] = left + right
+    return out
+
+
+def resample(data: np.ndarray, sr: int, target_sr: int = SAMPLE_RATE,
+             method: str = 'kaiser_best') -> np.ndarray:
+    """Resample to ``target_sr``. ``kaiser_best`` (default) is the
+    reference-parity path; ``polyphase`` keeps the earlier scipy
+    resampler for comparison."""
+    if method == 'kaiser_best':
+        return resample_kaiser(data, sr, target_sr)
     from scipy.signal import resample_poly
     ratio = Fraction(target_sr, sr)
     return resample_poly(data, ratio.numerator, ratio.denominator)
