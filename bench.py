@@ -25,6 +25,11 @@ setting that still meets the reference-parity bar. BENCH_PRECISION
 overrides (e.g. 'highest' for the float32 ladder rung, 'default' for the
 no-parity speed ceiling).
 
+The SECOND north-star model, R(2+1)D (BASELINE.md names both), gets its
+own in-graph + e2e rungs (``r21d_ingraph_*`` / ``r21d_e2e_*``) at the
+same precision stamp; its ladder lives in tools/r21d_precision_study.py
+(at 'mixed' the drift vs float32 is 2.0e-4 — parity-grade).
+
 Prints exactly ONE JSON line (all diagnostics — random-weights warnings,
 decoder chatter, cache notes — go to stderr). The headline value is the
 in-graph rung by policy on this environment (the e2e rung here measures a
@@ -86,6 +91,45 @@ def bench_ingraph(jax, precision, pins, device, platform, params,
     return batch * iters / elapsed
 
 
+def bench_r21d_ingraph(jax, precision, device, params, stack, iters,
+                       on_accel):
+    """R(2+1)D device-only clips/sec — the SECOND north-star model
+    (BASELINE.md names I3D rgb+flow AND R(2+1)D). Runs the production
+    extractor step (transforms + network, extract/r21d.py:_forward_batch)
+    on decode-geometry frames (the reference sample is 340x256; the
+    resize to 128x171 + 112px crop is part of the step). Ladder measured
+    by tools/r21d_precision_study.py — at 'mixed' (= ambient 'high') the
+    drift vs float32 is 2.0e-4, under the ≤1e-3 parity bar."""
+    from functools import partial
+
+    from jax import lax
+
+    from video_features_tpu.extract.r21d import ExtractR21D
+
+    h, w = (256, 340) if on_accel else (64, 86)
+    batch = 16 if on_accel else 1
+    step = partial(ExtractR21D._forward_batch, arch='r2plus1d_18')
+    rng = np.random.RandomState(0)
+    frames = jax.device_put(
+        rng.randint(0, 255, size=(iters, batch, stack, h, w, 3))
+        .astype(np.float32), device)
+
+    def chained(p, xs):
+        def body(acc, stacks):
+            with jax.default_matmul_precision(precision):
+                return acc + step(p, stacks).sum(), None
+        acc, _ = lax.scan(body, jax.numpy.float32(0), xs)
+        return acc
+
+    jitted = jax.jit(chained)
+    assert np.isfinite(float(jitted(params, frames)))   # compile + guard
+    t0 = time.perf_counter()
+    checksum = float(jitted(params, frames))
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+    return batch * iters / elapsed
+
+
 def _bench_video(tmp_dir: str) -> str:
     """A local benchmark clip: the reference sample if present, else a
     synthetic one (tools/make_sample_video.py). ``BENCH_VIDEO=synthetic``
@@ -112,20 +156,19 @@ def _bench_video(tmp_dir: str) -> str:
 
 
 def bench_e2e(precision: str, batch: int, stack: int, tmp_dir: str,
-              platform: str):
+              platform: str, feature_type: str = 'i3d', key: str = 'rgb'):
     """File → features clips/sec through the real extractor (decode,
     prefetch, overlapped H2D, fused device step, feature fetch)."""
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
 
     video = _bench_video(tmp_dir)
-    args = load_config('i3d', overrides={
+    args = load_config(feature_type, overrides={
         'video_paths': video,
         'device': platform,
         'precision': precision,
         'stack_size': stack, 'step_size': stack,
         'batch_size': batch,
-        'decode_workers': 2,
         'allow_random_weights': True,
         'on_extraction': 'print',  # extraction only; no disk write timing
         'output_path': os.path.join(tmp_dir, 'out'),
@@ -133,8 +176,8 @@ def bench_e2e(precision: str, batch: int, stack: int, tmp_dir: str,
     })
     ex = create_extractor(args)
     warm = ex.extract(video)                   # compile + cache warm
-    clips = warm['rgb'].shape[0]
-    assert clips > 0 and np.isfinite(warm['rgb']).all()
+    clips = warm[key].shape[0]
+    assert clips > 0 and np.isfinite(warm[key]).all()
     # median of independent runs: remote tunnels hiccup (a single stalled
     # transfer can triple one run's wall time), and the median is the
     # honest steady-state a user sees
@@ -144,7 +187,7 @@ def bench_e2e(precision: str, batch: int, stack: int, tmp_dir: str,
         t0 = time.perf_counter()
         out = ex.extract(video)
         rates.append(clips / (time.perf_counter() - t0))
-        assert out['rgb'].shape[0] == clips
+        assert out[key].shape[0] == clips
     return float(np.median(rates))
 
 
@@ -198,6 +241,20 @@ def run() -> dict:
         bench_ingraph(jax, ambient, pins, device, platform, params,
                       stack, size, batch, iters), 3)
 
+    # Second north-star model (BASELINE.md): R(2+1)D. Its own precision
+    # ladder (tools/r21d_precision_study.py, v5e): 'mixed'(=high) drift
+    # 2.0e-4 ✅ parity / 'default' 3.1e-3 ✗ — so the same 'mixed' stamp is
+    # parity-grade here too.
+    from video_features_tpu.models import r21d as r21d_model
+    r21d_params = jax.device_put(
+        transplant(r21d_model.init_state_dict(arch='r2plus1d_18')), device)
+    try:
+        rungs[f'r21d_ingraph_{precision}'] = round(
+            bench_r21d_ingraph(jax, ambient, device, r21d_params,
+                               stack, iters, on_accel), 3)
+    except Exception as e:
+        rungs['r21d_ingraph_error'] = f'{type(e).__name__}: {e}'
+
     mode = os.environ.get('BENCH_MODE', 'both' if on_accel else 'ingraph')
     if mode in ('both', 'e2e'):
         with tempfile.TemporaryDirectory() as tmp_dir:
@@ -207,6 +264,12 @@ def run() -> dict:
                               platform), 3)
             except Exception as e:
                 rungs['e2e_error'] = f'{type(e).__name__}: {e}'
+            try:
+                rungs[f'r21d_e2e_{precision}'] = round(
+                    bench_e2e(precision, min(batch, 8), stack, tmp_dir,
+                              platform, feature_type='r21d', key='r21d'), 3)
+            except Exception as e:
+                rungs['r21d_e2e_error'] = f'{type(e).__name__}: {e}'
     if mode == 'e2e' and f'e2e_{precision}' in rungs:
         headline_key = f'e2e_{precision}'
 
