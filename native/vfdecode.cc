@@ -28,6 +28,8 @@ extern "C" {
 }
 
 #include <cmath>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -414,5 +416,286 @@ long vf_audio_read(void* handle, float* out, long max_samples) {
 }
 
 void vf_audio_close(void* handle) { destroy_audio((AudioDecoder*)handle); }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// CFR re-encode: the reference's `ffmpeg -i in -filter:v fps=fps=N out.mp4`
+// (reference utils/io.py:14-36) without the ffmpeg binary.
+//
+// Replicates the two pieces that define the output pixels:
+//   * the fps filter (libavfilter vf_fps.c, round=near, eof_action=round):
+//     input pts are rescaled to the 1/N output timebase with near
+//     rounding; each output slot shows the latest input frame at or
+//     before it (zero-order hold with duplicate/drop);
+//   * the ffmpeg CLI's libx264 defaults (crf 23, encoder-default preset
+//     'medium', auto threads) on the DECODED YUV frames — the CLI invokes
+//     no pixel-format conversion when the input is already yuv420p.
+//
+// C ABI:
+//   vf_reencode_fps(in, out, fps) -> 0 ok, <0 error (vf_last_error()).
+
+namespace {
+
+struct Reencoder {
+  AVFormatContext* in_fmt = nullptr;
+  AVCodecContext* dec = nullptr;
+  AVFormatContext* out_fmt = nullptr;
+  AVCodecContext* enc = nullptr;
+  AVStream* out_stream = nullptr;
+  AVPacket* pkt = nullptr;
+  AVPacket* out_pkt = nullptr;
+  AVFrame* frame = nullptr;
+  AVFrame* held = nullptr;     // fps-filter zero-order-hold frame
+  int stream_index = -1;
+  int64_t next_pts = AV_NOPTS_VALUE;  // next output slot (out timebase)
+  int64_t last_in_pts = AV_NOPTS_VALUE;  // last input frame (in timebase)
+  int64_t prev_in_pts = AV_NOPTS_VALUE;  // the one before it
+  int64_t last_in_dur = 0;
+  AVRational in_tb{};
+  AVRational out_tb{};
+};
+
+void destroy_reenc(Reencoder* r) {
+  if (!r) return;
+  if (r->held) av_frame_free(&r->held);
+  if (r->frame) av_frame_free(&r->frame);
+  if (r->pkt) av_packet_free(&r->pkt);
+  if (r->out_pkt) av_packet_free(&r->out_pkt);
+  if (r->enc) avcodec_free_context(&r->enc);
+  if (r->out_fmt) {
+    if (!(r->out_fmt->oformat->flags & AVFMT_NOFILE) && r->out_fmt->pb)
+      avio_closep(&r->out_fmt->pb);
+    avformat_free_context(r->out_fmt);
+  }
+  if (r->dec) avcodec_free_context(&r->dec);
+  if (r->in_fmt) avformat_close_input(&r->in_fmt);
+  delete r;
+}
+
+int fail_i(const std::string& msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+// Drain encoder packets into the muxer.
+int mux_pending(Reencoder* r) {
+  while (true) {
+    int ret = avcodec_receive_packet(r->enc, r->out_pkt);
+    if (ret == AVERROR(EAGAIN) || ret == AVERROR_EOF) return 0;
+    if (ret < 0) return fail_i("encode failed");
+    av_packet_rescale_ts(r->out_pkt, r->enc->time_base,
+                         r->out_stream->time_base);
+    r->out_pkt->stream_index = r->out_stream->index;
+    if (av_interleaved_write_frame(r->out_fmt, r->out_pkt) < 0)
+      return fail_i("mux write failed");
+  }
+}
+
+// Emit the held frame once per output slot strictly before `until`.
+int emit_until(Reencoder* r, int64_t until) {
+  while (r->next_pts < until) {
+    r->held->pts = r->next_pts++;
+    r->held->pict_type = AV_PICTURE_TYPE_NONE;  // encoder decides
+    if (getenv("VF_REENC_DEBUG")) {
+      unsigned long sum = 0;
+      for (int p = 0; p < 3; ++p) {
+        int ph = p ? r->enc->height / 2 : r->enc->height;
+        int pw = p ? r->enc->width / 2 : r->enc->width;
+        for (int y = 0; y < ph; ++y)
+          for (int x = 0; x < pw; ++x)
+            sum = sum * 31 + r->held->data[p][y * r->held->linesize[p] + x];
+      }
+      fprintf(stderr, "[reenc] slot %ld yuvhash %lx\n",
+              (long)r->held->pts, sum);
+    }
+    int ret = avcodec_send_frame(r->enc, r->held);
+    if (ret < 0) return fail_i("encoder rejected frame");
+    if (mux_pending(r) < 0) return -1;
+  }
+  return 0;
+}
+
+// One decoded frame enters the fps filter: rescale its pts to the output
+// timebase (near rounding — vf_fps.c), flush slots owed to the held
+// frame, then hold this one (dropping the old if it never owned a slot).
+int fps_push(Reencoder* r, AVFrame* f) {
+  int64_t pts_out = av_rescale_q_rnd(
+      f->best_effort_timestamp, r->in_tb, r->out_tb,
+      (AVRounding)(AV_ROUND_NEAR_INF | AV_ROUND_PASS_MINMAX));
+  if (r->next_pts == AV_NOPTS_VALUE) r->next_pts = pts_out;
+  if (r->held && emit_until(r, pts_out) < 0) return -1;
+  if (!r->held) r->held = av_frame_alloc();
+  av_frame_unref(r->held);
+  if (av_frame_ref(r->held, f) < 0) return fail_i("frame ref failed");
+  r->held->pts = pts_out;
+  r->prev_in_pts = r->last_in_pts;
+  r->last_in_pts = f->best_effort_timestamp;
+#if LIBAVUTIL_VERSION_MAJOR >= 58
+  r->last_in_dur = f->duration;   // FFmpeg 6+
+#else
+  r->last_in_dur = f->pkt_duration;
+#endif
+  return 0;
+}
+
+int open_reencoder(Reencoder* r, const char* in_path, const char* out_path,
+                   AVRational fps) {
+  if (avformat_open_input(&r->in_fmt, in_path, nullptr, nullptr) < 0)
+    return fail_i(std::string("cannot open ") + in_path);
+  if (avformat_find_stream_info(r->in_fmt, nullptr) < 0)
+    return fail_i("no stream info");
+  const AVCodec* dec_codec = nullptr;
+  r->stream_index = av_find_best_stream(r->in_fmt, AVMEDIA_TYPE_VIDEO, -1,
+                                        -1, &dec_codec, 0);
+  if (r->stream_index < 0 || !dec_codec) return fail_i("no video stream");
+  AVStream* ist = r->in_fmt->streams[r->stream_index];
+  r->dec = avcodec_alloc_context3(dec_codec);
+  if (!r->dec ||
+      avcodec_parameters_to_context(r->dec, ist->codecpar) < 0)
+    return fail_i("decoder setup failed");
+  r->dec->thread_count = 0;
+  if (avcodec_open2(r->dec, dec_codec, nullptr) < 0)
+    return fail_i("cannot open decoder");
+  r->in_tb = ist->time_base;
+  r->out_tb = av_inv_q(fps);
+
+  const AVCodec* enc_codec = avcodec_find_encoder_by_name("libx264");
+  if (!enc_codec) return fail_i("libx264 encoder not available");
+  if (avformat_alloc_output_context2(&r->out_fmt, nullptr, nullptr,
+                                     out_path) < 0 || !r->out_fmt)
+    return fail_i("cannot create output context");
+  r->enc = avcodec_alloc_context3(enc_codec);
+  if (!r->enc) return fail_i("encoder alloc failed");
+  r->enc->width = r->dec->width;
+  r->enc->height = r->dec->height;
+  r->enc->sample_aspect_ratio = r->dec->sample_aspect_ratio;
+  // the CLI inserts no format filter for yuv420p input; yuvj420p maps to
+  // yuv420p + color_range copy
+  AVPixelFormat pix = r->dec->pix_fmt;
+  if (pix == AV_PIX_FMT_YUVJ420P) pix = AV_PIX_FMT_YUV420P;
+  if (pix != AV_PIX_FMT_YUV420P)
+    return fail_i("reencode supports yuv420p input only");
+  r->enc->pix_fmt = pix;
+  r->enc->color_range = r->dec->color_range;
+  r->enc->color_primaries = r->dec->color_primaries;
+  r->enc->color_trc = r->dec->color_trc;
+  r->enc->colorspace = r->dec->colorspace;
+  r->enc->time_base = r->out_tb;
+  r->enc->framerate = fps;
+  r->enc->thread_count = 0;  // auto, like the CLI
+  if (r->out_fmt->oformat->flags & AVFMT_GLOBALHEADER)
+    r->enc->flags |= AV_CODEC_FLAG_GLOBAL_HEADER;
+  // ffmpeg CLI default for libx264: crf 23 (preset stays the wrapper's
+  // default 'medium')
+  av_opt_set(r->enc->priv_data, "crf", "23", 0);
+  if (avcodec_open2(r->enc, enc_codec, nullptr) < 0)
+    return fail_i("cannot open libx264");
+
+  r->out_stream = avformat_new_stream(r->out_fmt, nullptr);
+  if (!r->out_stream) return fail_i("cannot create output stream");
+  if (avcodec_parameters_from_context(r->out_stream->codecpar, r->enc) < 0)
+    return fail_i("stream params failed");
+  r->out_stream->time_base = r->enc->time_base;
+  r->out_stream->avg_frame_rate = fps;
+  if (!(r->out_fmt->oformat->flags & AVFMT_NOFILE) &&
+      avio_open(&r->out_fmt->pb, out_path, AVIO_FLAG_WRITE) < 0)
+    return fail_i(std::string("cannot open for write: ") + out_path);
+  if (avformat_write_header(r->out_fmt, nullptr) < 0)
+    return fail_i("cannot write header");
+
+  r->pkt = av_packet_alloc();
+  r->out_pkt = av_packet_alloc();
+  r->frame = av_frame_alloc();
+  if (!r->pkt || !r->out_pkt || !r->frame) return fail_i("alloc failed");
+  return 0;
+}
+
+int run_reencode(Reencoder* r) {
+  bool draining = false;
+  while (true) {
+    int ret = avcodec_receive_frame(r->dec, r->frame);
+    if (ret == 0) {
+      if (fps_push(r, r->frame) < 0) return -1;
+      av_frame_unref(r->frame);
+      continue;
+    }
+    if (ret == AVERROR_EOF) break;
+    if (ret != AVERROR(EAGAIN)) return fail_i("decode failed");
+    if (draining) continue;
+    ret = av_read_frame(r->in_fmt, r->pkt);
+    if (ret < 0) {
+      avcodec_send_packet(r->dec, nullptr);
+      draining = true;
+      continue;
+    }
+    if (r->pkt->stream_index == r->stream_index)
+      avcodec_send_packet(r->dec, r->pkt);
+    av_packet_unref(r->pkt);
+  }
+  // EOF flush (eof_action=round): the held frame owns every slot strictly
+  // before the stream's end time (last frame pts + its duration, rescaled
+  // with near rounding) — round(duration·N) total frames for CFR input.
+  if (r->held && r->next_pts != AV_NOPTS_VALUE) {
+    // last frame's display interval: its own duration when known, else
+    // one decoder frame interval, else the last observed pts delta;
+    // with none of those (single frame, no metadata) grant it one slot.
+    int64_t dur = r->last_in_dur;
+    if (dur <= 0 && r->dec->framerate.num > 0)
+      dur = av_rescale_q(1, av_inv_q(r->dec->framerate), r->in_tb);
+    if (dur <= 0 && r->prev_in_pts != AV_NOPTS_VALUE)
+      dur = r->last_in_pts - r->prev_in_pts;
+    int64_t end_out;
+    if (dur > 0) {
+      end_out = av_rescale_q_rnd(
+          r->last_in_pts + dur, r->in_tb, r->out_tb,
+          (AVRounding)(AV_ROUND_NEAR_INF | AV_ROUND_PASS_MINMAX));
+      // a held frame whose slot lies at/after the end time is dropped,
+      // exactly like the filter (timing wins over content at the tail)
+    } else {
+      end_out = r->held->pts + 1;
+    }
+    if (emit_until(r, end_out) < 0) return -1;
+  }
+  if (avcodec_send_frame(r->enc, nullptr) < 0)  // flush encoder
+    return fail_i("encoder flush failed");
+  if (mux_pending(r) < 0) return -1;
+  if (av_write_trailer(r->out_fmt) < 0) return fail_i("trailer failed");
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int vf_reencode_fps(const char* in_path, const char* out_path, double fps) {
+  if (fps <= 0) return fail_i("fps must be positive");
+  // mirror the reference CLI's `-hide_banner -loglevel panic` (its ffmpeg
+  // invocation is silent; x264's per-encode stats would spam every video);
+  // VF_REENC_DEBUG=1 restores full logs for debugging
+  av_log_set_level(getenv("VF_REENC_DEBUG") ? AV_LOG_DEBUG : AV_LOG_ERROR);
+  // Pin the SSE FP environment for the encode (defense in depth; restore
+  // after). NOTE: x264's rate control was measured to make stably
+  // different decisions for IDENTICAL input frames depending on
+  // process-global state (flipped by XLA:CPU jit initialization in the
+  // same process; encoder-input YUV hashes and the x264 options banner
+  // identical, MXCSR unchanged — the mechanism is inside x264). Callers
+  // who need byte-deterministic output must run this function in a fresh
+  // process — io/reencode_cli.py, the production path — which matches
+  // the reference's ffmpeg-CLI execution model.
+#if defined(__SSE2__) || defined(__x86_64__)
+  unsigned int saved_csr = __builtin_ia32_stmxcsr();
+  __builtin_ia32_ldmxcsr(0x1f80);  // x86 default: no FTZ/DAZ, all masked
+#endif
+  Reencoder* r = new Reencoder();
+  AVRational rate = av_d2q(fps, 100000);
+  int ret = open_reencoder(r, in_path, out_path, rate);
+  if (ret == 0) ret = run_reencode(r);
+  destroy_reenc(r);
+#if defined(__SSE2__) || defined(__x86_64__)
+  __builtin_ia32_ldmxcsr(saved_csr);
+#endif
+  return ret;
+}
 
 }  // extern "C"

@@ -1,0 +1,178 @@
+"""Native in-process CFR re-encode (the ffmpeg `fps=` stage without the
+binary): determinism, fps-filter semantics, loader wiring, and the
+measured index-resample divergence.
+
+The reference retimes by shelling out to
+``ffmpeg -filter:v fps=fps=N`` and decoding the re-encoded file
+(reference utils/io.py:14-36,78-89). This host has no ffmpeg binary, so
+``native/vfdecode.cc:vf_reencode_fps`` implements that stage in-process
+(libavformat/libavcodec + libx264 at the CLI defaults). The
+vs-real-ffmpeg equivalence test runs wherever a binary exists (CI).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from video_features_tpu.io import native
+from video_features_tpu.io.video import (
+    VideoLoader, get_video_props, which_ffmpeg,
+)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native library unavailable')
+
+SRC_FPS = 20
+N_FRAMES = 50
+
+
+@pytest.fixture(scope='module')
+def graded_video(tmp_path_factory) -> str:
+    """Solid-gray frames whose level encodes the frame index (level =
+    10 + 5·i): lossy encoders preserve solid frames to ≪1 level, so the
+    decoded mean recovers which SOURCE frame each output slot shows."""
+    import cv2
+
+    out = str(tmp_path_factory.mktemp('reenc') / 'graded.mp4')
+    w = cv2.VideoWriter(out, cv2.VideoWriter_fourcc(*'mp4v'), SRC_FPS,
+                        (128, 96))
+    for i in range(N_FRAMES):
+        w.write(np.full((96, 128, 3), 10 + 5 * i, np.uint8))
+    w.release()
+    return out
+
+
+def _decoded_levels(path: str) -> np.ndarray:
+    import cv2
+
+    cap = cv2.VideoCapture(path)
+    means = []
+    while True:
+        ok, frame = cap.read()
+        if not ok:
+            break
+        means.append(frame.astype(np.float64).mean())
+    cap.release()
+    return np.asarray(means)
+
+
+def _recover_schedule(out_path: str, src_path: str) -> np.ndarray:
+    """Map each output frame to the SOURCE frame it shows, by nearest
+    decoded mean level (calibrated on the source's own decoded levels —
+    codecs shift solid-gray means by a constant, so absolute level
+    arithmetic would be off by a frame)."""
+    src_levels = _decoded_levels(src_path)
+    out_levels = _decoded_levels(out_path)
+    return np.asarray([int(np.argmin(np.abs(src_levels - v)))
+                       for v in out_levels])
+
+
+def _fps_filter_model(n_src: int, src_fps: float, target: float) -> list:
+    """The fps filter's zero-order hold on a CFR source: output slot k
+    shows the last source frame whose near-rounded rescaled pts ≤ k;
+    total slots = the stream end time rescaled (eof_action=round)."""
+    def near(x):  # av_rescale NEAR_INF: halves away from zero
+        return int(np.floor(x + 0.5))
+
+    pts_out = [near(i * target / src_fps) for i in range(n_src)]
+    end = near(n_src * target / src_fps)
+    out = []
+    for k in range(min(pts_out), end):
+        shown = max(i for i in range(n_src) if pts_out[i] <= k)
+        out.append(shown)
+    return out
+
+
+@pytest.mark.parametrize('target', [8.0, 40.0])
+def test_fps_filter_semantics(graded_video, tmp_path, target):
+    """Down- and up-sampling both reproduce the fps-filter's
+    duplicate/drop schedule (recovered per-slot source indices match the
+    model exactly)."""
+    got = native.reencode_fps_native(graded_video, str(tmp_path), target)
+    recovered = _recover_schedule(got, graded_video)
+    expect = _fps_filter_model(N_FRAMES, SRC_FPS, target)
+    assert len(recovered) == len(expect), (len(recovered), len(expect))
+    assert recovered.tolist() == expect
+    props = get_video_props(got)
+    assert abs(props['fps'] - target) < 1e-6
+
+
+def test_reencode_deterministic(graded_video, tmp_path):
+    """Two independent re-encodes produce byte-identical files (x264 is
+    deterministic for a fixed build/settings/thread count)."""
+    a = native.reencode_fps_native(graded_video, str(tmp_path / 'a'), 8.0)
+    b = native.reencode_fps_native(graded_video, str(tmp_path / 'b'), 8.0)
+    with open(a, 'rb') as fa, open(b, 'rb') as fb:
+        assert fa.read() == fb.read()
+
+
+def test_loader_uses_native_reencode(graded_video, tmp_path):
+    """With no ffmpeg binary, VideoLoader's fps path routes through the
+    native re-encoder (a real tmp re-encode, not the index fallback) and
+    reports the re-encoded stream's properties."""
+    loader = VideoLoader(graded_video, batch_size=8, fps=8.0,
+                         tmp_path=str(tmp_path))
+    if which_ffmpeg():
+        pytest.skip('binary present: loader prefers the CLI path')
+    assert loader._tmp_file is not None, 'index fallback was used'
+    assert loader._index_map is None
+    assert abs(loader.fps - 8.0) < 1e-6
+    frames = sum(b.shape[0] for b, _, _ in loader)
+    assert frames == loader.num_frames == 20   # round(2.5 s · 8)
+
+
+def test_index_resample_divergence_measured(graded_video, tmp_path):
+    """The documented divergence of the pure index-resample fallback vs
+    the re-encode path (VERDICT r3 #6): on a CFR source the FRAME
+    SCHEDULES land within one source frame of each other at every output
+    slot (the two roundings differ at slot boundaries), plus the
+    re-encode's lossy-pixel delta. Measured here at the schedule level;
+    the pixel-level term is bounded by test_fps_filter_semantics'
+    exact recovery (≪1 gray level on solid frames)."""
+    from video_features_tpu.io.video import resample_frame_indices
+
+    target = 8.0
+    got = native.reencode_fps_native(graded_video, str(tmp_path), target)
+    reenc_schedule = _recover_schedule(got, graded_video)
+    index_schedule = resample_frame_indices(N_FRAMES, SRC_FPS, target)
+    n = min(len(reenc_schedule), len(index_schedule))
+    assert abs(len(reenc_schedule) - len(index_schedule)) <= 1
+    diff = np.abs(reenc_schedule[:n] - index_schedule[:n])
+    frac_differing = float((diff > 0).mean())
+    print(f'[retiming] schedules differ at {frac_differing:.0%} of slots, '
+          f'max |Δsource-frame| = {diff.max()}')
+    assert diff.max() <= 1, 'schedules should disagree by ≤1 source frame'
+
+
+@pytest.mark.skipif(which_ffmpeg() == '', reason='needs the ffmpeg binary')
+def test_matches_ffmpeg_cli(graded_video, tmp_path):
+    """Where a real ffmpeg exists (CI), the native re-encode matches the
+    CLI's output at the decoded-frame level: identical frame count and
+    per-frame mean abs pixel delta < 2 levels (same filter schedule, same
+    x264 defaults; bitstreams may differ in container metadata)."""
+    import subprocess
+
+    from video_features_tpu.io.video import reencode_video_with_diff_fps
+
+    cli = reencode_video_with_diff_fps(graded_video,
+                                      str(tmp_path / 'cli'), 8.0)
+    ours = native.reencode_fps_native(graded_video,
+                                      str(tmp_path / 'native'), 8.0)
+    import cv2
+
+    def frames(path):
+        cap = cv2.VideoCapture(path)
+        out = []
+        while True:
+            ok, f = cap.read()
+            if not ok:
+                break
+            out.append(f.astype(np.int16))
+        cap.release()
+        return out
+
+    fa, fb = frames(cli), frames(ours)
+    assert len(fa) == len(fb), (len(fa), len(fb))
+    deltas = [np.abs(a - b).mean() for a, b in zip(fa, fb)]
+    assert max(deltas) < 2.0, f'max per-frame mean delta: {max(deltas)}'
+    del subprocess

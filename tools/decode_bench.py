@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Host decode throughput: native C++ decoder vs cv2, and worker scaling.
+
+SURVEY hard-part #3: at pod scale the wall is host decode, not device
+compute. This tool measures, on a real clip:
+
+  * raw decode frames/s per backend ('native' in-process libav vs 'cv2')
+    — the per-video ceiling (one coded stream decodes sequentially);
+  * decode + host-transform (short-side resize 256, the reference's i3d
+    preprocessing) frames/s as ``decode_workers`` scales 1→8 — the
+    transform pool is what actually parallelizes (VideoLoader's
+    ``transform_workers``);
+  * the implied e2e clips/s-per-host ceiling at stack 16.
+
+One JSON line per measurement. Results are published in
+docs/benchmarks.md ("Host decode throughput").
+
+    python tools/decode_bench.py [--video PATH] [--repeat 3]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _video(path: str | None) -> str:
+    if path:
+        return path
+    ref = Path('/root/reference/sample/v_GGSY1Qvo990.mp4')
+    if ref.exists():
+        return str(ref)
+    import subprocess
+    out = Path('./tmp/decode_bench/sample_moving_pattern.mp4')
+    if not out.exists():
+        subprocess.run(
+            [sys.executable,
+             str(Path(__file__).parent / 'make_sample_video.py'),
+             '--out', str(out.parent), '--seconds', '10', '--fps', '25',
+             '--size', '340x256'], check=True, stdout=sys.stderr)
+    return str(out)
+
+
+def bench_raw(video: str, backend: str, repeat: int) -> dict:
+    """Raw sequential decode frames/s for one backend."""
+    from video_features_tpu.io.video import VideoLoader
+
+    rates = []
+    frames = 0
+    for _ in range(repeat):
+        loader = VideoLoader(video, batch_size=32, backend=backend)
+        t0 = time.perf_counter()
+        frames = sum(b.shape[0] for b, _, _ in loader)
+        rates.append(frames / (time.perf_counter() - t0))
+    return {'measure': f'decode_raw_{backend}', 'frames': frames,
+            'frames_per_sec': round(float(np.median(rates)), 1)}
+
+
+def bench_transform(video: str, backend: str, workers: int,
+                    repeat: int) -> dict:
+    """Decode + short-side-resize-256 frames/s with a transform pool."""
+    from video_features_tpu.io.video import VideoLoader
+    from video_features_tpu.ops.transforms import short_side_resize_pil
+
+    rates = []
+    frames = 0
+    for _ in range(repeat):
+        loader = VideoLoader(
+            video, batch_size=32, backend=backend,
+            transform=lambda f: short_side_resize_pil(f, 256),
+            transform_workers=workers)
+        t0 = time.perf_counter()
+        frames = sum(len(b) for b, _, _ in loader)
+        rates.append(frames / (time.perf_counter() - t0))
+    return {'measure': f'decode_resize256_{backend}_workers{workers}',
+            'frames': frames,
+            'frames_per_sec': round(float(np.median(rates)), 1)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--video', default=None)
+    ap.add_argument('--repeat', type=int, default=3)
+    ns = ap.parse_args()
+    video = _video(ns.video)
+
+    from video_features_tpu.io import native
+    backends = ['cv2'] + (['native'] if native.available() else [])
+
+    records = []
+    for backend in backends:
+        records.append(bench_raw(video, backend, ns.repeat))
+    for backend in backends:
+        for workers in (1, 2, 4, 8):
+            records.append(bench_transform(video, backend, workers,
+                                           ns.repeat))
+    best = max(r['frames_per_sec'] for r in records
+               if r['measure'].startswith('decode_resize256'))
+    records.append({'measure': 'implied_e2e_ceiling_stack16',
+                    'clips_per_sec_per_host': round(best / 16, 1),
+                    'note': 'best decode+resize rate / 16-frame stacks; '
+                            'multi-video worklists run one decoder per '
+                            'process (shared-nothing DP), so per-host '
+                            'throughput scales with processes until '
+                            'cores saturate'})
+    for r in records:
+        print(json.dumps(r))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

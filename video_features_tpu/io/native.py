@@ -96,10 +96,46 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.vf_audio_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                   ctypes.c_long]
     lib.vf_audio_close.argtypes = [ctypes.c_void_p]
+    lib.vf_reencode_fps.restype = ctypes.c_int
+    lib.vf_reencode_fps.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_double]
 
 
 def available() -> bool:
     return load_library() is not None
+
+
+def reencode_fps_native(video_path: str, tmp_path: str,
+                        extraction_fps: float) -> str:
+    """CFR re-encode to ``extraction_fps`` — the reference's
+    ``ffmpeg -filter:v fps=fps=N`` stage (reference utils/io.py:14-36)
+    without the binary: native fps filter (round=near zero-order hold) +
+    libx264 at the CLI's defaults (crf 23, preset medium). Same output
+    naming contract as io.video.reencode_video_with_diff_fps.
+
+    Runs in a short-lived subprocess (io/reencode_cli.py) so the encode
+    is byte-deterministic regardless of host-process state — libx264's
+    rate control measurably changes its decisions after e.g. XLA:CPU jit
+    initialization in the same process; a fresh process matches the
+    reference's ffmpeg-CLI execution model exactly."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    if load_library() is None:   # build once here; child just dlopens
+        raise RuntimeError('native decode library unavailable')
+    os.makedirs(tmp_path, exist_ok=True)
+    new_path = os.path.join(tmp_path,
+                            f'{Path(video_path).stem}_new_fps.mp4')
+    proc = subprocess.run(
+        [sys.executable, '-m', 'video_features_tpu.io.reencode_cli',
+         str(video_path), new_path, repr(float(extraction_fps))],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f'native re-encode failed: {proc.stderr.strip()}')
+    return new_path
 
 
 class NativeFrameDecoder:

@@ -1,0 +1,42 @@
+"""Subprocess entry point for the native CFR re-encode.
+
+``python -m video_features_tpu.io.reencode_cli <in> <out> <fps>`` loads
+libvfdecode and runs one ``vf_reencode_fps`` call, then exits.
+
+Why a subprocess: libx264's rate control makes (stably) different
+float-path decisions depending on process-global state — measured in this
+repo as a different bitstream for identical YUV input after XLA:CPU's jit
+initialization ran in the host process (encoder input hashes identical,
+x264 banner identical, MXCSR unchanged; the precise mechanism is inside
+x264). A fresh process always encodes identically (verified across
+processes), which is exactly the execution model of the reference's
+``ffmpeg`` CLI invocation (reference utils/io.py:14-36) — so the
+production path runs the encode out-of-process and stays byte-
+deterministic no matter what the host process has loaded or run.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def main(argv) -> int:
+    if len(argv) != 3:
+        print('usage: reencode_cli <in> <out> <fps>', file=sys.stderr)
+        return 2
+    in_path, out_path, fps = argv[0], argv[1], float(argv[2])
+    from video_features_tpu.io.native import load_library
+
+    lib = load_library()
+    if lib is None:
+        print('native library unavailable', file=sys.stderr)
+        return 3
+    ret = lib.vf_reencode_fps(str(in_path).encode(),
+                              str(out_path).encode(), fps)
+    if ret != 0:
+        print(lib.vf_last_error().decode(errors='replace'), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main(sys.argv[1:]))

@@ -139,9 +139,11 @@ class VideoLoader:
             their core loops, so host preprocessing scales with threads —
             it is the usual bottleneck once the device is fast).
         overlap: frames shared between consecutive batches (flow pairing).
-        use_ffmpeg: force/forbid the ffmpeg re-encode backend; default: use
-            it iff a binary is present (exact reference parity), else the
-            index-resampling backend.
+        use_ffmpeg: force (True)/forbid (False) the ffmpeg-binary re-encode
+            backend. Default (None): the binary when present (exact
+            reference parity) → the in-process native re-encoder (same
+            fps-filter + libx264-default semantics, no binary needed) →
+            pure index resampling.
         backend: frame decode backend — 'native' (C++ libav service),
             'cv2', or 'auto' (native when buildable, else cv2).
     """
@@ -187,16 +189,29 @@ class VideoLoader:
         if total is not None:
             fps = total * src_fps / max(src_frames, 1)
 
+        # Retiming backend resolution: the ffmpeg binary when present
+        # (exact reference parity), else the in-process native re-encoder
+        # (same fps-filter semantics + libx264 at the CLI defaults —
+        # native/vfdecode.cc vf_reencode_fps), else pure index resampling.
+        native_reencode = False
         if use_ffmpeg is None:
             use_ffmpeg = which_ffmpeg() != ''
+            if not use_ffmpeg:
+                from video_features_tpu.io import native as native_mod
+                native_reencode = native_mod.available()
 
         self._index_map: Optional[np.ndarray] = None
         if fps is None:
             self.path = path
             self.fps = src_fps
             self.num_frames = src_frames
-        elif use_ffmpeg:
-            self.path = reencode_video_with_diff_fps(path, str(tmp_path), fps)
+        elif use_ffmpeg or native_reencode:
+            if use_ffmpeg:
+                self.path = reencode_video_with_diff_fps(
+                    path, str(tmp_path), fps)
+            else:
+                from video_features_tpu.io.native import reencode_fps_native
+                self.path = reencode_fps_native(path, str(tmp_path), fps)
             self._tmp_file = self.path
             new_props = get_video_props(self.path)
             self.fps = new_props['fps']
