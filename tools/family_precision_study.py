@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""Precision ladder across model families: drift + in-graph rate.
+
+Generalizes tools/r21d_precision_study.py to every BASELINE.md config
+family with a dense device step (r21d, s3d, resnet50, clip ViT-B/32):
+for each matmul precision it runs the PRODUCTION extractor step
+(transforms + network, the exact jit'd fn the extractor calls) on
+identical inputs + seeded weights and prints one JSON line per
+(family, precision): feature rel L2 vs the 'highest' baseline and the
+in-graph rate (bench.py methodology — lax.scan over distinct batches
+inside one jit, value fetch).
+
+Stack families (r21d, s3d) report clips (stacks) per second; frame-wise
+families (resnet, clip) report frames per second. `BENCH_STACK` overrides
+the stack length and `R21D_ARCH` the r21d variant (the knobs
+tools/r21d_precision_study.py documents).
+
+    python tools/family_precision_study.py [families...]
+    BENCH_PLATFORM=cpu python tools/family_precision_study.py s3d  # smoke
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+LADDER = ('highest', 'high', 'default')
+
+
+def _family_specs(on_accel: bool):
+    """{name: (init_fn, step_fn, batch_shape, unit)} — step fns are the
+    extractors' own; input geometry mirrors what each step receives in
+    production (decode-geometry stacks for the in-graph-resizing stack
+    families, host-cropped frames for the frame-wise ones)."""
+    from video_features_tpu.extract.clip import ExtractCLIP
+    from video_features_tpu.extract.r21d import ExtractR21D
+    from video_features_tpu.extract.resnet import ExtractResNet
+    from video_features_tpu.extract.s3d import ExtractS3D
+    from video_features_tpu.models import clip as clip_model
+    from video_features_tpu.models import r21d as r21d_model
+    from video_features_tpu.models import resnet as resnet_model
+    from video_features_tpu.models import s3d as s3d_model
+
+    h, w = (256, 340) if on_accel else (64, 86)
+    stack = int(os.environ.get('BENCH_STACK', 16))
+    r21d_arch = os.environ.get('R21D_ARCH', 'r2plus1d_18')
+    b_stack = 16 if on_accel else 1
+    b_frame = 64 if on_accel else 2
+    px = 224 if on_accel else 64
+    # CLIP's positional embedding fixes its input at 224, and s3d's
+    # in-graph center_crop is fixed at 224 (a smaller smoke frame would
+    # exercise a clamped crop production never sees) — shrink the batch,
+    # not the geometry, for smoke runs
+    clip_px, clip_b = 224, (b_frame if on_accel else 1)
+    s3d_h, s3d_w = (h, w) if on_accel else (256, 340)
+    s3d_scale = 224 / min(s3d_h, s3d_w)
+    s3d_hw = (math.floor(s3d_h * s3d_scale), math.floor(s3d_w * s3d_scale))
+    return {
+        'r21d': (
+            partial(r21d_model.init_state_dict, arch=r21d_arch),
+            partial(ExtractR21D._forward_batch, arch=r21d_arch),
+            (b_stack, stack, h, w, 3), 'clips/sec'),
+        's3d': (
+            s3d_model.init_state_dict,
+            partial(ExtractS3D._forward, resize_hw=s3d_hw,
+                    resize_scale=s3d_scale),
+            (b_stack, stack, s3d_h, s3d_w, 3), 'clips/sec'),
+        'resnet': (
+            partial(resnet_model.init_state_dict, arch='resnet50'),
+            partial(ExtractResNet._forward, arch='resnet50'),
+            (b_frame, px, px, 3), 'frames/sec'),
+        'clip': (
+            partial(clip_model.init_state_dict, model_name='ViT-B/32'),
+            partial(ExtractCLIP._forward, arch='ViT-B/32'),
+            (clip_b, clip_px, clip_px, 3), 'frames/sec'),
+    }
+
+
+def run_family(name: str, init_fn, step_fn, batch_shape, unit,
+               iters: int) -> None:
+    import jax
+    from jax import lax
+
+    from video_features_tpu.transplant.torch2jax import transplant
+    from video_features_tpu.utils.device import jax_device
+
+    platform = jax.devices()[0].platform
+    device = jax_device(platform)
+    params = jax.device_put(transplant(init_fn()), device)
+    rng = np.random.RandomState(0)
+    frames = jax.device_put(
+        rng.randint(0, 255, size=(iters,) + batch_shape)
+        .astype(np.float32), device)
+
+    def run(precision):
+        def chained(p, xs):
+            def body(_, batch):
+                with jax.default_matmul_precision(precision):
+                    return None, step_fn(p, batch)
+            _, feats = lax.scan(body, None, xs)
+            return feats
+        jitted = jax.jit(chained)
+        feats = np.asarray(jitted(params, frames))       # compile + warm
+        assert np.isfinite(feats).all()
+        t0 = time.perf_counter()
+        feats = np.asarray(jitted(params, frames))
+        elapsed = time.perf_counter() - t0
+        return feats, batch_shape[0] * iters / elapsed
+
+    base, _ = run('highest')
+    for precision in LADDER:
+        feats, rate = run(precision)
+        drift = float(np.linalg.norm(feats - base) / np.linalg.norm(base))
+        print(json.dumps({
+            'family': name, 'precision': precision, 'platform': platform,
+            'batch_shape': list(batch_shape),
+            'feature_rel_l2_vs_highest': float(f'{drift:.3e}'),
+            'rate': round(rate, 2), 'unit': unit,
+        }), flush=True)
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get('BENCH_PLATFORM'):
+        jax.config.update('jax_platforms', os.environ['BENCH_PLATFORM'])
+    from video_features_tpu.utils.device import enable_compilation_cache
+
+    platform = jax.devices()[0].platform
+    on_accel = platform != 'cpu'
+    enable_compilation_cache('~/.cache/video_features_tpu/xla', platform)
+    iters = int(os.environ.get('BENCH_ITERS', 8 if on_accel else 2))
+
+    specs = _family_specs(on_accel)
+    picks = sys.argv[1:] or list(specs)
+    for name in picks:
+        run_family(name, *specs[name], iters)
+
+
+if __name__ == '__main__':
+    main()

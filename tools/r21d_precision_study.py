@@ -1,100 +1,29 @@
 #!/usr/bin/env python3
-"""Precision ladder for the R(2+1)D lane: drift + in-graph clips/sec.
+"""Precision ladder for the R(2+1)D lane — see family_precision_study.py.
 
-BASELINE.md names R(2+1)D as the second north-star model; this tool
-produces the data behind its bench rung's precision stamp (the i3d ladder
-in tools/precision_study.py does NOT transfer: r21d has no flow-quantization
-cliff, so bf16 passes may well meet the ≤1e-3 parity bar that the fused
-i3d path fails at 1-pass).
+Kept as the documented entry point for the second north-star model
+(BASELINE.md; bench.py's r21d rungs cite this tool): it now delegates to
+the generalized tools/family_precision_study.py so there is exactly one
+copy of the ladder methodology. Knobs are unchanged:
 
-For each matmul precision ('highest', 'high', 'default') it runs the
-PRODUCTION r21d device step (extract.r21d.ExtractR21D._forward_batch —
-transforms + network, the same jit'd fn the extractor calls) on identical
-uint8-valued frames + seeded weights, and prints one JSON line per rung:
-feature rel L2 vs the 'highest' baseline and in-graph clips/sec (bench.py
-methodology: lax.scan over distinct batches inside one jit, value fetch).
-
-    python tools/r21d_precision_study.py             # on the default device
+    python tools/r21d_precision_study.py               # r2plus1d_18, v5e
+    R21D_ARCH=r2plus1d_34 BENCH_STACK=32 python tools/r21d_precision_study.py
     BENCH_PLATFORM=cpu python tools/r21d_precision_study.py   # smoke
+
+Measured on v5e (stack 16, 340x256 decode-geometry frames, batch 16):
+'mixed'(=high) drift 2.0e-4 vs float32 — parity-grade — at ~253
+clips/s/chip; 'default' 3.1e-3 (fails the 1e-3 bar) at ~446. The ig65m
+r2plus1d_34 at stack 32: mixed 3.9e-4 at ~91 clips/s, default 6.9e-3.
 """
 from __future__ import annotations
 
-import json
-import os
 import sys
-import time
-from functools import partial
 from pathlib import Path
-
-import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-LADDER = ('highest', 'high', 'default')
-
-
-def main() -> None:
-    import jax
-
-    if os.environ.get('BENCH_PLATFORM'):
-        jax.config.update('jax_platforms', os.environ['BENCH_PLATFORM'])
-    import jax.numpy as jnp
-    from jax import lax
-
-    from video_features_tpu.extract.r21d import ExtractR21D
-    from video_features_tpu.models import r21d as r21d_model
-    from video_features_tpu.transplant.torch2jax import transplant
-    from video_features_tpu.utils.device import (
-        enable_compilation_cache, jax_device,
-    )
-
-    platform = jax.devices()[0].platform
-    on_accel = platform != 'cpu'
-    arch = os.environ.get('R21D_ARCH', 'r2plus1d_18')
-    stack = int(os.environ.get('BENCH_STACK', 16))
-    # decode-size frames: the reference sample video is 340x256 and the
-    # transform chain resizes to (128, 171) in-graph, so the honest input
-    # is the decoded geometry, not the network's 112px crop
-    h, w = (256, 340) if on_accel else (64, 86)
-    batch = int(os.environ.get('BENCH_BATCH', 16 if on_accel else 2))
-    iters = int(os.environ.get('BENCH_ITERS', 8 if on_accel else 2))
-    enable_compilation_cache('~/.cache/video_features_tpu/xla', platform)
-
-    device = jax_device(platform)
-    params = jax.device_put(
-        transplant(r21d_model.init_state_dict(arch=arch)), device)
-    rng = np.random.RandomState(0)
-    frames = jax.device_put(
-        rng.randint(0, 255, size=(iters, batch, stack, h, w, 3))
-        .astype(np.float32), device)
-    step = partial(ExtractR21D._forward_batch, arch=arch)
-
-    def run(precision: str):
-        def chained(p, xs):
-            def body(_, stacks):
-                with jax.default_matmul_precision(precision):
-                    return None, step(p, stacks)
-            _, feats = lax.scan(body, None, xs)
-            return feats
-        jitted = jax.jit(chained)
-        feats = np.asarray(jitted(params, frames))       # compile + warm
-        assert np.isfinite(feats).all()
-        t0 = time.perf_counter()
-        feats = np.asarray(jitted(params, frames))       # value fetch = real
-        elapsed = time.perf_counter() - t0
-        return feats, batch * iters / elapsed
-
-    base, _ = run('highest')
-    for precision in LADDER:
-        feats, rate = run(precision)
-        drift = float(np.linalg.norm(feats - base) / np.linalg.norm(base))
-        print(json.dumps({
-            'arch': arch, 'precision': precision, 'platform': platform,
-            'stack': stack, 'input_px': [h, w], 'batch': batch,
-            'feature_rel_l2_vs_highest': float(f'{drift:.3e}'),
-            'clips_per_sec': round(rate, 2),
-        }))
-
-
 if __name__ == '__main__':
+    from tools.family_precision_study import main
+
+    sys.argv = [sys.argv[0], 'r21d']
     main()
