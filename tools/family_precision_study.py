@@ -1,17 +1,20 @@
 #!/usr/bin/env python3
 """Precision ladder across model families: drift + in-graph rate.
 
-Generalizes tools/r21d_precision_study.py to every BASELINE.md config
-family with a dense device step (r21d, s3d, resnet50, clip ViT-B/32):
-for each matmul precision it runs the PRODUCTION extractor step
-(transforms + network, the exact jit'd fn the extractor calls) on
-identical inputs + seeded weights and prints one JSON line per
-(family, precision): feature rel L2 vs the 'highest' baseline and the
-in-graph rate (bench.py methodology — lax.scan over distinct batches
-inside one jit, value fetch).
+Generalizes tools/r21d_precision_study.py to every family with a dense
+device step (r21d, s3d, resnet50, clip ViT-B/32, vggish): for each
+matmul precision it runs the PRODUCTION extractor step (transforms +
+network, the exact jit'd fn the extractor calls) on identical inputs +
+seeded weights and prints one JSON line per (family, precision): feature
+rel L2 vs the 'highest' baseline and the in-graph rate (bench.py
+methodology — lax.scan over distinct batches inside one jit, value
+fetch). Inputs match each step's production range as well as geometry
+(0-255 frames for the vision families, log-mel-scaled values for
+vggish — bf16 drift depends on activation magnitude).
 
 Stack families (r21d, s3d) report clips (stacks) per second; frame-wise
-families (resnet, clip) report frames per second. `BENCH_STACK` overrides
+families (resnet, clip) report frames per second; vggish reports 0.96 s
+log-mel examples per second. `BENCH_STACK` overrides
 the stack length and `R21D_ARCH` the r21d variant (the knobs
 tools/r21d_precision_study.py documents).
 
@@ -36,10 +39,12 @@ LADDER = ('highest', 'high', 'default')
 
 
 def _family_specs(on_accel: bool):
-    """{name: (init_fn, step_fn, batch_shape, unit)} — step fns are the
-    extractors' own; input geometry mirrors what each step receives in
-    production (decode-geometry stacks for the in-graph-resizing stack
-    families, host-cropped frames for the frame-wise ones)."""
+    """{name: (init_fn, step_fn, batch_shape, unit, input_map)} — step
+    fns are the extractors' own; input geometry AND value range mirror
+    what each step receives in production (decode-geometry 0-255 stacks
+    for the in-graph-resizing stack families, host-cropped 0-255 frames
+    for the frame-wise ones, log-mel-range examples for vggish —
+    input_map rescales the shared random tensor host-side)."""
     from video_features_tpu.extract.clip import ExtractCLIP
     from video_features_tpu.extract.r21d import ExtractR21D
     from video_features_tpu.extract.resnet import ExtractResNet
@@ -48,6 +53,7 @@ def _family_specs(on_accel: bool):
     from video_features_tpu.models import r21d as r21d_model
     from video_features_tpu.models import resnet as resnet_model
     from video_features_tpu.models import s3d as s3d_model
+    from video_features_tpu.models import vggish as vggish_model
 
     h, w = (256, 340) if on_accel else (64, 86)
     stack = int(os.environ.get('BENCH_STACK', 16))
@@ -63,29 +69,39 @@ def _family_specs(on_accel: bool):
     s3d_h, s3d_w = (h, w) if on_accel else (256, 340)
     s3d_scale = 224 / min(s3d_h, s3d_w)
     s3d_hw = (math.floor(s3d_h * s3d_scale), math.floor(s3d_w * s3d_scale))
+    # the VGG step consumes log-mel values log(mel + 0.01) ≈ [-4.6, 5]
+    # directly (no in-graph normalization) — map the shared 0-255 tensor
+    # into that range so drift is measured at production magnitude
+    def log_mel_range(x):
+        return x / 255.0 * 9.6 - 4.6
+
     return {
         'r21d': (
             partial(r21d_model.init_state_dict, arch=r21d_arch),
             partial(ExtractR21D._forward_batch, arch=r21d_arch),
-            (b_stack, stack, h, w, 3), 'clips/sec'),
+            (b_stack, stack, h, w, 3), 'clips/sec', None),
         's3d': (
             s3d_model.init_state_dict,
             partial(ExtractS3D._forward, resize_hw=s3d_hw,
                     resize_scale=s3d_scale),
-            (b_stack, stack, s3d_h, s3d_w, 3), 'clips/sec'),
+            (b_stack, stack, s3d_h, s3d_w, 3), 'clips/sec', None),
         'resnet': (
             partial(resnet_model.init_state_dict, arch='resnet50'),
             partial(ExtractResNet._forward, arch='resnet50'),
-            (b_frame, px, px, 3), 'frames/sec'),
+            (b_frame, px, px, 3), 'frames/sec', None),
         'clip': (
             partial(clip_model.init_state_dict, model_name='ViT-B/32'),
             partial(ExtractCLIP._forward, arch='ViT-B/32'),
-            (clip_b, clip_px, clip_px, 3), 'frames/sec'),
+            (clip_b, clip_px, clip_px, 3), 'frames/sec', None),
+        'vggish': (
+            vggish_model.init_state_dict,
+            vggish_model.forward,
+            (b_frame, 96, 64, 1), 'examples/sec', log_mel_range),
     }
 
 
 def run_family(name: str, init_fn, step_fn, batch_shape, unit,
-               iters: int) -> None:
+               input_map, iters: int) -> None:
     import jax
     from jax import lax
 
@@ -96,9 +112,11 @@ def run_family(name: str, init_fn, step_fn, batch_shape, unit,
     device = jax_device(platform)
     params = jax.device_put(transplant(init_fn()), device)
     rng = np.random.RandomState(0)
-    frames = jax.device_put(
-        rng.randint(0, 255, size=(iters,) + batch_shape)
-        .astype(np.float32), device)
+    raw = rng.randint(0, 255,
+                      size=(iters,) + batch_shape).astype(np.float32)
+    if input_map is not None:     # host-side: production value range
+        raw = input_map(raw).astype(np.float32)
+    frames = jax.device_put(raw, device)
 
     def run(precision):
         def chained(p, xs):
