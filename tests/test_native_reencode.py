@@ -121,6 +121,19 @@ def test_loader_uses_native_reencode(graded_video, tmp_path):
     assert frames == loader.num_frames == 20   # round(2.5 s · 8)
 
 
+def test_total_mode_uses_native_reencode(graded_video, tmp_path):
+    """`extraction_total=N` resolves to an fps and rides the same
+    re-encode backend: ~N frames come back through a real tmp re-encode
+    (the pre-existing total-mode test pins only the index fallback)."""
+    if which_ffmpeg():
+        pytest.skip('binary present: loader prefers the CLI path')
+    loader = VideoLoader(graded_video, batch_size=16, total=20,
+                         tmp_path=str(tmp_path))
+    assert loader._tmp_file is not None and loader._index_map is None
+    frames = sum(b.shape[0] for b, _, _ in loader)
+    assert abs(frames - 20) <= 1
+
+
 def test_index_resample_divergence_measured(graded_video, tmp_path):
     """The documented divergence of the pure index-resample fallback vs
     the re-encode path (VERDICT r3 #6): on a CFR source the FRAME
