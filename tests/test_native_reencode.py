@@ -157,6 +157,22 @@ def test_index_resample_divergence_measured(graded_video, tmp_path):
     assert diff.max() <= 1, 'schedules should disagree by ≤1 source frame'
 
 
+def test_real_sample_noninteger_fps(sample_video, tmp_path):
+    """The reference sample decodes at a NON-integer rate (~19.6 fps from
+    VFR-ish timestamps): re-encoding it to CFR 25 must produce a fully
+    decodable stream whose frame count matches round(duration·25) within
+    a frame — the tail/rounding arithmetic on real-world pts."""
+    got = native.reencode_fps_native(sample_video, str(tmp_path), 25.0)
+    props = get_video_props(got)
+    assert abs(props['fps'] - 25.0) < 1e-6
+    n = len(_decoded_levels(got))
+    # the encoder is byte-deterministic and the sample fixed, so the
+    # count is exact: the sample's real pts span ~18.05 s → 451 slots
+    # (cv2's metadata-derived 355/19.62·25 ≈ 452.3 is off by ~1 — VFR-ish
+    # container metadata); an off-by-one tail regression fails this hard
+    assert n == 451, n
+
+
 @pytest.mark.skipif(which_ffmpeg() == '', reason='needs the ffmpeg binary')
 def test_matches_ffmpeg_cli(graded_video, tmp_path):
     """Where a real ffmpeg exists (CI), the native re-encode matches the
