@@ -71,9 +71,27 @@ def flow_stream_input(raft_params, stacks, pads, crop_size,
     return scale_to_pm1(flow_to_uint8_levels(flow, 20.0))
 
 
+def _pil_short_side_geometry(h, w, size):
+    """PIL's short-side resize target for (h, w), or None when resize_pil
+    would no-op — delegates to the one home of the arithmetic
+    (ops.transforms.pil_edge_resize_geometry)."""
+    from video_features_tpu.ops.transforms import pil_edge_resize_geometry
+    return pil_edge_resize_geometry(h, w, size)
+
+
+def _device_resize_stacks(stacks, resize_to):
+    """(B, S, H, W, 3) → (B, S, H', W', 3) antialiased linear resize —
+    the ONE in-graph resize both the fused step and the show_pred debug
+    path apply (same filter, or debug predictions would diverge from the
+    extracted features)."""
+    B, S = stacks.shape[:2]
+    return jax.image.resize(stacks, (B, S) + tuple(resize_to) + (3,),
+                            method='linear', antialias=True)
+
+
 def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
                           crop_size=CROP_SIZE, platform=None, pins=None,
-                          raft_iters=raft_model.ITERS):
+                          raft_iters=raft_model.ITERS, resize_to=None):
     """(B, stack+1, H, W, 3) float frames → {stream: (B, 1024)}.
 
     The full two-stream graph — RAFT flow, quantization, both I3D towers —
@@ -84,8 +102,16 @@ def fused_two_stream_step(params, stacks, pads, streams, constrain_pairs=None,
     pairs — see parallel.mesh). ``pins`` selects per-sub-graph matmul
     precision (ops/precision.py: 'encoder'/'corr'/'iter'/'upsample' inside
     RAFT, 'i3d' for both towers) — the precision='mixed' fast-parity mode.
+
+    ``resize_to=(H', W')`` moves the short-side resize into the graph
+    (``device_resize=true``): raw decode-geometry frames in, antialiased
+    linear resize on device (the same triangle filter PIL applies, minus
+    PIL's uint8 intermediate rounding — measured ≤1 level per pixel;
+    feature-level cost quantified in tests/test_device_resize.py).
     """
     from video_features_tpu.ops.precision import pin_scope
+    if resize_to is not None:
+        stacks = _device_resize_stacks(stacks, resize_to)
     out = {}
     if 'rgb' in streams:
         rgb = rgb_stream_input(stacks, crop_size)
@@ -154,6 +180,12 @@ class ExtractI3D(BaseExtractor):
         self.batch_size = args.get('batch_size', 1)
         self.decode_workers = int(args.get('decode_workers', 1))
         self.decode_backend = args.get('decode_backend', 'auto')
+        # device_resize=true ships RAW decode-geometry uint8 frames and
+        # runs the short-side-256 resize inside the fused graph — lifting
+        # the host's per-frame PIL work (the measured host wall,
+        # docs/benchmarks.md) onto the MXU at the cost of ≤1-level pixel
+        # differences vs PIL's uint8 rounding (tests/test_device_resize.py)
+        self.device_resize = bool(args.get('device_resize', False))
         self.show_pred = args.show_pred
         self.output_feat_keys = list(self.streams)
         self._device = jax_device(self.device)
@@ -162,6 +194,11 @@ class ExtractI3D(BaseExtractor):
         # time axis) — the reference's only scale-out is launching one
         # process per GPU (reference README.md:70-84)
         self.data_parallel = args.get('data_parallel', False)
+        if self.data_parallel and self.device_resize:
+            raise NotImplementedError(
+                'device_resize with data_parallel is not wired up yet — '
+                'host resize (device_resize=false) composes with the '
+                'sharded step')
         if self.data_parallel:
             from video_features_tpu.parallel import (
                 build_sharded_two_stream_step, make_mesh, put_batch,
@@ -181,7 +218,8 @@ class ExtractI3D(BaseExtractor):
                 self.mesh, streams=tuple(self.streams),
                 pins=self.precision_pins, raft_iters=self.raft_iters)
 
-            def _step(params, stacks, pads, streams):
+            def _step(params, stacks, pads, streams, resize_to=None):
+                assert resize_to is None  # guarded in __init__
                 return sharded(params, stacks, pads)
 
             self._step = _step
@@ -194,7 +232,7 @@ class ExtractI3D(BaseExtractor):
                 partial(self._stack_batch, platform=self._device.platform,
                         pins=self.precision_pins,
                         raft_iters=self.raft_iters),
-                static_argnames=('pads', 'streams'))
+                static_argnames=('pads', 'streams', 'resize_to'))
 
     def load_params(self, args):
         """{'rgb': i3d params, 'flow': i3d params, 'raft': raft params}.
@@ -241,30 +279,41 @@ class ExtractI3D(BaseExtractor):
         # frames stay uint8 until they are on the device: values are exact
         # integers either way, and a (B, S+1, 256, W, 3) float32 stack batch
         # is 4x the host->device bytes of the uint8 one — H2D bandwidth is
-        # the CLI's bottleneck ahead of the fused compute
+        # the CLI's bottleneck ahead of the fused compute.
+        # device_resize lifts the PIL resize into the fused graph: raw
+        # decode frames ship as-is and the jitted step resizes them
+        # (resize_to computed below with PIL's own edge/truncation rule).
         loader = VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files,
-            transform=lambda f: resize_pil(f, MIN_SIDE_SIZE),
+            transform=(None if self.device_resize
+                       else lambda f: resize_pil(f, MIN_SIDE_SIZE)),
             transform_workers=self.decode_workers,
             backend=self.decode_backend)
 
         feats: Dict[str, list] = {s: [] for s in self.streams}
-        state = {'pads': None}
+        state = {'pads': None, 'resize_to': None}
 
         def run(stacks, valid, window_idx):
             if state['pads'] is None:
                 H, W = stacks.shape[2:4]
+                if self.device_resize:
+                    state['resize_to'] = _pil_short_side_geometry(
+                        H, W, MIN_SIDE_SIZE)
+                    if state['resize_to'] is not None:
+                        H, W = state['resize_to']
                 state['pads'] = tuple(raft_model.pad_to_multiple(
                     np.zeros((1, H, W, 1), np.float32))[1])
             with self.tracer.stage('model'):
                 out = self._step(self.params, stacks, pads=state['pads'],
-                                 streams=tuple(self.streams))
+                                 streams=tuple(self.streams),
+                                 resize_to=state['resize_to'])
                 for s in self.streams:
                     feats[s].append(np.asarray(out[s])[:valid])
             if self.show_pred:
-                self.maybe_show_pred(stacks[:valid], state['pads'], window_idx)
+                self.maybe_show_pred(stacks[:valid], state['pads'], window_idx,
+                                     state['resize_to'])
 
         with self.precision_scope():
             # decode thread assembles + transfers batch k+1 while the
@@ -281,12 +330,16 @@ class ExtractI3D(BaseExtractor):
             for s, v in feats.items()
         }
 
-    def maybe_show_pred(self, stacks, pads, stack_counter):
+    def maybe_show_pred(self, stacks, pads, stack_counter, resize_to=None):
         """Kinetics top-5 per STREAM, like the reference (extract_i3d.py:
         212-216 runs the classifier head on each stream's transformed
         slice). Debug surface only — the flow recompute happens outside the
-        fused hot path."""
+        fused hot path. Under device_resize the raw stacks are resized
+        here first (same graph-side resize the fused step applies)."""
         from video_features_tpu.utils.preds import show_predictions_on_dataset
+        if resize_to is not None:
+            stacks = np.asarray(_device_resize_stacks(
+                jnp.asarray(stacks, jnp.float32), resize_to))
         crop = min(CROP_SIZE, stacks.shape[2], stacks.shape[3])
         for stream in self.streams:
             logits = _pred_logits(self.params, jnp.asarray(stacks),

@@ -124,6 +124,20 @@ def flow_to_uint8_levels(x: Array, bound: float = 20.0) -> Array:
     return jnp.round(128.0 + x * (255.0 / (2.0 * bound)))
 
 
+def pil_edge_resize_geometry(h: int, w: int, size: int,
+                             to_smaller_edge: bool = True):
+    """(oh, ow) of a PIL edge resize, or None when it no-ops — the ONE
+    home of the edge-selection + ``int(size * other/edge)`` truncation
+    arithmetic (reference ResizeImproved, models/transforms.py:191-242),
+    shared by :func:`resize_pil` and the device-resize path
+    (extract/i3d.py)."""
+    if (w <= h and w == size) or (h <= w and h == size):
+        return None
+    if (w < h) == to_smaller_edge:
+        return int(size * h / w), size
+    return size, int(size * w / h)
+
+
 def resize_pil(frame: np.ndarray, size: int,
                to_smaller_edge: bool = True,
                interpolation: str = 'bilinear') -> np.ndarray:
@@ -140,14 +154,10 @@ def resize_pil(frame: np.ndarray, size: int,
 
     modes = {'bilinear': Image.BILINEAR, 'bicubic': Image.BICUBIC}
     h, w = frame.shape[:2]
-    if (w <= h and w == size) or (h <= w and h == size):
+    geom = pil_edge_resize_geometry(h, w, size, to_smaller_edge)
+    if geom is None:
         return frame
-    if (w < h) == to_smaller_edge:
-        ow = size
-        oh = int(size * h / w)
-    else:
-        oh = size
-        ow = int(size * w / h)
+    oh, ow = geom
     img = Image.fromarray(frame)
     return np.asarray(img.resize((ow, oh), modes[interpolation]))
 
