@@ -405,7 +405,9 @@ def resample_reference_literal(x: np.ndarray, sr_orig: int,
     index_step = int(scale * num_table)
     nwin = interp_win.shape[0]
     n_orig = x.shape[0]
-    n_out = int(np.ceil(n_orig * sample_ratio))
+    # resampy ≥0.4.0 (resampy/core.py): shape[axis] * sr_new // sr_orig —
+    # integer floor, its 0.4.0 output-length rounding fix
+    n_out = n_orig * int(sr_new) // int(sr_orig)
     y = np.zeros(n_out, dtype=np.float64)
     for t in range(n_out):
         time_register = t / sample_ratio

@@ -64,10 +64,12 @@ def test_kaiser_sine_fidelity():
 
 
 def test_kaiser_length_contract():
-    """n_out = ceil(n_in * ratio) — and exact-second inputs hit the exact
-    sample count."""
+    """n_out = n_in * sr_new // sr_orig (resampy ≥0.4.0's integer-floor
+    output length) — non-divisible lengths floor, exact-second inputs hit
+    the exact sample count."""
     assert resample_kaiser(np.zeros(44100), 44100, 16000).shape == (16000,)
-    assert resample_kaiser(np.zeros(44101), 44100, 16000).shape == (16001,)
+    assert resample_kaiser(np.zeros(44101), 44100, 16000).shape == (16000,)
+    assert resample_kaiser(np.zeros(44144), 44100, 16000).shape == (16015,)
     assert resample_kaiser(np.zeros(8000), 8000, 16000).shape == (16000,)
 
 

@@ -128,10 +128,19 @@ def reencode_fps_native(video_path: str, tmp_path: str,
     os.makedirs(tmp_path, exist_ok=True)
     new_path = os.path.join(tmp_path,
                             f'{Path(video_path).stem}_new_fps.mp4')
+    # The package may not be pip-installed: make the child resolve THIS
+    # checkout's package regardless of the caller's cwd. Invoking the
+    # entry point by file path puts the io/ dir (no package inside) at
+    # sys.path[0], so the PYTHONPATH entry below deterministically wins
+    # even when cwd contains a different video_features_tpu checkout.
+    pkg_parent = str(Path(__file__).resolve().parents[2])
+    env = dict(os.environ)
+    env['PYTHONPATH'] = os.pathsep.join(
+        [pkg_parent] + ([env['PYTHONPATH']] if env.get('PYTHONPATH') else []))
     proc = subprocess.run(
-        [sys.executable, '-m', 'video_features_tpu.io.reencode_cli',
+        [sys.executable, str(Path(__file__).with_name('reencode_cli.py')),
          str(video_path), new_path, repr(float(extraction_fps))],
-        capture_output=True, text=True)
+        capture_output=True, text=True, env=env)
     if proc.returncode != 0:
         raise RuntimeError(
             f'native re-encode failed: {proc.stderr.strip()}')

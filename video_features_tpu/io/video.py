@@ -22,6 +22,7 @@ Contract parity with the reference loader:
 """
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 from pathlib import Path
@@ -201,17 +202,28 @@ class VideoLoader:
                 native_reencode = native_mod.available()
 
         self._index_map: Optional[np.ndarray] = None
+        reencoded = None
+        if fps is not None and native_reencode and not use_ffmpeg:
+            # The native encoder hard-rejects inputs it can't handle (e.g.
+            # non-yuv420p); degrade to index resampling like a host with
+            # neither backend would, rather than killing extraction.
+            from video_features_tpu.io.native import reencode_fps_native
+            try:
+                reencoded = reencode_fps_native(path, str(tmp_path), fps)
+            except (RuntimeError, OSError) as e:
+                logging.warning(
+                    'native fps re-encode failed (%s); falling back to '
+                    'index resampling for %s', e, path)
         if fps is None:
             self.path = path
             self.fps = src_fps
             self.num_frames = src_frames
-        elif use_ffmpeg or native_reencode:
+        elif use_ffmpeg or reencoded is not None:
             if use_ffmpeg:
                 self.path = reencode_video_with_diff_fps(
                     path, str(tmp_path), fps)
             else:
-                from video_features_tpu.io.native import reencode_fps_native
-                self.path = reencode_fps_native(path, str(tmp_path), fps)
+                self.path = reencoded
             self._tmp_file = self.path
             new_props = get_video_props(self.path)
             self.fps = new_props['fps']

@@ -152,7 +152,10 @@ def resample_kaiser(data: np.ndarray, sr: int,
     ratio = Fraction(int(target_sr), int(sr))   # gcd-reduced, exact
     sample_ratio = float(ratio)
     n_in = data.shape[0]
-    n_out = int(np.ceil(n_in * sample_ratio))
+    # resampy ≥0.4.0 output length: shape[axis] * sr_new // sr_orig
+    # (integer floor — its 0.4.0 rounding fix); exact-int via the reduced
+    # fraction, which floors identically.
+    n_out = n_in * ratio.numerator // ratio.denominator
     win, delta, num_table = _interp_tables(sample_ratio)
     scale = min(1.0, sample_ratio)
     index_step = int(scale * num_table)
