@@ -34,6 +34,8 @@ extern "C" {
 #include <string>
 #include <vector>
 
+#include "yuv2rgb_cv2_tables.h"
+
 namespace {
 thread_local std::string g_last_error;
 
@@ -173,15 +175,59 @@ void rotate_rgb(const Decoder* d, const unsigned char* src,
   }
 }
 
+// cv2-exact yuv420p → RGB24: the integer-table arithmetic of cv2's
+// bundled swscale, recovered bit-exactly by tools/fit_cv2_yuv_tables.py
+// (see that tool's docstring for the method and verification). Nearest
+// chroma (U,V at [r/2][c/2]), per-channel table sums, clip. Makes the
+// native backend's pixels IDENTICAL to the reference's cv2 decode, which
+// is what lets it be the default backend at the parity bar.
+inline uint8_t clip8(int v) {
+  return (uint8_t)(v < 0 ? 0 : (v > 255 ? 255 : v));
+}
+
+void yuv420_to_rgb_cv2(const AVFrame* f, int w, int h, unsigned char* out) {
+  for (int r = 0; r < h; ++r) {
+    const uint8_t* yrow = f->data[0] + (size_t)r * f->linesize[0];
+    const uint8_t* urow = f->data[1] + (size_t)(r >> 1) * f->linesize[1];
+    const uint8_t* vrow = f->data[2] + (size_t)(r >> 1) * f->linesize[2];
+    unsigned char* o = out + (size_t)r * w * 3;
+    for (int c = 0; c < w; ++c, o += 3) {
+      const int y = yrow[c], u = urow[c >> 1], v = vrow[c >> 1];
+      o[0] = clip8(kTY_R[y] + kTV_R[v]);
+      o[1] = clip8(kTY_G[y] + kTU_G[u] + kTV_G[v]);
+      o[2] = clip8(kTY_B[y] + kTU_B[u]);
+    }
+  }
+}
+
+// The table path covers exactly what the tables were fitted on: 8-bit
+// 4:2:0, limited/unspecified range, BT.601-family (or untagged) matrix.
+// Anything else — 10-bit, 4:2:2, full-range jpeg variants, or a clip
+// whose VUI explicitly tags a non-601 matrix (BT.709 HD camera output,
+// which a metadata-aware cv2 would convert with 709 coefficients) —
+// goes through swscale: a documented approximation there, bit-exact-to-
+// cv2 here.
+bool use_cv2_tables(const Decoder* d) {
+  const AVColorSpace cs = d->frame->colorspace;
+  return d->frame->format == AV_PIX_FMT_YUV420P &&
+         d->frame->color_range != AVCOL_RANGE_JPEG &&
+         (cs == AVCOL_SPC_UNSPECIFIED || cs == AVCOL_SPC_BT470BG ||
+          cs == AVCOL_SPC_SMPTE170M);
+}
+
 void emit_rgb(Decoder* d, unsigned char* out) {
-  // rotation goes through the coded-geometry staging buffer; otherwise
-  // convert straight into the caller's frame slot (safe: ACCURATE_RND
-  // output does not depend on destination alignment)
   unsigned char* target = d->rotation ? d->stage : out;
-  uint8_t* dst[1] = {target};
-  int dst_linesize[1] = {3 * d->width};
-  sws_scale(d->sws, d->frame->data, d->frame->linesize, 0, d->height, dst,
-            dst_linesize);
+  if (use_cv2_tables(d)) {
+    yuv420_to_rgb_cv2(d->frame, d->width, d->height, target);
+  } else {
+    // rotation goes through the coded-geometry staging buffer; otherwise
+    // convert straight into the caller's frame slot (safe: ACCURATE_RND
+    // output does not depend on destination alignment)
+    uint8_t* dst[1] = {target};
+    int dst_linesize[1] = {3 * d->width};
+    sws_scale(d->sws, d->frame->data, d->frame->linesize, 0, d->height, dst,
+              dst_linesize);
+  }
   if (d->rotation) rotate_rgb(d, d->stage, out);
 }
 }  // namespace
@@ -211,28 +257,25 @@ void vf_props(void* handle, double* fps, long* num_frames, int* width,
 // Clockwise display rotation applied to emitted frames (0/90/180/270).
 int vf_rotation(void* handle) { return ((Decoder*)handle)->rotation; }
 
-long vf_read(void* handle, unsigned char* out, long max_frames) {
-  Decoder* d = (Decoder*)handle;
-  if (d->done || max_frames <= 0) return 0;
-  const long frame_bytes = 3L * d->width * d->height;
-  long produced = 0;
-
-  while (produced < max_frames) {
+// The one receive/drain/send packet pump both read surfaces share:
+// leaves the next decoded frame in d->frame and returns 1, or 0 at EOF
+// (sets d->done), -2 on decode error, -3 on a mid-stream resolution
+// change (the caller's buffer geometry would be stale). Caller must
+// av_frame_unref when finished with the frame.
+int next_frame(Decoder* d) {
+  if (d->done) return 0;
+  while (true) {
     int ret = avcodec_receive_frame(d->codec, d->frame);
     if (ret == 0) {
-      // A mid-stream resolution change would make sws_scale read past the
-      // frame's planes (and the caller's buffer geometry stale): hard error.
-      if (d->frame->width != d->width || d->frame->height != d->height)
+      if (d->frame->width != d->width || d->frame->height != d->height) {
+        av_frame_unref(d->frame);
         return -3;
-      if (!ensure_sws(d, (AVPixelFormat)d->frame->format)) return -1;
-      emit_rgb(d, out + produced * frame_bytes);
-      av_frame_unref(d->frame);
-      ++produced;
-      continue;
+      }
+      return 1;
     }
     if (ret == AVERROR_EOF) {
       d->done = true;
-      break;
+      return 0;
     }
     if (ret != AVERROR(EAGAIN)) return -2;
 
@@ -248,7 +291,58 @@ long vf_read(void* handle, unsigned char* out, long max_frames) {
       avcodec_send_packet(d->codec, d->pkt);
     av_packet_unref(d->pkt);
   }
+}
+
+long vf_read(void* handle, unsigned char* out, long max_frames) {
+  Decoder* d = (Decoder*)handle;
+  if (max_frames <= 0) return 0;
+  const long frame_bytes = 3L * d->width * d->height;
+  long produced = 0;
+
+  while (produced < max_frames) {
+    int ret = next_frame(d);
+    if (ret < 0) return ret;
+    if (ret == 0) break;
+    if (!use_cv2_tables(d) &&
+        !ensure_sws(d, (AVPixelFormat)d->frame->format)) {
+      av_frame_unref(d->frame);
+      return -1;
+    }
+    emit_rgb(d, out + produced * frame_bytes);
+    av_frame_unref(d->frame);
+    ++produced;
+  }
   return produced;
+}
+
+// Decode the next frame and expose its raw yuv420p planes (Y: H×W,
+// U/V: H/2×W/2, no rotation applied). Diagnostic surface for pinning the
+// YUV→RGB conversion stage against other decoders: the planes are what
+// libavcodec produced, before any swscale processing. Returns 1 on
+// success, 0 at EOF, <0 on error (-4: not yuv420p).
+long vf_read_yuv(void* handle, unsigned char* y, unsigned char* u,
+                 unsigned char* v) {
+  Decoder* d = (Decoder*)handle;
+  int ret = next_frame(d);
+  if (ret <= 0) return ret;
+  if (d->frame->format != AV_PIX_FMT_YUV420P &&
+      d->frame->format != AV_PIX_FMT_YUVJ420P) {
+    av_frame_unref(d->frame);
+    return -4;
+  }
+  const int w = d->width, h = d->height;
+  const int cw = (w + 1) / 2, ch = (h + 1) / 2;
+  for (int r = 0; r < h; ++r)
+    std::memcpy(y + (size_t)r * w,
+                d->frame->data[0] + (size_t)r * d->frame->linesize[0], w);
+  for (int r = 0; r < ch; ++r) {
+    std::memcpy(u + (size_t)r * cw,
+                d->frame->data[1] + (size_t)r * d->frame->linesize[1], cw);
+    std::memcpy(v + (size_t)r * cw,
+                d->frame->data[2] + (size_t)r * d->frame->linesize[2], cw);
+  }
+  av_frame_unref(d->frame);
+  return 1;
 }
 
 void vf_close(void* handle) { destroy((Decoder*)handle); }

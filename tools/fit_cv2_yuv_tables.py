@@ -1,0 +1,298 @@
+"""Reverse-engineer cv2's exact YUV→RGB conversion and emit C tables.
+
+The reference decodes video through ``cv2.VideoCapture`` (reference
+utils/io.py:96-154). cv2 ≥5.0 bundles FFmpeg 8's rewritten swscale
+(9.5.x), whose yuv420p→RGB integer arithmetic differs from the system
+libswscale (6.x) by ~1 level on most pixels — measured in round 4 as a
+2.9e-3 feature-level drift through the flow-quantization cliff, which is
+why the native decode backend could not be the default.
+
+Rather than approximating, this tool treats cv2 as an oracle and
+recovers its conversion EXACTLY:
+
+1. Decode the same videos twice — raw yuv420p planes through our native
+   service (``vf_read_yuv``) and RGB through ``cv2.VideoCapture`` — over
+   the reference samples plus synthetic full-gamut content (uniform and
+   beta-distributed RGB noise, saturated bars, gradients) written with
+   ``cv2.VideoWriter``.
+2. Verify the map is POINTWISE (no dithering: every (Y,U,V) triple maps
+   to one RGB everywhere it occurs, including across the 2×2 chroma
+   block — which also proves nearest-neighbor chroma upsampling).
+3. Solve the per-channel table decomposition
+       R = clip(TY_R[Y] + TV_R[V])
+       G = clip(TY_G[Y] + TU_G[U] + TV_G[V])
+       B = clip(TY_B[Y] + TU_B[U] + TV_B[B])
+   by sparse least squares over unclipped observations. The solve is
+   exact (residual ~1e-9) and the entries are integers — cv2's pipeline
+   IS table arithmetic. Slopes recovered: Y 9539>>13 (=1.16443, the
+   BT.601 limited-range 255/219), R/V 6537>>12, B/U 4131>>11,
+   G/U -401>>10, G/V -1665>>11.
+4. Entries never observed unclipped (a handful outside the legal
+   chroma range) are filled by linear extrapolation, then nudged to
+   satisfy every clipped observation (clip(pred)==0/255 inequalities).
+5. Verify ZERO mismatches over every collected observation (~1.8M
+   unique triples in the round-5 run), then emit
+   ``native/yuv2rgb_cv2_tables.h``.
+
+Scope: the tables reproduce cv2's conversion for 8-bit yuv420p with
+unspecified/limited color range — the only format the reference corpus
+and every H.264 CLI encode here produces. vfdecode.cc uses them for
+exactly that case and falls back to swscale otherwise.
+
+Usage:
+    python tools/fit_cv2_yuv_tables.py [--videos a.mp4 b.mp4 ...]
+                                       [--out native/yuv2rgb_cv2_tables.h]
+                                       [--skip-synthetic]
+"""
+from __future__ import annotations
+
+import argparse
+import ctypes
+import glob
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def _bind_read_yuv(lib):
+    lib.vf_read_yuv.restype = ctypes.c_long
+    lib.vf_read_yuv.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 3
+
+
+def write_synthetic(tmpdir: str) -> list:
+    """Full-gamut synthetic videos via cv2.VideoWriter (mp4v): uniform
+    noise, extreme-biased beta noise, 16px blocks (survive 4:2:0+DCT →
+    extreme chroma), saturated bars, gradients."""
+    import cv2
+    rng = np.random.RandomState(0)
+    W, H = 320, 240
+    out = []
+
+    def emit(name, frames):
+        path = os.path.join(tmpdir, name)
+        wr = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*'mp4v'), 30,
+                             (frames[0].shape[1], frames[0].shape[0]))
+        for f in frames:
+            wr.write(f)
+        wr.release()
+        out.append(path)
+
+    emit('noise.mp4', [rng.randint(0, 256, (H, W, 3), np.uint8)
+                       for _ in range(12)])
+    emit('beta.mp4', [(255 * rng.beta(0.25, 0.25, (H, W, 3))).astype(np.uint8)
+                      for _ in range(40)])
+    blocks = []
+    for _ in range(40):
+        small = (255 * rng.beta(0.2, 0.2, (H // 16, W // 16, 3))).astype(np.uint8)
+        blocks.append(np.repeat(np.repeat(small, 16, 0), 16, 1))
+    emit('blocks.mp4', blocks)
+    cols = [(0, 0, 255), (0, 255, 0), (255, 0, 0), (0, 255, 255),
+            (255, 255, 0), (255, 0, 255), (255, 255, 255), (0, 0, 0)]
+    bars = []
+    for i in range(8):
+        f = np.zeros((H, W, 3), np.uint8)
+        for j, c in enumerate(cols):
+            f[:, j * W // len(cols):(j + 1) * W // len(cols)] = c
+        bars.append(np.roll(f, i * 7, axis=1))
+    emit('bars.mp4', bars)
+    # odd-alignment width exercises any width-dependent SIMD path
+    W2 = 326
+    emit('noise_oddw.mp4', [rng.randint(0, 256, (H, W2, 3), np.uint8)
+                            for _ in range(8)])
+    return out
+
+
+def collect(videos: list, max_frames: int = 40):
+    """(Y,V,R), (Y,U,B), (Y,U,V,G) observation arrays, deduplicated, and
+    the pointwise-consistency violation count (must be 0)."""
+    import cv2
+    from video_features_tpu.io.native import load_library
+    lib = load_library()
+    assert lib is not None, 'native decode library unavailable'
+    _bind_read_yuv(lib)
+
+    obsR, obsB, obsG = [], [], []
+    for path in videos:
+        h0 = lib.vf_open(os.fsencode(path))
+        if not h0:
+            print(f'  skip (native open failed): {path}', file=sys.stderr)
+            continue
+        fps = ctypes.c_double(); n = ctypes.c_long()
+        w = ctypes.c_int(); h = ctypes.c_int()
+        lib.vf_props(h0, ctypes.byref(fps), ctypes.byref(n),
+                     ctypes.byref(w), ctypes.byref(h))
+        W, H = w.value, h.value
+        if W % 2 or H % 2:
+            lib.vf_close(h0)
+            continue
+        cap = cv2.VideoCapture(path)
+        Y = np.empty((H, W), np.uint8)
+        U = np.empty((H // 2, W // 2), np.uint8)
+        V = np.empty((H // 2, W // 2), np.uint8)
+        fi = 0
+        while fi < max_frames:
+            r = lib.vf_read_yuv(h0, Y.ctypes.data, U.ctypes.data,
+                                V.ctypes.data)
+            ok, bgr = cap.read()
+            if r != 1 or not ok:
+                break
+            rgb = bgr[:, :, ::-1]
+            Yb = Y.reshape(H // 2, 2, W // 2, 2).astype(np.int64)
+            Rb = rgb.reshape(H // 2, 2, W // 2, 2, 3).astype(np.int64)
+            Ue = np.broadcast_to(U[:, None, :, None].astype(np.int64), Yb.shape)
+            Ve = np.broadcast_to(V[:, None, :, None].astype(np.int64), Yb.shape)
+            obsR.append(np.stack([Yb.ravel(), Ve.ravel(), Rb[..., 0].ravel()], 1))
+            obsB.append(np.stack([Yb.ravel(), Ue.ravel(), Rb[..., 2].ravel()], 1))
+            obsG.append(np.stack([Yb.ravel(), Ue.ravel(), Ve.ravel(),
+                                  Rb[..., 1].ravel()], 1))
+            fi += 1
+        lib.vf_close(h0)
+        cap.release()
+        print(f'  {fi} frames from {path}', file=sys.stderr)
+
+    def dedup(obs, nkey, check_consistency=True):
+        o = np.concatenate(obs)
+        key = np.zeros(len(o), np.int64)
+        for i in range(nkey):
+            key = (key << 8) | o[:, i]
+        order = np.argsort(key, kind='stable')
+        ks, vs = key[order], o[order, nkey]
+        uniq, start = np.unique(ks, return_index=True)
+        # pointwise check: within each group all outputs identical
+        bad = 0
+        if check_consistency:
+            grp_max = np.maximum.reduceat(vs, start)
+            grp_min = np.minimum.reduceat(vs, start)
+            bad = int((grp_max != grp_min).sum())
+        return o[order][start], bad
+
+    R, badR = dedup(obsR, 2)
+    B, badB = dedup(obsB, 2)
+    G, badG = dedup(obsG, 3)
+    assert badR == badB == badG == 0, (
+        f'cv2 conversion is NOT pointwise: {badR}/{badB}/{badG} '
+        'inconsistent triples — table model invalid')
+    return R, B, G
+
+
+def solve_tables(obs, nterm, lab):
+    """Exact integer tables for one channel by sparse lsq over unclipped
+    observations + extrapolation/repair for unpinned entries."""
+    import scipy.sparse as sp
+    from scipy.sparse.linalg import lsqr
+
+    cols = [obs[:, i] for i in range(nterm)]
+    out = obs[:, nterm]
+    m = (out > 0) & (out < 255)
+    rows = np.arange(m.sum())
+    ci = np.concatenate([cols[i][m] + 256 * i for i in range(nterm)])
+    M = sp.coo_matrix((np.ones(nterm * m.sum()), (np.tile(rows, nterm), ci)),
+                      shape=(m.sum(), 256 * nterm)).tocsr()
+    sol = lsqr(M, out[m].astype(np.float64), atol=1e-13, btol=1e-13,
+               iter_lim=15000)[0]
+    resid = np.abs(M @ sol - out[m]).max()
+    assert resid < 1e-6, f'{lab}: not separable (resid {resid})'
+    tabs = [sol[256 * i:256 * (i + 1)].copy() for i in range(nterm)]
+    for i in range(1, nterm):   # gauge: integerize at the best-pinned entry
+        pin = np.bincount(cols[i][m], minlength=256).argmax()
+        sh = tabs[i][pin] - np.round(tabs[i][pin])
+        tabs[i] -= sh
+        tabs[0] += sh
+    pinned = [np.unique(cols[i][m]) for i in range(nterm)]
+    intd = max(np.abs(t[p] - np.round(t[p])).max()
+               for t, p in zip(tabs, pinned))
+    assert intd < 1e-4, f'{lab}: non-integer table entries ({intd})'
+    T = [np.full(256, np.nan) for _ in range(nterm)]
+    for i in range(nterm):
+        T[i][pinned[i]] = np.round(tabs[i][pinned[i]])
+    for t in T:   # unpinned entries: linear extrapolation first
+        idx = np.where(~np.isnan(t))[0]
+        miss = np.where(np.isnan(t))[0]
+        if len(miss):
+            t[miss] = np.round(np.polyval(np.polyfit(idx, t[idx], 1), miss))
+    T = [t.astype(np.int64) for t in T]
+    # repair: nudge unpinned entries until every CLIPPED observation holds
+    pinset = [set(p.tolist()) for p in pinned]
+    for _ in range(200):
+        pred = np.clip(sum(T[i][cols[i]] for i in range(nterm)), 0, 255)
+        bad = np.where(pred != out)[0]
+        if not len(bad):
+            break
+        i0 = bad[0]
+        for i in range(nterm):
+            c = cols[i][i0]
+            if c not in pinset[i]:
+                T[i][c] += np.sign(int(out[i0]) - int(pred[i0]))
+                break
+        else:
+            raise AssertionError(
+                f'{lab}: mismatch at fully pinned entry '
+                f'{[int(cols[i][i0]) for i in range(nterm)]}')
+    pred = np.clip(sum(T[i][cols[i]] for i in range(nterm)), 0, 255)
+    nbad = int((pred != out).sum())
+    print(f'{lab}: {len(obs)} unique obs, {nbad} mismatches, '
+          f'{[len(p) for p in pinned]} pinned', file=sys.stderr)
+    assert nbad == 0, f'{lab}: {nbad} mismatches remain'
+    return T
+
+
+def emit_header(tables: dict, out_path: str, n_obs: int) -> None:
+    lines = [
+        '// GENERATED by tools/fit_cv2_yuv_tables.py — do not edit.',
+        '//',
+        '// Exact integer tables reproducing cv2 (bundled FFmpeg/swscale)',
+        '// yuv420p -> RGB conversion, verified bit-exact over '
+        f'{n_obs} unique',
+        '// (Y,U,V) observations across the reference samples and synthetic',
+        '// full-gamut content. See the tool docstring for the method.',
+        '//',
+        '//   R = clip(TY_R[Y] + TV_R[V])',
+        '//   G = clip(TY_G[Y] + TU_G[U] + TV_G[V])',
+        '//   B = clip(TY_B[Y] + TU_B[U])',
+        '// chroma: nearest (U,V at [y/2][x/2]); 8-bit limited/unspec range.',
+        '#pragma once',
+        '#include <cstdint>',
+        '',
+    ]
+    for name, t in tables.items():
+        vals = ', '.join(str(int(v)) for v in t)
+        lines.append(f'static const int16_t {name}[256] = {{{vals}}};')
+    lines.append('')
+    Path(out_path).write_text('\n'.join(lines))
+    print(f'wrote {out_path}', file=sys.stderr)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--videos', nargs='*', default=None)
+    ap.add_argument('--out', default=str(REPO / 'native' /
+                                         'yuv2rgb_cv2_tables.h'))
+    ap.add_argument('--skip-synthetic', action='store_true')
+    ns = ap.parse_args()
+
+    videos = list(ns.videos or [])
+    if not videos:
+        videos = sorted(glob.glob('/root/reference/sample/*.mp4'))
+    with tempfile.TemporaryDirectory() as td:
+        if not ns.skip_synthetic:
+            videos += write_synthetic(td)
+        print('collecting observations...', file=sys.stderr)
+        R, B, G = collect(videos)
+        TR = solve_tables(R, 2, 'R')
+        TB = solve_tables(B, 2, 'B')
+        TG = solve_tables(G, 3, 'G')
+    emit_header({'kTY_R': TR[0], 'kTV_R': TR[1],
+                 'kTY_G': TG[0], 'kTU_G': TG[1], 'kTV_G': TG[2],
+                 'kTY_B': TB[0], 'kTU_B': TB[1]},
+                ns.out, len(R) + len(B) + len(G))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -339,10 +339,12 @@ def measure_e2e_i3d(ckpt_dir):
              real),
         ]
         # Same golden, decoded with the native C++ backend on our side
-        # (reference side stays cv2 — its own decoder): quantifies the
-        # feature-level cost of the non-default throughput backend. cv2
-        # is the config default because it is decode-exact vs the
-        # reference (VERDICT r3 #2); this row is the measured reason.
+        # (reference side stays cv2 — its own decoder). Since round 5 the
+        # native backend reproduces cv2's yuv420p→RGB integer tables
+        # bit-exactly (native/yuv2rgb_cv2_tables.h, fitted by
+        # tools/fit_cv2_yuv_tables.py), so this row must equal the cv2
+        # row EXACTLY — it pins decode-backend equivalence at the feature
+        # level, which is what let decode_backend default to 'auto'.
         from video_features_tpu.io import native
         if native.available():
             args_native = load_config('i3d', overrides={
