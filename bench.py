@@ -12,11 +12,22 @@ Two rungs, both at a PARITY-GRADE precision (the metric name stamps it):
   * ``e2e`` — video file → decoded frames → device → features, the
     pipeline a user actually runs (native decoder when built, cv2
     otherwise; prefetch + overlapped H2D on).
-  * ``ingraph`` — device-only ceiling: the fused graph on device-resident
-    batches, timed INSIDE one jit call (``lax.scan`` over distinct input
-    batches, result fetched) — remote-dispatch backends can return from
-    ``block_until_ready`` before executing, so only value fetches are
-    trustworthy and in-graph iteration amortizes the ~100 ms dispatch.
+  * ``ingraph_cli_geom`` — the HEADLINE: the fused graph on
+    device-resident batches at the geometry the CLI actually runs
+    (short-side-256 decode → 256×340 frames, RAFT over the full padded
+    frame, 224 crop in-graph — like the reference pipeline behind its
+    3.75 clips/s anecdote), timed INSIDE one jit call (``lax.scan`` over
+    distinct input batches, result fetched) — remote-dispatch backends
+    can return from ``block_until_ready`` before executing, so only
+    value fetches are trustworthy and in-graph iteration amortizes the
+    ~100 ms dispatch. A secondary 224² crop-first rung
+    (``ingraph_*_224px``) keeps cross-round comparability with the
+    round-3/4 headline geometry.
+
+Per-family rungs (s3d / resnet50 / clip / vggish / standalone raft at
+native flow resolution — the production steps from
+tools/family_precision_study.py) record every BASELINE config's measured
+rate in ``rungs`` at the same precision stamp.
 
 Default precision is 'mixed' (ops/precision.py): ambient 3-pass bf16 with
 the drift-tolerant sub-graphs on 1-pass — measured ≤1e-3 feature drift vs
@@ -53,19 +64,30 @@ BASELINE_CLIPS_PER_SEC = 3.75
 
 
 def bench_ingraph(jax, precision, pins, device, platform, params,
-                  stack, size, batch, iters):
-    """Device-only fused-graph clips/sec (in-graph scan, value fetch)."""
+                  stack, h, w, batch, iters):
+    """Device-only fused-graph clips/sec (in-graph scan, value fetch) at
+    an arbitrary frame geometry.
+
+    The CLI-geometry rung feeds the decode geometry the real pipeline
+    produces (short-side 256 → the sample's 256×340; RAFT sees the FULL
+    frame padded to /8, crop 224 happens in-graph after flow — reference
+    models/i3d/extract_i3d.py:38-62,143-164). The square-224 rung is the
+    crop-first ceiling the pipeline never runs; it stays as a secondary
+    rung only."""
     import jax.numpy as jnp
     from jax import lax
 
     from video_features_tpu.extract.i3d import fused_two_stream_step
+    from video_features_tpu.models import raft as raft_model
 
     rng = np.random.RandomState(0)
     all_stacks = jax.device_put(
-        rng.randint(0, 255, size=(iters, batch, stack + 1, size, size, 3))
+        rng.randint(0, 255, size=(iters, batch, stack + 1, h, w, 3))
         .astype(np.float32), device)
-    kwargs = dict(pads=(0, 0, 0, 0), streams=('rgb', 'flow'),
-                  crop_size=min(224, size), platform=platform, pins=pins)
+    pads = tuple(raft_model.pad_to_multiple(
+        np.zeros((1, h, w, 1), np.float32))[1])
+    kwargs = dict(pads=pads, streams=('rgb', 'flow'),
+                  crop_size=min(224, h, w), platform=platform, pins=pins)
 
     def chained(p, xs):
         # per-stream checksums double as the finiteness guard (any NaN/Inf
@@ -91,33 +113,27 @@ def bench_ingraph(jax, precision, pins, device, platform, params,
     return batch * iters / elapsed
 
 
-def bench_r21d_ingraph(jax, precision, device, params, stack, iters,
-                       on_accel):
-    """R(2+1)D device-only clips/sec — the SECOND north-star model
-    (BASELINE.md names I3D rgb+flow AND R(2+1)D). Runs the production
-    extractor step (transforms + network, extract/r21d.py:_forward_batch)
-    on decode-geometry frames (the reference sample is 340x256; the
-    resize to 128x171 + 112px crop is part of the step). Ladder measured
-    by tools/r21d_precision_study.py — at 'mixed' (= ambient 'high') the
-    drift vs float32 is 2.0e-4, under the ≤1e-3 parity bar."""
-    from functools import partial
-
+def bench_family_ingraph(jax, ambient, device, init_fn, step_fn,
+                         batch_shape, input_map, count_per_batch, iters,
+                         transplant):
+    """One family's device-only in-graph rate (scan + checksum fetch) —
+    the shared timing harness for every per-family rung, fed by
+    tools/family_precision_study._family_specs so bench.py and the
+    precision-ladder tool measure the identical production step."""
     from jax import lax
 
-    from video_features_tpu.extract.r21d import ExtractR21D
-
-    h, w = (256, 340) if on_accel else (64, 86)
-    batch = 16 if on_accel else 1
-    step = partial(ExtractR21D._forward_batch, arch='r2plus1d_18')
+    params = jax.device_put(transplant(init_fn()), device)
     rng = np.random.RandomState(0)
-    frames = jax.device_put(
-        rng.randint(0, 255, size=(iters, batch, stack, h, w, 3))
-        .astype(np.float32), device)
+    raw = rng.randint(0, 255,
+                      size=(iters,) + batch_shape).astype(np.float32)
+    if input_map is not None:
+        raw = input_map(raw).astype(np.float32)
+    frames = jax.device_put(raw, device)
 
     def chained(p, xs):
-        def body(acc, stacks):
-            with jax.default_matmul_precision(precision):
-                return acc + step(p, stacks).sum(), None
+        def body(acc, batch):
+            with jax.default_matmul_precision(ambient):
+                return acc + step_fn(p, batch).sum(), None
         acc, _ = lax.scan(body, jax.numpy.float32(0), xs)
         return acc
 
@@ -127,7 +143,9 @@ def bench_r21d_ingraph(jax, precision, device, params, stack, iters,
     checksum = float(jitted(params, frames))
     elapsed = time.perf_counter() - t0
     assert np.isfinite(checksum)
-    return batch * iters / elapsed
+    count = (count_per_batch if count_per_batch is not None
+             else batch_shape[0])
+    return count * iters / elapsed
 
 
 def _bench_video(tmp_dir: str) -> str:
@@ -221,7 +239,16 @@ def run() -> dict:
     ambient, pins = ((MIXED_AMBIENT, MIXED_PINS) if precision == 'mixed'
                      else (precision, None))
     stack = int(os.environ.get('BENCH_STACK', 16))
-    size = int(os.environ.get('BENCH_SIZE', 224 if on_accel else 64))
+    # Headline geometry = what the real CLI runs: short-side-256 decode of
+    # the reference sample → 256×340 frames, RAFT on the full padded frame,
+    # crop 224 in-graph (VERDICT r4 task 2 — the reference's ~3.75 clips/s
+    # anecdote ran THIS geometry, so vs_baseline must too). BENCH_SIZE
+    # overrides with a square geometry for smoke runs.
+    if os.environ.get('BENCH_SIZE'):
+        size = int(os.environ['BENCH_SIZE'])
+        cli_h, cli_w = size, size
+    else:
+        cli_h, cli_w = (256, 340) if on_accel else (64, 86)
     # batch sweep on v5e (lanes lookup): 8 → 26.9, 16 → 28.4, 32 → 28.8
     # clips/s; 16 takes nearly all of the win at half the HBM footprint
     batch = int(os.environ.get('BENCH_BATCH', 16 if on_accel else 1))
@@ -236,24 +263,50 @@ def run() -> dict:
     }, device)
 
     rungs = {}
-    headline_key = f'ingraph_{precision}'
+    # a BENCH_SIZE square override is NOT the CLI geometry — don't stamp
+    # it as such (the metric name would launder a crop-first number into
+    # the reconciled headline)
+    headline_key = (f'ingraph_cli_geom_{precision}'
+                    if not os.environ.get('BENCH_SIZE')
+                    else f'ingraph_{precision}')
     rungs[headline_key] = round(
         bench_ingraph(jax, ambient, pins, device, platform, params,
-                      stack, size, batch, iters), 3)
+                      stack, cli_h, cli_w, batch, iters), 3)
+    if on_accel and not os.environ.get('BENCH_SIZE'):
+        # secondary crop-first ceiling at 224² (the round-3/4 headline
+        # geometry, kept for cross-round comparability)
+        try:
+            rungs[f'ingraph_{precision}_224px'] = round(
+                bench_ingraph(jax, ambient, pins, device, platform, params,
+                              stack, 224, 224, batch, iters), 3)
+        except Exception as e:
+            rungs['ingraph_224px_error'] = f'{type(e).__name__}: {e}'
 
-    # Second north-star model (BASELINE.md): R(2+1)D. Its own precision
-    # ladder (tools/r21d_precision_study.py, v5e): 'mixed'(=high) drift
-    # 2.0e-4 ✅ parity / 'default' 3.1e-3 ✗ — so the same 'mixed' stamp is
-    # parity-grade here too.
-    from video_features_tpu.models import r21d as r21d_model
-    r21d_params = jax.device_put(
-        transplant(r21d_model.init_state_dict(arch='r2plus1d_18')), device)
-    try:
-        rungs[f'r21d_ingraph_{precision}'] = round(
-            bench_r21d_ingraph(jax, ambient, device, r21d_params,
-                               stack, iters, on_accel), 3)
-    except Exception as e:
-        rungs['r21d_ingraph_error'] = f'{type(e).__name__}: {e}'
+    # Per-family rungs through ONE shared harness (bench_family_ingraph),
+    # specs from tools/family_precision_study so bench and ladder tool
+    # measure the identical production steps. R(2+1)D is the second
+    # north-star model (BASELINE.md; ladder: 'mixed' drift 2.0e-4 ✅ /
+    # 'default' 3.1e-3 ✗) and always runs; the remaining BASELINE configs
+    # (s3d / resnet50 / clip / vggish + standalone raft at native flow
+    # resolution — VERDICT r4 task 6) run on accelerators by default,
+    # BENCH_FAMILIES=0/1 overrides.
+    sys.path.insert(0, str(Path(__file__).parent))
+    from tools.family_precision_study import _family_specs
+    all_families = (os.environ.get('BENCH_FAMILIES',
+                                   '1' if on_accel else '0') == '1')
+    for fam, spec in _family_specs(on_accel).items():
+        if fam != 'r21d' and not all_families:
+            continue
+        try:
+            init_fn, step_fn, bshape, unit, imap, count = spec
+            key = (f'r21d_ingraph_{precision}' if fam == 'r21d' else
+                   f'{fam}_ingraph_{precision}_{unit.split("/")[0]}')
+            rungs[key] = round(
+                bench_family_ingraph(jax, ambient, device, init_fn,
+                                     step_fn, bshape, imap, count, iters,
+                                     transplant), 3)
+        except Exception as e:
+            rungs[f'{fam}_ingraph_error'] = f'{type(e).__name__}: {e}'
 
     mode = os.environ.get('BENCH_MODE', 'both' if on_accel else 'ingraph')
     if mode in ('both', 'e2e'):
@@ -281,7 +334,7 @@ def run() -> dict:
     value = rungs[headline_key]
     return {
         'metric': f'i3d_two_stream_{headline_key}_clips_per_sec_'
-                  f'{platform}_stack{stack}_{size}px',
+                  f'{platform}_stack{stack}_{cli_h}x{cli_w}',
         'value': value,
         'unit': 'clips/sec/chip',
         'vs_baseline': round(value / BASELINE_CLIPS_PER_SEC, 3),

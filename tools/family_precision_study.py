@@ -39,18 +39,23 @@ LADDER = ('highest', 'high', 'default')
 
 
 def _family_specs(on_accel: bool):
-    """{name: (init_fn, step_fn, batch_shape, unit, input_map)} — step
-    fns are the extractors' own; input geometry AND value range mirror
-    what each step receives in production (decode-geometry 0-255 stacks
-    for the in-graph-resizing stack families, host-cropped 0-255 frames
-    for the frame-wise ones, log-mel-range examples for vggish —
-    input_map rescales the shared random tensor host-side)."""
+    """{name: (init_fn, step_fn, batch_shape, unit, input_map,
+    count_per_batch)} — step fns are the extractors' own; input geometry
+    AND value range mirror what each step receives in production
+    (decode-geometry 0-255 stacks for the in-graph-resizing stack
+    families, host-cropped 0-255 frames for the frame-wise ones,
+    log-mel-range examples for vggish — input_map rescales the shared
+    random tensor host-side). count_per_batch is the work-unit count one
+    step produces (None → batch_shape[0]; raft's B+1 frames make B
+    flows)."""
     from video_features_tpu.extract.clip import ExtractCLIP
     from video_features_tpu.extract.r21d import ExtractR21D
+    from video_features_tpu.extract.raft import ExtractRAFT
     from video_features_tpu.extract.resnet import ExtractResNet
     from video_features_tpu.extract.s3d import ExtractS3D
     from video_features_tpu.models import clip as clip_model
     from video_features_tpu.models import r21d as r21d_model
+    from video_features_tpu.models import raft as raft_model
     from video_features_tpu.models import resnet as resnet_model
     from video_features_tpu.models import s3d as s3d_model
     from video_features_tpu.models import vggish as vggish_model
@@ -75,33 +80,44 @@ def _family_specs(on_accel: bool):
     def log_mel_range(x):
         return x / 255.0 * 9.6 - 4.6
 
+    # raft-as-feature-type (flow fields out, reference models/raft/
+    # extract_raft.py:12-29): native-resolution geometry — the sample's
+    # 256x340 short-side-256 frame padded to /8 (256x344), B+1 frames in
+    # one extractor step -> B flows via forward_consecutive
+    raft_h, raft_w = (256, 344) if on_accel else (64, 88)
+    raft_b = (16 if on_accel else 2) + 1
+
     return {
         'r21d': (
             partial(r21d_model.init_state_dict, arch=r21d_arch),
             partial(ExtractR21D._forward_batch, arch=r21d_arch),
-            (b_stack, stack, h, w, 3), 'clips/sec', None),
+            (b_stack, stack, h, w, 3), 'clips/sec', None, None),
         's3d': (
             s3d_model.init_state_dict,
             partial(ExtractS3D._forward, resize_hw=s3d_hw,
                     resize_scale=s3d_scale),
-            (b_stack, stack, s3d_h, s3d_w, 3), 'clips/sec', None),
+            (b_stack, stack, s3d_h, s3d_w, 3), 'clips/sec', None, None),
         'resnet': (
             partial(resnet_model.init_state_dict, arch='resnet50'),
             partial(ExtractResNet._forward, arch='resnet50'),
-            (b_frame, px, px, 3), 'frames/sec', None),
+            (b_frame, px, px, 3), 'frames/sec', None, None),
         'clip': (
             partial(clip_model.init_state_dict, model_name='ViT-B/32'),
             partial(ExtractCLIP._forward, arch='ViT-B/32'),
-            (clip_b, clip_px, clip_px, 3), 'frames/sec', None),
+            (clip_b, clip_px, clip_px, 3), 'frames/sec', None, None),
         'vggish': (
             vggish_model.init_state_dict,
             vggish_model.forward,
-            (b_frame, 96, 64, 1), 'examples/sec', log_mel_range),
+            (b_frame, 96, 64, 1), 'examples/sec', log_mel_range, None),
+        'raft': (
+            raft_model.init_state_dict,
+            partial(ExtractRAFT._flow_batch, iters=raft_model.ITERS),
+            (raft_b, raft_h, raft_w, 3), 'flows/sec', None, raft_b - 1),
     }
 
 
 def run_family(name: str, init_fn, step_fn, batch_shape, unit,
-               input_map, iters: int) -> None:
+               input_map, count_per_batch, iters: int) -> None:
     import jax
     from jax import lax
 
@@ -131,7 +147,9 @@ def run_family(name: str, init_fn, step_fn, batch_shape, unit,
         t0 = time.perf_counter()
         feats = np.asarray(jitted(params, frames))
         elapsed = time.perf_counter() - t0
-        return feats, batch_shape[0] * iters / elapsed
+        count = (count_per_batch if count_per_batch is not None
+                 else batch_shape[0])
+        return feats, count * iters / elapsed
 
     base, _ = run('highest')
     for precision in LADDER:
