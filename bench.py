@@ -81,9 +81,13 @@ def bench_ingraph(jax, precision, pins, device, platform, params,
     from video_features_tpu.models import raft as raft_model
 
     rng = np.random.RandomState(0)
+    # uint8 device residents, cast in-graph: what production ships (the
+    # extractors keep frames uint8 until on device), and 4x less HBM for
+    # the iters-deep input buffer — the fp32 buffer pushed the v5e-8's
+    # 16G HBM over capacity at CLI geometry
     all_stacks = jax.device_put(
         rng.randint(0, 255, size=(iters, batch, stack + 1, h, w, 3))
-        .astype(np.float32), device)
+        .astype(np.uint8), device)
     pads = tuple(raft_model.pad_to_multiple(
         np.zeros((1, h, w, 1), np.float32))[1])
     kwargs = dict(pads=pads, streams=('rgb', 'flow'),
@@ -95,7 +99,8 @@ def bench_ingraph(jax, precision, pins, device, platform, params,
         # second full-graph executable
         def body(acc, stacks):
             with jax.default_matmul_precision(precision):
-                o = fused_two_stream_step(p, stacks, **kwargs)
+                o = fused_two_stream_step(p, jnp.asarray(stacks, jnp.float32),
+                                          **kwargs)
             return {k: acc[k] + o[k].sum() for k in acc}, None
         acc, _ = lax.scan(
             body, {k: jnp.float32(0) for k in kwargs['streams']}, xs)
@@ -148,16 +153,19 @@ def bench_family_ingraph(jax, ambient, device, init_fn, step_fn,
     return count * iters / elapsed
 
 
-def _bench_video(tmp_dir: str) -> str:
+def _bench_video(tmp_dir: str, seconds: str = None) -> str:
     """A local benchmark clip: the reference sample if present, else a
     synthetic one (tools/make_sample_video.py). ``BENCH_VIDEO=synthetic``
-    forces the synthetic clip and ``BENCH_E2E_SECONDS`` its length — the
-    contract smoke test uses a 1-stack clip so the e2e path stays cheap
-    on CPU."""
+    forces the synthetic clip and ``seconds`` (default
+    ``BENCH_E2E_SECONDS``) its length — the contract smoke test uses a
+    1-stack clip so the e2e path stays cheap on CPU. Also the ONE source
+    of clip selection for tools/worklist_bench.py, so the e2e and
+    worklist rungs always measure the same content."""
     ref = Path('/root/reference/sample/v_GGSY1Qvo990.mp4')
     if ref.exists() and os.environ.get('BENCH_VIDEO') != 'synthetic':
         return str(ref)
-    seconds = os.environ.get('BENCH_E2E_SECONDS', '10')
+    if seconds is None:
+        seconds = os.environ.get('BENCH_E2E_SECONDS', '10')
     out = Path(tmp_dir) / 'synth' / 'sample_moving_pattern.mp4'
     if not out.exists():
         import subprocess
@@ -323,6 +331,26 @@ def run() -> dict:
                               platform, feature_type='r21d', key='r21d'), 3)
             except Exception as e:
                 rungs['r21d_e2e_error'] = f'{type(e).__name__}: {e}'
+            # Sustained multi-video worklist (resume contract + prefetch
+            # + decode overlap live — the corpus-scale number, VERDICT r4
+            # task 5); BENCH_WORKLIST=0/1 overrides.
+            if os.environ.get('BENCH_WORKLIST',
+                              '1' if on_accel else '0') == '1':
+                try:
+                    from tools.worklist_bench import (
+                        make_worklist, run_worklist,
+                    )
+                    paths = make_worklist(tmp_dir, 4 if on_accel else 2,
+                                          10 if on_accel else 2)
+                    wrec = run_worklist('i3d', paths, tmp_dir, tmp_dir,
+                                        platform, batch_size=min(batch, 8),
+                                        stack=stack, precision=precision)
+                    rungs[f'worklist_videos_per_min_{precision}'] = \
+                        wrec['videos_per_min']
+                    rungs[f'worklist_clips_per_sec_{precision}'] = \
+                        wrec['clips_per_sec']
+                except Exception as e:
+                    rungs['worklist_error'] = f'{type(e).__name__}: {e}'
     if mode == 'e2e' and f'e2e_{precision}' in rungs:
         headline_key = f'e2e_{precision}'
 
