@@ -105,22 +105,20 @@ def short_video(tmp_path_factory) -> str:
     return out
 
 
-@pytest.fixture(scope='session')
-def video_33(tmp_path_factory) -> str:
-    """A 33-frame clip: exactly two stack_size=16 windows (2·16+1 frames)
-    for the end-to-end golden parity tests."""
+def _clip_from_sample(tmp_path_factory, n_frames: int, tag: str) -> str:
+    """First ``n_frames`` of the reference sample, re-encoded via cv2."""
     import cv2
 
     src = REFERENCE_ROOT / 'sample' / 'v_ZNVhz7ctTq0.mp4'
     if not src.exists():
         pytest.skip('sample video unavailable')
-    out = str(tmp_path_factory.mktemp('vids33') / 'clip33.mp4')
+    out = str(tmp_path_factory.mktemp(tag) / f'clip{n_frames}.mp4')
     cap = cv2.VideoCapture(str(src))
     fps = cap.get(cv2.CAP_PROP_FPS)
     w = int(cap.get(cv2.CAP_PROP_FRAME_WIDTH))
     h = int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT))
     writer = cv2.VideoWriter(out, cv2.VideoWriter_fourcc(*'mp4v'), fps, (w, h))
-    for _ in range(33):
+    for _ in range(n_frames):
         ok, frame = cap.read()
         if not ok:
             break
@@ -128,6 +126,21 @@ def video_33(tmp_path_factory) -> str:
     writer.release()
     cap.release()
     return out
+
+
+@pytest.fixture(scope='session')
+def video_33(tmp_path_factory) -> str:
+    """A 33-frame clip: exactly two stack_size=16 windows (2·16+1 frames)
+    for the end-to-end golden parity tests."""
+    return _clip_from_sample(tmp_path_factory, 33, 'vids33')
+
+
+@pytest.fixture(scope='session')
+def video_65(tmp_path_factory) -> str:
+    """A 65-frame clip: exactly one stack_size=64 window (64+1 frames) —
+    upstream's documented default stack (reference docs/models/i3d.md:15-18),
+    for the published-geometry golden."""
+    return _clip_from_sample(tmp_path_factory, 65, 'vids65')
 
 
 @pytest.fixture(scope='session')

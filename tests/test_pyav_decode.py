@@ -1,0 +1,115 @@
+"""PyAV (torchvision.io) vs our decode — the r21d/s3d decode-backend row.
+
+The reference decodes the r21d and s3d families through
+``torchvision.io.read_video`` (PyAV) rather than cv2
+(reference models/r21d/extract_r21d.py:72, models/s3d/extract_s3d.py:63),
+while every golden in this repo re-composes the reference side over cv2
+decode (torchvision is absent in the dev environment). PyAV-vs-cv2 frame
+divergence is exactly the class of delta that measured 2.9e-3 on the
+round-4 native-decode row — these tests quantify it for the two families
+where the reference actually uses PyAV (VERDICT r4 task 7).
+
+Runs where torchvision IS installed (the CI full lane installs it —
+.github/workflows/ci.yml); self-skips elsewhere. The clip is the
+reference sample when that checkout exists, else a locally-synthesized
+H.264-free mp4 (cv2.VideoWriter) — so the tests RUN in CI rather than
+silently skipping on the missing reference checkout. Both the
+frame-level delta and the feature-level delta through the r21d step are
+measured and printed, and asserted at documentation bands (frame deltas
+are expected to be small-but-nonzero: PyAV's decode is spec-exact like
+libavcodec's, so any difference is YUV→RGB conversion rounding, the same
+mechanism as the native-decode row — see docs/design.md).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torchvision = pytest.importorskip('torchvision')
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope='module')
+def decode_clip(tmp_path_factory):
+    """The reference sample when present, else a synthetic mp4 — never a
+    skip, so CI (no reference checkout) still exercises the comparison."""
+    from tests.conftest import REFERENCE_ROOT
+
+    sample = REFERENCE_ROOT / 'sample' / 'v_ZNVhz7ctTq0.mp4'
+    if sample.exists():
+        return str(sample)
+    import cv2
+    out = str(tmp_path_factory.mktemp('pyav') / 'clip.mp4')
+    rng = np.random.RandomState(3)
+    h, w = 240, 320
+    wr = cv2.VideoWriter(out, cv2.VideoWriter_fourcc(*'mp4v'), 25, (w, h))
+    base = rng.randint(0, 256, (h, w, 3), np.uint8)
+    for t in range(40):
+        wr.write(np.roll(base, 3 * t, axis=1))
+    wr.release()
+    return out
+
+
+@pytest.fixture(scope='module')
+def frame_pair(decode_clip):
+    """(pyav_frames, our_frames) uint8 RGB for the same clip, equal-length
+    prefix."""
+    tv_frames, _, _ = torchvision.io.read_video(
+        decode_clip, pts_unit='sec', output_format='THWC')
+    tv_frames = tv_frames.numpy()
+
+    from video_features_tpu.io.video import VideoLoader
+    ours = [f for batch, _, _ in VideoLoader(decode_clip, batch_size=64)
+            for f in batch]
+    n = min(len(tv_frames), len(ours), 64)
+    assert n >= 17, f'too few frames decoded: {n}'
+    return tv_frames[:n], np.stack(ours[:n])
+
+
+def test_pyav_frame_delta_quantified(frame_pair):
+    """Frame-level PyAV-vs-ours delta: measured, printed, and bounded.
+
+    Zero would mean torchvision's PyAV build converts YUV→RGB with the
+    same integer tables cv2 does (both bundle FFmpeg); small-nonzero
+    means conversion rounding exactly like the round-4 native-decode
+    analysis predicts. Either way the number is on record, and a LARGE
+    delta (mean > 2 levels / any pixel > 64) would indicate a real
+    decode divergence worth a golden re-run with this backend."""
+    tv, ours = frame_pair
+    assert tv.shape == ours.shape
+    d = np.abs(tv.astype(np.int16) - ours.astype(np.int16))
+    stats = dict(mean=float(d.mean()), max=int(d.max()),
+                 frac_nonzero=float((d > 0).mean()))
+    print(f'[pyav] frame delta vs our decode: {stats}')
+    assert stats['mean'] <= 2.0, stats
+    assert stats['max'] <= 64, stats
+
+
+def test_pyav_feature_delta_r21d(frame_pair):
+    """Feature-level cost of the PyAV-vs-ours frame delta through the
+    r21d production step (the family the reference feeds from PyAV):
+    both frame sets run the IDENTICAL step + seeded weights, so the only
+    difference is the decode. Held to the 1e-3 parity bar — if this
+    fails, the decode-backend divergence is feature-relevant and the
+    r21d/s3d goldens need a PyAV-side recomposition."""
+    import jax
+
+    from video_features_tpu.extract.r21d import ExtractR21D
+    from video_features_tpu.models import r21d as r21d_model
+    from video_features_tpu.transplant.torch2jax import transplant
+
+    tv, ours = frame_pair
+    stack = 16
+    params = transplant(r21d_model.init_state_dict(arch='r2plus1d_18'))
+    step = jax.jit(lambda p, x: ExtractR21D._forward_batch(
+        p, x, arch='r2plus1d_18'))
+
+    def feats(frames):
+        batch = frames[:stack][None].astype(np.float32)
+        return np.asarray(step(params, batch))
+
+    fa, fb = feats(tv), feats(ours)
+    rel = np.linalg.norm(fa - fb) / max(np.linalg.norm(fb), 1e-12)
+    print(f'[pyav] r21d feature rel L2 (decode-backend delta): {rel:.3e}')
+    assert rel < 1e-3, f'PyAV decode diverges at feature level: {rel}'

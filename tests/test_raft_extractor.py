@@ -78,3 +78,61 @@ def test_raft_iters_knob(short_video, tmp_path):
     assert few.shape == full.shape
     assert np.isfinite(few).all() and np.isfinite(full).all()
     assert not np.allclose(few, full)      # depth changes the refinement
+
+
+@pytest.mark.slow
+def test_bucket_multiple_shares_executables(short_video, tmp_path):
+    """bucket_multiple=64 rounds the replicate-pad to coarse buckets so
+    near-alike resolutions share ONE compiled step (shapes are static
+    per jit — without bucketing every distinct source geometry is a
+    fresh multi-minute compile). Checks (a) two different side_size
+    geometries land in one executable, (b) outputs keep their exact
+    source geometries, and (c) the measured flow delta vs the
+    reference-exact /8 pad (the cost of the wider visible pad) is on
+    record."""
+    def run(side, bucket, tag):
+        args = load_config('raft', overrides={
+            'video_paths': short_video, 'device': 'cpu', 'batch_size': 4,
+            'extraction_total': 5, 'side_size': side,
+            'raft_iters': 2, 'allow_random_weights': True,
+            'bucket_multiple': bucket,
+            'output_path': str(tmp_path / f'o{tag}'),
+            'tmp_path': str(tmp_path / f't{tag}'),
+        })
+        ex = create_extractor(args)
+        return ex, ex.extract(short_video)['raft']
+
+    # short_video is 320x240: side 96 -> 96x128 frames, side 90 -> 90x120;
+    # both round up to 128x128 at bucket 64 (one executable), while at
+    # the reference /8 pad they are distinct padded shapes (96x128 is
+    # already /8; 90x120 pads to 96x120)
+    ex96, flow96 = run(96, 64, 'b96')
+    assert ex96._step._cache_size() == 1
+    _, flow90 = run(90, 64, 'b90')
+    # same underlying jit cache only if it's the same Extractor instance;
+    # instead assert via a single instance processing both geometries
+    args = load_config('raft', overrides={
+        'video_paths': short_video, 'device': 'cpu', 'batch_size': 4,
+        'extraction_total': 5, 'raft_iters': 2,
+        'allow_random_weights': True, 'bucket_multiple': 64,
+        'output_path': str(tmp_path / 'oshared'),
+        'tmp_path': str(tmp_path / 'tshared'),
+    })
+    ex = create_extractor(args)
+    for side in (96, 90):
+        ex.side_size = side
+        ex.extract(short_video)
+    assert ex._step._cache_size() == 1, (
+        'bucketed geometries must share one compiled executable')
+
+    # geometry contract: outputs keep exact source dims
+    assert flow96.shape[2:] == (96, 128)
+    assert flow90.shape[2:] == (90, 120)
+
+    # numeric cost vs the reference-exact /8 pad, on record
+    _, flow96_ref = run(96, 8, 'ref96')
+    assert flow96.shape == flow96_ref.shape
+    rel = (np.linalg.norm(flow96 - flow96_ref)
+           / max(np.linalg.norm(flow96_ref), 1e-12))
+    print(f'[bucket] flow rel L2 bucket64 vs /8 pad: {rel:.3e}')
+    assert np.isfinite(rel)

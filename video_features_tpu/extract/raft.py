@@ -60,6 +60,17 @@ class ExtractRAFT(BaseExtractor):
         self.finetuned_on = args.get('finetuned_on', 'sintel')
         assert self.finetuned_on in FINETUNED_CKPTS, \
             f'finetuned_on must be one of {FINETUNED_CKPTS}'
+        # Shapes are static per jit: every distinct padded geometry is a
+        # fresh multi-minute compile (docs/design.md "one jit step per
+        # video geometry"). bucket_multiple > 8 rounds the replicate-pad
+        # up to coarser buckets so a heterogeneous corpus shares
+        # executables (e.g. 64 → 256×342 and 256×344 both run 256×384).
+        # Opt-in because wider replicate pads ARE visible to the flow
+        # numerics near borders (the padding participates in correlation
+        # and context) — measured in tests/test_raft_extractor.py.
+        self.bucket_multiple = int(args.get('bucket_multiple', 8))
+        assert self.bucket_multiple % 8 == 0 and self.bucket_multiple > 0, \
+            'bucket_multiple must be a positive multiple of 8'
         self.show_pred = args.show_pred
         self.output_feat_keys = [self.feature_type, 'fps', 'timestamps_ms']
         # data_parallel=true spreads the B consecutive-pair flows over all
@@ -166,7 +177,8 @@ class ExtractRAFT(BaseExtractor):
                         axis=0)
                     batch = np.concatenate([batch, pad], axis=0)
                 padded, pads = raft_model.pad_to_multiple(
-                    batch, mode=self.finetuned_on)
+                    batch, mode=self.finetuned_on,
+                    multiple=self.bucket_multiple)
                 yield padded, pads, valid, ts
 
         def put(padded):
