@@ -481,8 +481,6 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
     (ops/precision.py): 'corr', 'iter', 'upsample'."""
     from video_features_tpu.ops.precision import pin_scope
     platform = platform or jax.default_backend()
-    with pin_scope(pins, 'corr'):
-        pyramid = build_corr_pyramid(fmap1, fmap2)
     net, inp = jnp.split(cnet, [HIDDEN_DIM], axis=-1)
     net = jnp.tanh(net)
     inp = relu(inp)
@@ -497,23 +495,31 @@ def _refine(params: Params, fmap1: jax.Array, fmap2: jax.Array,
     impl = _lookup_impl()
     if impl == 'auto':
         impl = _resolve_auto_lookup(H8, W8, platform)
-    if impl in ('pallas', 'lanes'):
+    if impl == 'lanes':
+        # lane-layout pyramid built straight from the fmaps: the
+        # (N, h, w) detour + physical transpose was the fixed phase's
+        # single worst HBM pattern (see prep_pyramid_lanes_fused)
         from video_features_tpu.ops import pallas_corr
-        prep_fn, lookup_fn = {
-            'pallas': (partial(pallas_corr.prep_pyramid, radius=CORR_RADIUS),
-                       pallas_corr.lookup_corr),
-            'lanes': (pallas_corr.prep_pyramid_lanes,
-                      pallas_corr.lookup_corr_lanes),
-        }[impl]
-        interp = platform != 'tpu'
         with pin_scope(pins, 'corr'):
-            prepped = prep_fn(pyramid)
-        lookup = partial(lookup_fn, prepped,
-                         radius=CORR_RADIUS, interpret=interp)
-    elif impl == 'gather':
-        lookup = partial(lookup_corr, pyramid)
+            prepped = pallas_corr.prep_pyramid_lanes_fused(
+                fmap1, fmap2, levels=CORR_LEVELS)
+        lookup = partial(pallas_corr.lookup_corr_lanes, prepped,
+                         radius=CORR_RADIUS, interpret=platform != 'tpu')
     else:
-        lookup = partial(lookup_corr_dense, pyramid)
+        with pin_scope(pins, 'corr'):
+            pyramid = build_corr_pyramid(fmap1, fmap2)
+        if impl == 'pallas':
+            from video_features_tpu.ops import pallas_corr
+            with pin_scope(pins, 'corr'):
+                prepped = pallas_corr.prep_pyramid(pyramid,
+                                                   radius=CORR_RADIUS)
+            lookup = partial(pallas_corr.lookup_corr, prepped,
+                             radius=CORR_RADIUS,
+                             interpret=platform != 'tpu')
+        elif impl == 'gather':
+            lookup = partial(lookup_corr, pyramid)
+        else:
+            lookup = partial(lookup_corr_dense, pyramid)
 
     fh, mk = up['flow_head'], up['mask']
     gru = fuse_gru_params(up['gru'])

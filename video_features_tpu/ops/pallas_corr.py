@@ -199,6 +199,40 @@ def prep_pyramid_lanes(pyramid: Sequence[jax.Array]) -> List[jax.Array]:
     return out
 
 
+def prep_pyramid_lanes_fused(fmap1: jax.Array, fmap2: jax.Array,
+                             levels: int = 4) -> List[jax.Array]:
+    """Feature maps → lane-layout pyramid DIRECTLY, no (N, h, w) detour.
+
+    ``build_corr_pyramid`` + :func:`prep_pyramid_lanes` materializes the
+    ~2 GB level-0 volume in (N, h, w) layout and then physically
+    transposes it to the kernel's (h, w, N') layout — the worst HBM
+    access pattern in the fused step (measured 106 ms of the 362 ms
+    fixed phase at batch-16 CLI geometry, vs a ~10-20 ms traffic floor;
+    docs/benchmarks.md "The RAFT fixed phase, floored"). Emitting the
+    einsum straight into (h, w, b·n) order and average-pooling over the
+    LEADING axes (lane dim stays minor, so the pool is sequential HBM
+    traffic) removes the transpose: 106 → 75 ms measured, bit-close
+    (1e-9-class reassociation noise vs the two-step path, pinned by
+    tests/test_pallas_corr.py).
+    """
+    B, H, W, D = fmap1.shape
+    f1 = fmap1.reshape(B, H * W, D)
+    corr_t = jnp.einsum('bnd,bhwd->hwbn', f1, fmap2) / jnp.sqrt(
+        jnp.asarray(D, fmap1.dtype))
+    corr_t = corr_t.reshape(H, W, B * H * W)
+    pad = -corr_t.shape[-1] % LANES
+    corr_t = jnp.pad(corr_t, [(0, 0), (0, 0), (0, pad)])
+    out = [corr_t]
+    for _ in range(levels - 1):
+        h, w, n = corr_t.shape
+        h2, w2 = h // 2, w // 2
+        # valid 2×2/stride-2 mean — identical to avg_pool's window set
+        # (odd trailing row/col dropped)
+        corr_t = corr_t[:h2 * 2, :w2 * 2].reshape(h2, 2, w2, 2, n).mean((1, 3))
+        out.append(corr_t)
+    return out
+
+
 def _lanes_kernel(p1: int, h: int, w: int):
     """Kernel over one level, one 128-pixel lane tile; p1 = 2r+1."""
     p2 = p1 + 1
