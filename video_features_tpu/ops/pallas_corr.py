@@ -201,19 +201,29 @@ def prep_pyramid_lanes(pyramid: Sequence[jax.Array]) -> List[jax.Array]:
 
 def prep_pyramid_lanes_fused(fmap1: jax.Array, fmap2: jax.Array,
                              levels: int = 4) -> List[jax.Array]:
-    """Feature maps → lane-layout pyramid DIRECTLY, no (N, h, w) detour.
+    """Feature maps → lane-layout pyramid DIRECTLY, no (N, h, w) detour
+    and no giant-volume pooling.
 
-    ``build_corr_pyramid`` + :func:`prep_pyramid_lanes` materializes the
-    ~2 GB level-0 volume in (N, h, w) layout and then physically
-    transposes it to the kernel's (h, w, N') layout — the worst HBM
-    access pattern in the fused step (measured 106 ms of the 362 ms
-    fixed phase at batch-16 CLI geometry, vs a ~10-20 ms traffic floor;
-    docs/benchmarks.md "The RAFT fixed phase, floored"). Emitting the
-    einsum straight into (h, w, b·n) order and average-pooling over the
-    LEADING axes (lane dim stays minor, so the pool is sequential HBM
-    traffic) removes the transpose: 106 → 75 ms measured, bit-close
-    (1e-9-class reassociation noise vs the two-step path, pinned by
-    tests/test_pallas_corr.py).
+    Two compounding reformulations over ``build_corr_pyramid`` +
+    :func:`prep_pyramid_lanes` (which materialized the ~2 GB level-0
+    volume in (N, h, w) layout, physically transposed it to the kernel's
+    (h, w, N') layout, then average-pooled the volume three times — the
+    worst HBM pattern in the fused step, 106.8 ms of the 362 ms fixed
+    phase at batch-16 CLI geometry vs a ~10-20 ms traffic floor):
+
+    The einsum emits straight into (h, w, b·n) lane order and the
+    levels pool over the LEADING axes (lane dim stays minor, sequential
+    HBM traffic): 106.8 → 74.8 ms isolated, headline 9.44 → 9.69
+    clips/s. Same valid 2×2/stride-2 window set as ``avg_pool`` (odd
+    trailing row/col dropped); numerics at 1e-9-class reassociation
+    noise vs the two-step path, pinned by tests/test_pallas_corr.py.
+
+    Tried and rejected: pooling commutes with the dot product, so each
+    level can be computed as ⟨f1, avgpool^L(fmap2)⟩ with no giant-volume
+    pooling at all — 74.8 → 32.1 ms ISOLATED, but 9.69 → 9.53 clips/s
+    in the fused step (consistent across runs): re-reading the ~360 MB
+    f1 operand for four einsums costs the composed graph more than the
+    volume pooling it saves. End-to-end wins; the isolated number lies.
     """
     B, H, W, D = fmap1.shape
     f1 = fmap1.reshape(B, H * W, D)
@@ -226,8 +236,6 @@ def prep_pyramid_lanes_fused(fmap1: jax.Array, fmap2: jax.Array,
     for _ in range(levels - 1):
         h, w, n = corr_t.shape
         h2, w2 = h // 2, w // 2
-        # valid 2×2/stride-2 mean — identical to avg_pool's window set
-        # (odd trailing row/col dropped)
         corr_t = corr_t[:h2 * 2, :w2 * 2].reshape(h2, 2, w2, 2, n).mean((1, 3))
         out.append(corr_t)
     return out
