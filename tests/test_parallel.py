@@ -86,9 +86,13 @@ def test_sharded_two_stream_step_matches_single_device():
                                    rtol=2e-5, atol=2e-5)
 
 
-def test_extractor_data_parallel_e2e(short_video, tmp_path):
+@pytest.mark.parametrize('device_resize', [False, True],
+                         ids=['host-resize', 'device-resize'])
+def test_extractor_data_parallel_e2e(short_video, tmp_path, device_resize):
     """ExtractI3D(data_parallel=true) runs the mesh-sharded step from the
-    normal extract() path and matches the single-device extractor."""
+    normal extract() path and matches the single-device extractor — with
+    the host PIL resize and (round 5) with the bit-exact in-graph resize,
+    which is per-sample work that composes with the data sharding."""
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
 
@@ -96,7 +100,7 @@ def test_extractor_data_parallel_e2e(short_video, tmp_path):
         'video_paths': short_video, 'device': 'cpu',
         'streams': 'rgb',                       # rgb-only keeps CPU cost low
         'stack_size': 16, 'step_size': 16,
-        'concat_rgb_flow': False,
+        'concat_rgb_flow': False, 'device_resize': device_resize,
         'output_path': str(tmp_path / 'out'), 'tmp_path': str(tmp_path / 'tmp'),
     }
     dp = create_extractor(load_config('i3d', overrides={

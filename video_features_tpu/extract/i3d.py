@@ -198,11 +198,6 @@ class ExtractI3D(BaseExtractor):
         # time axis) — the reference's only scale-out is launching one
         # process per GPU (reference README.md:70-84)
         self.data_parallel = args.get('data_parallel', False)
-        if self.data_parallel and self.device_resize:
-            raise NotImplementedError(
-                'device_resize with data_parallel is not wired up yet — '
-                'host resize (device_resize=false) composes with the '
-                'sharded step')
         if self.data_parallel:
             from video_features_tpu.parallel import (
                 build_sharded_two_stream_step, make_mesh, put_batch,
@@ -223,8 +218,9 @@ class ExtractI3D(BaseExtractor):
                 pins=self.precision_pins, raft_iters=self.raft_iters)
 
             def _step(params, stacks, pads, streams, resize_to=None):
-                assert resize_to is None  # guarded in __init__
-                return sharded(params, stacks, pads)
+                return sharded(params, stacks, pads,
+                               resize_to=tuple(resize_to)
+                               if resize_to is not None else None)
 
             self._step = _step
         else:

@@ -30,14 +30,19 @@ def build_sharded_two_stream_step(mesh: Mesh,
                                   streams: Tuple[str, ...] = ('rgb', 'flow'),
                                   donate_stacks: bool = False,
                                   pins=None, raft_iters=None):
-    """jit-compiled ``step(params, stacks, pads, crop_size=…)`` over ``mesh``.
+    """jit-compiled ``step(params, stacks, pads, crop_size=…,
+    resize_to=…)`` over ``mesh``.
 
     ``stacks`` is (B, stack+1, H, W, 3) with B divisible by the data-axis
     size; ``pads`` is the static (top, bottom, left, right) /8 padding tuple
-    from raft.pad_to_multiple. Returns {stream: (B, 1024)} replicated.
+    from raft.pad_to_multiple; ``resize_to`` (static; None = off) runs the
+    bit-exact in-graph PIL resize (device_resize) before everything else —
+    per-sample work that composes with the data sharding, though each
+    distinct (pads, crop_size, resize_to) triple is its own executable.
+    Returns {stream: (B, 1024)} replicated.
 
     pjit rejects kwargs when in_shardings is given, so the static args are
-    positional here (argnums 2/3) and ``streams`` is baked per-build.
+    positional here (argnums 2/3/4) and ``streams`` is baked per-build.
     """
     def constrain_pairs(t: jax.Array) -> jax.Array:
         return jax.lax.with_sharding_constraint(t, pair_sharding(mesh))
@@ -46,23 +51,26 @@ def build_sharded_two_stream_step(mesh: Mesh,
     # corr-lookup dispatch from them, not the process default backend
     platform = mesh.devices.flat[0].platform
 
-    def step(params, stacks, pads, crop_size):
+    def step(params, stacks, pads, crop_size, resize_to):
         kw = {} if raft_iters is None else {'raft_iters': raft_iters}
         return fused_two_stream_step(params, stacks, pads, streams,
                                      constrain_pairs=constrain_pairs,
                                      crop_size=crop_size, platform=platform,
-                                     pins=pins, **kw)
+                                     pins=pins, resize_to=resize_to, **kw)
 
     jitted = jax.jit(
         step,
-        static_argnums=(2, 3),
+        static_argnums=(2, 3, 4),
         in_shardings=(replicated(mesh), batch_sharding(mesh)),
         out_shardings=replicated(mesh),
         donate_argnums=(1,) if donate_stacks else (),
     )
 
-    def call(params, stacks, pads, crop_size=224):
-        return jitted(params, stacks, pads, crop_size)
+    def call(params, stacks, pads, crop_size=224, resize_to=None):
+        # resize_to: the in-graph bit-exact PIL resize (device_resize) —
+        # per-sample work, so it composes with the data sharding with no
+        # extra collectives
+        return jitted(params, stacks, pads, crop_size, resize_to)
 
     return call
 
