@@ -49,6 +49,10 @@ struct Decoder {
   int width = 0;    // coded geometry (sws output)
   int height = 0;
   int rotation = 0;  // clockwise degrees to apply for display (0/90/180/270)
+  // last colorspace details applied to `sws` (avoid per-frame re-derivation)
+  AVColorSpace sws_colorspace = AVCOL_SPC_NB;
+  AVColorRange sws_range = AVCOL_RANGE_NB;
+  bool sws_details_warned = false;
   unsigned char* stage = nullptr;  // aligned sws_scale target (see emit_rgb)
   double fps = 0.0;
   long num_frames = 0;
@@ -143,15 +147,61 @@ bool open_impl(Decoder* d, const char* path) {
 // ~1% of pixels (measured; BITEXACT alone did NOT fix it). BITEXACT rides
 // along to additionally pin dithering/rounding across CPU architectures.
 // The accurate-rounding paths are alignment-independent and fully
-// deterministic; they sit within a few levels of cv2's conversion (mean <1
-// level on real content — cv2 runs the alignment-dependent SIMD paths, so
-// exact equality with it is not reproducible anyway).
+// deterministic.
+//
+// This is the FALLBACK converter (everything the cv2-exact table path
+// declines: tagged non-601 matrices, 10-bit, 4:2:2, full-range). It
+// honors the frame's tagged colorspace/range via sws_setColorspaceDetails
+// — a metadata-aware cv2 does the same, so e.g. BT.709-tagged HD content
+// converts with 709 coefficients on both sides (within swscale-generation
+// rounding, ~1 level), instead of silently using 601.
 bool ensure_sws(Decoder* d, AVPixelFormat src_fmt) {
+  SwsContext* prev = d->sws;
   d->sws = sws_getCachedContext(d->sws, d->width, d->height, src_fmt,
                                 d->width, d->height, AV_PIX_FMT_RGB24,
                                 SWS_BILINEAR | SWS_BITEXACT | SWS_ACCURATE_RND,
                                 nullptr, nullptr, nullptr);
-  return d->sws != nullptr;
+  if (!d->sws) return false;
+  // Re-derive the coefficient tables only when the context was rebuilt or
+  // the frame's tags changed — sws_setColorspaceDetails regenerates
+  // yuv2rgb tables, which must not run per frame in the decode hot loop.
+  if (d->sws == prev && d->frame->colorspace == d->sws_colorspace &&
+      d->frame->color_range == d->sws_range)
+    return true;
+  d->sws_colorspace = d->frame->colorspace;
+  d->sws_range = d->frame->color_range;
+  int cs = SWS_CS_ITU601;
+  switch (d->frame->colorspace) {
+    case AVCOL_SPC_BT709: cs = SWS_CS_ITU709; break;
+    case AVCOL_SPC_SMPTE240M: cs = SWS_CS_SMPTE240M; break;
+    case AVCOL_SPC_BT2020_NCL:
+    case AVCOL_SPC_BT2020_CL: cs = SWS_CS_BT2020; break;
+    default: break;
+  }
+  // Deprecated YUVJ* formats carry full range IN the format; honor it
+  // when the frame's own range tag is unspecified (a remux can strip the
+  // tag while the J format survives) — pre-round-5 handle_jpeg behavior.
+  const bool j_fmt = src_fmt == AV_PIX_FMT_YUVJ420P ||
+                     src_fmt == AV_PIX_FMT_YUVJ422P ||
+                     src_fmt == AV_PIX_FMT_YUVJ444P ||
+                     src_fmt == AV_PIX_FMT_YUVJ440P ||
+                     src_fmt == AV_PIX_FMT_YUVJ411P;
+  const int src_full =
+      (d->frame->color_range == AVCOL_RANGE_JPEG ||
+       (d->frame->color_range == AVCOL_RANGE_UNSPECIFIED && j_fmt)) ? 1 : 0;
+  if (sws_setColorspaceDetails(d->sws, sws_getCoefficients(cs), src_full,
+                               sws_getCoefficients(cs), 1 /* RGB full */,
+                               0, 1 << 16, 1 << 16) < 0 &&
+      !d->sws_details_warned) {
+    // -1 = this converter path ignores details: conversion proceeds with
+    // swscale defaults. Surface it once — a silently-601 tagged stream
+    // is exactly the failure mode the colorspace handling exists to stop.
+    fprintf(stderr,
+            "vfdecode: sws_setColorspaceDetails unsupported for this "
+            "format; converting with swscale defaults\n");
+    d->sws_details_warned = true;
+  }
+  return true;
 }
 
 // Rotate an RGB24 image by d->rotation degrees clockwise: src is coded

@@ -249,3 +249,86 @@ def test_decode_deterministic_odd_width(tmp_path):
     for x, y in ((a, b), (a, c)):
         for fa, fb in zip(x, y):
             np.testing.assert_array_equal(fa, fb)
+
+
+def _insert_colr_bt709(src: str, dst: str) -> None:
+    """Append a bt709 'colr' (nclx) box to the mp4v sample entry and fix
+    ancestor box sizes — tags the stream BT.709 without re-encoding."""
+    import struct
+
+    data = bytearray(open(src, 'rb').read())
+
+    def walk(buf, start, end, path=()):
+        off = start
+        while off + 8 <= end:
+            size, = struct.unpack('>I', buf[off:off + 4])
+            typ = bytes(buf[off + 4:off + 8])
+            if size < 8:
+                break
+            yield path + (typ,), off, size
+            if typ in (b'moov', b'trak', b'mdia', b'minf', b'stbl', b'stsd'):
+                body = off + 8 + (8 if typ == b'stsd' else 0)
+                yield from walk(buf, body, off + size, path + (typ,))
+            off += size
+
+    entries = [(o, s) for p, o, s in walk(data, 0, len(data))
+               if p[-1] == b'mp4v']
+    assert entries, 'no mp4v sample entry found'
+    off, size = entries[0]
+    colr = (struct.pack('>I', 19) + b'colr' + b'nclx'
+            + struct.pack('>HHH', 1, 1, 1) + bytes([0]))
+    new = bytearray(data[:off + size]) + colr + data[off + size:]
+    for p, o, s in walk(data, 0, len(data)):
+        if o <= off < o + s and p[-1] in (b'moov', b'trak', b'mdia', b'minf',
+                                          b'stbl', b'stsd', b'mp4v'):
+            cur, = struct.unpack('>I', bytes(new[o:o + 4]))
+            struct.pack_into('>I', new, o, cur + 19)
+    open(dst, 'wb').write(bytes(new))
+
+
+@needs_native
+def test_bt709_tagged_falls_back_and_tracks_cv2(tmp_path):
+    """A BT.709-tagged stream must NOT go through the BT.601-fitted
+    tables: the guard routes it to the swscale fallback, which honors the
+    tagged matrix via sws_setColorspaceDetails (like a metadata-aware
+    cv2). On smooth content the fallback sits within ~1 level of cv2
+    (swscale-generation + chroma-interpolation rounding); using the 601
+    tables here would be off by up to ~20 levels on saturated colors.
+
+    The clip is a smooth gradient (nearest-vs-bilinear chroma
+    upsampling, the dominant fallback-vs-cv2 difference, is tiny on
+    smooth chroma; on noise it dominates and proves nothing about the
+    matrix)."""
+    import cv2
+    base = str(tmp_path / 'grad.mp4')
+    tagged = str(tmp_path / 'grad709.mp4')
+    w, h = 64, 48
+    wr = cv2.VideoWriter(base, cv2.VideoWriter_fourcc(*'mp4v'), 25, (w, h))
+    gx = np.linspace(0, 255, w)[None, :]
+    gy = np.linspace(0, 255, h)[:, None]
+    for t in range(6):
+        f = np.stack([np.broadcast_to(gx, (h, w)),
+                      np.broadcast_to(gy, (h, w)),
+                      np.full((h, w), 40 * t)], -1).astype(np.uint8)
+        wr.write(f)
+    wr.release()
+    _insert_colr_bt709(base, tagged)
+
+    def decode_both(path):
+        nat = [f.copy() for _, f in native.NativeFrameDecoder(path)]
+        cv = [f for _, f in Cv2FrameDecoder(path)]
+        assert len(nat) == len(cv) > 0
+        return np.stack(nat).astype(np.int16), np.stack(cv).astype(np.int16)
+
+    # untagged: the 601 tables, bit-exact
+    n0, c0 = decode_both(base)
+    np.testing.assert_array_equal(n0, c0)
+    # tagged: swscale fallback with 709 coefficients, close to cv2's 709
+    n1, c1 = decode_both(tagged)
+    d = np.abs(n1 - c1)
+    print(f'[bt709] fallback vs cv2: mean {d.mean():.3f} max {int(d.max())}')
+    assert d.mean() < 2.5, d.mean()
+    # and the tag MATTERS: cv2's own 709 output differs from its 601
+    # output, so a guard regression (tables on tagged content) would
+    # show up as a much larger native-vs-cv2 delta than asserted above
+    assert np.abs(c1 - c0).max() > 5, 'tag had no effect — bad fixture'
