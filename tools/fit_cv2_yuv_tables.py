@@ -243,8 +243,10 @@ def solve_tables(obs, nterm, lab):
 
 
 def emit_header(tables: dict, out_path: str, n_obs: int) -> None:
+    import cv2
     lines = [
         '// GENERATED by tools/fit_cv2_yuv_tables.py — do not edit.',
+        f'// FITTED_CV2_VERSION: {cv2.__version__}',
         '//',
         '// Exact integer tables reproducing cv2 (bundled FFmpeg/swscale)',
         '// yuv420p -> RGB conversion, verified bit-exact over '
