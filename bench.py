@@ -29,6 +29,14 @@ native flow resolution — the production steps from
 tools/family_precision_study.py) record every BASELINE config's measured
 rate in ``rungs`` at the same precision stamp.
 
+The corpus-scale pair: ``worklist_clips_per_sec`` runs the per-video
+outer loop over a multi-video worklist (resume contract + prefetch live),
+and ``worklist_packed_clips_per_sec`` runs the SAME worklist batch-major
+(``pack_across_videos=true`` — device batches fill across video
+boundaries, parallel/packing.py) in the same session, with
+``worklist_packed_batch_occupancy`` recording how full the compiled step
+actually ran.
+
 Default precision is 'mixed' (ops/precision.py): ambient 3-pass bf16 with
 the drift-tolerant sub-graphs on 1-pass — measured ≤1e-3 feature drift vs
 float32 on the fused path (tools/precision_study.py), i.e. the fastest
@@ -336,13 +344,14 @@ def run() -> dict:
             # task 5); BENCH_WORKLIST=0/1 overrides.
             if os.environ.get('BENCH_WORKLIST',
                               '1' if on_accel else '0') == '1':
+                wl_paths = None
                 try:
                     from tools.worklist_bench import (
                         make_worklist, run_worklist,
                     )
-                    paths = make_worklist(tmp_dir, 4 if on_accel else 2,
-                                          10 if on_accel else 2)
-                    wrec = run_worklist('i3d', paths, tmp_dir, tmp_dir,
+                    wl_paths = make_worklist(tmp_dir, 4 if on_accel else 2,
+                                             10 if on_accel else 2)
+                    wrec = run_worklist('i3d', wl_paths, tmp_dir, tmp_dir,
                                         platform, batch_size=min(batch, 8),
                                         stack=stack, precision=precision)
                     rungs[f'worklist_videos_per_min_{precision}'] = \
@@ -351,6 +360,26 @@ def run() -> dict:
                         wrec['clips_per_sec']
                 except Exception as e:
                     rungs['worklist_error'] = f'{type(e).__name__}: {e}'
+                # The SAME worklist object, batch-major
+                # (pack_across_videos=true): batches fill across video
+                # boundaries (parallel/packing.py) so the compiled step
+                # stops running padded tails per video — measured in the
+                # same session, with its own output root (the unpacked
+                # pass's files would otherwise make it an all-skip no-op).
+                if wl_paths is not None:
+                    try:
+                        wrec_packed = run_worklist(
+                            'i3d', wl_paths, os.path.join(tmp_dir, 'packed'),
+                            tmp_dir, platform, batch_size=min(batch, 8),
+                            stack=stack, precision=precision, packed=True)
+                        rungs[f'worklist_packed_clips_per_sec_{precision}'] \
+                            = wrec_packed['clips_per_sec']
+                        if wrec_packed.get('batch_occupancy') is not None:
+                            rungs['worklist_packed_batch_occupancy'] = \
+                                wrec_packed['batch_occupancy']
+                    except Exception as e:
+                        rungs['worklist_packed_error'] = \
+                            f'{type(e).__name__}: {e}'
     if mode == 'e2e' and f'e2e_{precision}' in rungs:
         headline_key = f'e2e_{precision}'
 

@@ -52,6 +52,11 @@ struct Decoder {
   // last colorspace details applied to `sws` (avoid per-frame re-derivation)
   AVColorSpace sws_colorspace = AVCOL_SPC_NB;
   AVColorRange sws_range = AVCOL_RANGE_NB;
+  // last source pixel format the details were derived for: pointer
+  // equality on `sws` cannot detect a context sws_getCachedContext
+  // rebuilt at the SAME address after a mid-stream pix_fmt change, and
+  // the J-format full-range inference depends on src_fmt too
+  AVPixelFormat sws_src_fmt = AV_PIX_FMT_NONE;
   bool sws_details_warned = false;
   unsigned char* stage = nullptr;  // aligned sws_scale target (see emit_rgb)
   double fps = 0.0;
@@ -165,9 +170,17 @@ bool ensure_sws(Decoder* d, AVPixelFormat src_fmt) {
   // Re-derive the coefficient tables only when the context was rebuilt or
   // the frame's tags changed — sws_setColorspaceDetails regenerates
   // yuv2rgb tables, which must not run per frame in the decode hot loop.
-  if (d->sws == prev && d->frame->colorspace == d->sws_colorspace &&
+  // `src_fmt` participates in the staleness check because a mid-stream
+  // pixel-format change makes sws_getCachedContext free + re-create the
+  // context, and the fresh allocation can land at the SAME address —
+  // pointer equality alone would then skip the re-derivation a brand-new
+  // context needs (and the YUVJ* full-range inference below reads src_fmt
+  // even when the colorspace/range tags are unchanged).
+  if (d->sws == prev && src_fmt == d->sws_src_fmt &&
+      d->frame->colorspace == d->sws_colorspace &&
       d->frame->color_range == d->sws_range)
     return true;
+  d->sws_src_fmt = src_fmt;
   d->sws_colorspace = d->frame->colorspace;
   d->sws_range = d->frame->color_range;
   int cs = SWS_CS_ITU601;

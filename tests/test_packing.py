@@ -1,0 +1,362 @@
+"""Packed corpus mode (pack_across_videos): the batch-major outer loop
+must be externally indistinguishable from the per-video loop — identical
+output files, identical resume/skip behavior, per-video fault isolation —
+while filling device batches across video boundaries (parallel/packing.py).
+
+All fixtures are synthesized with cv2 so the suite runs without the
+reference sample corpus.
+"""
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import load_config
+from video_features_tpu.registry import create_extractor
+from video_features_tpu.utils.output import make_path
+
+
+def _write_clip(path: str, n_frames: int, w: int = 64, h: int = 48,
+                seed: int = 0) -> str:
+    """A deterministic little mp4: a noise card scrolling horizontally."""
+    import cv2
+
+    wr = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*'mp4v'),
+                         25.0, (w, h))
+    rng = np.random.RandomState(seed)
+    base = (rng.rand(h, w, 3) * 255).astype(np.uint8)
+    for t in range(n_frames):
+        wr.write(np.roll(base, t * 3, axis=1))
+    wr.release()
+    return str(path)
+
+
+@pytest.fixture(scope='module')
+def mixed_worklist(tmp_path_factory):
+    """Three clips of DIFFERENT lengths: none fills a whole device batch
+    alone, so packing across boundaries is actually exercised."""
+    d = tmp_path_factory.mktemp('packvids')
+    return [_write_clip(d / f'vid{i}.mp4', n, seed=i)
+            for i, n in enumerate((9, 4, 14))]
+
+
+def _resnet_args(paths, out, tmp, **kw):
+    over = dict(video_paths=paths, device='cpu', model_name='resnet18',
+                batch_size=4, allow_random_weights=True,
+                on_extraction='save_numpy', output_path=str(out),
+                tmp_path=str(tmp))
+    over.update(kw)
+    return load_config('resnet', overrides=over)
+
+
+RESNET_KEYS = ('resnet', 'fps', 'timestamps_ms')
+
+
+def _load_outputs(out_path, paths, keys=RESNET_KEYS):
+    return {(p, k): np.load(make_path(str(out_path), p, k, '.npy'))
+            for p in paths for k in keys}
+
+
+def test_packed_matches_per_video_framewise(mixed_worklist, tmp_path):
+    """Packed outputs are element-identical to the per-video path on a
+    mixed-length worklist: same filenames, same arrays — the batches
+    differ (packed slots carry other videos' frames where the per-video
+    loop carried padding), but per-sample results must not."""
+    ex_pv = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 'pv', tmp_path / 'tmp1'))
+    for p in mixed_worklist:
+        ex_pv._extract(p)
+    ex_pk = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 'pk', tmp_path / 'tmp2'))
+    ex_pk.extract_packed(mixed_worklist)
+
+    a = _load_outputs(ex_pv.output_path, mixed_worklist)
+    b = _load_outputs(ex_pk.output_path, mixed_worklist)
+    assert set(Path(f).name for f in os.listdir(ex_pv.output_path)) == \
+        set(Path(f).name for f in os.listdir(ex_pk.output_path))
+    for key in a:
+        assert a[key].shape == b[key].shape, key
+        np.testing.assert_array_equal(a[key], b[key], err_msg=str(key))
+
+
+def test_packed_matches_per_video_i3d_stacks(tmp_path, tmp_path_factory):
+    """The stack family: i3d rgb stream over windows that straddle the
+    batch across videos (stack 10, batch 2 → 2+1 windows from 2 clips)."""
+    d = tmp_path_factory.mktemp('i3dvids')
+    paths = [_write_clip(d / 'a.mp4', 25, seed=7),
+             _write_clip(d / 'b.mp4', 12, seed=8)]
+
+    def make(out, tmp):
+        return create_extractor(load_config('i3d', overrides=dict(
+            video_paths=paths, device='cpu', streams='rgb',
+            stack_size=10, step_size=10, batch_size=2,
+            concat_rgb_flow=False, allow_random_weights=True,
+            on_extraction='save_numpy', output_path=str(tmp_path / out),
+            tmp_path=str(tmp_path / tmp))))
+
+    ex_pv = make('pv', 'tmp1')
+    for p in paths:
+        ex_pv._extract(p)
+    ex_pk = make('pk', 'tmp2')
+    ex_pk.extract_packed(paths)
+
+    for p, n_windows in zip(paths, (2, 1)):
+        a = np.load(make_path(ex_pv.output_path, p, 'rgb', '.npy'))
+        b = np.load(make_path(ex_pk.output_path, p, 'rgb', '.npy'))
+        assert a.shape == b.shape == (n_windows, 1024)
+        np.testing.assert_array_equal(a, b, err_msg=p)
+
+
+def test_packed_fault_isolation_bad_file(mixed_worklist, tmp_path):
+    """A video that fails to open mid-worklist must not poison the batches
+    it would have shared: the good videos' outputs are still written and
+    still identical to a clean run's."""
+    clean = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 'clean', tmp_path / 'tmpc'))
+    clean.extract_packed(mixed_worklist)
+
+    bad = str(tmp_path / 'gone.mp4')          # never created
+    worklist = mixed_worklist[:1] + [bad] + mixed_worklist[1:]
+    ex = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 'faulty', tmp_path / 'tmpf'))
+    ex.extract_packed(worklist)               # must not raise
+
+    for p in mixed_worklist:
+        for k in RESNET_KEYS:
+            got = np.load(make_path(ex.output_path, p, k, '.npy'))
+            ref = np.load(make_path(clean.output_path, p, k, '.npy'))
+            np.testing.assert_array_equal(got, ref)
+    assert not Path(make_path(ex.output_path, bad, 'resnet',
+                              '.npy')).exists()
+
+
+def test_packed_fault_isolation_mid_stream(mixed_worklist, tmp_path):
+    """A decode failure MID-video (after windows already entered shared
+    batches): the failing video saves nothing, its batch-mates save
+    everything, bit-identical to a clean run."""
+    clean = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 'clean2', tmp_path / 'tmpc2'))
+    clean.extract_packed(mixed_worklist)
+
+    victim = mixed_worklist[1]
+    ex = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 'mid', tmp_path / 'tmpm'))
+    orig = ex.packed_windows
+
+    def flaky(task):
+        it = orig(task)
+        if task.path == victim:
+            yield next(it)                    # one frame reaches the pool
+            raise RuntimeError('decoder died mid-video')
+        yield from it
+
+    ex.packed_windows = flaky
+    ex.extract_packed(mixed_worklist)         # must not raise
+
+    assert not Path(make_path(ex.output_path, victim, 'resnet',
+                              '.npy')).exists()
+    for p in mixed_worklist:
+        if p == victim:
+            continue
+        for k in RESNET_KEYS:
+            got = np.load(make_path(ex.output_path, p, k, '.npy'))
+            ref = np.load(make_path(clean.output_path, p, k, '.npy'))
+            np.testing.assert_array_equal(got, ref)
+
+
+def _r21d_args(paths, out, tmp, **kw):
+    over = dict(video_paths=paths, device='cpu', stack_size=4, step_size=4,
+                batch_size=2, allow_random_weights=True,
+                on_extraction='save_numpy', output_path=str(out),
+                tmp_path=str(tmp))
+    over.update(kw)
+    return load_config('r21d', overrides=over)
+
+
+@pytest.fixture(scope='module')
+def mixed_geometry_worklist(tmp_path_factory):
+    """Three clips where the MIDDLE one has a different resolution: its
+    windows pool separately (stack families ship decode-geometry windows)
+    and only flush at the final drain."""
+    d = tmp_path_factory.mktemp('geomvids')
+    return [_write_clip(d / 'a.mp4', 9, w=64, h=48, seed=1),
+            _write_clip(d / 'odd.mp4', 5, w=80, h=64, seed=2),
+            _write_clip(d / 'c.mp4', 9, w=64, h=48, seed=3)]
+
+
+def test_packed_mixed_geometry_parity_and_no_head_blocking(
+        mixed_geometry_worklist, tmp_path):
+    """A mixed-resolution corpus packs per geometry and still matches the
+    per-video path; and a video whose pool can't fill (the lone odd clip)
+    must NOT hold up the flush of completed videos behind it — its own
+    output simply lands at the final drain."""
+    paths = mixed_geometry_worklist
+    ex_pv = create_extractor(_r21d_args(paths, tmp_path / 'pv',
+                                        tmp_path / 'tmp1'))
+    for p in paths:
+        ex_pv._extract(p)
+    ex_pk = create_extractor(_r21d_args(paths, tmp_path / 'pk',
+                                        tmp_path / 'tmp2'))
+    save_order = []
+    orig_save = ex_pk.action_on_extraction
+
+    def recording_save(feats_dict, video_path):
+        save_order.append(Path(video_path).stem)
+        return orig_save(feats_dict, video_path)
+
+    ex_pk.action_on_extraction = recording_save
+    ex_pk.extract_packed(paths)
+
+    for p, n_windows in zip(paths, (2, 1, 2)):
+        a = np.load(make_path(ex_pv.output_path, p, 'r21d', '.npy'))
+        b = np.load(make_path(ex_pk.output_path, p, 'r21d', '.npy'))
+        assert a.shape == b.shape == (n_windows, 512)
+        np.testing.assert_array_equal(a, b, err_msg=p)
+    # 'c' completes while 'odd' is still pooled — it must flush before
+    # 'odd', not behind it (head-of-line regression guard)
+    assert save_order.index('c') < save_order.index('odd')
+
+
+def test_packed_device_step_fault_isolation(mixed_geometry_worklist,
+                                            tmp_path):
+    """A device-step failure (e.g. a geometry that won't compile) fails
+    exactly the videos in that batch and the worklist continues — same
+    blast radius as the per-video loop."""
+    paths = mixed_geometry_worklist
+    ex = create_extractor(_r21d_args(paths, tmp_path / 'stepf',
+                                     tmp_path / 'tmpsf'))
+    orig_step = ex.packed_step
+
+    def bad_step(stacks):
+        if stacks.shape[2] == 64:     # the odd 80x64 clip's geometry
+            raise RuntimeError('no executable for this geometry')
+        return orig_step(stacks)
+
+    ex.packed_step = bad_step
+    ex.extract_packed(paths)          # must not raise
+
+    victim = paths[1]
+    assert not Path(make_path(ex.output_path, victim, 'r21d',
+                              '.npy')).exists()
+    for p, n_windows in zip(paths, (2, 1, 2)):
+        if p == victim:
+            continue
+        feats = np.load(make_path(ex.output_path, p, 'r21d', '.npy'))
+        assert feats.shape == (n_windows, 512)
+
+
+def test_packed_resume_contract(mixed_worklist, tmp_path, capsys):
+    """is_already_exist semantics survive the inversion: a second packed
+    run skips every video without rewriting anything, and after deleting
+    one video's outputs (interrupted-run shape) only that video is
+    re-extracted."""
+    ex = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 'res', tmp_path / 'tmpr'))
+    ex.extract_packed(mixed_worklist)
+    files = sorted(Path(ex.output_path).glob('*.npy'))
+    assert len(files) == len(mixed_worklist) * len(RESNET_KEYS)
+    mtimes = {f: f.stat().st_mtime_ns for f in files}
+
+    capsys.readouterr()
+    ex.extract_packed(mixed_worklist)         # resume: everything skips
+    out = capsys.readouterr().out
+    assert out.count('already exist') == len(mixed_worklist)
+    assert {f: f.stat().st_mtime_ns for f in files} == mtimes
+
+    # resume-after-interrupt: one video's outputs lost mid-corpus
+    victim = mixed_worklist[1]
+    removed = [f for f in files
+               if f.name.startswith(Path(victim).stem + '_')]
+    assert removed
+    for f in removed:
+        f.unlink()
+    time.sleep(0.01)                          # mtime resolution guard
+    ex.extract_packed(mixed_worklist)
+    for f in files:
+        if f in removed:
+            assert f.exists()                 # re-extracted
+        else:
+            assert f.stat().st_mtime_ns == mtimes[f], f  # untouched
+
+
+def test_packed_batch_occupancy_reported(mixed_worklist, tmp_path, capsys):
+    """The packed run reports batch occupancy: 9+4+14=27 frames in batches
+    of 4 → 7 batches, 27/28 slots real (the per-video loop would run 9
+    batches at 27/36). The occ% and ramp columns land in the summary."""
+    ex = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 'occ', tmp_path / 'tmpo', profile=True))
+    real_summary = {}
+    real_reset = ex.tracer.reset
+    ex.tracer.reset = lambda: real_summary.update(ex.tracer.report()) \
+        or real_reset()
+    ex.extract_packed(mixed_worklist)
+    ex.tracer.reset = real_reset
+    out = capsys.readouterr().out
+    assert 'occ%' in out and 'ramp' in out
+    assert 'packed worklist' in out
+
+    model = real_summary['model']
+    assert model['count'] == 7                # vs 9 in the per-video loop
+    assert model['occupancy'] == pytest.approx(27 / 28)
+    assert model['occupancy'] > 27 / 36       # strictly beats per-video
+    assert 'ramp' in model                    # first-call wall measured
+
+
+def test_packed_zero_window_video(tmp_path, tmp_path_factory):
+    """A clip shorter than one stack window still produces its (empty)
+    output files, exactly like the per-video path — resume depends on it."""
+    d = tmp_path_factory.mktemp('tiny')
+    paths = [_write_clip(d / 'long.mp4', 25, seed=3),
+             _write_clip(d / 'short.mp4', 5, seed=4)]
+    ex = create_extractor(load_config('i3d', overrides=dict(
+        video_paths=paths, device='cpu', streams='rgb',
+        stack_size=10, step_size=10, batch_size=2,
+        concat_rgb_flow=False, allow_random_weights=True,
+        on_extraction='save_numpy', output_path=str(tmp_path / 'zout'),
+        tmp_path=str(tmp_path / 'ztmp'))))
+    ex.extract_packed(paths)
+    long_feats = np.load(make_path(ex.output_path, paths[0], 'rgb', '.npy'))
+    short_feats = np.load(make_path(ex.output_path, paths[1], 'rgb', '.npy'))
+    assert long_feats.shape == (2, 1024)
+    assert short_feats.shape == (0, 1024)
+
+
+def test_sanity_check_gates_packing(tmp_path):
+    """pack_across_videos degrades (with a warning) for families without
+    packed support and for the per-video show_pred debug surface."""
+    clip = _write_clip(tmp_path / 'c.mp4', 4)
+    args = load_config('vggish', overrides=dict(
+        video_paths=clip, device='cpu', pack_across_videos=True,
+        output_path=str(tmp_path / 'o'), tmp_path=str(tmp_path / 't')))
+    assert args['pack_across_videos'] is False
+    args = load_config('resnet', overrides=dict(
+        video_paths=clip, device='cpu', model_name='resnet18',
+        pack_across_videos=True, show_pred=True,
+        output_path=str(tmp_path / 'o2'), tmp_path=str(tmp_path / 't2')))
+    assert args['pack_across_videos'] is False
+
+
+def test_cli_routes_packed(tmp_path, tmp_path_factory, capsys):
+    """End to end through the CLI: pack_across_videos=true drives the
+    packed scheduler and writes the standard outputs."""
+    from video_features_tpu.cli import main
+
+    d = tmp_path_factory.mktemp('clivids')
+    paths = [str(_write_clip(d / f'v{i}.mp4', n, seed=i))
+             for i, n in enumerate((6, 9))]
+    out = tmp_path / 'cliout'
+    rc = main([
+        'feature_type=resnet', 'model_name=resnet18', 'device=cpu',
+        f'video_paths=[{",".join(paths)}]', 'pack_across_videos=true',
+        'batch_size=4', 'allow_random_weights=true',
+        'on_extraction=save_numpy', f'output_path={out}',
+        f'tmp_path={tmp_path / "clitmp"}'])
+    assert rc == 0
+    assert 'Packing device batches across 2 videos' in capsys.readouterr().out
+    for p in paths:
+        # sanity_check appends <feature_type>/<model_name> to output_path
+        feats = np.load(make_path(str(out / 'resnet' / 'resnet18'), p,
+                                  'resnet', '.npy'))
+        assert feats.shape[1] == 512

@@ -19,8 +19,11 @@ Usage:
     BENCH_PLATFORM=cpu N_VIDEOS=2 WORKLIST_SECONDS=2 \
         python tools/worklist_bench.py                # smoke
 
-Prints one JSON line per phase (extract, resume) on stdout; bench.py
-embeds the extract phase as the ``worklist_videos_per_min`` rung.
+Prints one JSON record per mode on stdout — the per-video loop first,
+then the packed corpus pipeline (``pack_across_videos=true``: batch-major
+across videos, parallel/packing.py) with its batch-occupancy figure;
+bench.py embeds them as the ``worklist_clips_per_sec`` and
+``worklist_packed_clips_per_sec`` rungs.
 """
 from __future__ import annotations
 
@@ -58,12 +61,17 @@ def make_worklist(tmp_dir: str, n_videos: int, seconds: float) -> list:
 
 def run_worklist(feature_type: str, paths: list, out_dir: str,
                  tmp_dir: str, platform: str, batch_size: int = 8,
-                 stack: int = 16, precision: str = None):
-    """One timed pass of the real per-video loop; returns the record.
+                 stack: int = 16, precision: str = None,
+                 packed: bool = False):
+    """One timed pass of the real worklist loop; returns the record.
 
-    The extractor is created once (matching cli.py) so compile caches,
-    weights, and the decode service amortize across the worklist the way
-    they do in production."""
+    ``packed=False`` times the per-video loop cli.py runs by default;
+    ``packed=True`` times the batch-major corpus pipeline
+    (``pack_across_videos=true`` → ``extract_packed``, parallel/packing.py)
+    and additionally reports the compiled step's batch occupancy. The
+    extractor is created once (matching cli.py) so compile caches, weights,
+    and the decode service amortize across the worklist the way they do in
+    production."""
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
 
@@ -76,6 +84,7 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         'batch_size': batch_size,
         'allow_random_weights': True,
         'profile': True,                       # per-stage Tracer on
+        'pack_across_videos': packed,
         'on_extraction': 'save_numpy',         # resume contract is real
         'output_path': os.path.join(out_dir, 'out'),
         'tmp_path': os.path.join(tmp_dir, 'tmp'),
@@ -85,10 +94,17 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
     args = load_config(feature_type, overrides=overrides)
     ex = create_extractor(args)
 
+    def run_pass(worklist):
+        if packed:
+            ex.extract_packed(worklist)
+        else:
+            for p in worklist:
+                ex._extract(p)
+
     # warm pass on the FIRST video only: compile time is a per-process
     # constant, not a per-video term — excluding it measures the
     # sustained rate a long worklist converges to
-    ex._extract(paths[0])
+    run_pass(paths[:1])
     warm_outputs = [f for f in Path(ex.output_path).rglob('*') if f.is_file()]
     assert warm_outputs, (
         'warm pass produced no outputs — extraction failed before the '
@@ -103,8 +119,7 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
     ex.tracer.reset = lambda: None
 
     t0 = time.perf_counter()
-    for p in paths:                           # the cli.py loop, timed
-        ex._extract(p)
+    run_pass(paths)                           # the cli.py loop, timed
     elapsed = time.perf_counter() - t0
     stages = ex.tracer.report()
     ex.tracer.reset = real_reset
@@ -128,17 +143,20 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         'failed (see stderr) or the source clip is shorter than one stack')
 
     t1 = time.perf_counter()
-    for p in paths:                           # resume pass: all skip
-        ex._extract(p)
+    run_pass(paths)                           # resume pass: all skip
     resume_elapsed = time.perf_counter() - t1
 
+    occupancy = stages.get('model', {}).get('occupancy')
     return {
         'feature_type': feature_type,
         'precision': precision,
+        'packed': packed,
         'n_videos': len(paths),
         'videos_per_min': round(len(paths) / elapsed * 60, 3),
         'clips_total': int(clips),
         'clips_per_sec': round(clips / elapsed, 3),
+        'batch_occupancy': (round(occupancy, 4)
+                            if occupancy is not None else None),
         'resume_pass_s': round(resume_elapsed, 4),
         'stages': {k: {'total_s': round(v['total_s'], 3),
                        'count': v['count']}
@@ -168,10 +186,24 @@ def main() -> int:
     with tempfile.TemporaryDirectory() as td, \
             contextlib.redirect_stdout(sys.stderr):
         paths = make_worklist(td, n, seconds)
+        batch = 8 if on_accel else 2
+        stack = int(os.environ.get('BENCH_STACK', 16))
         rec = run_worklist(feature_type, paths, td, td, platform,
-                           batch_size=8 if on_accel else 2,
-                           stack=int(os.environ.get('BENCH_STACK', 16)))
+                           batch_size=batch, stack=stack)
+        # packed mode writes under its own output root so the per-video
+        # pass's resume files can't turn it into an all-skip no-op; only
+        # families with packed support run it — an unsupported feature
+        # must still emit its per-video record, not crash the tool
+        from video_features_tpu.registry import PACKED_FEATURES
+        rec_packed = None
+        if feature_type in PACKED_FEATURES:
+            rec_packed = run_worklist(feature_type, paths,
+                                      os.path.join(td, 'packed'), td,
+                                      platform, batch_size=batch,
+                                      stack=stack, packed=True)
     print(json.dumps(rec), file=stdout)
+    if rec_packed is not None:
+        print(json.dumps(rec_packed), file=stdout)
     return 0
 
 

@@ -191,6 +191,18 @@ def sanity_check(args: Config) -> None:
                   'running single-device (scale out with multihost=true / '
                   'sharded worklists instead)')
             args['data_parallel'] = False
+    if args.get('pack_across_videos'):
+        from video_features_tpu.registry import PACKED_FEATURES
+        if ft not in PACKED_FEATURES:
+            print(f'WARNING: pack_across_videos is not implemented for {ft} '
+                  '— running the per-video loop')
+            args['pack_across_videos'] = False
+        elif args.get('show_pred'):
+            # show_pred is a per-video debug surface (it narrates windows in
+            # video order); a packed batch interleaves videos
+            print('WARNING: show_pred is incompatible with '
+                  'pack_across_videos — running the per-video loop')
+            args['pack_across_videos'] = False
     if ft == 'i3d' and args.get('stack_size') is not None:
         assert args['stack_size'] >= 10, (
             f'I3D does not support inputs shorter than 10 timestamps. '

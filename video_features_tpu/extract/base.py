@@ -34,6 +34,15 @@ from video_features_tpu.utils.output import (
 from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
 
 
+def log_extraction_error(video_path) -> None:
+    """The one per-video failure report (fault-isolation contract): every
+    loop — per-video, cross-video windower, packed finalize — prints the
+    same shape, so operators and log scrapers see one format."""
+    print(f'An error occurred during extraction from: {video_path}:')
+    traceback.print_exc()
+    print('Continuing...')
+
+
 class BaseExtractor:
     """Common per-video orchestration inherited by every extractor."""
 
@@ -127,9 +136,7 @@ class BaseExtractor:
         except KeyboardInterrupt:
             raise
         except Exception:
-            print(f'An error occurred during extraction from: {video_path}:')
-            traceback.print_exc()
-            print('Continuing...')
+            log_extraction_error(video_path)
         finally:
             # report+reset even on failure so one bad video's timings never
             # leak into the next video's table
@@ -140,6 +147,60 @@ class BaseExtractor:
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         raise NotImplementedError
+
+    # -- packed corpus mode (pack_across_videos=true) -----------------------
+    #
+    # The batch-major outer loop: instead of draining one video at a time
+    # (leaving every video's last batch mostly padded and paying pipeline
+    # ramp per video), the scheduler in parallel.packing fills every device
+    # batch across video boundaries and scatters features back per video.
+    # Subclasses opt in by setting ``supports_packing = True`` and
+    # implementing the three hooks below; every per-video contract (output
+    # files, resume, fault isolation) is preserved by the scheduler.
+
+    supports_packing = False
+
+    def packed_batch_size(self) -> int:
+        """Window slots per packed device batch (the compiled batch)."""
+        return int(self.batch_size)
+
+    def _packed_setup(self) -> None:
+        """One-time pre-run setup (e.g. lazy data-parallel mesh build) —
+        runs before ``packed_batch_size`` is read."""
+
+    def packed_windows(self, task):
+        """Yield ``(window, meta)`` for one video, in window order.
+
+        ``window`` is the host array one batch slot carries (a frame stack
+        or a single frame); ``meta`` is per-window metadata scattered back
+        alongside the features (e.g. a timestamp), or None. Video-level
+        metadata goes in ``task.info``.
+        """
+        raise NotImplementedError
+
+    def packed_step(self, batch) -> Dict[str, np.ndarray]:
+        """One compiled device step on a packed ``(B, ...)`` batch →
+        ``{key: (B, D) ndarray}``. Geometry-dependent state (pads, resize,
+        per-shape executables) is derived from ``batch.shape`` and cached
+        by the implementation."""
+        raise NotImplementedError
+
+    def packed_result(self, task) -> Dict[str, np.ndarray]:
+        """Assemble one video's feats_dict from its scattered rows
+        (``task.rows`` / ``task.meta_rows`` / ``task.info``) — the same
+        mapping :meth:`extract` returns for that video."""
+        raise NotImplementedError
+
+    def extract_packed(self, video_paths, decode_ahead: int = 2,
+                       batch_size: int = None) -> None:
+        """Run the whole worklist batch-major (see parallel.packing)."""
+        if not self.supports_packing:
+            raise NotImplementedError(
+                f'{type(self).__name__} does not support pack_across_videos')
+        from video_features_tpu.parallel.packing import run_packed
+        run_packed(self, video_paths, batch_size=batch_size,
+                   decode_ahead=decode_ahead)
+
 
     def _maybe_concat_streams(self, feats_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """rgb||flow → single (T, 2C) array under 'rgb' when configured.
@@ -205,3 +266,41 @@ class BaseExtractor:
         if self.concat_rgb_flow and 'rgb' in keys and 'flow' in keys:
             keys.remove('flow')
         return keys
+
+
+class StackPackingMixin:
+    """Shared packed hooks for stack families that window RAW decode
+    frames into ``stack_batch``-sized device batches (r21d, s3d — i3d
+    differs: host resize transform, stack_size+1 windows, multi-stream
+    output). One window = one (stack_size, H, W, 3) frame stack; the
+    subclass supplies ``packed_step`` and ``packed_feat_dim``."""
+
+    supports_packing = True
+    packed_feat_dim: int = 0          # subclasses set the feature width
+
+    def packed_batch_size(self) -> int:
+        return int(self.stack_batch)
+
+    def _packed_setup(self) -> None:
+        if self.data_parallel:
+            self._ensure_mesh('stack_batch')
+
+    def _make_loader(self, video_path: str):
+        from video_features_tpu.io.video import VideoLoader
+        return VideoLoader(
+            video_path, batch_size=64,
+            fps=self.extraction_fps, tmp_path=self.tmp_path,
+            keep_tmp=self.keep_tmp_files,
+            backend=self.decode_backend)
+
+    def packed_windows(self, task):
+        from video_features_tpu.extract.streaming import stream_windows
+        for window in stream_windows(self._make_loader(task.path),
+                                     self.stack_size, self.step_size):
+            yield window, None
+
+    def packed_result(self, task) -> Dict[str, np.ndarray]:
+        rows = task.rows.get(self.feature_type, [])
+        return {self.feature_type: (np.stack(rows) if rows
+                                    else np.zeros((0, self.packed_feat_dim),
+                                                  np.float32))}

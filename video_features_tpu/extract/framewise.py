@@ -61,10 +61,8 @@ class BaseFrameWiseExtractor(BaseExtractor):
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         pass
 
-    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        if self.data_parallel:
-            self._ensure_mesh('batch_size')
-        loader = VideoLoader(
+    def _make_loader(self, video_path: str) -> VideoLoader:
+        return VideoLoader(
             video_path,
             batch_size=self.batch_size,
             fps=self.extraction_fps,
@@ -75,6 +73,44 @@ class BaseFrameWiseExtractor(BaseExtractor):
             transform_workers=self.decode_workers,
             backend=self.decode_backend,
         )
+
+    # -- packed corpus mode (see extract.base / parallel.packing) -----------
+    #
+    # One packed "window" is a single host-transformed frame; the packer
+    # fills frame batches across video boundaries — at corpus scale the
+    # per-video tail batch (up to batch_size - 1 padded slots, paid per
+    # video today) collapses into one tail batch per corpus.
+
+    supports_packing = True
+
+    def _packed_setup(self) -> None:
+        if self.data_parallel:
+            self._ensure_mesh('batch_size')
+
+    def packed_windows(self, task):
+        loader = self._make_loader(task.path)
+        task.info['fps'] = loader.fps
+        for batch, times, _ in loader:
+            for frame, t_ms in zip(batch, times):
+                yield np.asarray(frame), t_ms
+
+    def packed_step(self, batch) -> Dict[str, np.ndarray]:
+        return {self.feature_type: np.asarray(self.device_step(batch))}
+
+    def packed_result(self, task) -> Dict[str, np.ndarray]:
+        rows = task.rows.get(self.feature_type, [])
+        return {
+            self.feature_type: (np.stack(rows) if rows
+                                else np.zeros((0, self.feat_dim),
+                                              np.float32)),
+            'fps': np.array(task.info.get('fps', 0.0)),
+            'timestamps_ms': np.array(task.meta_rows),
+        }
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        if self.data_parallel:
+            self._ensure_mesh('batch_size')
+        loader = self._make_loader(video_path)
         feats, timestamps = [], []
 
         def assembled():

@@ -36,6 +36,7 @@ from video_features_tpu.ops.transforms import (
     center_crop, flow_to_uint8_levels, resize_pil, scale_to_pm1,
 )
 from video_features_tpu.utils.device import jax_device
+from video_features_tpu.utils.tracing import NULL_TRACER
 
 MIN_SIDE_SIZE = 256
 CROP_SIZE = 224
@@ -192,6 +193,10 @@ class ExtractI3D(BaseExtractor):
         self.device_resize = bool(args.get('device_resize', False))
         self.show_pred = args.show_pred
         self.output_feat_keys = list(self.streams)
+        # decode-geometry (H, W) -> (pads, resize_to): shared by the
+        # per-video and packed paths so a corpus of same-geometry videos
+        # derives its RAFT padding / device-resize target exactly once
+        self._geom_cache: Dict[tuple, tuple] = {}
         self._device = jax_device(self.device)
         # data_parallel=true shards stack batches over ALL local devices with
         # one pjit program (params replicated, RAFT pairs spread over the
@@ -264,26 +269,23 @@ class ExtractI3D(BaseExtractor):
 
     # -- extraction ---------------------------------------------------------
 
-    def _stream_windows(self, loader):
+    def _stream_windows(self, loader, tracer=None):
         """(stack_size+1)-frame windows (B+1 frames → B flow pairs) streamed
         off the decoder; see extract.streaming for the semantics."""
         from video_features_tpu.extract.streaming import stream_windows
+        tracer = self.tracer if tracer is None else tracer
         return stream_windows(loader, self.stack_size + 1, self.step_size,
-                              self.tracer, 'decode+preprocess')
+                              tracer, 'decode+preprocess')
 
-    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        from video_features_tpu.extract.streaming import (
-            iter_batched_windows, transfer_batches,
-        )
-
+    def _make_loader(self, video_path: str) -> VideoLoader:
         # frames stay uint8 until they are on the device: values are exact
         # integers either way, and a (B, S+1, 256, W, 3) float32 stack batch
         # is 4x the host->device bytes of the uint8 one — H2D bandwidth is
         # the CLI's bottleneck ahead of the fused compute.
         # device_resize lifts the PIL resize into the fused graph: raw
         # decode frames ship as-is and the jitted step resizes them
-        # (resize_to computed below with PIL's own edge/truncation rule).
-        loader = VideoLoader(
+        # (resize_to computed per geometry with PIL's own edge rule).
+        return VideoLoader(
             video_path, batch_size=64,
             fps=self.extraction_fps, tmp_path=self.tmp_path,
             keep_tmp=self.keep_tmp_files,
@@ -292,28 +294,51 @@ class ExtractI3D(BaseExtractor):
             transform_workers=self.decode_workers,
             backend=self.decode_backend)
 
+    def _geometry(self, h: int, w: int) -> tuple:
+        """(pads, resize_to) for decode geometry (h, w), cached per shape."""
+        geom = self._geom_cache.get((h, w))
+        if geom is None:
+            # every distinct geometry also specializes the jitted step
+            # (static pads/resize_to); bound that executable growth on
+            # long heterogeneous corpora by dropping ALL specializations
+            # past 16 geometries (coarser than s3d's per-entry FIFO —
+            # jit's internal cache is all-or-nothing — but real corpora
+            # cluster into a handful of aspect ratios, so this never
+            # fires in practice; the data_parallel wrapper has no
+            # clear_cache and keeps jit's unbounded default)
+            if len(self._geom_cache) >= 16:
+                getattr(self._step, 'clear_cache', lambda: None)()
+                self._geom_cache.clear()
+            resize_to = None
+            gh, gw = h, w
+            if self.device_resize:
+                resize_to = _pil_short_side_geometry(gh, gw, MIN_SIDE_SIZE)
+                if resize_to is not None:
+                    gh, gw = resize_to
+            pads = tuple(raft_model.pad_to_multiple(
+                np.zeros((1, gh, gw, 1), np.float32))[1])
+            geom = self._geom_cache[(h, w)] = (pads, resize_to)
+        return geom
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        from video_features_tpu.extract.streaming import (
+            iter_batched_windows, transfer_batches,
+        )
+
+        loader = self._make_loader(video_path)
         feats: Dict[str, list] = {s: [] for s in self.streams}
-        state = {'pads': None, 'resize_to': None}
 
         def run(stacks, valid, window_idx):
-            if state['pads'] is None:
-                H, W = stacks.shape[2:4]
-                if self.device_resize:
-                    state['resize_to'] = _pil_short_side_geometry(
-                        H, W, MIN_SIDE_SIZE)
-                    if state['resize_to'] is not None:
-                        H, W = state['resize_to']
-                state['pads'] = tuple(raft_model.pad_to_multiple(
-                    np.zeros((1, H, W, 1), np.float32))[1])
+            pads, resize_to = self._geometry(*stacks.shape[2:4])
             with self.tracer.stage('model'):
-                out = self._step(self.params, stacks, pads=state['pads'],
+                out = self._step(self.params, stacks, pads=pads,
                                  streams=tuple(self.streams),
-                                 resize_to=state['resize_to'])
+                                 resize_to=resize_to)
                 for s in self.streams:
                     feats[s].append(np.asarray(out[s])[:valid])
             if self.show_pred:
-                self.maybe_show_pred(stacks[:valid], state['pads'], window_idx,
-                                     state['resize_to'])
+                self.maybe_show_pred(stacks[:valid], pads, window_idx,
+                                     resize_to)
 
         with self.precision_scope():
             # decode thread assembles + transfers batch k+1 while the
@@ -328,6 +353,28 @@ class ExtractI3D(BaseExtractor):
             s: (np.concatenate(v, axis=0) if v
                 else np.zeros((0, i3d_model.FEAT_DIM), np.float32))
             for s, v in feats.items()
+        }
+
+    # -- packed corpus mode (see extract.base / parallel.packing) -----------
+
+    supports_packing = True
+
+    def packed_windows(self, task):
+        for window in self._stream_windows(self._make_loader(task.path),
+                                           tracer=NULL_TRACER):
+            yield window, None
+
+    def packed_step(self, stacks):
+        pads, resize_to = self._geometry(*stacks.shape[2:4])
+        out = self._step(self.params, stacks, pads=pads,
+                         streams=tuple(self.streams), resize_to=resize_to)
+        return {s: np.asarray(out[s]) for s in self.streams}
+
+    def packed_result(self, task):
+        return {
+            s: (np.stack(task.rows[s]) if task.rows.get(s)
+                else np.zeros((0, i3d_model.FEAT_DIM), np.float32))
+            for s in self.streams
         }
 
     def maybe_show_pred(self, stacks, pads, stack_counter, resize_to=None):

@@ -17,8 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.io.video import VideoLoader
+from video_features_tpu.extract.base import BaseExtractor, StackPackingMixin
 from video_features_tpu.models import r21d as r21d_model
 from video_features_tpu.ops.transforms import (
     center_crop, normalize, resize_bilinear, to_float_zero_one,
@@ -39,7 +38,7 @@ MODEL_CFGS = {
 STACK_BATCH = 4
 
 
-class ExtractR21D(BaseExtractor):
+class ExtractR21D(StackPackingMixin, BaseExtractor):
 
     def __init__(self, args) -> None:
         super().__init__(
@@ -95,6 +94,14 @@ class ExtractR21D(BaseExtractor):
         x = center_crop(x, (112, 112))
         return r21d_model.forward(params, x, arch=arch, features=True)
 
+    # -- packed corpus mode: hooks from StackPackingMixin -------------------
+
+    packed_feat_dim = 512
+
+    def packed_step(self, stacks):
+        return {self.feature_type: np.asarray(self._step(self.params,
+                                                         stacks))}
+
     # -- extraction ---------------------------------------------------------
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
@@ -102,11 +109,7 @@ class ExtractR21D(BaseExtractor):
 
         if self.data_parallel:
             self._ensure_mesh('stack_batch')
-        loader = VideoLoader(
-            video_path, batch_size=64,
-            fps=self.extraction_fps, tmp_path=self.tmp_path,
-            keep_tmp=self.keep_tmp_files,
-            backend=self.decode_backend)
+        loader = self._make_loader(video_path)
         windows = stream_windows(loader, self.stack_size, self.step_size,
                                  self.tracer, 'decode')
 

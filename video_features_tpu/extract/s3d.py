@@ -13,8 +13,7 @@ from typing import Dict
 import jax
 import numpy as np
 
-from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.io.video import VideoLoader
+from video_features_tpu.extract.base import BaseExtractor, StackPackingMixin
 from video_features_tpu.models import s3d as s3d_model
 from video_features_tpu.ops.transforms import (
     center_crop, resize_bilinear_scale, to_float_zero_one,
@@ -24,7 +23,7 @@ from video_features_tpu.utils.device import jax_device
 STACK_BATCH = 1  # 64-frame stacks are large; one per device step
 
 
-class ExtractS3D(BaseExtractor):
+class ExtractS3D(StackPackingMixin, BaseExtractor):
 
     def __init__(self, args) -> None:
         super().__init__(
@@ -48,8 +47,10 @@ class ExtractS3D(BaseExtractor):
         self.data_parallel = args.get('data_parallel', False)
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
-        # the jit step is built per video: the resize geometry is static
-        # per aspect ratio (see extract())
+        # the jit step is static per decode geometry (the short-side-224
+        # resize scale); cache one executable per (h, w) so a corpus of
+        # same-geometry videos compiles exactly once
+        self._geom_steps: dict = {}
 
     def load_params(self, args):
         from video_features_tpu.extract.weights import load_or_init
@@ -66,16 +67,49 @@ class ExtractS3D(BaseExtractor):
         x = center_crop(x, (224, 224))
         return s3d_model.forward(params, x, features=True)
 
+    def _geometry_step(self, h: int, w: int):
+        """(jitted step, resize_hw, scale) for decode geometry (h, w).
+
+        Short-side 224 at the GIVEN scale 224/min(h, w): BOTH the output
+        sizes and the sampling grid follow torch's
+        F.interpolate(scale_factor=s, recompute_scale_factor=False) —
+        sizes are floor(dim * s) with the exact float s (e.g.
+        floor(480 * (224/336)) = 319, and a 107px short side floors to
+        223, not 224 — the subsequent CenterCrop then behaves exactly
+        like the reference's). Cached per (h, w) so a whole corpus of
+        same-geometry videos compiles once.
+        """
+        cached = self._geom_steps.get((h, w))
+        if cached is None:
+            import math
+            # bound the executable cache: each entry retains a compiled
+            # XLA program + buffers, and a long heterogeneous corpus must
+            # not accumulate them without limit (FIFO eviction trades a
+            # recompile for bounded memory; real corpora cluster into a
+            # handful of aspect ratios, so evictions are rare)
+            if len(self._geom_steps) >= 16:
+                self._geom_steps.pop(next(iter(self._geom_steps)))
+            scale = 224.0 / min(h, w)
+            resize_hw = (math.floor(h * scale), math.floor(w * scale))
+            step = jax.jit(partial(self._forward, resize_hw=resize_hw,
+                                   resize_scale=scale))
+            cached = self._geom_steps[(h, w)] = (step, resize_hw, scale)
+        return cached
+
+    # -- packed corpus mode: hooks from StackPackingMixin -------------------
+
+    packed_feat_dim = s3d_model.FEAT_DIM
+
+    def packed_step(self, stacks):
+        step, _, _ = self._geometry_step(*stacks.shape[2:4])
+        return {self.feature_type: np.asarray(step(self.params, stacks))}
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         from video_features_tpu.extract.streaming import stream_windows
 
         if self.data_parallel:
             self._ensure_mesh('stack_batch')
-        loader = VideoLoader(
-            video_path, batch_size=64,
-            fps=self.extraction_fps, tmp_path=self.tmp_path,
-            keep_tmp=self.keep_tmp_files,
-            backend=self.decode_backend)
+        loader = self._make_loader(video_path)
         windows = stream_windows(loader, self.stack_size, self.step_size,
                                  self.tracer, 'decode')
 
@@ -83,35 +117,19 @@ class ExtractS3D(BaseExtractor):
             iter_batched_windows, transfer_batches,
         )
 
-        state = {'step': None, 'resize_hw': None, 'scale': None}
         feats: list = []
 
         def run(stacks, host_stacks, valid, window_idx):
-            if state['step'] is None:
-                # short-side 224 at the GIVEN scale 224/min(h, w): BOTH the
-                # output sizes and the sampling grid follow torch's
-                # F.interpolate(scale_factor=s, recompute_scale_factor=
-                # False) — sizes are floor(dim * s) with the exact float s
-                # (e.g. floor(480 * (224/336)) = 319, and a 107px short
-                # side floors to 223, not 224 — the subsequent CenterCrop
-                # then behaves exactly like the reference's)
-                import math
-                h, w = stacks.shape[2:4]
-                state['scale'] = 224.0 / min(h, w)
-                state['resize_hw'] = (math.floor(h * state['scale']),
-                                      math.floor(w * state['scale']))
-                state['step'] = jax.jit(
-                    partial(self._forward, resize_hw=state['resize_hw'],
-                            resize_scale=state['scale']))
+            step, resize_hw, scale = self._geometry_step(*stacks.shape[2:4])
             with self.tracer.stage('model'):
-                out = np.asarray(state['step'](self.params, stacks))[:valid]
+                out = np.asarray(step(self.params, stacks))[:valid]
             feats.append(out)
             if self.show_pred:
                 for k in range(valid):
                     start = (window_idx + k) * self.step_size
                     self.maybe_show_pred(host_stacks[k:k + 1], start,
                                          start + self.stack_size,
-                                         state['resize_hw'], state['scale'])
+                                         resize_hw, scale)
 
         with self.precision_scope():
             # decode thread assembles + transfers stack batch k+1 while

@@ -10,10 +10,15 @@ device compute.
 Windowing semantics are exactly ``utils.slicing.form_slices``: window k
 starts at ``k·step``; only full windows are emitted (partial final stacks
 are dropped, like the reference, extract_i3d.py:126-129).
+
+``stream_windows_across_videos`` extends the windower across video
+boundaries for the packed corpus mode (``parallel.packing``): one
+fault-isolated stream over the whole worklist, so device batches can fill
+with windows from several videos instead of padding at every video's tail.
 """
 from __future__ import annotations
 
-from typing import Iterable, Iterator, List
+from typing import Callable, Iterable, Iterator, List
 
 import numpy as np
 
@@ -87,6 +92,50 @@ def transfer_batches(items: Iterable[tuple], put, keep_host: bool = False,
         return (dev, host) + tuple(item[1:])
 
     return prefetch(map(to_device, items), depth=1)
+
+
+def stream_windows_across_videos(tasks: Iterable,
+                                 open_windows: Callable) -> Iterator[tuple]:
+    """The corpus-mode windower: yield ``(task, window, meta)`` across video
+    boundaries so a downstream packer can fill device batches from the whole
+    worklist instead of draining one video at a time.
+
+    ``tasks`` iterates scheduler tasks (``parallel.packing.VideoTask``);
+    ``open_windows(task)`` returns that video's ``(window, meta)`` iterator
+    (an extractor's ``packed_windows`` hook). Videos are drained in order —
+    the tail windows of video k and the head windows of video k+1 land in
+    the same stream, which is exactly what lets the packed batch stay full
+    at boundaries.
+
+    Per-video fault isolation matches ``BaseExtractor._extract``: an
+    exception while opening or decoding one video marks that task failed
+    (its partial windows may still flow through a shared batch — harmless,
+    they are never saved) and the stream continues with the next video; one
+    bad file never kills the worklist nor the batches it shares
+    (KeyboardInterrupt re-raises). ``task.emitted``/``task.exhausted`` are
+    maintained here — the scatter side uses them to decide when a video's
+    features are complete.
+    """
+    from video_features_tpu.extract.base import log_extraction_error
+    for task in tasks:
+        try:
+            for window, meta in open_windows(task):
+                if task.failed:
+                    # the consumer failed this video mid-run (device-step
+                    # fault): stop decoding the rest of it — only the few
+                    # windows already buffered/pooled still flow through
+                    # (and are dropped at scatter), instead of the whole
+                    # remainder of the video burning decode + device time
+                    break
+                task.emitted += 1
+                yield task, window, meta
+        except KeyboardInterrupt:
+            raise
+        except Exception:
+            task.failed = True
+            log_extraction_error(task.path)
+        finally:
+            task.exhausted = True
 
 
 def stream_windows(batches: Iterable, win: int, step: int,

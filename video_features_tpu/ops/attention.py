@@ -150,9 +150,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if hasattr(lax, 'pcast'):
         def cast(t):
             return lax.pcast(t, axis_name, to='varying')
-    else:
+    elif hasattr(lax, 'pvary'):
         def cast(t):
             return lax.pvary(t, axis_name)
+    else:
+        # jax 0.4.x shard_map has no varying-axis typing; no cast needed
+        def cast(t):
+            return t
     m, l, o = (cast(t) for t in _online_init(q))
     if synthesized_mask:   # caller-provided masks are already device-varying
         kv_valid = cast(kv_valid)

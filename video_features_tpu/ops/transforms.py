@@ -125,12 +125,18 @@ def _pil_bilinear_coeff_matrix(in_size: int, out_size: int) -> np.ndarray:
 def _limb_split(M: np.ndarray) -> np.ndarray:
     """(out, in) non-negative int64 → (3, out, in) float32 byte limbs,
     M = limbs[2]·2^16 + limbs[1]·2^8 + limbs[0]. Each limb ≤ 255, so a
-    limb×uint8-pixel matmul stays exact in float32 (products < 2^17,
-    ≤258-tap sums < 2^25 — asserted) — how the integer resample rides
-    the MXU without integer matmul support."""
+    limb×uint8-pixel matmul stays exact in float32: products < 2^17, and
+    fp32 represents integers exactly only up to 2^24, so the real
+    constraint is on the window sum — nnz·255·255 < 2^24 (asserted
+    below; at the widest window this allows, 258 taps, the worst case is
+    16,776,450, just 766 under the limit — zero headroom, which is why
+    the assert derives from the constraint instead of pinning a tap
+    count). This is how the integer resample rides the MXU without
+    integer matmul support."""
     assert (M >= 0).all(), 'bilinear coefficients are non-negative'
-    nnz_per_row = (M != 0).sum(1).max()
-    assert nnz_per_row <= 258, f'window too wide for fp32 limbs: {nnz_per_row}'
+    nnz_per_row = int((M != 0).sum(1).max())
+    assert nnz_per_row * 255 * 255 < 2 ** 24, \
+        f'window too wide for exact fp32 limb sums: {nnz_per_row} taps'
     return np.stack([(M & 0xFF), (M >> 8) & 0xFF, (M >> 16) & 0xFF],
                     0).astype(np.float32)
 

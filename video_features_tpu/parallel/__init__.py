@@ -12,6 +12,9 @@ from video_features_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS, TIME_AXIS, batch_sharding, factor_mesh_shape, make_mesh,
     pair_sharding, replicated, round_batch_to_data_axis,
 )
+from video_features_tpu.parallel.packing import (  # noqa: F401
+    VideoTask, packed_batches, run_packed,
+)
 from video_features_tpu.parallel.pipeline import (  # noqa: F401
     build_sharded_two_stream_step, put_batch, put_replicated,
     setup_data_parallel,

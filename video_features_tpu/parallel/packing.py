@@ -1,0 +1,243 @@
+"""Corpus-level packed execution: batch-major scheduling across videos.
+
+The reference (and, until this module, this framework) runs a video-major
+outer loop: every video separately streams its windows into the compiled
+device step, so at corpus shapes (K400: a handful of stack windows per
+clip) the last batch of every video runs mostly padded and every video
+pays the pipeline ramp (prefetch fill, cache warm, H2D latency) again.
+
+This module inverts the loop — batch-major over the whole worklist:
+
+  * a cross-video window stream (``extract.streaming.
+    stream_windows_across_videos``) drains clip stacks / frames from one
+    video after another, with per-video fault isolation;
+  * a decode-ahead thread (``io.video.prefetch_across_videos``) keeps the
+    decoder busy across video boundaries under a bounded window buffer;
+  * the packer fills every device batch to capacity with
+    (video, window_idx) provenance, grouping by window geometry so mixed
+    corpora still feed fixed-shape executables;
+  * features scatter back into per-video accumulators that flush as each
+    video completes (NOT necessarily in worklist order — a video whose
+    geometry pool can't fill must not block videos behind it) through the
+    UNCHANGED per-video output contract (``is_already_exist`` skip,
+    idempotent ``action_on_extraction`` writes, identical filenames) —
+    the same files as the per-video loop, except the chip stays fed.
+
+Composition: batches go through ``BaseExtractor.put_input``, so
+``data_parallel=true`` sharding works unchanged; the worklist arrives
+already sharded per host in multihost runs (``cli.py``), so packing is a
+per-host concern and needs no cross-host coordination.
+"""
+from __future__ import annotations
+
+import traceback
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+class VideoTask:
+    """Per-video scheduling + scatter-back state for the packed pipeline.
+
+    ``emitted`` counts windows the decode side yielded, ``done`` counts
+    windows whose features have scattered back; the video is complete when
+    ``exhausted and done == emitted``. ``skipped`` (resume hit) and
+    ``failed`` both finalize without writing. ``rows``/``meta_rows`` accumulate
+    the scattered per-window feature rows (in window order — the packer
+    preserves per-video FIFO because a video's windows share one geometry
+    pool); ``info`` carries video-level metadata (e.g. fps) set by the
+    extractor's window stream.
+    """
+
+    __slots__ = ('path', 'video_id', 'rows', 'meta_rows', 'info',
+                 'emitted', 'done', 'exhausted', 'failed', 'skipped')
+
+    def __init__(self, path: str, video_id: int) -> None:
+        self.path = path
+        self.video_id = video_id
+        self.rows: Dict[str, List[np.ndarray]] = {}
+        self.meta_rows: List = []
+        self.info: Dict = {}
+        self.emitted = 0
+        self.done = 0
+        self.exhausted = False
+        self.failed = False
+        self.skipped = False
+
+
+def packed_batches(windows: Iterable[tuple],
+                   batch: int) -> Iterator[Tuple[np.ndarray, list, int]]:
+    """Group a cross-video ``(task, window, meta)`` stream into full
+    fixed-size batches: ``(stacks, provenance, valid)`` where provenance is
+    the per-slot ``(task, meta)`` list for the ``valid`` real slots.
+
+    Windows pool per geometry (shape, dtype) so a mixed-resolution corpus
+    still feeds fixed-shape compiled steps — a batch only ever mixes
+    windows of identical geometry, and each geometry's pool holds at most
+    ``batch - 1`` windows (memory stays bounded by the number of DISTINCT
+    geometries in flight, not by corpus size). Tail pools flush padded
+    (repeating the last window, masked via ``valid``) only once the whole
+    worklist is drained — that final partial batch per geometry is the only
+    padding the corpus pays, vs one per video in the per-video loop.
+    """
+    pools: Dict[tuple, list] = {}
+
+    def flush(pool):
+        valid = len(pool)
+        wins = [w for _, w, _ in pool]
+        while len(wins) < batch:
+            wins.append(wins[-1])
+        return np.stack(wins), [(t, m) for t, _, m in pool], valid
+
+    for task, window, meta in windows:
+        window = np.asarray(window)
+        key = (window.shape, window.dtype.str)
+        pool = pools.setdefault(key, [])
+        pool.append((task, window, meta))
+        if len(pool) == batch:
+            yield flush(pool)
+            pools[key] = []
+    for pool in pools.values():
+        if pool:
+            yield flush(pool)
+
+
+def run_packed(ex, video_paths: Iterable[str],
+               batch_size: Optional[int] = None,
+               decode_ahead: int = 2) -> None:
+    """Drive one extractor over the whole worklist, batch-major.
+
+    Preserves every externally observable per-video contract:
+
+      * resume — ``is_already_exist`` is checked as the decode side
+        reaches each video (same skip message, amortized like the
+        per-video loop — never an up-front O(corpus) scan) and re-checked
+        by ``action_on_extraction`` right before writing, so concurrent
+        workers still collide benignly;
+      * outputs — identical filenames and array contents flow through the
+        same ``_maybe_concat_streams`` + ``action_on_extraction`` path;
+      * fault isolation — a video that fails to decode, compute, or save
+        prints the same error and the worklist continues; windows it
+        contributed to shared batches are computed but never saved, and a
+        device-step failure (e.g. a geometry that won't compile) fails
+        only the videos in that batch — one bad video cannot poison the
+        batch it shares, nor abort the worklist.
+
+    ``decode_ahead`` bounds the cross-video decode lookahead at
+    ``decode_ahead × batch`` windows (see ``io.video.
+    prefetch_across_videos``).
+    """
+    from video_features_tpu.extract.streaming import (
+        stream_windows_across_videos, transfer_batches,
+    )
+    from video_features_tpu.io.video import prefetch_across_videos
+
+    ex._packed_setup()
+    batch = int(batch_size or ex.packed_batch_size())
+    tasks = [VideoTask(p, i) for i, p in enumerate(video_paths)]
+
+    def open_windows(task: VideoTask):
+        # The resume check runs here — lazily, as the decode side reaches
+        # each video — NOT as an up-front scan: is_already_exist loads
+        # every output file, and an eager pass over a mostly-done 20K
+        # worklist would block for minutes before the first batch packs.
+        # Amortized across the run it costs what the per-video loop paid.
+        if ex.is_already_exist(task.path):
+            task.skipped = True
+            return iter(())
+        return ex.packed_windows(task)
+
+    # flush each video as soon as its last window's features land. NOT
+    # strictly in worklist order: a video whose geometry pool can't fill
+    # (e.g. the lone odd-resolution clip in a mixed corpus — its tail
+    # windows sit pooled until the final drain) must not hold up every
+    # video behind it, or their accumulated rows pin O(corpus) host RAM
+    # and a crash loses outputs that were long since computed. The scan
+    # stops at the first video the decode side hasn't reached (videos
+    # start strictly in worklist order), so each sweep touches only the
+    # small in-flight window, not the whole worklist.
+    open_q: List[VideoTask] = list(tasks)
+
+    def finalize(t: VideoTask) -> None:
+        if t.failed or t.skipped:
+            t.rows = {}
+            return
+        from video_features_tpu.extract.base import log_extraction_error
+        try:
+            feats_dict = ex._maybe_concat_streams(ex.packed_result(t))
+            with ex.tracer.stage('save'):
+                ex.action_on_extraction(feats_dict, t.path)
+        except KeyboardInterrupt:
+            raise
+        except Exception:
+            log_extraction_error(t.path)
+        finally:
+            t.rows = {}               # free feature memory as we go
+
+    def sweep(final: bool = False) -> None:
+        i = 0
+        while i < len(open_q):
+            t = open_q[i]
+            if not t.exhausted and t.emitted == 0:
+                break                 # decode hasn't reached this video yet
+            if t.exhausted and t.done >= t.emitted:
+                del open_q[i]
+                finalize(t)
+            else:
+                i += 1
+        if final and open_q:
+            # the stream is fully drained; every task must be ready
+            t = open_q[0]
+            raise AssertionError(
+                f'packed scheduler lost windows for {t.path}: '
+                f'{t.done}/{t.emitted} scattered, exhausted={t.exhausted}')
+
+    source = stream_windows_across_videos(tasks, open_windows)
+    # decode (and host preprocessing) runs on the prefetch producer thread,
+    # ahead of the device across video boundaries; wrap_iter inside the
+    # prefetch so decode time is attributed where it is actually spent
+    timed = ex.tracer.wrap_iter('decode+preprocess', source)
+    ahead = prefetch_across_videos(timed, decode_ahead * batch)
+
+    with ex.precision_scope():
+        # batch assembly + H2D of batch k+1 overlap the device running k
+        for dev, _, prov, valid in transfer_batches(
+                packed_batches(ahead, batch), ex.put_input,
+                tracer=ex.tracer):
+            try:
+                with ex.tracer.stage('model'):
+                    out = ex.packed_step(dev)
+            except KeyboardInterrupt:
+                raise
+            except Exception:
+                # device-step fault isolation: a batch whose geometry
+                # can't compile/fit fails exactly the videos it carries
+                # (the per-video loop would likewise lose only them) and
+                # the worklist continues; their accounting still advances
+                # so the sweep never stalls
+                print('An error occurred in the packed device step '
+                      f'(batch of {valid} windows from '
+                      f'{sorted({t.path for t, _ in prov})}):')
+                traceback.print_exc()
+                print('Continuing...')
+                for task, _ in prov:
+                    task.failed = True
+                    task.done += 1
+                sweep()
+                continue
+            ex.tracer.add_occupancy('model', valid, batch)
+            for i, (task, meta) in enumerate(prov):
+                task.done += 1
+                if task.failed:       # already doomed: don't grow its rows
+                    continue
+                for key, arr in out.items():
+                    task.rows.setdefault(key, []).append(arr[i])
+                task.meta_rows.append(meta)
+            sweep()
+    sweep(final=True)
+
+    if ex.tracer.enabled and ex.tracer.report():
+        print(f'--- stage timing: packed worklist ({len(tasks)} videos, '
+              f'batch {batch})')
+        print(ex.tracer.summary())
+        ex.tracer.reset()

@@ -29,6 +29,14 @@ EXTRACTORS: Dict[str, Tuple[str, str]] = {
 DATA_PARALLEL_FEATURES = frozenset(
     {'i3d', 'r21d', 's3d', 'vggish', 'resnet', 'raft', 'clip', 'timm'})
 
+# feature types whose extractor implements the packed corpus mode
+# (pack_across_videos=true — batch-major scheduling across videos,
+# parallel/packing.py). Same deliberate-literal policy as above: a new
+# extractor must opt in here AND set supports_packing, or sanity_check
+# degrades the knob to the per-video loop with a warning.
+PACKED_FEATURES = frozenset(
+    {'i3d', 'r21d', 's3d', 'resnet', 'clip', 'timm'})
+
 
 def create_extractor(args: 'Config') -> 'BaseExtractor':
     feature_type = args['feature_type']

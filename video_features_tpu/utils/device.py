@@ -8,6 +8,14 @@ import jax
 MATMUL_PRECISIONS = ('default', 'high', 'highest', 'mixed',
                      'bfloat16', 'tensorfloat32', 'float32')
 
+# The ONE home of the shard_map version shim: jax >= 0.5 re-exports it at
+# the top level, 0.4.x keeps it in experimental. Every shard_map consumer
+# imports it from here so the next jax API move is a single edit.
+try:
+    from jax import shard_map  # noqa: F401
+except ImportError:
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
 
 def enable_compilation_cache(cache_dir, device: str = 'any') -> None:
     """Point jax's persistent compilation cache at ``cache_dir``.

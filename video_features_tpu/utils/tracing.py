@@ -30,18 +30,47 @@ from typing import Dict, Iterable, Iterator, List, Optional
 
 
 class _StageStat:
-    __slots__ = ('count', 'total_s', 'max_s')
+    __slots__ = ('count', 'total_s', 'max_s', 'first_s',
+                 'occ_valid', 'occ_capacity')
 
     def __init__(self) -> None:
         self.count = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        # first-call wall time: the pipeline-ramp term (compile + cache
+        # warm + prefetch fill) that a batch-major corpus loop pays once
+        # instead of once per video
+        self.first_s = 0.0
+        # batch-slot accounting (add_occupancy): how full the compiled
+        # batch actually ran — padded tail slots burn the same device time
+        # as real work
+        self.occ_valid = 0
+        self.occ_capacity = 0
 
     def add(self, dt: float) -> None:
+        if self.count == 0:
+            self.first_s = dt
         self.count += 1
         self.total_s += dt
         if dt > self.max_s:
             self.max_s = dt
+
+    def ramp(self) -> Optional[float]:
+        """first-call time over the steady-state mean (None until 2 calls).
+
+        ~1.0 = no ramp; large values = a compile/warm-up wall that a
+        longer run (or cross-video packing) amortizes away.
+        """
+        if self.count < 2:
+            return None
+        steady = (self.total_s - self.first_s) / (self.count - 1)
+        return self.first_s / steady if steady > 0 else None
+
+    def occupancy(self) -> Optional[float]:
+        """valid-slot fraction of all batch slots (None if never recorded)."""
+        if self.occ_capacity <= 0:
+            return None
+        return self.occ_valid / self.occ_capacity
 
 
 class Tracer:
@@ -64,6 +93,21 @@ class Tracer:
                 stat = self._stats[name] = _StageStat()
                 self._order.append(name)
             stat.add(dt)
+
+    def add_occupancy(self, name: str, valid: int, capacity: int) -> None:
+        """Record that a ``capacity``-slot batch under ``name`` carried
+        ``valid`` real items (the rest was padding). The summary table then
+        reports the stage's aggregate batch occupancy — the fraction of
+        compiled-step slots that did useful work."""
+        if not self.enabled:
+            return
+        with self._lock:
+            stat = self._stats.get(name)
+            if stat is None:
+                stat = self._stats[name] = _StageStat()
+                self._order.append(name)
+            stat.occ_valid += int(valid)
+            stat.occ_capacity += int(capacity)
 
     @contextmanager
     def stage(self, name: str):
@@ -100,36 +144,54 @@ class Tracer:
 
     # -- reporting -----------------------------------------------------------
 
+    @staticmethod
+    def _stat_record(s: '_StageStat') -> Dict[str, float]:
+        rec = {'count': s.count, 'total_s': s.total_s,
+               'mean_s': s.total_s / max(s.count, 1), 'max_s': s.max_s,
+               'first_s': s.first_s}
+        ramp = s.ramp()
+        if ramp is not None:
+            rec['ramp'] = ramp
+        occ = s.occupancy()
+        if occ is not None:
+            rec['occupancy'] = occ
+        return rec
+
     def report(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
-            return {
-                name: {'count': s.count, 'total_s': s.total_s,
-                       'mean_s': s.total_s / max(s.count, 1), 'max_s': s.max_s}
-                for name, s in self._stats.items()
-            }
+            return {name: self._stat_record(s)
+                    for name, s in self._stats.items()}
 
     def summary(self) -> str:
-        """Human-readable stage table, ordered by first occurrence."""
+        """Human-readable stage table, ordered by first occurrence.
+
+        Beyond the wall-time split, two pipeline-health columns:
+        ``occ%`` — aggregate batch occupancy (valid slots / all slots) where
+        the stage recorded it (the compiled device step under packed or
+        batched loops); ``ramp`` — first-call time over the steady-state
+        mean, i.e. the compile/warm-up wall the run amortizes (≈1 = none).
+        """
         # one lock acquisition for both stats and order: a concurrent add()
         # (e.g. a lingering prefetch thread) must not desync them
         with self._lock:
             order = list(self._order)
-            rep = {
-                name: {'count': s.count, 'total_s': s.total_s,
-                       'mean_s': s.total_s / max(s.count, 1), 'max_s': s.max_s}
-                for name, s in self._stats.items()
-            }
+            rep = {name: self._stat_record(s)
+                   for name, s in self._stats.items()}
         if not rep:
             return '(no stages recorded)'
         total = sum(r['total_s'] for r in rep.values())
         width = max(len(n) for n in order)
-        lines = [f'{"stage".ljust(width)} | count |  total s |   mean ms | share']
+        lines = [f'{"stage".ljust(width)} | count |  total s |   mean ms '
+                 f'| share |  occ% |   ramp']
         for name in order:
             r = rep[name]
             share = r['total_s'] / total * 100 if total else 0.0
+            occ = (f'{r["occupancy"] * 100:5.1f}'
+                   if 'occupancy' in r else '    -')
+            ramp = f'{r["ramp"]:6.1f}' if 'ramp' in r else '     -'
             lines.append(
                 f'{name.ljust(width)} | {r["count"]:5d} | {r["total_s"]:8.3f} '
-                f'| {r["mean_s"] * 1e3:9.2f} | {share:4.1f}%')
+                f'| {r["mean_s"] * 1e3:9.2f} | {share:4.1f}% | {occ} | {ramp}')
         return '\n'.join(lines)
 
     def reset(self) -> None:
