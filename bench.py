@@ -37,6 +37,13 @@ boundaries, parallel/packing.py) in the same session, with
 ``worklist_packed_batch_occupancy`` recording how full the compiled step
 actually ran.
 
+The serving rung (``serve_*``): the same worklist submitted as dynamic
+per-video requests over the warm-pool daemon's socket (serve/) —
+sustained warm clips/sec vs the cold-start rate a one-shot CLI pays,
+plus p50/p99 request latency and the warm-pool hit rate (asserted > 0,
+or the "warm" number is mislabeled). ``BENCH_SERVE=0/1`` overrides the
+accelerator-only default.
+
 Default precision is 'mixed' (ops/precision.py): ambient 3-pass bf16 with
 the drift-tolerant sub-graphs on 1-pass — measured ≤1e-3 feature drift vs
 float32 on the fused path (tools/precision_study.py), i.e. the fastest
@@ -159,6 +166,72 @@ def bench_family_ingraph(jax, ambient, device, init_fn, step_fn,
     count = (count_per_batch if count_per_batch is not None
              else batch_shape[0])
     return count * iters / elapsed
+
+
+def bench_serve(precision: str, batch: int, stack: int, tmp_dir: str,
+                platform: str, wl_paths: list) -> dict:
+    """The serving rung: sustained clips/sec + p50/p99 request latency
+    through the warm-pool service (serve/), against the SAME worklist the
+    cold-CLI rungs measure.
+
+    Two passes of per-video requests over the live socket: the COLD pass
+    pays transplant + compile inside its first request (what a cold CLI
+    invocation pays every time); the WARM pass is the steady state a
+    resident server actually serves — its pool hit rate must be > 0 or
+    the measurement is mislabeled (asserted). Fresh output roots per pass
+    keep the resume contract from turning pass 2 into an all-skip no-op.
+    """
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+    from video_features_tpu.utils.output import make_path
+
+    base = {
+        'device': platform, 'precision': precision,
+        'stack_size': stack, 'step_size': stack, 'batch_size': batch,
+        'allow_random_weights': True, 'on_extraction': 'save_numpy',
+        'tmp_path': os.path.join(tmp_dir, 'serve_tmp'),
+    }
+    server = ExtractionServer(
+        base_overrides=base,
+        queue_depth=max(64, 4 * len(wl_paths))).start()
+    try:
+        client = ServeClient(port=server.port)
+
+        def one_pass(tag):
+            out_root = os.path.join(tmp_dir, f'serve_out_{tag}')
+            t0 = time.perf_counter()
+            # one request per video: dynamic arrivals, packed across
+            # requests by the server — NOT one batch-submitted worklist
+            rids = [client.submit('i3d', [p],
+                                  overrides={'output_path': out_root})
+                    for p in wl_paths]
+            for rid in rids:
+                st = client.wait(rid, timeout_s=900)
+                assert st['state'] == 'done', f'serve pass {tag}: {st}'
+            return out_root, time.perf_counter() - t0
+
+        _, cold_s = one_pass('cold')
+        warm_root, warm_s = one_pass('warm')
+
+        clips = 0
+        for p in wl_paths:
+            # sanity_check appends <feature_type> to each request's root
+            arr = np.load(make_path(os.path.join(warm_root, 'i3d'),
+                                    p, 'rgb', '.npy'))
+            clips += arr.shape[0]
+        assert clips > 0, 'serve warm pass produced no clips'
+        m = client.metrics()
+        assert m['warm_pool']['hit_rate'] > 0, \
+            'warm pass never hit the warm pool — rung mislabeled'
+        return {
+            'serve_clips_per_sec': round(clips / warm_s, 3),
+            'serve_cold_clips_per_sec': round(clips / cold_s, 3),
+            'serve_p50_latency_s': m['latency']['p50_s'],
+            'serve_p99_latency_s': m['latency']['p99_s'],
+            'serve_warm_hit_rate': round(m['warm_pool']['hit_rate'], 4),
+        }
+    finally:
+        server.drain(wait=True, grace_s=120)
 
 
 def _bench_video(tmp_dir: str, seconds: str = None) -> str:
@@ -342,9 +415,9 @@ def run() -> dict:
             # Sustained multi-video worklist (resume contract + prefetch
             # + decode overlap live — the corpus-scale number, VERDICT r4
             # task 5); BENCH_WORKLIST=0/1 overrides.
+            wl_paths = None
             if os.environ.get('BENCH_WORKLIST',
                               '1' if on_accel else '0') == '1':
-                wl_paths = None
                 try:
                     from tools.worklist_bench import (
                         make_worklist, run_worklist,
@@ -380,6 +453,34 @@ def run() -> dict:
                     except Exception as e:
                         rungs['worklist_packed_error'] = \
                             f'{type(e).__name__}: {e}'
+            # The serving rung (serve/): the same worklist content
+            # submitted as dynamic per-video requests against the
+            # warm-pool daemon — sustained warm clips/sec, the cold-start
+            # rate a one-shot CLI pays, and request-latency percentiles.
+            # Independent of BENCH_WORKLIST (it builds its own worklist
+            # when that rung was skipped); BENCH_SERVE=0/1 overrides.
+            if os.environ.get('BENCH_SERVE',
+                              '1' if on_accel else '0') == '1':
+                try:
+                    if wl_paths is None:
+                        from tools.worklist_bench import make_worklist
+                        wl_paths = make_worklist(
+                            tmp_dir, 4 if on_accel else 2,
+                            10 if on_accel else 2)
+                    srec = bench_serve(precision, min(batch, 8), stack,
+                                       tmp_dir, platform, wl_paths)
+                    rungs[f'serve_clips_per_sec_{precision}'] = \
+                        srec['serve_clips_per_sec']
+                    rungs[f'serve_cold_clips_per_sec_{precision}'] = \
+                        srec['serve_cold_clips_per_sec']
+                    rungs['serve_p50_latency_s'] = \
+                        srec['serve_p50_latency_s']
+                    rungs['serve_p99_latency_s'] = \
+                        srec['serve_p99_latency_s']
+                    rungs['serve_warm_hit_rate'] = \
+                        srec['serve_warm_hit_rate']
+                except Exception as e:
+                    rungs['serve_error'] = f'{type(e).__name__}: {e}'
     if mode == 'e2e' and f'e2e_{precision}' in rungs:
         headline_key = f'e2e_{precision}'
 

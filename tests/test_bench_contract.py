@@ -50,3 +50,21 @@ def test_bench_mode_both_keeps_contract():
     # both rungs recorded (or an explicit e2e_error key — never a crash)
     assert any(k.startswith('ingraph_') for k in rec['rungs'])
     assert any(k.startswith('e2e') for k in rec['rungs'])
+
+
+def test_bench_serve_rung_emits_keys():
+    """BENCH_SERVE=1 drives the warm-pool service rung (serve/): the
+    record must carry the sustained + cold clips/sec, the latency
+    percentiles, and a warm-pool hit rate > 0 — all while keeping the
+    one-JSON-line stdout contract (the server threads print diagnostics
+    that must stay on stderr)."""
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_SERVE': '1', 'BENCH_WORKLIST': '0'})
+    rungs = rec['rungs']
+    assert 'serve_error' not in rungs, rungs.get('serve_error')
+    assert any(k.startswith('serve_clips_per_sec') for k in rungs)
+    assert any(k.startswith('serve_cold_clips_per_sec') for k in rungs)
+    assert rungs['serve_p50_latency_s'] > 0
+    assert rungs['serve_p99_latency_s'] >= rungs['serve_p50_latency_s']
+    assert rungs['serve_warm_hit_rate'] > 0

@@ -139,6 +139,25 @@ def test_precision_validated(tmp_path):
             'video_paths': v, 'device': 'cpu', 'precision': 'fp8'})
 
 
+def test_pack_fallback_warns_off_stdout(tmp_path, capsys):
+    """The pack_across_videos degradations must go through warnings.warn
+    (stderr), NOT print: with on_extraction=print the feature stream owns
+    stdout and an interleaved WARNING line breaks its parsers."""
+    v = _mk_video(tmp_path)
+    with pytest.warns(UserWarning, match='not implemented for vggish'):
+        args = load_config('vggish', overrides={
+            'video_paths': v, 'device': 'cpu', 'pack_across_videos': True})
+    assert args['pack_across_videos'] is False
+    assert 'WARNING' not in capsys.readouterr().out
+
+    with pytest.warns(UserWarning, match='show_pred is incompatible'):
+        args = load_config('resnet', overrides={
+            'video_paths': v, 'device': 'cpu', 'model_name': 'resnet18',
+            'pack_across_videos': True, 'show_pred': True})
+    assert args['pack_across_videos'] is False
+    assert 'WARNING' not in capsys.readouterr().out
+
+
 def test_precision_reaches_extractor(tmp_path):
     from video_features_tpu.registry import create_extractor
     v = _mk_video(tmp_path)

@@ -18,19 +18,7 @@ from video_features_tpu.registry import create_extractor
 from video_features_tpu.utils.output import make_path
 
 
-def _write_clip(path: str, n_frames: int, w: int = 64, h: int = 48,
-                seed: int = 0) -> str:
-    """A deterministic little mp4: a noise card scrolling horizontally."""
-    import cv2
-
-    wr = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*'mp4v'),
-                         25.0, (w, h))
-    rng = np.random.RandomState(seed)
-    base = (rng.rand(h, w, 3) * 255).astype(np.uint8)
-    for t in range(n_frames):
-        wr.write(np.roll(base, t * 3, axis=1))
-    wr.release()
-    return str(path)
+from tools.make_sample_video import write_noise_clip as _write_clip  # noqa: E402
 
 
 @pytest.fixture(scope='module')

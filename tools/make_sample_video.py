@@ -21,6 +21,26 @@ from pathlib import Path
 import numpy as np
 
 
+def write_noise_clip(path, n_frames: int, w: int = 64, h: int = 48,
+                     seed: int = 0) -> str:
+    """A deterministic little mp4: a noise card scrolling horizontally.
+
+    The ONE tiny-fixture clip writer shared by the packing/serve test
+    suites and the driver's ``dryrun_serve`` — a codec/fps tweak here
+    reaches every consumer at once.
+    """
+    import cv2
+
+    wr = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*'mp4v'),
+                         25.0, (w, h))
+    rng = np.random.RandomState(seed)
+    base = (rng.rand(h, w, 3) * 255).astype(np.uint8)
+    for t in range(n_frames):
+        wr.write(np.roll(base, t * 3, axis=1))
+    wr.release()
+    return str(path)
+
+
 def write_video(path: Path, seconds: float, fps: float, w: int, h: int) -> None:
     import cv2
 

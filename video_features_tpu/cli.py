@@ -19,9 +19,15 @@ from video_features_tpu.registry import create_extractor
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == 'serve':
+        # long-running warm-pool service (serve/): models stay resident,
+        # requests arrive over a local socket and pack into shared batches
+        from video_features_tpu.serve.server import serve_main
+        return serve_main(argv[1:])
     cli_args = parse_dotlist(argv)
     if 'feature_type' not in cli_args:
-        print('Usage: python -m video_features_tpu feature_type=<name> [key=value ...]')
+        print('Usage: python -m video_features_tpu feature_type=<name> [key=value ...]\n'
+              '       python -m video_features_tpu serve [serve_port=N ...]')
         return 2
     # single source of truth: multihost must come from the CLI because the
     # runtime must initialize before anything probes jax devices

@@ -9,8 +9,9 @@ from __future__ import annotations
 
 import os
 import random
+import warnings
 from pathlib import Path
-from typing import Any, Dict, Iterable, List, Optional, Union
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
 
 import yaml
 
@@ -193,15 +194,20 @@ def sanity_check(args: Config) -> None:
             args['data_parallel'] = False
     if args.get('pack_across_videos'):
         from video_features_tpu.registry import PACKED_FEATURES
+        # warnings.warn (→ stderr), NOT print: with on_extraction=print the
+        # features themselves go to stdout and a WARNING line interleaved
+        # there breaks downstream parsers of the feature stream
         if ft not in PACKED_FEATURES:
-            print(f'WARNING: pack_across_videos is not implemented for {ft} '
-                  '— running the per-video loop')
+            warnings.warn(
+                f'pack_across_videos is not implemented for {ft} — running '
+                'the per-video loop')
             args['pack_across_videos'] = False
         elif args.get('show_pred'):
             # show_pred is a per-video debug surface (it narrates windows in
             # video order); a packed batch interleaves videos
-            print('WARNING: show_pred is incompatible with '
-                  'pack_across_videos — running the per-video loop')
+            warnings.warn(
+                'show_pred is incompatible with pack_across_videos — '
+                'running the per-video loop')
             args['pack_across_videos'] = False
     if ft == 'i3d' and args.get('stack_size') is not None:
         assert args['stack_size'] >= 10, (
@@ -229,6 +235,73 @@ def sanity_check(args: Config) -> None:
         tmp = os.path.join(tmp, p.replace('/', '_'))
     args['output_path'] = out
     args['tmp_path'] = tmp
+
+
+# -- serving (python -m video_features_tpu serve) ---------------------------
+
+# Server-level knobs (everything else on the serve command line becomes a
+# BASE OVERRIDE merged under every request's config — e.g. device=tpu
+# allow_random_weights=true output_path=...). One flat namespace so the
+# serve CLI stays the same dotlist as extraction.
+SERVE_DEFAULTS: Dict[str, Any] = {
+    # local JSON-lines endpoint (requests + metrics); port 0 = ephemeral,
+    # printed at startup
+    'serve_host': '127.0.0.1',
+    'serve_port': 0,
+    # admission control: max videos queued-or-in-flight across the server;
+    # submits that would exceed it are REJECTED (backpressure), not queued
+    'serve_queue_depth': 64,
+    # warm-pool bound: distinct (feature_type, geometry, …) executables
+    # kept resident; LRU-evicted (gracefully drained) beyond this
+    'serve_warm_pool_size': 4,
+    # arrival-lull flush: when a worker's request feed is idle this long
+    # with windows still pooled, partial batches flush padded so a lone
+    # request's tail latency is bounded by this + one device step
+    'serve_idle_flush_s': 0.05,
+    # liveness bound under CONTINUOUS traffic: even with the queue never
+    # idle, partial geometry pools flush at least this often — a lone
+    # odd-geometry request can't starve behind a stream of other
+    # geometries (trade: more padded slots as this shrinks)
+    'serve_max_batch_wait_s': 2.0,
+    # default per-request deadline (seconds, null = none): requests whose
+    # deadline passes before a video STARTS decoding expire unstarted
+    'serve_default_timeout_s': None,
+    # optional metrics mirror: the live metrics JSON is atomically
+    # rewritten here on every request completion (scrape without a socket)
+    'serve_metrics_path': None,
+}
+
+
+def split_serve_config(cli_args: Dict[str, Any]) -> Tuple[Config, Config]:
+    """Split a serve-command dotlist into (server knobs, base overrides).
+
+    ``serve_*`` keys must be known (a typo'd knob silently becoming a
+    per-request override would be maddening to debug); everything else is
+    merged under every request's per-feature config via ``load_config``.
+    """
+    serve, base = Config(SERVE_DEFAULTS), Config()
+    for key, value in dict(cli_args).items():
+        if key.startswith('serve_'):
+            if key not in SERVE_DEFAULTS:
+                raise ValueError(
+                    f'Unknown serve option {key!r}. '
+                    f'Known: {", ".join(sorted(SERVE_DEFAULTS))}')
+            serve[key] = value
+        else:
+            base[key] = value
+    for key in ('serve_queue_depth', 'serve_warm_pool_size'):
+        serve[key] = int(serve[key])
+        if serve[key] < 1:
+            raise ValueError(f'{key} must be >= 1; got {serve[key]}')
+    serve['serve_port'] = int(serve['serve_port'])
+    for key in ('serve_idle_flush_s', 'serve_max_batch_wait_s'):
+        serve[key] = float(serve[key])
+        if serve[key] <= 0:
+            raise ValueError(f'{key} must be > 0')
+    if serve['serve_default_timeout_s'] is not None:
+        serve['serve_default_timeout_s'] = \
+            float(serve['serve_default_timeout_s'])
+    return serve, base
 
 
 def form_list_from_user_input(

@@ -192,14 +192,24 @@ class BaseExtractor:
         raise NotImplementedError
 
     def extract_packed(self, video_paths, decode_ahead: int = 2,
-                       batch_size: int = None) -> None:
-        """Run the whole worklist batch-major (see parallel.packing)."""
+                       batch_size: int = None, on_video_done=None,
+                       max_pool_age_s: float = None) -> None:
+        """Run the whole worklist batch-major (see parallel.packing).
+
+        ``video_paths`` may be any (lazily consumed, possibly blocking)
+        iterable of paths / ``VideoTask``s / ``FLUSH`` sentinels — the
+        serving layer feeds a live request queue through here;
+        ``on_video_done(task)`` fires as each video finalizes;
+        ``max_pool_age_s`` bounds how long a partial geometry pool may
+        wait for batch-mates (dynamic sources only — a static worklist
+        wants maximally full batches)."""
         if not self.supports_packing:
             raise NotImplementedError(
                 f'{type(self).__name__} does not support pack_across_videos')
         from video_features_tpu.parallel.packing import run_packed
         run_packed(self, video_paths, batch_size=batch_size,
-                   decode_ahead=decode_ahead)
+                   decode_ahead=decode_ahead, on_video_done=on_video_done,
+                   max_pool_age_s=max_pool_age_s)
 
 
     def _maybe_concat_streams(self, feats_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -216,8 +226,14 @@ class BaseExtractor:
 
     # -- output actions -----------------------------------------------------
 
-    def action_on_extraction(self, feats_dict: Dict[str, np.ndarray], video_path: str) -> None:
-        if self.on_extraction in ACTION_TO_EXT and self.is_already_exist(video_path):
+    def action_on_extraction(self, feats_dict: Dict[str, np.ndarray], video_path: str,
+                             output_path: str = None) -> None:
+        """``output_path`` (default: the extractor's configured root)
+        routes this one video's files elsewhere — the serving layer passes
+        each request's root through a shared warm extractor."""
+        out_root = output_path or self.output_path
+        if self.on_extraction in ACTION_TO_EXT and \
+                self.is_already_exist(video_path, output_path=out_root):
             # A concurrent worker finished this video while we extracted it.
             print('WARNING: extraction didnt find feature files on the 1st try '
                   'but did on the 2nd try.')
@@ -230,8 +246,8 @@ class BaseExtractor:
                 print(f'max: {value.max():.8f}; mean: {value.mean():.8f}; min: {value.min():.8f}')
                 print()
             elif self.on_extraction in ACTION_TO_EXT:
-                os.makedirs(self.output_path, exist_ok=True)
-                fpath = make_path(self.output_path, video_path, key,
+                os.makedirs(out_root, exist_ok=True)
+                fpath = make_path(out_root, video_path, key,
                                   ACTION_TO_EXT[self.on_extraction])
                 if key != 'fps' and len(value) == 0:
                     print(f'Warning: the value is empty for {key} @ {fpath}')
@@ -240,14 +256,16 @@ class BaseExtractor:
                 raise NotImplementedError(
                     f'on_extraction: {self.on_extraction} is not implemented')
 
-    def is_already_exist(self, video_path: Union[str, Path]) -> bool:
+    def is_already_exist(self, video_path: Union[str, Path],
+                         output_path: str = None) -> bool:
         """True iff every output file exists and loads cleanly (resume contract)."""
         if self.on_extraction not in ACTION_TO_EXT:
             return False
 
+        out_root = output_path or self.output_path
         keys = self._saved_feat_keys()
         for key in keys:
-            fpath = make_path(self.output_path, video_path, key,
+            fpath = make_path(out_root, video_path, key,
                               ACTION_TO_EXT[self.on_extraction])
             if not Path(fpath).exists():
                 return False
@@ -257,7 +275,7 @@ class BaseExtractor:
                 # Corrupted (e.g. a worker died mid-write) → re-extract.
                 return False
         print(f'Features for {video_path} already exist in '
-              f'{Path(self.output_path).absolute()}/ - skipping..')
+              f'{Path(out_root).absolute()}/ - skipping..')
         return True
 
     def _saved_feat_keys(self) -> List[str]:

@@ -86,6 +86,9 @@ def transfer_batches(items: Iterable[tuple], put, keep_host: bool = False,
 
     def to_device(item):
         batch = item[0]
+        if batch is None:
+            # batchless scheduler marker (packed NUDGE): nothing to copy
+            return (None, None) + tuple(item[1:])
         host = batch if keep_host else None
         with tracer.stage('h2d'):
             dev = put(batch)
@@ -115,9 +118,17 @@ def stream_windows_across_videos(tasks: Iterable,
     (KeyboardInterrupt re-raises). ``task.emitted``/``task.exhausted`` are
     maintained here — the scatter side uses them to decide when a video's
     features are complete.
+
+    The ``parallel.packing.FLUSH`` sentinel (dynamic sources: the serve
+    request feed marks an arrival lull) passes straight through to the
+    downstream packer, which flushes its partial geometry pools.
     """
     from video_features_tpu.extract.base import log_extraction_error
+    from video_features_tpu.parallel.packing import FLUSH, NUDGE
     for task in tasks:
+        if task is FLUSH:
+            yield FLUSH
+            continue
         try:
             for window, meta in open_windows(task):
                 if task.failed:
@@ -136,6 +147,11 @@ def stream_windows_across_videos(tasks: Iterable,
             log_extraction_error(task.path)
         finally:
             task.exhausted = True
+        if task.emitted == 0:
+            # no batch will ever carry this video's completion (resume
+            # skip / too-short clip / failed open): NUDGE the consumer so
+            # it finalizes NOW — a dynamic stream may not end for hours
+            yield NUDGE
 
 
 def stream_windows(batches: Iterable, win: int, step: int,

@@ -27,13 +27,37 @@ Composition: batches go through ``BaseExtractor.put_input``, so
 ``data_parallel=true`` sharding works unchanged; the worklist arrives
 already sharded per host in multihost runs (``cli.py``), so packing is a
 per-host concern and needs no cross-host coordination.
+
+Since the serving layer (``serve/``) the worklist no longer has to be a
+static list: ``run_packed`` consumes its ``video_paths`` iterable lazily
+(it may block — e.g. on a request queue) and accepts pre-built
+``VideoTask`` objects, so dynamically arriving requests pack into the
+same device batches as a static corpus. The ``FLUSH`` sentinel bounds
+latency under dynamic arrivals: when the source momentarily runs dry it
+can push ``FLUSH`` through the stream to force the partial geometry
+pools out as padded batches instead of holding a lone request's windows
+hostage until the next request happens to share its geometry.
 """
 from __future__ import annotations
 
 import traceback
-from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+# Stream sentinel: "no more input for now — flush partial pools". Yielded
+# by dynamic sources (the serve request feed) between arrival bursts;
+# passes through the windower/prefetch layers untouched and is consumed
+# by ``packed_batches``. Identity-compared everywhere (``is FLUSH``).
+FLUSH = object()
+
+# Stream marker: "a video exhausted without emitting any window" (resume
+# skip, zero-window clip, failed open). It must REACH the consumer — all
+# finalization runs on the consumer thread, and with no batch to carry the
+# news a dynamic stream would otherwise not finalize such videos until
+# drain (an all-skip request would hang). ``packed_batches`` forwards it
+# as a batchless ``(None, [], 0)`` item that triggers a sweep.
+NUDGE = object()
 
 
 class VideoTask:
@@ -46,15 +70,21 @@ class VideoTask:
     the scattered per-window feature rows (in window order — the packer
     preserves per-video FIFO because a video's windows share one geometry
     pool); ``info`` carries video-level metadata (e.g. fps) set by the
-    extractor's window stream.
+    extractor's window stream. ``out_root`` (None for CLI worklists)
+    overrides the extractor's ``output_path`` for this one video — the
+    serving layer routes concurrent requests with different output roots
+    through one shared warm extractor.
     """
 
     __slots__ = ('path', 'video_id', 'rows', 'meta_rows', 'info',
-                 'emitted', 'done', 'exhausted', 'failed', 'skipped')
+                 'emitted', 'done', 'exhausted', 'failed', 'skipped',
+                 'out_root')
 
-    def __init__(self, path: str, video_id: int) -> None:
+    def __init__(self, path: str, video_id: int = -1,
+                 out_root: Optional[str] = None) -> None:
         self.path = path
         self.video_id = video_id
+        self.out_root = out_root
         self.rows: Dict[str, List[np.ndarray]] = {}
         self.meta_rows: List = []
         self.info: Dict = {}
@@ -65,8 +95,9 @@ class VideoTask:
         self.skipped = False
 
 
-def packed_batches(windows: Iterable[tuple],
-                   batch: int) -> Iterator[Tuple[np.ndarray, list, int]]:
+def packed_batches(windows: Iterable[tuple], batch: int,
+                   max_pool_age_s: Optional[float] = None,
+                   ) -> Iterator[Tuple[np.ndarray, list, int]]:
     """Group a cross-video ``(task, window, meta)`` stream into full
     fixed-size batches: ``(stacks, provenance, valid)`` where provenance is
     the per-slot ``(task, meta)`` list for the ``valid`` real slots.
@@ -79,33 +110,79 @@ def packed_batches(windows: Iterable[tuple],
     (repeating the last window, masked via ``valid``) only once the whole
     worklist is drained — that final partial batch per geometry is the only
     padding the corpus pays, vs one per video in the per-video loop.
-    """
-    pools: Dict[tuple, list] = {}
 
-    def flush(pool):
+    A ``FLUSH`` item in the stream forces that tail flush early, for
+    dynamic sources whose "worklist" has momentarily run dry: a serving
+    queue must bound a lone request's latency by batch-padding now rather
+    than waiting for future arrivals to fill the pool.
+
+    ``max_pool_age_s`` (serving: ``serve_max_batch_wait_s``) additionally
+    ages pools OUT-OF-BAND of the source: any pool whose oldest window
+    has waited that long flushes padded as the next window — of ANY
+    geometry — arrives. This is what bounds a lone odd-geometry request
+    under CONTINUOUS traffic, where the upstream feed is never idle (and
+    so never emits FLUSH) but other geometries' windows keep flowing.
+    """
+    import time as _time
+
+    pools: Dict[tuple, list] = {}
+    ages: Dict[tuple, float] = {}      # key → oldest pooled window's time
+
+    def flush(key):
+        pool = pools[key]
+        pools[key] = []
+        ages.pop(key, None)
         valid = len(pool)
         wins = [w for _, w, _ in pool]
         while len(wins) < batch:
             wins.append(wins[-1])
         return np.stack(wins), [(t, m) for t, _, m in pool], valid
 
-    for task, window, meta in windows:
+    for item in windows:
+        if item is FLUSH:
+            for key in list(pools):
+                if pools[key]:
+                    yield flush(key)
+            continue
+        if item is NUDGE:
+            # batchless marker: lets the consumer sweep for zero-window
+            # videos without waiting for a real batch (or stream end)
+            yield None, [], 0
+            continue
+        task, window, meta = item
         window = np.asarray(window)
         key = (window.shape, window.dtype.str)
         pool = pools.setdefault(key, [])
+        if not pool:
+            ages[key] = _time.monotonic()
         pool.append((task, window, meta))
         if len(pool) == batch:
-            yield flush(pool)
-            pools[key] = []
-    for pool in pools.values():
-        if pool:
-            yield flush(pool)
+            yield flush(key)
+        if max_pool_age_s is not None:
+            now = _time.monotonic()
+            for k in list(pools):
+                if pools[k] and now - ages[k] >= max_pool_age_s:
+                    yield flush(k)
+    for key in list(pools):
+        if pools[key]:
+            yield flush(key)
 
 
-def run_packed(ex, video_paths: Iterable[str],
+def run_packed(ex, video_paths: Iterable,
                batch_size: Optional[int] = None,
-               decode_ahead: int = 2) -> None:
+               decode_ahead: int = 2,
+               on_video_done: Optional[Callable] = None,
+               max_pool_age_s: Optional[float] = None) -> None:
     """Drive one extractor over the whole worklist, batch-major.
+
+    ``video_paths`` yields ``str`` paths, pre-built :class:`VideoTask`
+    objects (dynamic sources attach request state / ``out_root``), or the
+    ``FLUSH`` sentinel; it is consumed LAZILY on the decode thread and may
+    block — a serving queue feeds the packer exactly like a static
+    worklist, the stream simply ends when the source drains.
+    ``on_video_done(task)`` (if given) fires after each video finalizes —
+    saved, skipped, failed, or empty — which is how the serving layer maps
+    scattered videos back to request completions.
 
     Preserves every externally observable per-video contract:
 
@@ -134,7 +211,24 @@ def run_packed(ex, video_paths: Iterable[str],
 
     ex._packed_setup()
     batch = int(batch_size or ex.packed_batch_size())
-    tasks = [VideoTask(p, i) for i, p in enumerate(video_paths)]
+
+    # open_q doubles as the lazy task registry: the decode thread appends
+    # each task as the source yields it (list.append is atomic; only the
+    # consumer thread deletes), so a blocking dynamic source needs no
+    # up-front worklist materialization.
+    open_q: List[VideoTask] = []
+    n_started = [0]
+
+    def task_stream() -> Iterator:
+        for item in video_paths:
+            if item is FLUSH:
+                yield FLUSH
+                continue
+            task = item if isinstance(item, VideoTask) else VideoTask(item)
+            task.video_id = n_started[0]
+            n_started[0] += 1
+            open_q.append(task)
+            yield task
 
     def open_windows(task: VideoTask):
         # The resume check runs here — lazily, as the decode side reaches
@@ -142,7 +236,13 @@ def run_packed(ex, video_paths: Iterable[str],
         # every output file, and an eager pass over a mostly-done 20K
         # worklist would block for minutes before the first batch packs.
         # Amortized across the run it costs what the per-video loop paid.
-        if ex.is_already_exist(task.path):
+        # the output_path kwarg is passed only when a task carries a
+        # per-request root: hooks monkeypatched/overridden with the
+        # classic (self, video_path) signature keep working for CLI runs
+        exists = (ex.is_already_exist(task.path, output_path=task.out_root)
+                  if task.out_root is not None
+                  else ex.is_already_exist(task.path))
+        if exists:
             task.skipped = True
             return iter(())
         return ex.packed_windows(task)
@@ -156,23 +256,27 @@ def run_packed(ex, video_paths: Iterable[str],
     # stops at the first video the decode side hasn't reached (videos
     # start strictly in worklist order), so each sweep touches only the
     # small in-flight window, not the whole worklist.
-    open_q: List[VideoTask] = list(tasks)
 
     def finalize(t: VideoTask) -> None:
-        if t.failed or t.skipped:
-            t.rows = {}
-            return
         from video_features_tpu.extract.base import log_extraction_error
         try:
-            feats_dict = ex._maybe_concat_streams(ex.packed_result(t))
-            with ex.tracer.stage('save'):
-                ex.action_on_extraction(feats_dict, t.path)
+            if not (t.failed or t.skipped):
+                feats_dict = ex._maybe_concat_streams(ex.packed_result(t))
+                with ex.tracer.stage('save'):
+                    if t.out_root is not None:
+                        ex.action_on_extraction(feats_dict, t.path,
+                                                output_path=t.out_root)
+                    else:
+                        ex.action_on_extraction(feats_dict, t.path)
         except KeyboardInterrupt:
             raise
         except Exception:
+            t.failed = True           # a failed save IS a failed video
             log_extraction_error(t.path)
         finally:
             t.rows = {}               # free feature memory as we go
+            if on_video_done is not None:
+                on_video_done(t)
 
     def sweep(final: bool = False) -> None:
         i = 0
@@ -192,18 +296,40 @@ def run_packed(ex, video_paths: Iterable[str],
                 f'packed scheduler lost windows for {t.path}: '
                 f'{t.done}/{t.emitted} scattered, exhausted={t.exhausted}')
 
-    source = stream_windows_across_videos(tasks, open_windows)
-    # decode (and host preprocessing) runs on the prefetch producer thread,
-    # ahead of the device across video boundaries; wrap_iter inside the
-    # prefetch so decode time is attributed where it is actually spent
-    timed = ex.tracer.wrap_iter('decode+preprocess', source)
+    source = stream_windows_across_videos(task_stream(), open_windows)
+
+    def timed_source():
+        # decode (and host preprocessing) runs on the prefetch producer
+        # thread, ahead of the device across video boundaries; timed here
+        # (inside the prefetch) so decode cost lands on the thread that
+        # spends it. A dynamic source (serve) also BLOCKS in next() while
+        # its request queue is idle — those spans surface as FLUSH items
+        # and are attributed to a separate ``queue_idle`` stage, not
+        # laundered into decode time.
+        import time as _time
+        it = iter(source)
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            ex.tracer.add('queue_idle' if item is FLUSH
+                          else 'decode+preprocess',
+                          _time.perf_counter() - t0)
+            yield item
+
+    timed = timed_source() if ex.tracer.enabled else source
     ahead = prefetch_across_videos(timed, decode_ahead * batch)
 
     with ex.precision_scope():
         # batch assembly + H2D of batch k+1 overlap the device running k
         for dev, _, prov, valid in transfer_batches(
-                packed_batches(ahead, batch), ex.put_input,
-                tracer=ex.tracer):
+                packed_batches(ahead, batch, max_pool_age_s=max_pool_age_s),
+                ex.put_input, tracer=ex.tracer):
+            if dev is None:
+                sweep()           # NUDGE: zero-window videos finalize now
+                continue
             try:
                 with ex.tracer.stage('model'):
                     out = ex.packed_step(dev)
@@ -237,7 +363,7 @@ def run_packed(ex, video_paths: Iterable[str],
     sweep(final=True)
 
     if ex.tracer.enabled and ex.tracer.report():
-        print(f'--- stage timing: packed worklist ({len(tasks)} videos, '
+        print(f'--- stage timing: packed worklist ({n_started[0]} videos, '
               f'batch {batch})')
         print(ex.tracer.summary())
         ex.tracer.reset()

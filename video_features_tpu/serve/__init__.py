@@ -1,0 +1,21 @@
+"""Warm-pool extraction service: the long-running serving layer.
+
+``python -m video_features_tpu serve`` starts the daemon
+(:mod:`serve.server`); :mod:`serve.client` talks to it;
+:mod:`serve.pool` keeps transplanted weights + compiled executables
+resident; :mod:`serve.metrics` is the live health surface. See
+``docs/serving.md``.
+"""
+from video_features_tpu.serve.client import ServeClient, ServeError  # noqa: F401
+from video_features_tpu.serve.pool import WarmPool  # noqa: F401
+
+__all__ = ['ServeClient', 'ServeError', 'WarmPool', 'ExtractionServer']
+
+
+def __getattr__(name):
+    # ExtractionServer pulls in config/registry (and transitively jax at
+    # request time); keep the package importable feather-light for clients
+    if name == 'ExtractionServer':
+        from video_features_tpu.serve.server import ExtractionServer
+        return ExtractionServer
+    raise AttributeError(name)

@@ -1,0 +1,98 @@
+"""Thin client for the warm-pool extraction service.
+
+One connection per call (submit/status/metrics are sub-millisecond
+against a loopback endpoint — holding a pooled connection buys nothing
+and would add reconnect logic); ``wait`` polls status. Raises
+:class:`ServeError` for any ``ok: false`` response so callers get Python
+exceptions, not dicts to inspect.
+"""
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Dict, List, Optional
+
+from video_features_tpu.serve import protocol
+
+
+class ServeError(RuntimeError):
+    """The server answered ``ok: false`` (the message is the reason)."""
+
+
+class ServeClient:
+    def __init__(self, port: int, host: str = '127.0.0.1',
+                 connect_timeout_s: float = 10.0) -> None:
+        self.host, self.port = host, int(port)
+        self.connect_timeout_s = connect_timeout_s
+
+    def _connect(self) -> socket.socket:
+        conn = socket.create_connection((self.host, self.port),
+                                        timeout=self.connect_timeout_s)
+        conn.settimeout(None)                 # extraction can take a while
+        return conn
+
+    @staticmethod
+    def _read_response(rfile) -> Dict[str, Any]:
+        line = rfile.readline()
+        if not line:
+            raise ServeError('server closed the connection')
+        resp = protocol.decode(line)
+        if not resp.get('ok'):
+            raise ServeError(resp.get('error', 'unknown server error'))
+        return resp
+
+    def _call(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._connect() as conn:
+            conn.sendall(protocol.encode(msg))
+            with conn.makefile('rb') as rfile:
+                return self._read_response(rfile)
+
+    # -- commands ------------------------------------------------------------
+
+    def ping(self) -> bool:
+        return bool(self._call({'cmd': 'ping'}).get('ok'))
+
+    def submit(self, feature_type: str, video_paths: List[str],
+               overrides: Optional[Dict[str, Any]] = None,
+               timeout_s: Optional[float] = None) -> str:
+        """Enqueue one extraction request; returns its request_id.
+        Raises :class:`ServeError` on rejection (queue_full, draining,
+        invalid config, …) — backpressure is the caller's to handle."""
+        msg: Dict[str, Any] = {'cmd': 'submit', 'feature_type': feature_type,
+                               'video_paths': list(video_paths)}
+        if overrides:
+            msg['overrides'] = dict(overrides)
+        if timeout_s is not None:
+            msg['timeout_s'] = float(timeout_s)
+        return self._call(msg)['request_id']
+
+    def status(self, request_id: str) -> Dict[str, Any]:
+        return self._call({'cmd': 'status', 'request_id': request_id})
+
+    def wait(self, request_id: str, timeout_s: float = 300.0,
+             poll_s: float = 0.05) -> Dict[str, Any]:
+        """Block until the request reaches a terminal state; returns the
+        final status snapshot. Polls over ONE persistent connection — the
+        protocol is request/response per line, and a waiter reconnecting
+        20×/s would make the server churn a handler thread per poll."""
+        deadline = time.monotonic() + timeout_s
+        with self._connect() as conn:
+            rfile = conn.makefile('rb')
+            while True:
+                conn.sendall(protocol.encode(
+                    {'cmd': 'status', 'request_id': request_id}))
+                st = self._read_response(rfile)
+                if st['state'] != 'running':
+                    return st
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f'request {request_id} still {st["state"]} after '
+                        f'{timeout_s}s: {st}')
+                time.sleep(poll_s)
+
+    def metrics(self) -> Dict[str, Any]:
+        return self._call({'cmd': 'metrics'})['metrics']
+
+    def drain(self) -> None:
+        """Ask the server to drain (finish queued work, then exit)."""
+        self._call({'cmd': 'drain'})

@@ -1,0 +1,100 @@
+"""Live metrics for the extraction service.
+
+One JSON document (schema in ``docs/serving.md``) assembled on demand
+from sources that are each already thread-safe — the warm pool's
+counters, the admission gate's depth, per-request latency samples, and
+every pool entry's ``utils.tracing.Tracer`` report (stage latencies,
+batch occupancy, compile ramp). Exposed two ways: the ``metrics`` socket
+command, and — when ``serve_metrics_path`` is set — an atomically
+rewritten JSON file (``utils.output.atomic_write``: a scraper never
+reads a torn document).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from video_features_tpu.utils.tracing import merge_reports
+
+# bounded latency window: p50/p99 over the most recent completions, not
+# an unbounded all-time list (a week-long server would otherwise grow
+# without bound and average away regressions)
+LATENCY_WINDOW = 1024
+
+
+class RequestStats:
+    """Thread-safe request counters + completion-latency window."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counts = {'submitted': 0, 'completed': 0, 'failed': 0,
+                       'rejected': 0, 'expired_videos': 0}
+        self._latencies: List[float] = []
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counts[key] += n
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(float(seconds))
+            if len(self._latencies) > LATENCY_WINDOW:
+                del self._latencies[:-LATENCY_WINDOW]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = dict(self.counts)
+            lat = list(self._latencies)
+        out: Dict[str, Any] = {'requests': counts}
+        if lat:
+            out['latency'] = {
+                'count': len(lat),
+                'p50_s': round(float(np.percentile(lat, 50)), 4),
+                'p99_s': round(float(np.percentile(lat, 99)), 4),
+                'max_s': round(max(lat), 4),
+            }
+        else:
+            out['latency'] = {'count': 0, 'p50_s': None, 'p99_s': None,
+                              'max_s': None}
+        return out
+
+
+def build_metrics(started_at: float,
+                  queue_depth: int,
+                  queue_capacity: int,
+                  draining: bool,
+                  pool_stats: Dict[str, Any],
+                  request_stats: RequestStats,
+                  stage_reports: Dict[str, Dict],
+                  ) -> Dict[str, Any]:
+    """Assemble the one metrics document. ``stage_reports`` maps a
+    human-readable pool-entry label → that entry's ``Tracer.report()``;
+    the aggregate view merges them (``tracing.merge_reports``)."""
+    doc: Dict[str, Any] = {
+        'uptime_s': round(time.monotonic() - started_at, 3),
+        'queue': {'depth': queue_depth, 'capacity': queue_capacity,
+                  'draining': draining},
+        'warm_pool': pool_stats,
+    }
+    doc.update(request_stats.snapshot())
+    doc['stages'] = {label: rep for label, rep in stage_reports.items()}
+    doc['stages_merged'] = merge_reports(stage_reports.values())
+    return doc
+
+
+def write_metrics_file(path: Optional[str], doc: Dict[str, Any]) -> None:
+    """Atomically mirror the metrics document to ``path`` (no-op if unset).
+    Failures are swallowed — metrics mirroring must never take down the
+    serving loop."""
+    if not path:
+        return
+    from video_features_tpu.utils.output import atomic_write
+    try:
+        atomic_write(path, lambda f: f.write(
+            json.dumps(doc, sort_keys=True).encode('utf-8')))
+    except OSError:
+        pass

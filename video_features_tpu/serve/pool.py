@@ -1,0 +1,118 @@
+"""Warm pool: LRU-bounded cache of live extractor workers.
+
+The cost structure serving must hide: building an extractor transplants
+weights (seconds) and the first batch through a geometry compiles an XLA
+executable (more seconds). Both attach to the extractor instance — its
+params live on device, its jitted step functions cache per input shape —
+so keeping the INSTANCE resident keeps everything warm. The pool keys
+entries by executable identity (``serve.server.pool_key``: feature_type,
+model/geometry knobs, precision, device — everything that changes the
+compiled program or the weights) and bounds residency with LRU eviction,
+because each entry pins HBM for its params.
+
+Eviction is GRACEFUL: an entry may have queued work, so the pool never
+hard-kills — it calls ``entry.close()`` (stop accepting, drain, exit) and
+hands the entry back to the caller to join. Busy entries are passed over
+in favor of idle ones; if every entry is busy the pool temporarily runs
+over capacity rather than stalling admission behind a drain.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+
+class WarmPool:
+    """Thread-safe LRU of serve workers with hit/miss/eviction accounting."""
+
+    def __init__(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError(f'warm pool capacity must be >= 1: {capacity}')
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: 'OrderedDict[tuple, Any]' = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: tuple) -> Optional[Any]:
+        """The entry for ``key`` (refreshing its recency) or None. Counts
+        a hit or a miss — the serve metrics hit rate is exactly this."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry
+
+    def peek(self, key: tuple) -> Optional[Any]:
+        """Like :meth:`get` but counts nothing and touches no recency —
+        for double-checked insertion after a lockless build."""
+        with self._lock:
+            return self._entries.get(key)
+
+    def put(self, key: tuple, entry: Any) -> List[Any]:
+        """Insert a fresh entry; returns the entries LRU-evicted to make
+        room (already ``close()``d — caller joins/retires them). Only
+        ``entry.idle()`` entries are evicted; when all are busy the pool
+        runs over capacity until a later ``put`` finds an idle victim."""
+        evicted = []
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            excess = len(self._entries) - self.capacity
+            if excess > 0:
+                for k in list(self._entries):
+                    if excess == 0:
+                        break
+                    if k == key:
+                        continue
+                    victim = self._entries[k]
+                    if victim.idle():
+                        del self._entries[k]
+                        self.evictions += 1
+                        evicted.append(victim)
+                        excess -= 1
+        for victim in evicted:
+            victim.close()
+        return evicted
+
+    def entries(self) -> List[Any]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def remove(self, key: tuple, entry: Any = None) -> Optional[Any]:
+        """Drop ``key`` without counting an eviction (crash retirement —
+        the caller already owns closing the entry). With ``entry`` given,
+        remove only if the slot still holds THAT entry: a crashed
+        worker's retirement must not evict the healthy replacement a
+        concurrent submit already installed under the same key."""
+        with self._lock:
+            current = self._entries.get(key)
+            if current is None or (entry is not None
+                                   and current is not entry):
+                return None
+            del self._entries[key]
+            return current
+
+    def pop_all(self) -> List[Any]:
+        """Remove every entry (drain path); caller closes/joins them."""
+        with self._lock:
+            out = list(self._entries.values())
+            self._entries.clear()
+            return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                'size': len(self._entries),
+                'capacity': self.capacity,
+                'hits': self.hits,
+                'misses': self.misses,
+                'hit_rate': (self.hits / total) if total else 0.0,
+                'evictions': self.evictions,
+            }

@@ -1,0 +1,61 @@
+"""Wire protocol for the warm-pool extraction service: JSON lines over a
+local TCP socket.
+
+One request per line, one response per line, UTF-8, newline-delimited —
+the simplest framing that composes with ``socket.makefile`` buffering,
+survives partial reads, and stays debuggable with ``nc``/``telnet``. The
+endpoint binds loopback only; this is a LOCAL control surface (same
+trust domain as the process), not an internet-facing API.
+
+Commands (the ``cmd`` field):
+
+  * ``submit``  — ``{cmd, feature_type, video_paths: [..],
+    overrides: {..}, timeout_s}`` → ``{ok, request_id}`` or
+    ``{ok: false, error}``. ``overrides`` merge over the server's base
+    overrides and the feature YAML exactly like CLI dotlist keys.
+  * ``status``  — ``{cmd, request_id}`` → per-request state + per-video
+    states (see ``serve.server.Request.snapshot``).
+  * ``metrics`` — ``{cmd}`` → the live metrics document
+    (``docs/serving.md`` schema).
+  * ``drain``   — stop admitting, finish everything queued, shut down.
+  * ``ping``    — liveness probe.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+COMMANDS = ('submit', 'status', 'metrics', 'drain', 'ping')
+
+# submit() fields copied verbatim into the request (everything else in the
+# message is rejected — catches client/server schema drift loudly)
+SUBMIT_FIELDS = ('cmd', 'feature_type', 'video_paths', 'overrides',
+                 'timeout_s')
+
+
+def encode(msg: Dict[str, Any]) -> bytes:
+    """One wire frame. Rejects objects whose JSON would embed a newline
+    (impossible for json.dumps output, but the assert documents the
+    framing invariant the reader relies on)."""
+    line = json.dumps(msg, separators=(',', ':'))
+    assert '\n' not in line
+    return line.encode('utf-8') + b'\n'
+
+
+def decode(line: bytes) -> Dict[str, Any]:
+    msg = json.loads(line.decode('utf-8'))
+    if not isinstance(msg, dict):
+        raise ValueError('protocol messages must be JSON objects')
+    return msg
+
+
+def error(message: str, **extra: Any) -> Dict[str, Any]:
+    out = {'ok': False, 'error': message}
+    out.update(extra)
+    return out
+
+
+def ok(**fields: Any) -> Dict[str, Any]:
+    out = {'ok': True}
+    out.update(fields)
+    return out

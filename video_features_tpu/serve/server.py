@@ -1,0 +1,672 @@
+"""The warm-pool extraction service: a long-running serving daemon.
+
+``python -m video_features_tpu serve [serve_*=.. base_override=..]``
+turns the run-to-completion toolkit into a resident server: models stay
+transplanted and compiled in a :class:`serve.pool.WarmPool`, and
+dynamically arriving requests feed the SAME batch-major packer that PR 1
+built for static worklists (``parallel/packing.py``) — windows from
+concurrent requests fill shared device batches, with the per-video
+fault-isolation and scatter-back contract carried over unchanged, so one
+bad request never poisons a batch it shares.
+
+Architecture (all per-process, loopback-only):
+
+  accept thread ── JSON lines (serve/protocol.py) ── per-conn handlers
+        │ submit                                        │ status/metrics
+        ▼                                               ▼
+  admission gate (bounded queue depth, per-request deadline)
+        │ pool hit → enqueue      │ pool miss → build extractor (warm)
+        ▼                         ▼
+  one _Worker per warm-pool entry: a queue-fed generator streaming
+  VideoTasks (+ FLUSH on arrival lulls) into ``run_packed``, which never
+  returns until the worker drains — requests arriving while the device
+  runs batch k pack into batch k+1.
+
+Graceful drain (SIGTERM / ``drain`` command): admission closes, every
+worker's feed ends after its queued videos, ``run_packed`` flushes its
+tail pools and finalizes every started video, then the process exits —
+no completed request's output is ever lost, and interrupted videos
+re-extract on restart via the unchanged resume contract.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import signal
+import socket
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from video_features_tpu.config import Config, load_config, split_serve_config
+from video_features_tpu.parallel.packing import FLUSH, VideoTask
+from video_features_tpu.registry import PACKED_FEATURES, create_extractor
+from video_features_tpu.serve import metrics as metrics_mod
+from video_features_tpu.serve import protocol
+from video_features_tpu.serve.pool import WarmPool
+
+_CLOSE = object()
+
+# terminal requests retained for status() queries; older ones age out so a
+# week-long daemon's request table stays bounded (same reasoning as
+# metrics.LATENCY_WINDOW)
+REQUEST_HISTORY = 4096
+
+# config keys that do NOT change the compiled program or the weights —
+# everything else lands in the pool key. Output roots are per-REQUEST
+# (VideoTask.out_root routes them through a shared extractor), video lists
+# are the payload, and profile is forced on for the metrics surface.
+# tmp_path stays IN the key: loaders read the entry's tmp root, so a
+# request with a different tmp_path must get its own entry rather than
+# silently writing re-encode temps under another request's root.
+_KEY_EXCLUDE = frozenset({
+    'video_paths', 'file_with_video_paths', 'output_path',
+    'profile', 'profile_dir', 'timeout_s',
+})
+
+
+def pool_key(args: Config) -> tuple:
+    """Executable identity of a sanity-checked request config."""
+    return tuple(sorted((k, repr(v)) for k, v in args.items()
+                        if k not in _KEY_EXCLUDE))
+
+
+class _ServeTask(VideoTask):
+    """A packed-scheduler task carrying its originating request."""
+
+    __slots__ = ('request',)
+
+    def __init__(self, path: str, request: 'Request',
+                 out_root: str) -> None:
+        super().__init__(path, out_root=out_root)
+        self.request = request
+
+
+class Request:
+    """Admission-to-completion state for one submit."""
+
+    def __init__(self, request_id: str, feature_type: str, paths: List[str],
+                 deadline: Optional[float]) -> None:
+        self.id = request_id
+        self.feature_type = feature_type
+        self.videos: Dict[str, str] = {p: 'pending' for p in paths}
+        self.pending = len(paths)
+        self.deadline = deadline          # monotonic, None = no deadline
+        self.t0 = time.monotonic()
+        self.done_t: Optional[float] = None
+
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() >= self.deadline
+
+    def state(self) -> str:
+        if self.pending > 0:
+            return 'running'
+        states = set(self.videos.values())
+        if states <= {'saved', 'skipped'}:
+            return 'done'
+        if states & {'saved', 'skipped'}:
+            return 'partial'
+        return 'failed'
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = {'request_id': self.id, 'state': self.state(),
+               'feature_type': self.feature_type,
+               'videos': dict(self.videos)}
+        if self.done_t is not None:
+            out['latency_s'] = round(self.done_t - self.t0, 4)
+        return out
+
+
+class _Worker:
+    """One warm-pool entry: an extractor + the thread that drives one
+    long-lived ``run_packed`` over a queue-fed task stream."""
+
+    def __init__(self, server: 'ExtractionServer', key: tuple, label: str,
+                 extractor, idle_flush_s: float,
+                 max_batch_wait_s: float = 2.0) -> None:
+        self.server = server
+        self.key = key
+        self.label = label
+        self.ex = extractor
+        self.idle_flush_s = idle_flush_s
+        self.max_batch_wait_s = max_batch_wait_s
+        self.queue: 'queue.Queue' = queue.Queue()
+        self.outstanding: set = set()
+        self._lock = threading.Lock()
+        self.closed = False
+        self.crashed = False
+        self.thread = threading.Thread(
+            target=self._run, name=f'serve-worker-{label}', daemon=True)
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def submit(self, tasks: List[_ServeTask]) -> None:
+        with self._lock:
+            self.outstanding.update(tasks)
+        for t in tasks:
+            self.queue.put(t)
+        if self.crashed:
+            # lost the race with a crash mid-submit: the crash handler may
+            # have already swept outstanding — fail whatever it missed so
+            # no request hangs
+            with self._lock:
+                stranded = [t for t in tasks if t in self.outstanding]
+                for t in stranded:
+                    self.outstanding.discard(t)
+            for t in stranded:
+                t.failed = True
+                self.server._video_done(t)
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self.outstanding
+
+    def close(self) -> None:
+        """Stop accepting; the feed ends after everything already queued."""
+        self.closed = True
+        self.queue.put(_CLOSE)
+
+    def _feed(self):
+        """Blocking task stream for ``run_packed``: yields queued tasks,
+        skips videos whose request deadline already passed, and emits
+        FLUSH (a) after each arrival burst — pooled windows never wait on
+        future traffic — and (b) at least every ``max_batch_wait_s``
+        between tasks. The primary continuous-traffic liveness bound is
+        ``packed_batches``' pool aging (it fires on every flowing
+        window, mid-video included); this feed-level timer covers the
+        complement where tasks flow but windows don't (e.g. a run of
+        resume-skip requests while an odd-geometry window sits pooled)."""
+        dirty = False
+        last_flush = time.monotonic()
+        while True:
+            was_idle = not dirty
+            try:
+                item = self.queue.get(
+                    timeout=self.idle_flush_s if dirty else None)
+            except queue.Empty:
+                dirty = False
+                last_flush = time.monotonic()
+                yield FLUSH
+                continue
+            if item is _CLOSE:
+                return
+            task = item
+            if task.request.expired():
+                with self._lock:
+                    self.outstanding.discard(task)
+                self.server._video_expired(task)
+                continue
+            if was_idle:
+                # the blocking wait just ended inside the scheduler's
+                # next(); yielding FLUSH first pins that idle span on the
+                # queue_idle stage instead of this task's decode time
+                # (no-op for the empty pools)
+                last_flush = time.monotonic()
+                yield FLUSH
+            elif time.monotonic() - last_flush >= self.max_batch_wait_s:
+                last_flush = time.monotonic()
+                yield FLUSH
+            dirty = True
+            yield task
+
+    def _on_video_done(self, task) -> None:
+        with self._lock:
+            self.outstanding.discard(task)
+        self.server._video_done(task)
+
+    def _run(self) -> None:
+        try:
+            self.ex.extract_packed(self._feed(),
+                                   on_video_done=self._on_video_done,
+                                   max_pool_age_s=self.max_batch_wait_s)
+        except Exception:
+            # scheduler-level crash (bugs, OOM — NOT per-video faults,
+            # which run_packed isolates): fail everything outstanding so
+            # no request hangs, and retire this entry so the next submit
+            # rebuilds a healthy one
+            self.crashed = True
+            print(f'serve worker {self.label} crashed:', file=sys.stderr)
+            traceback.print_exc()
+            with self._lock:
+                stranded = list(self.outstanding)
+                self.outstanding.clear()
+            for task in stranded:
+                task.failed = True
+                self.server._video_done(task)
+            self.server._retire_crashed(self)
+
+
+class ExtractionServer:
+    """Resident extraction daemon + its loopback JSON-lines endpoint."""
+
+    def __init__(self,
+                 base_overrides: Optional[Dict[str, Any]] = None,
+                 host: str = '127.0.0.1',
+                 port: int = 0,
+                 queue_depth: int = 64,
+                 pool_size: int = 4,
+                 idle_flush_s: float = 0.05,
+                 max_batch_wait_s: float = 2.0,
+                 default_timeout_s: Optional[float] = None,
+                 metrics_path: Optional[str] = None) -> None:
+        self.base_overrides = dict(base_overrides or {})
+        self.host, self._port_req = host, port
+        self.queue_depth = queue_depth
+        self.idle_flush_s = idle_flush_s
+        self.max_batch_wait_s = max_batch_wait_s
+        self.default_timeout_s = default_timeout_s
+        self.metrics_path = metrics_path
+
+        self.pool = WarmPool(pool_size)
+        self.stats = metrics_mod.RequestStats()
+        self._started_at = time.monotonic()
+        # one coarse lock serializes admission + request-state mutation;
+        # the hot path (device batches) never takes it
+        self._lock = threading.RLock()
+        self._requests: Dict[str, Request] = {}
+        self._done_ids: 'deque[str]' = deque()   # completion order, bounded
+        self._inflight_videos = 0
+        self._next_id = 0
+        # per-key build serialization: N concurrent cold submits for one
+        # config must transplant ONCE, not N times (the latecomers wait,
+        # then adopt the winner's warm worker)
+        self._build_locks: Dict[tuple, threading.Lock] = {}
+        self._builds = 0
+        self._retired: List[_Worker] = []
+        # ONE merged stage report accumulates every retired/crashed
+        # entry's history — per-entry retention would grow (and bloat
+        # every metrics document) linearly with lifetime eviction count
+        self._retired_stages: Dict[str, Dict] = {}
+        self._draining = False
+        self._drained = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        assert self._sock is not None, 'server not started'
+        return self._sock.getsockname()[1]
+
+    def start(self) -> 'ExtractionServer':
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self._port_req))
+        self._sock.listen(16)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name='serve-accept', daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → graceful drain (daemon entry point only — in a
+        test/library context the caller drives ``drain()`` itself)."""
+        def _on_signal(signum, frame):
+            print(f'serve: signal {signum} — draining', file=sys.stderr)
+            self.drain(wait=False)
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+
+    def serve_forever(self) -> None:
+        self._drained.wait()
+
+    def drain(self, wait: bool = True, grace_s: float = 300.0) -> None:
+        """Graceful shutdown: close admission, let every worker finish its
+        queued videos (tail pools flush padded), then stop the endpoint.
+        Idempotent; ``wait=False`` returns immediately and finishes on a
+        background thread (the signal-handler path)."""
+        with self._lock:
+            already = self._draining
+            self._draining = True
+        if already:
+            if wait:
+                self._drained.wait(grace_s)
+            return
+        with self._lock:
+            # snapshot under the lock: _reap_retired_locked mutates
+            # _retired concurrently
+            workers = self.pool.pop_all() + list(self._retired)
+        for w in workers:
+            w.close()
+
+        def _finish():
+            deadline = time.monotonic() + grace_s
+            pending = workers
+            while pending:
+                for w in pending:
+                    if w.thread.is_alive():
+                        w.thread.join(max(0.0, deadline - time.monotonic()))
+                # re-sweep: a cold submit racing the drain may have
+                # inserted a freshly built worker after the first
+                # pop_all snapshot
+                with self._lock:
+                    pending = self.pool.pop_all()
+                for w in pending:
+                    w.close()
+                if time.monotonic() >= deadline:
+                    break
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+            metrics_mod.write_metrics_file(self.metrics_path, self.metrics())
+            self._drained.set()
+
+        if wait:
+            _finish()
+        else:
+            threading.Thread(target=_finish, name='serve-drain',
+                             daemon=True).start()
+
+    @property
+    def drained(self) -> bool:
+        return self._drained.is_set()
+
+    # -- admission + dispatch ------------------------------------------------
+
+    def submit(self, feature_type: str, video_paths: List[str],
+               overrides: Optional[Dict[str, Any]] = None,
+               timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        if not isinstance(video_paths, (list, tuple)) or not video_paths:
+            self.stats.bump('rejected')
+            return protocol.error('video_paths must be a non-empty list')
+        paths = [str(p) for p in video_paths]
+        if len(set(paths)) != len(paths):
+            # Request.videos is keyed by path: a duplicate would collapse
+            # there and the request could never complete. (sanity_check's
+            # unique-stem assert also catches this, but asserts vanish
+            # under `python -O` — this check must not.)
+            self.stats.bump('rejected')
+            return protocol.error('duplicate video_paths in one request')
+        if feature_type not in PACKED_FEATURES:
+            self.stats.bump('rejected')
+            return protocol.error(
+                f'feature_type {feature_type!r} has no packed/serving '
+                f'support; serveable: {", ".join(sorted(PACKED_FEATURES))}')
+        # config resolution is LOCK-FREE: the YAML read + sanity_check
+        # must not stall completion callbacks or status/metrics — the
+        # admission lock guards only server state (the block below)
+        merged = dict(self.base_overrides)
+        merged.update(overrides or {})
+        merged['video_paths'] = paths
+        merged.pop('file_with_video_paths', None)
+        merged['feature_type'] = feature_type
+        merged['profile'] = True              # tracer feeds /metrics
+        try:
+            args = load_config(feature_type, overrides=merged)
+        except Exception as e:
+            self.stats.bump('rejected')
+            return protocol.error(f'invalid request: {e}')
+        key = pool_key(args)
+
+        with self._lock:
+            if self._draining:
+                self.stats.bump('rejected')
+                return protocol.error('draining')
+            if self._inflight_videos + len(paths) > self.queue_depth:
+                self.stats.bump('rejected')
+                return protocol.error(
+                    'queue_full', depth=self._inflight_videos,
+                    capacity=self.queue_depth)
+            worker = self.pool.get(key)
+            build_lock = self._build_locks.setdefault(
+                key, threading.Lock())
+
+        # bounded retry: a just-acquired worker can in principle be LRU-
+        # evicted (it is idle until we enqueue) between acquisition and
+        # admission — enqueueing behind its _CLOSE sentinel would strand
+        # the tasks, so re-acquire instead
+        for _ in range(5):
+            if worker is None or worker.closed or worker.crashed:
+                # the cold-start cost serving exists to amortize:
+                # transplant here, compile on the first batch — both
+                # attached to this entry for its whole residency.
+                # Deliberately OUTSIDE the admission lock (a multi-second
+                # build must not stall warm workers' completions or
+                # status/metrics calls) but UNDER the per-key build lock
+                # (N concurrent cold submits transplant once — the losers
+                # block here, then adopt the winner's).
+                with build_lock:
+                    existing = self.pool.peek(key)
+                    if existing is not None and not (existing.closed
+                                                     or existing.crashed):
+                        worker = existing
+                    else:
+                        label = args['feature_type'] + (
+                            f"/{args['model_name']}"
+                            if args.get('model_name') else '')
+                        try:
+                            extractor = create_extractor(args)
+                        except Exception as e:
+                            self.stats.bump('rejected')
+                            return protocol.error(
+                                f'extractor build failed: {e}')
+                        worker = _Worker(self, key, label, extractor,
+                                         self.idle_flush_s,
+                                         self.max_batch_wait_s)
+                        worker.start()
+                        with self._lock:
+                            self._builds += 1
+                            self._retired.extend(self.pool.put(key, worker))
+
+            with self._lock:
+                if self._draining:
+                    # drain may have swept the pool before our (possibly
+                    # just-built) worker landed in it — close it too, so
+                    # a late insert can't outlive the drain (graceful:
+                    # close never drops already-enqueued work)
+                    worker.close()
+                    self.stats.bump('rejected')
+                    return protocol.error('draining')
+                if self._inflight_videos + len(paths) > self.queue_depth:
+                    # re-check after the lockless build window; the
+                    # freshly built worker stays pooled, warm for the
+                    # caller's retry
+                    self.stats.bump('rejected')
+                    return protocol.error(
+                        'queue_full', depth=self._inflight_videos,
+                        capacity=self.queue_depth)
+                if worker.closed or worker.crashed:
+                    worker = None         # evicted/crashed in the window
+                    continue
+                self._reap_retired_locked()
+
+                if timeout_s is None:
+                    timeout_s = self.default_timeout_s
+                deadline = (time.monotonic() + float(timeout_s)
+                            if timeout_s is not None else None)
+                self._next_id += 1
+                req = Request(f'r{self._next_id:06d}', feature_type, paths,
+                              deadline)
+                self._requests[req.id] = req
+                self._inflight_videos += len(paths)
+                tasks = [_ServeTask(p, req, out_root=args['output_path'])
+                         for p in paths]
+                # enqueue under the admission lock: eviction (pool.put)
+                # also runs under it, so a worker can't be judged idle
+                # and closed between admission and enqueue
+                worker.submit(tasks)
+            self.stats.bump('submitted')
+            return protocol.ok(request_id=req.id)
+        self.stats.bump('rejected')
+        return protocol.error('worker churn outpaced admission; retry')
+
+    def status(self, request_id: str) -> Dict[str, Any]:
+        with self._lock:
+            req = self._requests.get(request_id)
+            if req is None:
+                return protocol.error(f'unknown request_id {request_id!r}')
+            return protocol.ok(**req.snapshot())
+
+    def _fold_retired_locked(self, report: Dict[str, Dict]) -> None:
+        from video_features_tpu.utils.tracing import merge_reports
+        self._retired_stages = merge_reports([self._retired_stages, report])
+
+    def _reap_retired_locked(self) -> None:
+        """Free evicted workers whose graceful drain has finished: fold
+        the tracer report into the merged history and drop the worker so
+        its extractor — transplanted device params plus compiled
+        executables — stops pinning memory. Caller holds ``self._lock``."""
+        for w in list(self._retired):
+            if not w.thread.is_alive():
+                self._fold_retired_locked(w.ex.tracer.report())
+                self._retired.remove(w)
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            self._reap_retired_locked()
+            depth = self._inflight_videos
+            draining = self._draining
+            builds = self._builds
+            reports = {}
+            for i, w in enumerate(self.pool.entries() + self._retired):
+                label = w.label if w.label not in reports \
+                    else f'{w.label}#{i}'
+                reports[label] = w.ex.tracer.report()
+            if self._retired_stages:
+                reports['retired'] = dict(self._retired_stages)
+        pool_stats = self.pool.stats()
+        # builds ≤ misses: concurrent cold submits for one key all count
+        # misses but transplant exactly once (the per-key build lock)
+        pool_stats['builds'] = builds
+        return metrics_mod.build_metrics(
+            self._started_at, depth, self.queue_depth, draining,
+            pool_stats, self.stats, reports)
+
+    # -- completion callbacks (worker threads) -------------------------------
+
+    def _finish_video(self, task, state: str) -> None:
+        req = task.request
+        with self._lock:
+            if req.videos.get(task.path) == 'pending':
+                req.videos[task.path] = state
+                req.pending -= 1
+                self._inflight_videos -= 1
+            completed = req.pending == 0 and req.done_t is None
+            if completed:
+                req.done_t = time.monotonic()
+                # age out the oldest terminal requests: status() history
+                # is bounded, a resident daemon's request table must not
+                # grow with lifetime traffic
+                self._done_ids.append(req.id)
+                while len(self._done_ids) > REQUEST_HISTORY:
+                    self._requests.pop(self._done_ids.popleft(), None)
+        if completed:
+            self.stats.bump('completed')
+            if req.state() in ('partial', 'failed'):
+                self.stats.bump('failed')
+            self.stats.observe_latency(req.done_t - req.t0)
+            if self.metrics_path:
+                # building the metrics document takes the server lock and
+                # snapshots every tracer — skip it entirely when no
+                # mirror is configured
+                metrics_mod.write_metrics_file(self.metrics_path,
+                                               self.metrics())
+
+    def _video_done(self, task) -> None:
+        state = ('skipped' if task.skipped
+                 else 'failed' if task.failed else 'saved')
+        self._finish_video(task, state)
+
+    def _video_expired(self, task) -> None:
+        self.stats.bump('expired_videos')
+        self._finish_video(task, 'expired')
+
+    def _retire_crashed(self, worker: _Worker) -> None:
+        with self._lock:
+            # identity-checked: a healthy replacement may already serve
+            # this key — removing by key alone would evict IT instead
+            self.pool.remove(worker.key, worker)
+            self._fold_retired_locked(worker.ex.tracer.report())
+
+    # -- endpoint ------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return                        # socket closed: drained
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        with conn:
+            rfile = conn.makefile('rb')
+            wfile = conn.makefile('wb')
+            for line in rfile:
+                if not line.strip():
+                    continue
+                try:
+                    msg = protocol.decode(line)
+                    resp = self._dispatch(msg)
+                except Exception as e:
+                    resp = protocol.error(f'{type(e).__name__}: {e}')
+                try:
+                    wfile.write(protocol.encode(resp))
+                    wfile.flush()
+                except (OSError, ValueError):
+                    return                    # client went away
+
+    def _dispatch(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        cmd = msg.get('cmd')
+        if cmd == 'ping':
+            return protocol.ok(draining=self._draining)
+        if cmd == 'submit':
+            unknown = set(msg) - set(protocol.SUBMIT_FIELDS)
+            if unknown:
+                return protocol.error(
+                    f'unknown submit fields: {sorted(unknown)}')
+            return self.submit(msg.get('feature_type'),
+                               msg.get('video_paths'),
+                               overrides=msg.get('overrides'),
+                               timeout_s=msg.get('timeout_s'))
+        if cmd == 'status':
+            return self.status(msg.get('request_id'))
+        if cmd == 'metrics':
+            return protocol.ok(metrics=self.metrics())
+        if cmd == 'drain':
+            self.drain(wait=False)
+            return protocol.ok(draining=True)
+        return protocol.error(
+            f'unknown cmd {cmd!r}; known: {", ".join(protocol.COMMANDS)}')
+
+
+def serve_main(argv: List[str]) -> int:
+    """``python -m video_features_tpu serve`` entry point."""
+    from video_features_tpu.config import parse_dotlist
+    serve_cfg, base = split_serve_config(parse_dotlist(argv))
+    server = ExtractionServer(
+        base_overrides=base,
+        host=serve_cfg['serve_host'],
+        port=serve_cfg['serve_port'],
+        queue_depth=serve_cfg['serve_queue_depth'],
+        pool_size=serve_cfg['serve_warm_pool_size'],
+        idle_flush_s=serve_cfg['serve_idle_flush_s'],
+        max_batch_wait_s=serve_cfg['serve_max_batch_wait_s'],
+        default_timeout_s=serve_cfg['serve_default_timeout_s'],
+        metrics_path=serve_cfg['serve_metrics_path'],
+    ).start()
+    server.install_signal_handlers()
+    # machine-greppable endpoint line (tests and tooling scrape it)
+    print(f'serving on {server.host}:{server.port} '
+          f'(pid {os.getpid()}; queue_depth='
+          f'{serve_cfg["serve_queue_depth"]}, warm_pool='
+          f'{serve_cfg["serve_warm_pool_size"]})', flush=True)
+    server.serve_forever()
+    print('serve: drained, exiting', flush=True)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # hard exit: the workers ran XLA on non-main threads, and letting the
+    # interpreter walk C++ static destructors after that intermittently
+    # aborts ("terminate called without an active exception") — every
+    # output is already durably published (atomic writes) and both
+    # streams are flushed, so skip teardown and give supervisors a
+    # clean 0
+    os._exit(0)

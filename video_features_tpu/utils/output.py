@@ -1,10 +1,20 @@
-"""Output pathing & serialization (reference utils/utils.py:56-63,252-262)."""
+"""Output pathing & serialization (reference utils/utils.py:56-63,252-262).
+
+Writes are ATOMIC: same-directory tmp file + ``os.replace``. The resume
+contract (``is_already_exist`` loads every file) tolerates corruption by
+re-extracting, but a killed process or a multihost collision
+(``parallel/worklist.py`` assumes collisions are benign) must never leave
+a partial file AT THE FINAL PATH — a reader between death and re-extract
+would see it, and two writers racing ``os.replace`` each publish a
+complete file (last one wins) instead of interleaving.
+"""
 from __future__ import annotations
 
 import os
 import pickle
+import tempfile
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 import numpy as np
 
@@ -20,12 +30,43 @@ def make_path(output_root: str, video_path: str, output_key: str, ext: str) -> s
     return os.path.join(output_root, fname)
 
 
+# process umask, read once (os.umask is set-and-return; toggling it per
+# write would race other threads). mkstemp creates 0600 files — published
+# outputs must keep the 0666&~umask mode plain open() gave before.
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
+def atomic_write(fpath: str, write_fn: Callable) -> None:
+    """Publish a file atomically: ``write_fn(binary_file)`` fills a tmp
+    file in the TARGET's directory (os.replace cannot cross filesystems),
+    then one rename makes it visible. Any failure removes the tmp, so
+    neither a crash nor an exception strands partial bytes at ``fpath``.
+    """
+    d = os.path.dirname(fpath) or '.'
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=Path(fpath).name + '.',
+                               suffix='.tmp')
+    try:
+        os.fchmod(fd, 0o666 & ~_UMASK)
+        with os.fdopen(fd, 'wb') as f:
+            write_fn(f)
+        os.replace(tmp, fpath)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def load_numpy(fpath: str) -> np.ndarray:
     return np.load(fpath)
 
 
 def write_numpy(fpath: str, value: Any) -> None:
-    np.save(fpath, value)
+    # np.save on a FILE OBJECT never appends '.npy', so the tmp name
+    # passes through atomic_write untouched
+    atomic_write(fpath, lambda f: np.save(f, value))
 
 
 def load_pickle(fpath: str) -> Any:
@@ -34,8 +75,7 @@ def load_pickle(fpath: str) -> Any:
 
 
 def write_pickle(fpath: str, value: Any) -> None:
-    with open(fpath, 'wb') as f:
-        pickle.dump(value, f)
+    atomic_write(fpath, lambda f: pickle.dump(value, f))
 
 
 ACTION_TO_EXT = {'save_numpy': '.npy', 'save_pickle': '.pkl'}

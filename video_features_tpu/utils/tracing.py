@@ -155,6 +155,11 @@ class Tracer:
         occ = s.occupancy()
         if occ is not None:
             rec['occupancy'] = occ
+            # raw slot counts ride along so reports stay mergeable
+            # (merge_reports recomputes aggregate occupancy from these —
+            # averaging the derived ratios would weight batches wrongly)
+            rec['occ_valid'] = s.occ_valid
+            rec['occ_capacity'] = s.occ_capacity
         return rec
 
     def report(self) -> Dict[str, Dict[str, float]]:
@@ -201,6 +206,37 @@ class Tracer:
 
 
 NULL_TRACER = Tracer(enabled=False)
+
+
+def merge_reports(reports: Iterable[Dict[str, Dict[str, float]]]
+                  ) -> Dict[str, Dict[str, float]]:
+    """Combine several ``Tracer.report()`` dicts into one aggregate table.
+
+    The serve metrics endpoint exposes one fleet-wide stage view across
+    every warm-pool entry's tracer: counts/totals sum, ``max_s`` maxes,
+    ``first_s`` keeps the worst cold-start, occupancy recombines from the
+    raw slot counts. ``ramp`` is per-tracer by construction (first call vs
+    ITS steady state) and is dropped rather than faked.
+    """
+    merged: Dict[str, Dict[str, float]] = {}
+    for rep in reports:
+        for name, r in rep.items():
+            m = merged.setdefault(name, {
+                'count': 0, 'total_s': 0.0, 'max_s': 0.0, 'first_s': 0.0,
+            })
+            m['count'] += r.get('count', 0)
+            m['total_s'] += r.get('total_s', 0.0)
+            m['max_s'] = max(m['max_s'], r.get('max_s', 0.0))
+            m['first_s'] = max(m['first_s'], r.get('first_s', 0.0))
+            if 'occ_capacity' in r:
+                m['occ_valid'] = m.get('occ_valid', 0) + r['occ_valid']
+                m['occ_capacity'] = (m.get('occ_capacity', 0)
+                                     + r['occ_capacity'])
+    for m in merged.values():
+        m['mean_s'] = m['total_s'] / max(m['count'], 1)
+        if m.get('occ_capacity'):
+            m['occupancy'] = m['occ_valid'] / m['occ_capacity']
+    return merged
 
 
 @contextmanager
