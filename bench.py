@@ -44,6 +44,13 @@ plus p50/p99 request latency and the warm-pool hit rate (asserted > 0,
 or the "warm" number is mislabeled). ``BENCH_SERVE=0/1`` overrides the
 accelerator-only default.
 
+The cache rung (``cache_*``): the same worklist run twice with the
+content-addressed feature cache on (cache/) — cold clips/s with publish
+overhead vs warm-hit clips/s (pure O(read) materialization, no decode or
+inference), plus per-video hit latency and the store hit rate (asserted
+to cover the worklist). ``BENCH_CACHE=0/1`` overrides the
+accelerator-only default.
+
 Default precision is 'mixed' (ops/precision.py): ambient 3-pass bf16 with
 the drift-tolerant sub-graphs on 1-pass — measured ≤1e-3 feature drift vs
 float32 on the fused path (tools/precision_study.py), i.e. the fastest
@@ -232,6 +239,57 @@ def bench_serve(precision: str, batch: int, stack: int, tmp_dir: str,
         }
     finally:
         server.drain(wait=True, grace_s=120)
+
+
+def bench_cache(precision: str, batch: int, stack: int, tmp_dir: str,
+                platform: str, wl_paths: list) -> dict:
+    """The content-addressed cache rung (cache/): the SAME worklist run
+    twice with ``cache_enabled=true`` — the cold pass pays decode +
+    inference and publishes, the warm pass materializes every video from
+    the store (fresh output root, so the resume contract can't mask the
+    measurement). Reports cold vs warm-hit clips/s, the per-video hit
+    latency, and the store's hit rate (hits must cover the worklist or
+    the rung is mislabeled — asserted)."""
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    from video_features_tpu.utils.output import make_path
+
+    cache_dir = os.path.join(tmp_dir, 'feature_cache')
+
+    def one_pass(tag):
+        args = load_config('i3d', overrides={
+            'video_paths': wl_paths,
+            'device': platform, 'precision': precision,
+            'stack_size': stack, 'step_size': stack, 'batch_size': batch,
+            'allow_random_weights': True, 'on_extraction': 'save_numpy',
+            'output_path': os.path.join(tmp_dir, f'cache_out_{tag}'),
+            'tmp_path': os.path.join(tmp_dir, 'cache_tmp'),
+            'cache_enabled': True, 'cache_dir': cache_dir,
+        })
+        ex = create_extractor(args)
+        t0 = time.perf_counter()
+        for p in wl_paths:
+            ex._extract(p)
+        return ex, time.perf_counter() - t0
+
+    ex_cold, cold_s = one_pass('cold')
+    ex_warm, warm_s = one_pass('warm')
+
+    clips = 0
+    for p in wl_paths:
+        arr = np.load(make_path(ex_warm.output_path, p, 'rgb', '.npy'))
+        clips += arr.shape[0]
+    assert clips > 0, 'cache warm pass produced no clips'
+    st = ex_warm.cache.stats()
+    assert st['hits'] >= len(wl_paths), \
+        f'cache warm pass missed the store — rung mislabeled: {st}'
+    return {
+        'cache_cold_clips_per_sec': round(clips / cold_s, 3),
+        'cache_hit_clips_per_sec': round(clips / warm_s, 3),
+        'cache_hit_latency_s': round(warm_s / len(wl_paths), 4),
+        'cache_hit_rate': round(st['hit_rate'], 4),
+        'cache_bytes_saved': int(st['bytes_saved']),
+    }
 
 
 def _bench_video(tmp_dir: str, seconds: str = None) -> str:
@@ -481,6 +539,30 @@ def run() -> dict:
                         srec['serve_warm_hit_rate']
                 except Exception as e:
                     rungs['serve_error'] = f'{type(e).__name__}: {e}'
+            # The content-addressed cache rung (cache/): cold extraction
+            # vs warm O(read) hits over the same worklist — the dedupe
+            # win a corpus with repeated/duplicated videos sees per
+            # repeat. BENCH_CACHE=0/1 overrides.
+            if os.environ.get('BENCH_CACHE',
+                              '1' if on_accel else '0') == '1':
+                try:
+                    if wl_paths is None:
+                        from tools.worklist_bench import make_worklist
+                        wl_paths = make_worklist(
+                            tmp_dir, 4 if on_accel else 2,
+                            10 if on_accel else 2)
+                    crec = bench_cache(precision, min(batch, 8), stack,
+                                       tmp_dir, platform, wl_paths)
+                    rungs[f'cache_cold_clips_per_sec_{precision}'] = \
+                        crec['cache_cold_clips_per_sec']
+                    rungs[f'cache_hit_clips_per_sec_{precision}'] = \
+                        crec['cache_hit_clips_per_sec']
+                    rungs['cache_hit_latency_s'] = \
+                        crec['cache_hit_latency_s']
+                    rungs['cache_hit_rate'] = crec['cache_hit_rate']
+                    rungs['cache_bytes_saved'] = crec['cache_bytes_saved']
+                except Exception as e:
+                    rungs['cache_error'] = f'{type(e).__name__}: {e}'
     if mode == 'e2e' and f'e2e_{precision}' in rungs:
         headline_key = f'e2e_{precision}'
 

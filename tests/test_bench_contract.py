@@ -60,7 +60,8 @@ def test_bench_serve_rung_emits_keys():
     that must stay on stderr)."""
     rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
                       'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
-                      'BENCH_SERVE': '1', 'BENCH_WORKLIST': '0'})
+                      'BENCH_SERVE': '1', 'BENCH_WORKLIST': '0',
+                      'BENCH_CACHE': '0'})
     rungs = rec['rungs']
     assert 'serve_error' not in rungs, rungs.get('serve_error')
     assert any(k.startswith('serve_clips_per_sec') for k in rungs)
@@ -68,3 +69,25 @@ def test_bench_serve_rung_emits_keys():
     assert rungs['serve_p50_latency_s'] > 0
     assert rungs['serve_p99_latency_s'] >= rungs['serve_p50_latency_s']
     assert rungs['serve_warm_hit_rate'] > 0
+
+
+def test_bench_cache_rung_emits_keys():
+    """BENCH_CACHE=1 drives the content-addressed cache rung (cache/):
+    the record must carry cold vs warm-hit clips/sec, the per-video hit
+    latency, a hit rate > 0, and bytes saved — the warm number must beat
+    the cold one (a hit is an O(read) copy vs decode + inference), all
+    while keeping the one-JSON-line stdout contract."""
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_SERVE': '0', 'BENCH_WORKLIST': '0',
+                      'BENCH_CACHE': '1'})
+    rungs = rec['rungs']
+    assert 'cache_error' not in rungs, rungs.get('cache_error')
+    cold = next(rungs[k] for k in rungs
+                if k.startswith('cache_cold_clips_per_sec'))
+    warm = next(rungs[k] for k in rungs
+                if k.startswith('cache_hit_clips_per_sec'))
+    assert warm > cold, (cold, warm)
+    assert rungs['cache_hit_latency_s'] > 0
+    assert rungs['cache_hit_rate'] > 0
+    assert rungs['cache_bytes_saved'] > 0

@@ -1,0 +1,17 @@
+"""Content-addressed feature cache: dedupe + short-circuit repeated
+extraction across the CLI loop, packed worklists, and the serve daemon.
+
+For a fixed (video content, extractor, config, checkpoint) the output
+features are deterministic, so the second request for any video is an
+O(read) hit instead of a decode + inference. Key derivation lives in
+:mod:`.key`, the store (manifest, objects, LRU GC, integrity checks) in
+:mod:`.store`; ``tools/cache_gc.py`` is the offline maintenance surface
+and docs/caching.md the operator guide.
+"""
+from video_features_tpu.cache.key import (  # noqa: F401
+    CONFIG_KEY_EXCLUDE, config_fingerprint, hash_file, run_fingerprint,
+    video_cache_key, weights_fingerprint,
+)
+from video_features_tpu.cache.store import (  # noqa: F401
+    FeatureCache, log_cache_error, merge_cache_stats,
+)

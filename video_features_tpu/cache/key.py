@@ -1,0 +1,182 @@
+"""Cache-key derivation: what makes two extractions "the same work".
+
+The toolkit is inference-only over frozen models: for a fixed video,
+extractor, config, and checkpoint the output features are deterministic,
+so a result is fully identified by
+
+    (video content hash, config fingerprint, weights fingerprint)
+
+and the cache key is one SHA-256 over the three. Each part is derived
+here with one goal: NEVER a false hit, and as few false misses as
+practical.
+
+  * the video hash is over file CONTENT (streaming SHA-256), not the
+    path — the same clip under ten names/copies is one cache entry;
+  * the config fingerprint covers only EXTRACTION-RELEVANT keys: knobs
+    that cannot change the output bytes (``output_path``, ``tmp_path``,
+    device/parallelism/profiling toggles, the ``cache_*`` namespace
+    itself) are excluded so they don't fragment the key space, while
+    anything unrecognized stays IN the fingerprint — an unknown future
+    knob costs a redundant miss, never a wrong hit;
+  * the weights fingerprint hashes the configured checkpoint FILES (a
+    re-fetched or swapped checkpoint under the same path invalidates),
+    with an explicit ``random`` marker for the allow-random-weights
+    escape hatch (tests/benches; see docs/caching.md for why sharing a
+    cache dir across random-weight processes is meaningless).
+
+File hashes are memoized by ``(realpath, size, mtime_ns)`` so repeated
+requests for the same corpus — the serving layer's common case — pay the
+streaming read once per file version, not once per request.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Dict, Mapping
+
+_CHUNK = 1 << 20  # 1 MiB streaming-read granularity
+
+# Keys that cannot change the extracted bytes. Everything NOT listed here
+# lands in the fingerprint (fail-closed: unknown knobs fragment the key
+# space rather than risking a stale hit). Checkpoint paths are excluded
+# from the CONFIG fingerprint because the WEIGHTS fingerprint covers
+# their content (a path string is not an identity — the file behind it
+# can change).
+CONFIG_KEY_EXCLUDE = frozenset({
+    # payload / routing
+    'video_paths', 'file_with_video_paths', 'output_path', 'tmp_path',
+    'keep_tmp_files',
+    # device & parallelism: where the program runs, not what it computes
+    # (numerics are pinned by `precision`, which stays IN the key)
+    'device', 'device_ids', 'data_parallel', 'multihost',
+    'coordinator_address', 'num_processes', 'process_id',
+    'pack_across_videos', 'pack_decode_ahead', 'decode_workers',
+    'compilation_cache_dir',
+    # observability / debug surfaces
+    'profile', 'profile_dir', 'show_pred',
+    # the cache's own namespace must not fragment its key space
+    'cache_enabled', 'cache_dir', 'cache_max_bytes',
+    # covered by the weights fingerprint
+    'allow_random_weights',
+    # serve-side per-request plumbing
+    'timeout_s', 'config',
+})
+
+# (realpath, size, mtime_ns) → hex digest; bounded so a week-long serving
+# process over a rotating corpus can't grow it without limit
+_HASH_MEMO: Dict[tuple, str] = {}
+_HASH_MEMO_MAX = 65536
+_MEMO_LOCK = threading.Lock()
+
+
+def hash_file(path: str) -> str:
+    """Streaming SHA-256 of a file's content, memoized by stat identity.
+
+    The memo key includes size AND mtime_ns, so an overwritten file
+    (re-fetched checkpoint, re-encoded clip) re-hashes; a merely re-read
+    one doesn't.
+    """
+    import os
+
+    real = os.path.realpath(path)
+    st = os.stat(real)
+    memo_key = (real, st.st_size, st.st_mtime_ns)
+    with _MEMO_LOCK:
+        hit = _HASH_MEMO.get(memo_key)
+    if hit is not None:
+        return hit
+    h = hashlib.sha256()
+    with open(real, 'rb') as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                break
+            h.update(chunk)
+    digest = h.hexdigest()
+    with _MEMO_LOCK:
+        if len(_HASH_MEMO) >= _HASH_MEMO_MAX:
+            _HASH_MEMO.clear()
+        _HASH_MEMO[memo_key] = digest
+    return digest
+
+
+def _canonical(obj: Any) -> str:
+    """Deterministic serialization for fingerprint material. ``repr`` for
+    non-JSON values keeps the function total; sort_keys keeps dict order
+    out of the identity."""
+    return json.dumps(obj, sort_keys=True, default=repr)
+
+
+def config_fingerprint(args: Mapping[str, Any]) -> str:
+    """SHA-256 over the extraction-relevant subset of a merged config."""
+    relevant = {k: v for k, v in args.items()
+                if k not in CONFIG_KEY_EXCLUDE
+                and 'checkpoint_path' not in k}
+    return hashlib.sha256(_canonical(relevant).encode()).hexdigest()
+
+
+def _null_checkpoint_marker(args: Mapping[str, Any]) -> str:
+    """Identity for a NULL checkpoint key — which is not always random:
+    two families load real weights without a configured path, and each
+    must key on what it actually loads or different weight sets alias.
+
+      * timm (extract/timm.py): pip-timm pulls pretrained weights when
+        importable (``pretrained`` not disabled) → key on the timm
+        package version; a host without pip-timm degrades to the random
+        marker, so its entries can never serve a pretrained run's key;
+      * clip model_name=custom (extract/clip.py): the implicit
+        ``./checkpoints/CLIP-custom.pth`` → key on that file's content.
+
+    Everything else with a null path runs the gated seeded random init
+    (deterministic per code version) → the ``random`` marker.
+    """
+    import os
+
+    ft = args.get('feature_type')
+    if ft == 'timm' and args.get('pretrained', True):
+        try:
+            import timm
+            return f'timm-pretrained:{timm.__version__}'
+        except ImportError:
+            pass
+    if ft == 'clip' and args.get('model_name') == 'custom':
+        implicit = './checkpoints/CLIP-custom.pth'
+        if os.path.exists(implicit):
+            return f'file:{hash_file(implicit)}'
+    return 'random'
+
+
+def weights_fingerprint(args: Mapping[str, Any]) -> str:
+    """SHA-256 over the CONTENT of every configured checkpoint file.
+
+    A null checkpoint key contributes :func:`_null_checkpoint_marker`
+    (usually ``random`` — the escape hatch seeds its init
+    deterministically — but timm/clip implicit-weight loads key on their
+    real provenance). A configured-but-unreadable checkpoint raises —
+    the extractor build would fail on it anyway, and a silent fallback
+    here could alias two different weight sets.
+    """
+    material: Dict[str, str] = {}
+    for k in sorted(args):
+        if 'checkpoint_path' not in k:
+            continue
+        v = args[k]
+        material[k] = (f'file:{hash_file(str(v))}' if v
+                       else _null_checkpoint_marker(args))
+    return hashlib.sha256(_canonical(material).encode()).hexdigest()
+
+
+def run_fingerprint(args: Mapping[str, Any]) -> str:
+    """The one identity string for "this exact extraction recipe":
+    config fingerprint + weights fingerprint. This is what resume
+    sidecars record and what the video hash combines with."""
+    return hashlib.sha256(
+        f'cfg:{config_fingerprint(args)}|w:{weights_fingerprint(args)}'
+        .encode()).hexdigest()
+
+
+def video_cache_key(video_path: str, fingerprint: str) -> str:
+    """The content-addressed store key for one (video, recipe) pair."""
+    return hashlib.sha256(
+        f'{fingerprint}|video:{hash_file(video_path)}'.encode()).hexdigest()

@@ -19,6 +19,24 @@ CONFIG_DIR = Path(__file__).parent / 'configs'
 
 KNOWN_FEATURE_TYPES = ('i3d', 'r21d', 's3d', 'vggish', 'resnet', 'raft', 'clip', 'timm')
 
+# -- content-addressed feature cache (cache/; docs/caching.md) ---------------
+# Injected into every merged config (CLI dotlist wins, as always) rather
+# than copied into each per-feature YAML: one source of truth for the
+# namespace, and older user YAMLs pick the knobs up automatically.
+CACHE_DEFAULTS: Dict[str, Any] = {
+    # consult/publish the content-addressed result store: the second
+    # request for any (video content, config, checkpoint) becomes an
+    # O(read) hit that skips decode + inference, with byte-identical
+    # outputs. Off by default — today's behavior exactly.
+    'cache_enabled': False,
+    # where entries live (manifest.jsonl + objects/); shared across
+    # processes/workers on one host
+    'cache_dir': '~/.cache/video_features_tpu/features',
+    # LRU size bound in bytes (null = unbounded); enforced inline on
+    # publish and offline via tools/cache_gc.py
+    'cache_max_bytes': None,
+}
+
 
 class Config(dict):
     """A flat dict with attribute access — the shape every extractor consumes.
@@ -102,6 +120,8 @@ def load_config(
             f'Extractor {feature_type!r} is not implemented. '
             f'Known: {", ".join(KNOWN_FEATURE_TYPES)}')
     args = load_yaml(cfg_path)
+    for key, value in CACHE_DEFAULTS.items():
+        args.setdefault(key, value)
     args.update(overrides)
     if run_sanity_check:
         sanity_check(args)
@@ -172,6 +192,21 @@ def sanity_check(args: Config) -> None:
         raise ValueError(
             f"decode_backend must be 'auto', 'native', or 'cv2'; "
             f'got {backend!r}')
+    if args.get('cache_enabled'):
+        if not args.get('cache_dir'):
+            raise ValueError('cache_enabled=true requires cache_dir '
+                             '(see docs/caching.md)')
+        if args.get('cache_max_bytes') is not None:
+            args['cache_max_bytes'] = int(args['cache_max_bytes'])
+            if args['cache_max_bytes'] < 0:
+                raise ValueError('cache_max_bytes must be >= 0 or null; '
+                                 f'got {args["cache_max_bytes"]}')
+        if args.get('on_extraction') == 'print':
+            # nothing reaches disk, so there is nothing to address by
+            # content — warn-and-disable (same policy as the packing knob)
+            warnings.warn('cache_enabled has no effect with '
+                          'on_extraction=print — disabling the cache')
+            args['cache_enabled'] = False
 
     assert args.get('file_with_video_paths') or args.get('video_paths'), \
         '`video_paths` or `file_with_video_paths` must be specified'

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import os
 import traceback
+import warnings
 from pathlib import Path
 from typing import Dict, List, Union
 
@@ -30,6 +31,7 @@ import numpy as np
 
 from video_features_tpu.utils.output import (
     ACTION_TO_EXT, ACTION_TO_LOAD, ACTION_TO_SAVE, make_path,
+    read_fingerprint, write_fingerprint,
 )
 from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
 
@@ -71,6 +73,11 @@ class BaseExtractor:
         self.precision = precision
         self.tracer = Tracer(enabled=True) if profile else NULL_TRACER
         self._mesh = None  # set by _ensure_mesh for data_parallel extractors
+        # content-addressed feature cache + run identity — attached by
+        # configure_cache (registry.create_extractor calls it with the
+        # full merged config); None = legacy behavior everywhere
+        self.cache = None
+        self.run_fingerprint = None
 
     def precision_scope(self):
         """Matmul-precision context for the device loop. ``highest`` (the
@@ -122,6 +129,78 @@ class BaseExtractor:
         self._mesh, self.params, self._put_batch = mesh, params, put
         setattr(self, batch_attr, global_batch)
 
+    # -- content-addressed feature cache (cache/) ---------------------------
+
+    def configure_cache(self, args) -> None:
+        """Attach the run fingerprint (config + weights identity — always,
+        it also keys config-aware resume) and, when ``cache_enabled``, the
+        shared :class:`cache.FeatureCache` for ``cache_dir``. Called by
+        ``registry.create_extractor`` with the full merged config;
+        extractors constructed directly (tests, stubs) stay legacy."""
+        from video_features_tpu.cache import (
+            FeatureCache, log_cache_error, run_fingerprint,
+        )
+        try:
+            self.run_fingerprint = run_fingerprint(args)
+        except Exception:
+            # e.g. an unreadable checkpoint path: the build itself will
+            # report it; a fingerprint failure must not mask that error
+            log_cache_error('fingerprint derivation')
+            self.run_fingerprint = None
+            return
+        if args.get('cache_enabled') and self.on_extraction in ACTION_TO_EXT:
+            try:
+                self.cache = FeatureCache.get(args.get('cache_dir'),
+                                              args.get('cache_max_bytes'))
+            except Exception:
+                log_cache_error(f'open ({args.get("cache_dir")})')
+                self.cache = None
+
+    def _video_cache_key(self, video_path: str) -> str:
+        from video_features_tpu.cache import video_cache_key
+        return video_cache_key(video_path, self.run_fingerprint)
+
+    def cache_fetch(self, video_path: str, output_path: str = None) -> bool:
+        """Serve this video's outputs from the cache if present: a hit
+        atomically materializes byte-identical files under the output
+        root (plus the resume sidecar) WITHOUT decoding or running the
+        network. Cache failures degrade to a miss, never to a failed
+        video."""
+        if self.cache is None or self.run_fingerprint is None:
+            return False
+        out_root = output_path or self.output_path
+        from video_features_tpu.cache import log_cache_error
+        try:
+            hit = self.cache.fetch_to(self._video_cache_key(video_path),
+                                      out_root, video_path,
+                                      fingerprint=self.run_fingerprint)
+        except Exception:
+            log_cache_error(f'lookup for {video_path}')
+            return False
+        if hit:
+            print(f'Features for {video_path} served from cache into '
+                  f'{Path(out_root).absolute()}/ - skipping extraction..')
+        return hit
+
+    def cache_publish(self, video_path: str, output_path: str = None) -> None:
+        """Publish the just-saved output files into the cache (exact
+        bytes, so every future hit is byte-identical to this cold run)."""
+        if self.cache is None or self.run_fingerprint is None:
+            return
+        out_root = output_path or self.output_path
+        ext = ACTION_TO_EXT[self.on_extraction]
+        files = {key: (make_path(out_root, video_path, key, ext), ext)
+                 for key in self._saved_feat_keys()}
+        if not all(os.path.exists(src) for src, _ in files.values()):
+            return                       # partial save (failed video): skip
+        from video_features_tpu.cache import log_cache_error
+        try:
+            self.cache.put(self._video_cache_key(video_path), files,
+                           meta={'video': Path(video_path).name,
+                                 'feature_type': self.feature_type})
+        except Exception:
+            log_cache_error(f'publish for {video_path}')
+
     # -- per-video driver ---------------------------------------------------
 
     def _extract(self, video_path: str) -> None:
@@ -129,10 +208,18 @@ class BaseExtractor:
         try:
             if self.is_already_exist(video_path):
                 return
+            if self.cache is not None:
+                with self.tracer.stage('cache_lookup'):
+                    hit = self.cache_fetch(video_path)
+                if hit:
+                    return
             feats_dict = self.extract(video_path)
             feats_dict = self._maybe_concat_streams(feats_dict)
             with self.tracer.stage('save'):
                 self.action_on_extraction(feats_dict, video_path)
+            if self.cache is not None:
+                with self.tracer.stage('cache_publish'):
+                    self.cache_publish(video_path)
         except KeyboardInterrupt:
             raise
         except Exception:
@@ -255,6 +342,12 @@ class BaseExtractor:
             else:
                 raise NotImplementedError(
                     f'on_extraction: {self.on_extraction} is not implemented')
+        if self.on_extraction in ACTION_TO_EXT \
+                and self.run_fingerprint is not None:
+            # resume sidecar: records which config+weights produced these
+            # files, so a later run under a DIFFERENT recipe re-extracts
+            # instead of silently reusing them (is_already_exist)
+            write_fingerprint(out_root, video_path, self.run_fingerprint)
 
     def is_already_exist(self, video_path: Union[str, Path],
                          output_path: str = None) -> bool:
@@ -274,6 +367,23 @@ class BaseExtractor:
             except Exception:
                 # Corrupted (e.g. a worker died mid-write) → re-extract.
                 return False
+        if self.run_fingerprint is not None:
+            recorded = read_fingerprint(out_root, video_path)
+            if recorded is not None and recorded != self.run_fingerprint:
+                # config-aware resume: these files came from a DIFFERENT
+                # config/checkpoint recipe — reusing them would hand the
+                # caller features from a run they didn't ask for.
+                # warnings.warn (stderr), not print: with
+                # on_extraction=print the feature stream owns stdout
+                warnings.warn(
+                    f'Existing outputs for {video_path} in '
+                    f'{Path(out_root).absolute()}/ were produced under a '
+                    f'different config/checkpoint (fingerprint '
+                    f'{recorded[:12]} != {self.run_fingerprint[:12]}) — '
+                    're-extracting instead of reusing them')
+                return False
+            # no sidecar: pre-fingerprint outputs keep the legacy skip
+            # (absence can't prove staleness)
         print(f'Features for {video_path} already exist in '
               f'{Path(out_root).absolute()}/ - skipping..')
         return True

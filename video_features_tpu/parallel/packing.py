@@ -78,7 +78,7 @@ class VideoTask:
 
     __slots__ = ('path', 'video_id', 'rows', 'meta_rows', 'info',
                  'emitted', 'done', 'exhausted', 'failed', 'skipped',
-                 'out_root')
+                 'cached', 'out_root')
 
     def __init__(self, path: str, video_id: int = -1,
                  out_root: Optional[str] = None) -> None:
@@ -93,6 +93,10 @@ class VideoTask:
         self.exhausted = False
         self.failed = False
         self.skipped = False
+        # skipped via a content-addressed cache hit (outputs materialized
+        # from the cache rather than found on disk) — consumers that care
+        # about the difference (serve per-video states, metrics) read it
+        self.cached = False
 
 
 def packed_batches(windows: Iterable[tuple], batch: int,
@@ -245,6 +249,15 @@ def run_packed(ex, video_paths: Iterable,
         if exists:
             task.skipped = True
             return iter(())
+        # content-addressed cache: a hit materializes this video's outputs
+        # right here and drops it from batch planning entirely — it never
+        # decodes, never occupies batch slots, and finalizes through the
+        # same sweep/on_video_done path as a resume skip
+        if getattr(ex, 'cache', None) is not None and \
+                ex.cache_fetch(task.path, output_path=task.out_root):
+            task.skipped = True
+            task.cached = True
+            return iter(())
         return ex.packed_windows(task)
 
     # flush each video as soon as its last window's features land. NOT
@@ -268,6 +281,9 @@ def run_packed(ex, video_paths: Iterable,
                                                 output_path=t.out_root)
                     else:
                         ex.action_on_extraction(feats_dict, t.path)
+                if getattr(ex, 'cache', None) is not None:
+                    with ex.tracer.stage('cache_publish'):
+                        ex.cache_publish(t.path, output_path=t.out_root)
         except KeyboardInterrupt:
             raise
         except Exception:

@@ -50,4 +50,9 @@ def create_extractor(args: 'Config') -> 'BaseExtractor':
         enable_compilation_cache(args.get('compilation_cache_dir'),
                                  str(args.get('device') or 'any'))
     module = importlib.import_module(module_name)
-    return getattr(module, class_name)(args)
+    extractor = getattr(module, class_name)(args)
+    if hasattr(args, 'get'):
+        # run fingerprint (config-aware resume) + content-addressed
+        # feature cache; duck-typed arg objects without .get stay legacy
+        extractor.configure_cache(args)
+    return extractor

@@ -32,7 +32,10 @@ class RequestStats:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.counts = {'submitted': 0, 'completed': 0, 'failed': 0,
-                       'rejected': 0, 'expired_videos': 0}
+                       'rejected': 0, 'expired_videos': 0,
+                       # videos answered from the content-addressed
+                       # feature cache (pre-admission or in-worker hits)
+                       'cached_videos': 0}
         self._latencies: List[float] = []
 
     def bump(self, key: str, n: int = 1) -> None:
@@ -70,16 +73,26 @@ def build_metrics(started_at: float,
                   pool_stats: Dict[str, Any],
                   request_stats: RequestStats,
                   stage_reports: Dict[str, Dict],
+                  cache_stats: Optional[Dict[str, Any]] = None,
                   ) -> Dict[str, Any]:
     """Assemble the one metrics document. ``stage_reports`` maps a
     human-readable pool-entry label → that entry's ``Tracer.report()``;
-    the aggregate view merges them (``tracing.merge_reports``)."""
+    the aggregate view merges them (``tracing.merge_reports``).
+    ``cache_stats`` is the merged content-addressed feature-cache view
+    (``cache.store.merge_cache_stats`` over every cache dir requests have
+    named) — always present in the document so scrapers see hit/miss/
+    bytes-saved counters next to the warm-pool hit rate even before the
+    first cache-enabled request."""
     doc: Dict[str, Any] = {
         'uptime_s': round(time.monotonic() - started_at, 3),
         'queue': {'depth': queue_depth, 'capacity': queue_capacity,
                   'draining': draining},
         'warm_pool': pool_stats,
     }
+    if cache_stats is None:
+        from video_features_tpu.cache.store import merge_cache_stats
+        cache_stats = merge_cache_stats(())
+    doc['cache'] = cache_stats
     doc.update(request_stats.snapshot())
     doc['stages'] = {label: rep for label, rep in stage_reports.items()}
     doc['stages_merged'] = merge_reports(stage_reports.values())

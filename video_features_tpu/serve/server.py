@@ -61,7 +61,11 @@ REQUEST_HISTORY = 4096
 # are the payload, and profile is forced on for the metrics surface.
 # tmp_path stays IN the key: loaders read the entry's tmp root, so a
 # request with a different tmp_path must get its own entry rather than
-# silently writing re-encode temps under another request's root.
+# silently writing re-encode temps under another request's root. The
+# cache_* namespace also stays IN the key: the worker's extractor
+# publishes/consults the cache configured at build time, so requests
+# with different cache settings must not share an entry (they'd inherit
+# the first builder's cache behavior silently).
 _KEY_EXCLUDE = frozenset({
     'video_paths', 'file_with_video_paths', 'output_path',
     'profile', 'profile_dir', 'timeout_s',
@@ -105,9 +109,9 @@ class Request:
         if self.pending > 0:
             return 'running'
         states = set(self.videos.values())
-        if states <= {'saved', 'skipped'}:
+        if states <= {'saved', 'skipped', 'cached'}:
             return 'done'
-        if states & {'saved', 'skipped'}:
+        if states & {'saved', 'skipped', 'cached'}:
             return 'partial'
         return 'failed'
 
@@ -276,6 +280,10 @@ class ExtractionServer:
         # then adopt the winner's warm worker)
         self._build_locks: Dict[tuple, threading.Lock] = {}
         self._builds = 0
+        # content-addressed feature caches touched by requests, keyed by
+        # cache dir — metrics merges their hit/miss/bytes-saved counters
+        # alongside the warm-pool hit rate
+        self._caches: Dict[str, Any] = {}
         self._retired: List[_Worker] = []
         # ONE merged stage report accumulates every retired/crashed
         # entry's history — per-entry retention would grow (and bloat
@@ -405,11 +413,40 @@ class ExtractionServer:
             return protocol.error(f'invalid request: {e}')
         key = pool_key(args)
 
+        # -- content-addressed cache: answer hits BEFORE admission -------
+        # A hit is an O(read) file copy — it must not occupy a queue slot
+        # (admission capacity is for decode+inference work), must not wake
+        # a worker, and is answered even when the queue is full. Lookup
+        # failures (unreadable video, broken cache dir) degrade to misses
+        # and take the normal extraction path, where the standard
+        # per-video fault isolation reports them.
+        cache_hits: List[str] = []
+        if args.get('cache_enabled') and not self._draining:
+            cache_hits = self._answer_cache_hits(args, paths)
+            if cache_hits:
+                self.stats.bump('cached_videos', len(cache_hits))
+        miss_paths = ([p for p in paths if p not in set(cache_hits)]
+                      if cache_hits else paths)
+        if not miss_paths:
+            # the whole request was served from cache: terminal at birth
+            with self._lock:
+                self._next_id += 1
+                req = Request(f'r{self._next_id:06d}', feature_type, paths,
+                              None)
+                for p in paths:
+                    req.videos[p] = 'cached'
+                req.pending = 0
+                self._requests[req.id] = req
+                self._record_done_locked(req)
+            self.stats.bump('submitted')
+            self._after_completion(req)
+            return protocol.ok(request_id=req.id)
+
         with self._lock:
             if self._draining:
                 self.stats.bump('rejected')
                 return protocol.error('draining')
-            if self._inflight_videos + len(paths) > self.queue_depth:
+            if self._inflight_videos + len(miss_paths) > self.queue_depth:
                 self.stats.bump('rejected')
                 return protocol.error(
                     'queue_full', depth=self._inflight_videos,
@@ -464,7 +501,7 @@ class ExtractionServer:
                     worker.close()
                     self.stats.bump('rejected')
                     return protocol.error('draining')
-                if self._inflight_videos + len(paths) > self.queue_depth:
+                if self._inflight_videos + len(miss_paths) > self.queue_depth:
                     # re-check after the lockless build window; the
                     # freshly built worker stays pooled, warm for the
                     # caller's retry
@@ -484,10 +521,15 @@ class ExtractionServer:
                 self._next_id += 1
                 req = Request(f'r{self._next_id:06d}', feature_type, paths,
                               deadline)
+                for p in cache_hits:
+                    # already answered from cache above: terminal before
+                    # the misses even enqueue
+                    req.videos[p] = 'cached'
+                    req.pending -= 1
                 self._requests[req.id] = req
-                self._inflight_videos += len(paths)
+                self._inflight_videos += len(miss_paths)
                 tasks = [_ServeTask(p, req, out_root=args['output_path'])
-                         for p in paths]
+                         for p in miss_paths]
                 # enqueue under the admission lock: eviction (pool.put)
                 # also runs under it, so a worker can't be judged idle
                 # and closed between admission and enqueue
@@ -496,6 +538,36 @@ class ExtractionServer:
             return protocol.ok(request_id=req.id)
         self.stats.bump('rejected')
         return protocol.error('worker churn outpaced admission; retry')
+
+    def _answer_cache_hits(self, args: Config,
+                           paths: List[str]) -> List[str]:
+        """Materialize every video the feature cache already holds for
+        this request's recipe into its output root; returns the hit
+        paths. Never raises — any cache-side failure is a miss, and the
+        normal extraction path owns reporting it."""
+        from video_features_tpu.cache import (
+            FeatureCache, log_cache_error, run_fingerprint, video_cache_key,
+        )
+        hits: List[str] = []
+        try:
+            cache = FeatureCache.get(args.get('cache_dir'),
+                                     args.get('cache_max_bytes'))
+            with self._lock:
+                self._caches[cache.cache_dir] = cache
+            fp = run_fingerprint(args)
+        except Exception:
+            log_cache_error('serve-side open')
+            return hits
+        for p in paths:
+            try:
+                if cache.fetch_to(video_cache_key(p, fp),
+                                  args['output_path'], p, fingerprint=fp):
+                    hits.append(p)
+            except Exception:
+                # e.g. the video file itself is unreadable (can't be
+                # content-hashed): let extraction fail it properly
+                log_cache_error(f'serve-side lookup for {p}')
+        return hits
 
     def status(self, request_id: str) -> Dict[str, Any]:
         with self._lock:
@@ -531,15 +603,42 @@ class ExtractionServer:
                 reports[label] = w.ex.tracer.report()
             if self._retired_stages:
                 reports['retired'] = dict(self._retired_stages)
+            caches = list(self._caches.values())
         pool_stats = self.pool.stats()
         # builds ≤ misses: concurrent cold submits for one key all count
         # misses but transplant exactly once (the per-key build lock)
         pool_stats['builds'] = builds
+        from video_features_tpu.cache.store import merge_cache_stats
         return metrics_mod.build_metrics(
             self._started_at, depth, self.queue_depth, draining,
-            pool_stats, self.stats, reports)
+            pool_stats, self.stats, reports,
+            cache_stats=merge_cache_stats(c.stats() for c in caches))
 
     # -- completion callbacks (worker threads) -------------------------------
+
+    def _record_done_locked(self, req: Request) -> None:
+        """Terminal-request bookkeeping (caller holds ``self._lock``):
+        stamp completion time and age out the oldest terminal requests —
+        status() history is bounded, a resident daemon's request table
+        must not grow with lifetime traffic."""
+        req.done_t = time.monotonic()
+        self._done_ids.append(req.id)
+        while len(self._done_ids) > REQUEST_HISTORY:
+            self._requests.pop(self._done_ids.popleft(), None)
+
+    def _after_completion(self, req: Request) -> None:
+        """Lock-free completion accounting, shared by the worker path
+        and the all-cache-hit terminal-at-birth path."""
+        self.stats.bump('completed')
+        if req.state() in ('partial', 'failed'):
+            self.stats.bump('failed')
+        self.stats.observe_latency(req.done_t - req.t0)
+        if self.metrics_path:
+            # building the metrics document takes the server lock and
+            # snapshots every tracer — skip it entirely when no
+            # mirror is configured
+            metrics_mod.write_metrics_file(self.metrics_path,
+                                           self.metrics())
 
     def _finish_video(self, task, state: str) -> None:
         req = task.request
@@ -550,26 +649,17 @@ class ExtractionServer:
                 self._inflight_videos -= 1
             completed = req.pending == 0 and req.done_t is None
             if completed:
-                req.done_t = time.monotonic()
-                # age out the oldest terminal requests: status() history
-                # is bounded, a resident daemon's request table must not
-                # grow with lifetime traffic
-                self._done_ids.append(req.id)
-                while len(self._done_ids) > REQUEST_HISTORY:
-                    self._requests.pop(self._done_ids.popleft(), None)
+                self._record_done_locked(req)
         if completed:
-            self.stats.bump('completed')
-            if req.state() in ('partial', 'failed'):
-                self.stats.bump('failed')
-            self.stats.observe_latency(req.done_t - req.t0)
-            if self.metrics_path:
-                # building the metrics document takes the server lock and
-                # snapshots every tracer — skip it entirely when no
-                # mirror is configured
-                metrics_mod.write_metrics_file(self.metrics_path,
-                                               self.metrics())
+            self._after_completion(req)
 
     def _video_done(self, task) -> None:
+        # 'cached': an in-worker cache hit — the video missed at admission
+        # but another request published it before this one reached decode
+        if getattr(task, 'cached', False):
+            self.stats.bump('cached_videos')
+            self._finish_video(task, 'cached')
+            return
         state = ('skipped' if task.skipped
                  else 'failed' if task.failed else 'saved')
         self._finish_video(task, state)

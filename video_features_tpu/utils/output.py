@@ -10,13 +10,22 @@ complete file (last one wins) instead of interleaving.
 """
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import tempfile
 from pathlib import Path
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import numpy as np
+
+
+class CorruptOutputError(RuntimeError):
+    """A saved output file exists but cannot be read back (truncated,
+    overwritten, wrong format). Distinct from the raw numpy/pickle
+    errors so resume and the feature-cache GC can EVICT-and-re-extract
+    on this, while genuine bugs (a missing file, a type error in caller
+    code) still surface as themselves."""
 
 
 def make_path(output_root: str, video_path: str, output_key: str, ext: str) -> str:
@@ -60,7 +69,19 @@ def atomic_write(fpath: str, write_fn: Callable) -> None:
 
 
 def load_numpy(fpath: str) -> np.ndarray:
-    return np.load(fpath)
+    # A zero-byte file is np.load's worst case (an opaque EOFError deep in
+    # the format reader) and the most common crash artifact — check first.
+    if os.path.getsize(fpath) == 0:
+        raise CorruptOutputError(f'empty output file: {fpath}')
+    try:
+        return np.load(fpath)
+    except (ValueError, EOFError, OSError, pickle.UnpicklingError) as e:
+        # Missing files propagate as-is (a caller bug / race to report,
+        # not corruption); anything the format reader chokes on is.
+        if isinstance(e, FileNotFoundError):
+            raise
+        raise CorruptOutputError(
+            f'corrupt/truncated .npy file: {fpath} ({e})') from e
 
 
 def write_numpy(fpath: str, value: Any) -> None:
@@ -70,8 +91,17 @@ def write_numpy(fpath: str, value: Any) -> None:
 
 
 def load_pickle(fpath: str) -> Any:
-    with open(fpath, 'rb') as f:
-        return pickle.load(f)
+    if os.path.getsize(fpath) == 0:
+        raise CorruptOutputError(f'empty output file: {fpath}')
+    try:
+        with open(fpath, 'rb') as f:
+            return pickle.load(f)
+    except (ValueError, EOFError, OSError, pickle.UnpicklingError,
+            AttributeError, ImportError, IndexError) as e:
+        if isinstance(e, FileNotFoundError):
+            raise
+        raise CorruptOutputError(
+            f'corrupt/truncated .pkl file: {fpath} ({e})') from e
 
 
 def write_pickle(fpath: str, value: Any) -> None:
@@ -81,3 +111,36 @@ def write_pickle(fpath: str, value: Any) -> None:
 ACTION_TO_EXT = {'save_numpy': '.npy', 'save_pickle': '.pkl'}
 ACTION_TO_SAVE = {'save_numpy': write_numpy, 'save_pickle': write_pickle}
 ACTION_TO_LOAD = {'save_numpy': load_numpy, 'save_pickle': load_pickle}
+
+
+# -- resume fingerprint sidecar ----------------------------------------------
+#
+# `<stem>_fingerprint.json` next to a video's output files records the
+# cache/key.run_fingerprint (config + weights identity) that produced
+# them. Resume (BaseExtractor.is_already_exist) keys the skip on it:
+# outputs from a DIFFERENT recipe re-extract with a warning instead of
+# being silently reused; outputs with no sidecar (pre-fingerprint runs)
+# keep the legacy skip.
+
+def fingerprint_path(output_root: str, video_path: str) -> str:
+    return make_path(output_root, video_path, 'fingerprint', '.json')
+
+
+def write_fingerprint(output_root: str, video_path: str,
+                      fingerprint: str) -> None:
+    atomic_write(
+        fingerprint_path(output_root, video_path),
+        lambda f: f.write(json.dumps(
+            {'fingerprint': fingerprint}).encode('utf-8')))
+
+
+def read_fingerprint(output_root: str, video_path: str) -> Optional[str]:
+    """The recorded fingerprint, or None when absent/unreadable (an
+    unreadable sidecar must degrade to 'unknown provenance', not crash
+    the resume scan)."""
+    try:
+        with open(fingerprint_path(output_root, video_path),
+                  encoding='utf-8') as f:
+            return json.load(f).get('fingerprint')
+    except (OSError, ValueError):
+        return None
