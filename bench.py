@@ -323,7 +323,10 @@ def _bench_video(tmp_dir: str, seconds: str = None) -> str:
 def bench_e2e(precision: str, batch: int, stack: int, tmp_dir: str,
               platform: str, feature_type: str = 'i3d', key: str = 'rgb'):
     """File → features clips/sec through the real extractor (decode,
-    prefetch, overlapped H2D, fused device step, feature fetch)."""
+    prefetch, overlapped H2D, fused device step, feature fetch).
+    Returns ``(rate, stage_report)`` — the production Tracer's wall-time
+    split over the timed runs rides into the bench record
+    (``stage_reports``) so a BENCH_*.json explains its own number."""
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
 
@@ -335,6 +338,7 @@ def bench_e2e(precision: str, batch: int, stack: int, tmp_dir: str,
         'stack_size': stack, 'step_size': stack,
         'batch_size': batch,
         'allow_random_weights': True,
+        'profile': True,           # per-stage Tracer feeds stage_reports
         'on_extraction': 'print',  # extraction only; no disk write timing
         'output_path': os.path.join(tmp_dir, 'out'),
         'tmp_path': os.path.join(tmp_dir, 'tmp'),
@@ -343,6 +347,7 @@ def bench_e2e(precision: str, batch: int, stack: int, tmp_dir: str,
     warm = ex.extract(video)                   # compile + cache warm
     clips = warm[key].shape[0]
     assert clips > 0 and np.isfinite(warm[key]).all()
+    ex.tracer.reset()                          # timed runs only
     # median of independent runs: remote tunnels hiccup (a single stalled
     # transfer can triple one run's wall time), and the median is the
     # honest steady-state a user sees
@@ -353,7 +358,8 @@ def bench_e2e(precision: str, batch: int, stack: int, tmp_dir: str,
         out = ex.extract(video)
         rates.append(clips / (time.perf_counter() - t0))
         assert out[key].shape[0] == clips
-    return float(np.median(rates))
+    from video_features_tpu.utils.tracing import round_report
+    return float(np.median(rates)), round_report(ex.tracer.report())
 
 
 def run() -> dict:
@@ -455,19 +461,26 @@ def run() -> dict:
         except Exception as e:
             rungs[f'{fam}_ingraph_error'] = f'{type(e).__name__}: {e}'
 
+    # per-rung Tracer stage reports (decode/h2d/model/save split) ride
+    # along in the record so tools/bench_diff.py users can see WHERE a
+    # regression landed, not just that one did
+    stage_reports = {}
     mode = os.environ.get('BENCH_MODE', 'both' if on_accel else 'ingraph')
     if mode in ('both', 'e2e'):
         with tempfile.TemporaryDirectory() as tmp_dir:
             try:
-                rungs[f'e2e_{precision}'] = round(
-                    bench_e2e(precision, min(batch, 8), stack, tmp_dir,
-                              platform), 3)
+                rate, rep = bench_e2e(precision, min(batch, 8), stack,
+                                      tmp_dir, platform)
+                rungs[f'e2e_{precision}'] = round(rate, 3)
+                stage_reports[f'e2e_{precision}'] = rep
             except Exception as e:
                 rungs['e2e_error'] = f'{type(e).__name__}: {e}'
             try:
-                rungs[f'r21d_e2e_{precision}'] = round(
-                    bench_e2e(precision, min(batch, 8), stack, tmp_dir,
-                              platform, feature_type='r21d', key='r21d'), 3)
+                rate, rep = bench_e2e(precision, min(batch, 8), stack,
+                                      tmp_dir, platform,
+                                      feature_type='r21d', key='r21d')
+                rungs[f'r21d_e2e_{precision}'] = round(rate, 3)
+                stage_reports[f'r21d_e2e_{precision}'] = rep
             except Exception as e:
                 rungs['r21d_e2e_error'] = f'{type(e).__name__}: {e}'
             # Sustained multi-video worklist (resume contract + prefetch
@@ -489,6 +502,7 @@ def run() -> dict:
                         wrec['videos_per_min']
                     rungs[f'worklist_clips_per_sec_{precision}'] = \
                         wrec['clips_per_sec']
+                    stage_reports[f'worklist_{precision}'] = wrec['stages']
                 except Exception as e:
                     rungs['worklist_error'] = f'{type(e).__name__}: {e}'
                 # The SAME worklist object, batch-major
@@ -505,6 +519,8 @@ def run() -> dict:
                             stack=stack, precision=precision, packed=True)
                         rungs[f'worklist_packed_clips_per_sec_{precision}'] \
                             = wrec_packed['clips_per_sec']
+                        stage_reports[f'worklist_packed_{precision}'] = \
+                            wrec_packed['stages']
                         if wrec_packed.get('batch_occupancy') is not None:
                             rungs['worklist_packed_batch_occupancy'] = \
                                 wrec_packed['batch_occupancy']
@@ -579,6 +595,9 @@ def run() -> dict:
         'unit': 'clips/sec/chip',
         'vs_baseline': round(value / BASELINE_CLIPS_PER_SEC, 3),
         'rungs': rungs,
+        # rung name → per-stage Tracer report for every instrumented rung
+        # (empty dict on in-graph-only runs)
+        'stage_reports': stage_reports,
     }
 
 

@@ -64,11 +64,15 @@ def test_corrupted_output_triggers_reextraction(tmp_path):
 
 
 def test_error_isolation(tmp_path, capsys):
+    """The failure report goes through the structured log channel →
+    stderr (video path + traceback); stdout — the feature stream under
+    on_extraction=print — stays untouched (obs/events)."""
     ex = StubExtractor(tmp_path / 'tmp', tmp_path / 'out', fail=True)
     ex._extract('/videos/bad.mp4')  # must not raise
     captured = capsys.readouterr()
-    assert 'An error occurred' in captured.out
-    assert 'Continuing' in captured.out
+    assert 'An error occurred' not in captured.out
+    assert 'bad.mp4' in captured.err
+    assert 'decode exploded' in captured.err      # full traceback
 
 
 def test_keyboard_interrupt_propagates(tmp_path):

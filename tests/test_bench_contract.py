@@ -27,7 +27,8 @@ def _run_bench(extra_env):
     lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, f'expected ONE line, got: {lines}'
     rec = json.loads(lines[0])
-    assert set(rec) == {'metric', 'value', 'unit', 'vs_baseline', 'rungs'}
+    assert set(rec) == {'metric', 'value', 'unit', 'vs_baseline', 'rungs',
+                        'stage_reports'}
     assert rec['unit'] == 'clips/sec/chip'
     assert rec['value'] > 0
     assert rec['rungs']
@@ -50,6 +51,14 @@ def test_bench_mode_both_keeps_contract():
     # both rungs recorded (or an explicit e2e_error key — never a crash)
     assert any(k.startswith('ingraph_') for k in rec['rungs'])
     assert any(k.startswith('e2e') for k in rec['rungs'])
+    # instrumented rungs embed their per-stage Tracer report: the record
+    # explains its own number (tools/bench_diff.py reads these)
+    if not any(k.endswith('e2e_error') for k in rec['rungs']):
+        e2e_reports = [v for k, v in rec['stage_reports'].items()
+                       if k.startswith('e2e')]
+        assert e2e_reports and all('count' in s and 'total_s' in s
+                                   for rep in e2e_reports
+                                   for s in rep.values())
 
 
 def test_bench_serve_rung_emits_keys():

@@ -1,0 +1,535 @@
+"""The flight recorder (obs/): span timeline, metrics registry,
+Prometheus exposition, run manifest, structured error log — and the
+contracts that pin their schemas.
+"""
+import json
+import logging
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.obs.metrics import (
+    DEFAULT_BUCKETS, Histogram, MetricsRegistry,
+)
+from video_features_tpu.obs.spans import SpanRecorder
+from video_features_tpu.utils.tracing import Tracer
+
+from tools.make_sample_video import write_noise_clip as _write_clip  # noqa: E402
+from tools.trace_view import validate_events  # noqa: E402
+
+
+# -- span recorder -----------------------------------------------------------
+
+def test_span_recorder_records_and_exports(tmp_path):
+    rec = SpanRecorder(capacity=100)
+    t0 = 1.0
+    rec.span('decode', t0, t0 + 0.5, video='a.mp4')
+    rec.instant('video_done', video='a.mp4', outcome='saved')
+    events = rec.snapshot()
+    spans = [e for e in events if e['ph'] == 'X']
+    assert len(spans) == 1
+    assert spans[0]['name'] == 'decode'
+    assert spans[0]['args']['video'] == 'a.mp4'
+    assert spans[0]['dur'] == pytest.approx(0.5e6)
+    assert validate_events(events) == []
+
+    out = tmp_path / 'trace.json'
+    rec.export(str(out))
+    doc = json.loads(out.read_text())
+    assert isinstance(doc['traceEvents'], list)
+    assert doc['otherData']['events_dropped'] == 0
+
+
+def test_span_recorder_ring_buffer_drops_oldest():
+    rec = SpanRecorder(capacity=4)
+    for i in range(10):
+        rec.span(f's{i}', float(i), float(i) + 0.1)
+    assert rec.dropped == 6
+    names = [e['name'] for e in rec.snapshot() if e['ph'] == 'X']
+    assert names == ['s6', 's7', 's8', 's9']
+
+
+def test_merge_traces_aligns_recorders_on_common_origin():
+    """Recorders created at different times (serve workers built hours
+    apart) share one CLOCK; the merged export must re-base everything to
+    ONE origin so cross-worker ordering survives — each recorder's own
+    snapshot re-bases to its own epoch."""
+    from video_features_tpu.obs.spans import merge_traces
+    a, b = SpanRecorder(capacity=8), SpanRecorder(capacity=8)
+    a._t0, b._t0 = 100.0, 110.0            # b "built" 10s later
+    a.span('a_span', 100.0, 100.5)
+    b.span('b_span', 110.0, 110.5)
+    # alone, each re-bases to its own epoch: both spans sit at ts=0
+    assert [e['ts'] for e in a.snapshot() if e['ph'] == 'X'] == [0.0]
+    assert [e['ts'] for e in b.snapshot() if e['ph'] == 'X'] == [0.0]
+    merged = {e['name']: e for e in merge_traces([a, b])
+              if e['ph'] == 'X'}
+    assert merged['a_span']['ts'] == 0.0
+    assert merged['b_span']['ts'] == pytest.approx(10e6)
+
+
+def test_disabled_recorder_is_noop():
+    rec = SpanRecorder(capacity=8, enabled=False)
+    rec.span('x', 0.0, 1.0)
+    rec.instant('y')
+    assert [e for e in rec.snapshot() if e['ph'] != 'M'] == []
+
+
+def test_tracer_feeds_recorder():
+    """The stage table and the span timeline are two views over the SAME
+    instrumentation sites: a tracer with a recorder attached both
+    aggregates and appends span events, with attrs flowing through."""
+    rec = SpanRecorder(capacity=100)
+    t = Tracer(enabled=True, recorder=rec)
+    with t.stage('model', video='v.mp4'):
+        pass
+    t.add('decode', 0.25, video='w.mp4')
+    rep = t.report()
+    assert rep['model']['count'] == 1 and rep['decode']['count'] == 1
+    spans = {e['name']: e for e in rec.snapshot() if e['ph'] == 'X'}
+    assert spans['model']['args']['video'] == 'v.mp4'
+    assert spans['decode']['args']['video'] == 'w.mp4'
+    assert spans['decode']['dur'] == pytest.approx(0.25e6, rel=1e-3)
+
+
+def test_null_tracer_never_records():
+    from video_features_tpu.utils.tracing import NULL_TRACER
+    with NULL_TRACER.stage('x', video='v'):
+        pass
+    assert NULL_TRACER.report() == {}
+
+
+# -- trace_view validation ---------------------------------------------------
+
+def test_trace_view_rejects_violations(tmp_path):
+    from tools.trace_view import main as trace_view_main
+    bad = {'traceEvents': [
+        {'name': 'a', 'ph': 'X', 'ts': 5.0, 'dur': 1.0, 'pid': 1, 'tid': 1},
+        {'name': 'b', 'ph': 'X', 'ts': 2.0, 'dur': -1.0, 'pid': 1, 'tid': 1},
+        {'name': 'c', 'ph': 'E', 'ts': 9.0, 'pid': 1, 'tid': 1},
+        {'ph': 'X', 'ts': 1.0, 'pid': 1, 'tid': 1},
+    ]}
+    p = tmp_path / 'bad.json'
+    p.write_text(json.dumps(bad))
+    assert trace_view_main([str(p)]) == 1
+    assert trace_view_main([str(tmp_path / 'missing.json')]) == 2
+
+
+def test_trace_view_accepts_b_e_pairs(tmp_path):
+    from tools.trace_view import main as trace_view_main
+    good = {'traceEvents': [
+        {'name': 'outer', 'ph': 'B', 'ts': 0.0, 'pid': 1, 'tid': 1},
+        {'name': 'inner', 'ph': 'B', 'ts': 1.0, 'pid': 1, 'tid': 1},
+        {'name': 'inner', 'ph': 'E', 'ts': 2.0, 'pid': 1, 'tid': 1},
+        {'name': 'outer', 'ph': 'E', 'ts': 3.0, 'pid': 1, 'tid': 1},
+    ]}
+    p = tmp_path / 'good.json'
+    p.write_text(json.dumps(good))
+    assert trace_view_main([str(p), '--quiet']) == 0
+
+
+# -- metrics registry + Prometheus exposition --------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+    r'(NaN|[+-]?Inf|[-+0-9.eE]+)$')
+
+
+def assert_valid_prometheus(text: str) -> None:
+    """Line-grammar check for the text exposition format 0.0.4."""
+    assert text.endswith('\n')
+    seen_type = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith('# HELP ') or line.startswith('# TYPE '):
+            parts = line.split(' ', 3)
+            assert len(parts) >= 4 or parts[1] == 'TYPE', line
+            if parts[1] == 'TYPE':
+                seen_type[parts[2]] = parts[3]
+            continue
+        assert _SAMPLE_RE.match(line), f'bad sample line: {line!r}'
+    assert seen_type, 'no TYPE lines'
+
+
+def test_registry_counter_gauge_histogram_render():
+    reg = MetricsRegistry()
+    reg.counter('vft_requests_total', 'requests',
+                labels={'outcome': 'completed'}).inc(3)
+    reg.counter('vft_requests_total',
+                labels={'outcome': 'failed'}).inc()
+    reg.gauge('vft_queue_depth', 'queued videos').set(7)
+    h = reg.histogram('vft_latency_seconds', 'latency',
+                      buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.render()
+    assert_valid_prometheus(text)
+    assert 'vft_requests_total{outcome="completed"} 3' in text
+    assert 'vft_queue_depth 7' in text
+    # cumulative buckets: 0.1→1, 1.0→2, 10→3, +Inf→4
+    assert 'vft_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'vft_latency_seconds_bucket{le="1"} 2' in text
+    assert 'vft_latency_seconds_bucket{le="10"} 3' in text
+    assert 'vft_latency_seconds_bucket{le="+Inf"} 4' in text
+    assert 'vft_latency_seconds_count 4' in text
+    assert 'vft_latency_seconds_sum 55.55' in text
+    # re-registration returns the same series
+    assert reg.gauge('vft_queue_depth').value == 7
+
+
+def test_registry_rejects_type_conflicts_and_negative_inc():
+    reg = MetricsRegistry()
+    reg.counter('x_total')
+    with pytest.raises(ValueError):
+        reg.gauge('x_total')
+    with pytest.raises(ValueError):
+        reg.counter('y_total').inc(-1)
+
+
+def test_histogram_default_buckets_cover_latency_range():
+    h = Histogram()
+    assert h.buckets == tuple(sorted(DEFAULT_BUCKETS))
+    h.observe(0.0)
+    assert h.snapshot()['buckets'][0][1] == 1
+
+
+def test_prometheus_from_serve_doc():
+    """The serve metrics document renders to valid Prometheus text with
+    the queue depth, pool hit rate, cache hits, and latency histogram
+    the acceptance criteria name."""
+    from video_features_tpu.obs.metrics import MetricsRegistry
+    from video_features_tpu.serve import metrics as metrics_mod
+
+    reg = MetricsRegistry()
+    stats = metrics_mod.RequestStats(registry=reg)
+    stats.bump('submitted')
+    stats.bump('completed')
+    stats.observe_latency(0.2)
+    doc = metrics_mod.build_metrics(
+        started_at=0.0, queue_depth=3, queue_capacity=64, draining=False,
+        pool_stats={'size': 1, 'capacity': 4, 'hits': 5, 'misses': 1,
+                    'hit_rate': 5 / 6, 'evictions': 0, 'builds': 1},
+        request_stats=stats,
+        stage_reports={'i3d': {'model': {
+            'count': 4, 'total_s': 2.0, 'mean_s': 0.5, 'max_s': 0.9,
+            'first_s': 0.9, 'occupancy': 0.75, 'occ_valid': 12,
+            'occ_capacity': 16}}},
+        cache_stats={'caches': 1, 'entries': 2, 'bytes': 10, 'hits': 7,
+                     'misses': 3, 'hit_rate': 0.7, 'puts': 2,
+                     'evictions': 0, 'corrupt_evicted': 0,
+                     'bytes_saved': 123})
+    text = metrics_mod.prometheus_text(doc, reg)
+    assert_valid_prometheus(text)
+    for needle in ('vft_serve_queue_depth 3',
+                   'vft_warm_pool_hit_rate',
+                   'vft_cache_hits 7',
+                   'vft_serve_request_latency_seconds_bucket',
+                   'vft_serve_requests_total{outcome="completed"} 1',
+                   'vft_stage_seconds{stage="model"} 2',
+                   'vft_stage_occupancy{stage="model"} 0.75'):
+        assert needle in text, f'{needle!r} missing from:\n{text}'
+
+
+# -- structured event log ----------------------------------------------------
+
+def _make_stub(tmp_path, on_extraction, fail=True):
+    from video_features_tpu.extract.base import BaseExtractor
+
+    class Stub(BaseExtractor):
+        output_feat_keys = ['rgb']
+
+        def extract(self, video_path):
+            if fail:
+                raise RuntimeError('decode exploded')
+            return {'rgb': np.ones((2, 3), np.float32)}
+
+    return Stub('stub', on_extraction, str(tmp_path / 'tmp'),
+                str(tmp_path / 'out'), keep_tmp_files=False, device='cpu')
+
+
+def test_error_log_keeps_print_mode_stdout_clean(tmp_path, capsys, caplog):
+    """The fault-isolation error report must never interleave with the
+    feature stream: stdout stays byte-clean, the structured record (video
+    path + traceback) lands on the logging channel → stderr."""
+    ex = _make_stub(tmp_path, 'print')
+    with caplog.at_level(logging.WARNING, logger='video_features_tpu'):
+        ex._extract('/videos/bad.mp4')          # must not raise
+    captured = capsys.readouterr()
+    assert captured.out == ''                   # byte-clean feature stream
+    assert 'bad.mp4' in captured.err
+    assert 'RuntimeError' in captured.err       # full traceback, stderr
+    rec = next(r for r in caplog.records if getattr(r, 'video', None))
+    assert rec.levelno == logging.WARNING
+    assert rec.video == '/videos/bad.mp4'
+    assert rec.exc_info is not None
+
+
+def test_packed_device_step_error_goes_to_logger(tmp_path, capsys, caplog):
+    """parallel/packing.py's device-step fault isolation reports through
+    the same structured channel — batch videos named, stdout untouched."""
+    from video_features_tpu.obs.events import log_batch_error
+    with caplog.at_level(logging.WARNING, logger='video_features_tpu'):
+        try:
+            raise RuntimeError('geometry will not compile')
+        except RuntimeError:
+            log_batch_error(['a.mp4', 'b.mp4'], valid=3, batch=4)
+    captured = capsys.readouterr()
+    assert captured.out == ''
+    assert 'a.mp4' in captured.err and 'geometry will not compile' in captured.err
+    rec = next(r for r in caplog.records if getattr(r, 'videos', None))
+    assert rec.valid == 3 and rec.batch == 4
+
+
+# -- the packed CLI run: trace + manifest end to end -------------------------
+
+@pytest.fixture(scope='module')
+def obs_worklist(tmp_path_factory):
+    d = tmp_path_factory.mktemp('obsvids')
+    return [str(_write_clip(d / f'v{i}.mp4', n, seed=10 + i))
+            for i, n in enumerate((6, 9))]
+
+
+def test_packed_cli_trace_out_covers_every_video(obs_worklist, tmp_path,
+                                                 capsys):
+    """Acceptance: one packed CLI run with trace_out yields a Chrome
+    trace whose spans cover decode/pack/device-step/save for EVERY video
+    in the worklist, and tools/trace_view.py validates it."""
+    from tools.trace_view import main as trace_view_main
+    from video_features_tpu.cli import main
+
+    trace = tmp_path / 'trace.json'
+    manifest = tmp_path / 'manifest.json'
+    rc = main([
+        'feature_type=resnet', 'model_name=resnet18', 'device=cpu',
+        f'video_paths=[{",".join(obs_worklist)}]',
+        'pack_across_videos=true', 'batch_size=4',
+        'allow_random_weights=true', 'on_extraction=save_numpy',
+        f'output_path={tmp_path / "out"}', f'tmp_path={tmp_path / "tmp"}',
+        f'trace_out={trace}', f'manifest_out={manifest}'])
+    assert rc == 0
+    capsys.readouterr()
+
+    doc = json.loads(trace.read_text())
+    events = doc['traceEvents']
+    assert validate_events(events) == []
+    spans = [e for e in events if e['ph'] == 'X']
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e['name'], []).append(e)
+    for path in obs_worklist:
+        assert any(e['args'].get('video') == path
+                   for e in by_name.get('decode+preprocess', [])
+                   if 'args' in e), f'no decode span for {path}'
+        assert any(path in e['args'].get('videos', [])
+                   for e in by_name.get('pack', []) if 'args' in e), \
+            f'no pack span for {path}'
+        assert any(path in e['args'].get('videos', [])
+                   for e in by_name.get('model', []) if 'args' in e), \
+            f'no device-step span for {path}'
+        assert any(e['args'].get('video') == path
+                   for e in by_name.get('save', []) if 'args' in e), \
+            f'no save span for {path}'
+    # the validator tool accepts the real artifact (tier-1 exercise)
+    assert trace_view_main([str(trace), '--quiet']) == 0
+    capsys.readouterr()
+
+    # -- run manifest: fingerprints + outcomes + stages ----------------------
+    man = json.loads(manifest.read_text())
+    assert man['schema'] == 'video_features_tpu.run_manifest/1'
+    assert man['fingerprints']['run']
+    assert man['fingerprints']['config']
+    assert set(man['videos']) == set(obs_worklist)
+    assert all(v['outcome'] == 'saved' for v in man['videos'].values())
+    assert man['outcomes'] == {'saved': len(obs_worklist)}
+    assert 'model' in man['stages'] and man['stages']['model']['count'] > 0
+    assert man['config']['feature_type'] == 'resnet'
+    # outputs written normally alongside the telemetry
+    from video_features_tpu.utils.output import make_path
+    for p in obs_worklist:
+        arr = np.load(make_path(str(tmp_path / 'out' / 'resnet' /
+                                    'resnet18'), p, 'resnet', '.npy'))
+        assert arr.shape[1] == 512
+
+
+def test_one_shot_cli_trace_and_manifest(obs_worklist, tmp_path, capsys):
+    """The per-video loop records the same telemetry: a video span per
+    clip plus the stage spans, and a manifest with per-video outcomes."""
+    from video_features_tpu.cli import main
+
+    trace = tmp_path / 'trace.json'
+    manifest = tmp_path / 'manifest.json'
+    rc = main([
+        'feature_type=resnet', 'model_name=resnet18', 'device=cpu',
+        f'video_paths=[{",".join(obs_worklist)}]', 'batch_size=4',
+        'allow_random_weights=true', 'on_extraction=save_numpy',
+        f'output_path={tmp_path / "out"}', f'tmp_path={tmp_path / "tmp"}',
+        f'trace_out={trace}', f'manifest_out={manifest}'])
+    assert rc == 0
+    capsys.readouterr()
+    events = json.loads(trace.read_text())['traceEvents']
+    assert validate_events(events) == []
+    vids = [e for e in events if e['ph'] == 'X' and e['name'] == 'video']
+    assert {e['args']['video'] for e in vids} == set(obs_worklist)
+    assert all(e['args']['outcome'] == 'saved' for e in vids)
+    man = json.loads(manifest.read_text())
+    assert man['outcomes'] == {'saved': len(obs_worklist)}
+    assert man['stages']                       # folded across the reset
+
+
+# -- serve: Prometheus endpoint + file mirror --------------------------------
+
+def test_serve_prometheus_endpoint_and_mirror(tmp_path):
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+
+    metrics_path = str(tmp_path / 'metrics.json')
+    server = ExtractionServer(metrics_path=metrics_path).start()
+    try:
+        client = ServeClient(port=server.port)
+        text = client.metrics_prom()
+        assert_valid_prometheus(text)
+        for needle in ('vft_serve_queue_depth 0',
+                       'vft_serve_queue_capacity 64',
+                       'vft_warm_pool_hit_rate',
+                       'vft_cache_hits',
+                       'vft_serve_request_latency_seconds_count',
+                       'vft_serve_uptime_seconds'):
+            assert needle in text, f'{needle!r} missing from:\n{text}'
+    finally:
+        server.drain(wait=True, grace_s=30)
+    # the atomic mirror wrote BOTH formats on drain
+    doc = json.loads(Path(metrics_path).read_text())
+    assert 'queue' in doc
+    prom = Path(metrics_path + '.prom').read_text()
+    assert_valid_prometheus(prom)
+    assert 'vft_serve_draining 1' in prom
+
+
+def test_serve_drain_exports_merged_trace(obs_worklist, tmp_path):
+    """A server-wide trace_out base override stitches EVERY worker's
+    recorder into one Chrome trace at drain — spans from a real request
+    (decode/pack/model/save, request ids) survive the merge and the
+    export validates."""
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+
+    trace = tmp_path / 'serve_trace.json'
+    server = ExtractionServer(base_overrides={
+        'device': 'cpu', 'model_name': 'resnet18', 'batch_size': 4,
+        'allow_random_weights': True, 'on_extraction': 'save_numpy',
+        'tmp_path': str(tmp_path / 'serve_tmp'),
+        'output_path': str(tmp_path / 'serve_out'),
+        'trace_out': str(trace),
+    }, queue_depth=8, pool_size=2).start()
+    try:
+        client = ServeClient(port=server.port)
+        rid = client.submit('resnet', [obs_worklist[0]])
+        st = client.wait(rid, timeout_s=300)
+        assert st['state'] == 'done', st
+    finally:
+        server.drain(wait=True, grace_s=120)
+
+    doc = json.loads(trace.read_text())
+    events = doc['traceEvents']
+    assert validate_events(events) == []
+    assert doc['otherData']['recorders_merged'] >= 1
+    spans = [e for e in events if e['ph'] == 'X' and 'args' in e]
+    assert any(e['name'] == 'model' for e in spans)
+    assert any(e['name'] == 'save'
+               and e['args'].get('video') == obs_worklist[0]
+               and e['args'].get('request_id') == rid for e in spans)
+
+
+# -- bench_diff --------------------------------------------------------------
+
+def _bench_rec(**rungs):
+    return {'metric': 'm', 'value': rungs.get('value', 1.0), 'unit': 'u',
+            'vs_baseline': 1.0, 'rungs': rungs}
+
+
+def test_bench_diff_detects_direction_aware_regressions(tmp_path, capsys):
+    from tools.bench_diff import main as bench_diff_main
+    old = tmp_path / 'old.json'
+    new = tmp_path / 'new.json'
+    old.write_text(json.dumps(_bench_rec(
+        e2e_mixed=10.0, serve_p99_latency_s=1.0, only_old=5.0)))
+    # throughput dropped 50% AND latency doubled: both are regressions
+    new.write_text(json.dumps(_bench_rec(
+        e2e_mixed=5.0, serve_p99_latency_s=2.0, only_new='err')))
+    assert bench_diff_main([str(old), str(new)]) == 0   # report-only mode
+    capsys.readouterr()
+    assert bench_diff_main([str(old), str(new),
+                            '--fail-on-regression', '10']) == 1
+    err = capsys.readouterr().err
+    assert 'e2e_mixed' in err and 'serve_p99_latency_s' in err
+
+    # within threshold → pass
+    new.write_text(json.dumps(_bench_rec(
+        e2e_mixed=9.8, serve_p99_latency_s=1.02)))
+    assert bench_diff_main([str(old), str(new),
+                            '--fail-on-regression', '10']) == 0
+    assert bench_diff_main([str(tmp_path / 'nope.json'), str(new)]) == 2
+
+
+def test_bench_diff_latency_improvement_is_not_regression(tmp_path):
+    from tools.bench_diff import main as bench_diff_main
+    old = tmp_path / 'o.json'
+    new = tmp_path / 'n.json'
+    old.write_text(json.dumps(_bench_rec(serve_p50_latency_s=2.0)))
+    new.write_text(json.dumps(_bench_rec(serve_p50_latency_s=0.5)))
+    assert bench_diff_main([str(old), str(new),
+                            '--fail-on-regression', '1']) == 0
+
+
+# -- schema contracts --------------------------------------------------------
+
+TRACER_RECORD_KEYS = {'count', 'total_s', 'mean_s', 'max_s', 'first_s',
+                      'ramp', 'occupancy', 'occ_valid', 'occ_capacity'}
+METRICS_DOC_KEYS = {'uptime_s', 'queue', 'warm_pool', 'cache', 'requests',
+                    'latency', 'stages', 'stages_merged'}
+TRACE_EVENT_KEYS = {'name', 'ph', 'ts', 'dur', 'pid', 'tid', 'args', 's'}
+MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
+                 'config', 'fingerprints', 'videos', 'outcomes', 'stages',
+                 'compile', 'executables'}
+
+
+def test_schema_contract_key_sets(tmp_path):
+    """Pin the three export schemas: a key rename is an intentional,
+    test-visible event — scrapers and dashboards depend on these."""
+    # tracer report records
+    t = Tracer()
+    with t.stage('a'):
+        pass
+    with t.stage('a'):
+        pass
+    t.add_occupancy('a', 3, 4)
+    rec = t.report()['a']
+    assert set(rec) <= TRACER_RECORD_KEYS
+    assert {'count', 'total_s', 'mean_s', 'max_s', 'first_s'} <= set(rec)
+
+    # serve metrics document
+    from video_features_tpu.serve import metrics as metrics_mod
+    doc = metrics_mod.build_metrics(
+        started_at=0.0, queue_depth=0, queue_capacity=1, draining=False,
+        pool_stats={}, request_stats=metrics_mod.RequestStats(),
+        stage_reports={})
+    assert set(doc) == METRICS_DOC_KEYS
+    assert set(doc['requests']) == {'submitted', 'completed', 'failed',
+                                    'rejected', 'expired_videos',
+                                    'cached_videos'}
+
+    # trace events
+    sr = SpanRecorder(capacity=8)
+    sr.span('s', 0.0, 1.0, video='v')
+    sr.instant('i')
+    for ev in sr.snapshot():
+        assert set(ev) <= TRACE_EVENT_KEYS, ev
+
+    # run manifest
+    from video_features_tpu.obs.manifest import RunManifest
+    man = RunManifest({'feature_type': 'resnet'}).document()
+    assert set(man) == MANIFEST_KEYS

@@ -2,7 +2,11 @@
 import threading
 import time
 
-from video_features_tpu.utils.tracing import NULL_TRACER, Tracer, jax_profiler_trace
+import pytest
+
+from video_features_tpu.utils.tracing import (
+    NULL_TRACER, Tracer, jax_profiler_trace, merge_reports,
+)
 
 
 def test_stage_accumulates():
@@ -75,6 +79,59 @@ def test_summary_and_reset():
     t.reset()
     assert t.report() == {}
     assert t.summary() == '(no stages recorded)'
+
+
+def test_merge_reports_occupancy_recombines_from_raw_counts():
+    """Aggregate occupancy must recompute from the raw slot counts —
+    averaging the per-tracer ratios would weight batches wrongly (a
+    1-batch 50% tracer would pull down a 100-batch 95% tracer)."""
+    a = Tracer()
+    a.add('model', 0.1)
+    a.add_occupancy('model', 1, 2)            # 50% over 2 slots
+    b = Tracer()
+    b.add('model', 0.2)
+    b.add_occupancy('model', 95, 100)         # 95% over 100 slots
+    merged = merge_reports([a.report(), b.report()])
+    m = merged['model']
+    assert m['occ_valid'] == 96 and m['occ_capacity'] == 102
+    assert m['occupancy'] == pytest.approx(96 / 102)
+    # NOT the mean of ratios (0.725)
+    assert abs(m['occupancy'] - 0.725) > 0.1
+    assert m['count'] == 2
+    assert m['total_s'] == pytest.approx(0.3)
+    assert m['mean_s'] == pytest.approx(0.15)
+
+
+def test_merge_reports_first_s_keeps_worst_cold_start():
+    """The fleet view's first_s is the WORST cold start across tracers
+    (the number an operator sizes warm-up budgets by), and max_s maxes;
+    per-tracer ramp is dropped rather than faked."""
+    a = Tracer()
+    a.add('model', 3.0)                       # cold compile wall
+    a.add('model', 0.1)
+    b = Tracer()
+    b.add('model', 0.5)
+    b.add('model', 0.1)
+    rep_a, rep_b = a.report(), b.report()
+    assert 'ramp' in rep_a['model']
+    merged = merge_reports([rep_a, rep_b])
+    m = merged['model']
+    assert m['first_s'] == pytest.approx(3.0)
+    assert m['max_s'] == pytest.approx(3.0)
+    assert m['count'] == 4
+    assert 'ramp' not in m
+    # stages without occupancy never grow occupancy keys
+    assert 'occupancy' not in m and 'occ_valid' not in m
+
+
+def test_merge_reports_disjoint_stages_union():
+    a = Tracer()
+    a.add('decode', 1.0)
+    b = Tracer()
+    b.add('save', 2.0)
+    merged = merge_reports([a.report(), b.report()])
+    assert set(merged) == {'decode', 'save'}
+    assert merged['save']['mean_s'] == pytest.approx(2.0)
 
 
 def test_jax_profiler_trace_none_is_noop():

@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Validate (and summarize) a Chrome trace-event JSON export.
+
+``obs.spans.SpanRecorder.export`` (the ``trace_out=`` knob on all three
+execution paths) writes the ``traceEvents`` document this tool checks.
+CI runs it against a dryrun-produced trace so a refactor that breaks the
+export surfaces as a red test, not as Perfetto silently rendering an
+empty timeline a week later.
+
+Checks:
+  * top level is an object with a ``traceEvents`` list;
+  * every event carries ``name``/``ph``/``ts``/``pid``/``tid`` (ids
+    present), ``ts >= 0``; complete events (``X``) carry ``dur >= 0``;
+  * begin/end (``B``/``E``) events balance per ``(pid, tid)`` with
+    LIFO name matching (the recorder emits ``X`` spans, but hand-made
+    or merged traces may not);
+  * timestamps are monotonically non-decreasing over the event list
+    (the exporter sorts; a torn or hand-concatenated file fails here).
+
+Exit codes: 0 valid · 1 invalid (details on stderr) · 2 usage/IO error.
+
+Usage:
+    python tools/trace_view.py TRACE.json [--quiet]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Tuple
+
+REQUIRED_KEYS = ('name', 'ph', 'ts', 'pid', 'tid')
+# metadata events (process/thread naming) are exempt from the timeline
+# checks — viewers place them outside the time axis
+META_PHASES = ('M',)
+
+
+def validate_events(events: List[Dict[str, Any]]) -> List[str]:
+    """All violations found (empty list = valid)."""
+    errors: List[str] = []
+    open_stacks: Dict[Tuple[Any, Any], List[str]] = defaultdict(list)
+    last_ts = None
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f'event[{i}]: not an object')
+            continue
+        missing = [k for k in REQUIRED_KEYS if k not in ev]
+        if missing:
+            errors.append(f'event[{i}] ({ev.get("name")!r}): missing '
+                          f'keys {missing}')
+            continue
+        ph = ev['ph']
+        if ph in META_PHASES:
+            continue
+        ts = ev['ts']
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f'event[{i}] ({ev["name"]!r}): bad ts {ts!r}')
+            continue
+        if last_ts is not None and ts < last_ts:
+            errors.append(f'event[{i}] ({ev["name"]!r}): ts {ts} < '
+                          f'previous {last_ts} (not monotonic)')
+        last_ts = ts
+        if ph == 'X':
+            dur = ev.get('dur')
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f'event[{i}] ({ev["name"]!r}): X event '
+                              f'with bad dur {dur!r}')
+        elif ph == 'B':
+            open_stacks[(ev['pid'], ev['tid'])].append(ev['name'])
+        elif ph == 'E':
+            stack = open_stacks[(ev['pid'], ev['tid'])]
+            if not stack:
+                errors.append(f'event[{i}] ({ev["name"]!r}): E without '
+                              f'matching B on tid {ev["tid"]}')
+            elif stack[-1] != ev['name']:
+                errors.append(f'event[{i}]: E {ev["name"]!r} crosses '
+                              f'open B {stack[-1]!r}')
+            else:
+                stack.pop()
+    for (pid, tid), stack in open_stacks.items():
+        if stack:
+            errors.append(f'unclosed B events on pid {pid} tid {tid}: '
+                          f'{stack}')
+    return errors
+
+
+def summarize(events: List[Dict[str, Any]]) -> str:
+    spans: Dict[str, List[float]] = defaultdict(list)
+    instants: Dict[str, int] = defaultdict(int)
+    for ev in events:
+        if ev.get('ph') == 'X':
+            spans[ev['name']].append(float(ev.get('dur', 0.0)))
+        elif ev.get('ph') == 'i':
+            instants[ev['name']] += 1
+    lines = []
+    if spans:
+        width = max(len(n) for n in spans)
+        lines.append(f'{"span".ljust(width)} | count |  total ms |  mean us')
+        for name in sorted(spans, key=lambda n: -sum(spans[n])):
+            durs = spans[name]
+            lines.append(f'{name.ljust(width)} | {len(durs):5d} '
+                         f'| {sum(durs) / 1e3:9.3f} '
+                         f'| {sum(durs) / len(durs):8.1f}')
+    for name in sorted(instants):
+        lines.append(f'instant {name}: {instants[name]}')
+    return '\n'.join(lines) if lines else '(no timeline events)'
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('trace', help='Chrome trace-event JSON file')
+    ap.add_argument('--quiet', action='store_true',
+                    help='validate only; no summary table')
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.trace) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f'trace_view: cannot read {args.trace}: {e}', file=sys.stderr)
+        return 2
+    if not isinstance(doc, dict) or \
+            not isinstance(doc.get('traceEvents'), list):
+        print('trace_view: not a trace-event document (expected an '
+              'object with a traceEvents list)', file=sys.stderr)
+        return 1
+
+    events = doc['traceEvents']
+    errors = validate_events(events)
+    if errors:
+        for err in errors[:50]:
+            print(f'trace_view: {err}', file=sys.stderr)
+        print(f'trace_view: INVALID — {len(errors)} violation(s) in '
+              f'{len(events)} events', file=sys.stderr)
+        return 1
+    dropped = (doc.get('otherData') or {}).get('events_dropped', 0)
+    if not args.quiet:
+        print(summarize(events))
+    print(f'trace_view: OK — {len(events)} events'
+          + (f' ({dropped} dropped at record time)' if dropped else ''))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -74,6 +74,7 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
     production."""
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
+    from video_features_tpu.utils.tracing import round_report
 
     if precision is None:
         precision = os.environ.get('BENCH_PRECISION', 'mixed')
@@ -158,9 +159,10 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         'batch_occupancy': (round(occupancy, 4)
                             if occupancy is not None else None),
         'resume_pass_s': round(resume_elapsed, 4),
-        'stages': {k: {'total_s': round(v['total_s'], 3),
-                       'count': v['count']}
-                   for k, v in stages.items()},
+        # the FULL per-stage Tracer report (not just totals): bench.py
+        # embeds it under the record's stage_reports so a BENCH_*.json
+        # carries the wall-time split behind every rung
+        'stages': round_report(stages),
     }
 
 

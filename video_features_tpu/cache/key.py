@@ -53,8 +53,10 @@ CONFIG_KEY_EXCLUDE = frozenset({
     'coordinator_address', 'num_processes', 'process_id',
     'pack_across_videos', 'pack_decode_ahead', 'decode_workers',
     'compilation_cache_dir',
-    # observability / debug surfaces
+    # observability / debug surfaces (the flight recorder's obs/ knobs
+    # record telemetry; they cannot change the extracted bytes)
     'profile', 'profile_dir', 'show_pred',
+    'trace_out', 'trace_capacity', 'manifest_out',
     # the cache's own namespace must not fragment its key space
     'cache_enabled', 'cache_dir', 'cache_max_bytes',
     # covered by the weights fingerprint

@@ -38,7 +38,6 @@ from __future__ import annotations
 import json
 import os
 import shutil
-import sys
 import threading
 import time
 from hashlib import sha256
@@ -446,8 +445,12 @@ def merge_cache_stats(stats: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
 
 def log_cache_error(what: str) -> None:
     """Cache failures degrade to misses, never to failed extractions —
-    but silently eating them would hide a broken cache dir forever."""
-    import traceback
-    print(f'WARNING: feature cache {what} failed (continuing uncached):',
-          file=sys.stderr)
-    traceback.print_exc()
+    but silently eating them would hide a broken cache dir forever.
+    Reported through the structured event log (obs/events: warning
+    level, stderr, full traceback) like every other degraded path."""
+    import logging
+
+    from video_features_tpu.obs.events import event
+    event(logging.WARNING,
+          f'feature cache {what} failed (continuing uncached)',
+          subsystem='cache', exc_info=True)

@@ -69,23 +69,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f'The number of specified videos: {len(video_paths)}')
 
     # profile=true prints per-stage timing tables after each video;
-    # profile_dir=<path> additionally captures a jax/XLA device trace.
+    # profile_dir=<path> additionally captures a jax/XLA device trace;
+    # trace_out=<path> records the host-side span timeline (Perfetto) and
+    # manifest_out=<path> the per-run JSON manifest — both published by
+    # finish_obs below even when a video failed (docs/observability.md).
     from video_features_tpu.utils.tracing import jax_profiler_trace
-    with jax_profiler_trace(args.get('profile_dir')):
-        if args.get('pack_across_videos'):
-            # corpus mode: batch-major over the whole (per-host) worklist —
-            # every device batch fills across video boundaries, outputs and
-            # resume behavior are identical to the per-video loop
-            # (parallel/packing.py)
-            print(f'Packing device batches across {len(video_paths)} videos')
-            ahead = args.get('pack_decode_ahead')
-            extractor.extract_packed(
-                video_paths,
-                decode_ahead=2 if ahead is None else int(ahead))
-        else:
-            for i, video_path in enumerate(video_paths):
-                print(f'[{i + 1}/{len(video_paths)}] {video_path}')
-                extractor._extract(video_path)
+    try:
+        with jax_profiler_trace(args.get('profile_dir')):
+            if args.get('pack_across_videos'):
+                # corpus mode: batch-major over the whole (per-host)
+                # worklist — every device batch fills across video
+                # boundaries, outputs and resume behavior are identical to
+                # the per-video loop (parallel/packing.py)
+                print(f'Packing device batches across {len(video_paths)} '
+                      'videos')
+                ahead = args.get('pack_decode_ahead')
+                extractor.extract_packed(
+                    video_paths,
+                    decode_ahead=2 if ahead is None else int(ahead))
+            else:
+                for i, video_path in enumerate(video_paths):
+                    print(f'[{i + 1}/{len(video_paths)}] {video_path}')
+                    extractor._extract(video_path)
+    finally:
+        extractor.finish_obs()
 
     if multihost:
         # process 0 hosts the coordinator service: hold every process at a

@@ -37,6 +37,24 @@ CACHE_DEFAULTS: Dict[str, Any] = {
     'cache_max_bytes': None,
 }
 
+# -- flight recorder (obs/; docs/observability.md) ---------------------------
+# Same injection policy as CACHE_DEFAULTS: one source of truth, older
+# user YAMLs pick the knobs up automatically, CLI dotlist wins.
+OBS_DEFAULTS: Dict[str, Any] = {
+    # Chrome trace-event JSON export of the run's span timeline (open in
+    # Perfetto / chrome://tracing; validate with tools/trace_view.py).
+    # Works on all three paths: one-shot CLI, packed worklists, serve
+    # (base override; each worker exports on drain). null = off.
+    'trace_out': None,
+    # span ring-buffer bound (events): the recorder keeps the most
+    # recent window and stamps how many older events were dropped
+    'trace_capacity': 200_000,
+    # per-run JSON manifest: merged config + config/weights fingerprints,
+    # per-video outcomes, aggregate stage table, XLA compile time, and
+    # per-executable-identity cost analysis. null = off.
+    'manifest_out': None,
+}
+
 
 class Config(dict):
     """A flat dict with attribute access — the shape every extractor consumes.
@@ -121,6 +139,8 @@ def load_config(
             f'Known: {", ".join(KNOWN_FEATURE_TYPES)}')
     args = load_yaml(cfg_path)
     for key, value in CACHE_DEFAULTS.items():
+        args.setdefault(key, value)
+    for key, value in OBS_DEFAULTS.items():
         args.setdefault(key, value)
     args.update(overrides)
     if run_sanity_check:
@@ -207,6 +227,17 @@ def sanity_check(args: Config) -> None:
             warnings.warn('cache_enabled has no effect with '
                           'on_extraction=print — disabling the cache')
             args['cache_enabled'] = False
+
+    # flight-recorder knobs (obs/): paths coerce to str; the ring-buffer
+    # bound must be a positive int or the recorder silently records nothing
+    for key in ('trace_out', 'manifest_out'):
+        if args.get(key) is not None:
+            args[key] = str(args[key])
+    if args.get('trace_capacity') is not None:
+        args['trace_capacity'] = int(args['trace_capacity'])
+        if args['trace_capacity'] < 1:
+            raise ValueError('trace_capacity must be >= 1; got '
+                             f'{args["trace_capacity"]}')
 
     assert args.get('file_with_video_paths') or args.get('video_paths'), \
         '`video_paths` or `file_with_video_paths` must be specified'

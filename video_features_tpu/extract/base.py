@@ -22,7 +22,7 @@ present — upstream behavior for everyone else.
 from __future__ import annotations
 
 import os
-import traceback
+import time as _time
 import warnings
 from pathlib import Path
 from typing import Dict, List, Union
@@ -36,13 +36,15 @@ from video_features_tpu.utils.output import (
 from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
 
 
-def log_extraction_error(video_path) -> None:
+def log_extraction_error(video_path, request_id=None, stage=None) -> None:
     """The one per-video failure report (fault-isolation contract): every
-    loop — per-video, cross-video windower, packed finalize — prints the
-    same shape, so operators and log scrapers see one format."""
-    print(f'An error occurred during extraction from: {video_path}:')
-    traceback.print_exc()
-    print('Continuing...')
+    loop — per-video, cross-video windower, packed finalize, serve worker
+    — emits the same shape through the structured event log (obs/events:
+    warning level, stderr, video path + full traceback), so operators and
+    log scrapers see one format and ``on_extraction: print`` stdout stays
+    byte-clean."""
+    from video_features_tpu.obs.events import log_extraction_error as _log
+    _log(video_path, request_id=request_id, stage=stage)
 
 
 class BaseExtractor:
@@ -71,6 +73,9 @@ class BaseExtractor:
         self.device = device
         self.concat_rgb_flow = concat_rgb_flow
         self.precision = precision
+        # profile controls the PRINTED stage tables; the tracer may also
+        # be enabled (tables off) by configure_obs for trace/manifest runs
+        self.profile = profile
         self.tracer = Tracer(enabled=True) if profile else NULL_TRACER
         self._mesh = None  # set by _ensure_mesh for data_parallel extractors
         # content-addressed feature cache + run identity — attached by
@@ -78,6 +83,12 @@ class BaseExtractor:
         # full merged config); None = legacy behavior everywhere
         self.cache = None
         self.run_fingerprint = None
+        # flight recorder (obs/) — attached by configure_obs when the
+        # trace_out / manifest_out knobs are set; None = no telemetry
+        # artifacts, exactly today's behavior
+        self.trace_out = None
+        self.manifest = None
+        self.manifest_out = None
 
     def precision_scope(self):
         """Matmul-precision context for the device loop. ``highest`` (the
@@ -156,6 +167,74 @@ class BaseExtractor:
                 log_cache_error(f'open ({args.get("cache_dir")})')
                 self.cache = None
 
+    # -- flight recorder (obs/) ---------------------------------------------
+
+    def configure_obs(self, args) -> None:
+        """Attach the flight recorder when the ``trace_out`` /
+        ``manifest_out`` knobs are set: a span recorder on the tracer
+        (enabling timing if profiling is off — the printed tables stay
+        gated on ``profile``) and a per-run manifest collector. Called by
+        ``registry.create_extractor``; extractors constructed directly
+        stay legacy."""
+        trace_out = args.get('trace_out')
+        manifest_out = args.get('manifest_out')
+        if not (trace_out or manifest_out):
+            return
+        if not self.tracer.enabled:
+            self.tracer = Tracer(enabled=True)
+        if trace_out:
+            from video_features_tpu.obs.spans import (
+                DEFAULT_CAPACITY, SpanRecorder,
+            )
+            self.trace_out = str(trace_out)
+            self.tracer.recorder = SpanRecorder(
+                int(args.get('trace_capacity') or DEFAULT_CAPACITY))
+        if manifest_out:
+            from video_features_tpu.obs.manifest import RunManifest
+            self.manifest_out = str(manifest_out)
+            self.manifest = RunManifest(args)
+
+    def finish_obs(self, export_trace: bool = True) -> None:
+        """Publish the run's telemetry artifacts (CLI end-of-run; serve
+        worker drain). ``export_trace=False`` skips the trace export for
+        callers that own a merged export of the same path (the serve
+        daemon's server-wide ``trace_out``). Never raises — a failed
+        telemetry write must not fail a run whose outputs are already
+        durably saved."""
+        import logging as _logging
+
+        from video_features_tpu.obs.events import event
+        if self.manifest is not None and self.manifest_out:
+            try:
+                # residual stages (the loops fold+reset as they go; this
+                # catches anything recorded since the last reset)
+                self.manifest.fold_stages(self.tracer.report())
+                self.manifest.write(self.manifest_out)
+            except Exception:
+                event(_logging.WARNING, 'run-manifest write failed',
+                      exc_info=True, path=self.manifest_out)
+        rec = getattr(self.tracer, 'recorder', None)
+        if export_trace and rec is not None and self.trace_out:
+            try:
+                rec.export(self.trace_out)
+            except Exception:
+                event(_logging.WARNING, 'trace export failed',
+                      exc_info=True, path=self.trace_out)
+
+    def executable_cost(self, batch):
+        """Best-effort XLA ``cost_analysis`` (FLOPs / bytes accessed) of
+        the compiled step at ``batch``'s geometry — the run-manifest
+        ``executables`` section. Works for families that follow the
+        ``self._step = jax.jit(...)``, ``self._step(self.params, batch)``
+        convention; returns None anywhere the convention doesn't hold.
+        An optimization report, never a requirement."""
+        step = getattr(self, '_step', None)
+        params = getattr(self, 'params', None)
+        if step is None or params is None or not hasattr(step, 'lower'):
+            return None
+        from video_features_tpu.obs.manifest import xla_cost_analysis
+        return xla_cost_analysis(step, params, batch)
+
     def _video_cache_key(self, video_path: str) -> str:
         from video_features_tpu.cache import video_cache_key
         return video_cache_key(video_path, self.run_fingerprint)
@@ -205,32 +284,53 @@ class BaseExtractor:
 
     def _extract(self, video_path: str) -> None:
         """Fault-isolating wrapper around :meth:`extract` for the work loop."""
+        recorder = getattr(self.tracer, 'recorder', None)
+        t0_video = _time.perf_counter() if recorder is not None else 0.0
+        outcome = 'failed'
         try:
             if self.is_already_exist(video_path):
+                outcome = 'skipped'
                 return
             if self.cache is not None:
-                with self.tracer.stage('cache_lookup'):
+                with self.tracer.stage('cache_lookup',
+                                       video=str(video_path)):
                     hit = self.cache_fetch(video_path)
                 if hit:
+                    outcome = 'cached'
                     return
             feats_dict = self.extract(video_path)
             feats_dict = self._maybe_concat_streams(feats_dict)
-            with self.tracer.stage('save'):
+            with self.tracer.stage('save', video=str(video_path)):
                 self.action_on_extraction(feats_dict, video_path)
             if self.cache is not None:
-                with self.tracer.stage('cache_publish'):
+                with self.tracer.stage('cache_publish',
+                                       video=str(video_path)):
                     self.cache_publish(video_path)
+            outcome = ('saved' if self.on_extraction in ACTION_TO_EXT
+                       else 'printed')
         except KeyboardInterrupt:
             raise
         except Exception:
+            outcome = 'failed'
             log_extraction_error(video_path)
         finally:
             # report+reset even on failure so one bad video's timings never
-            # leak into the next video's table
-            if self.tracer.enabled and self.tracer.report():
-                print(f'--- stage timing: {video_path}')
-                print(self.tracer.summary())
-                self.tracer.reset()
+            # leak into the next video's table; the run manifest keeps the
+            # whole-run aggregate by folding each video's report first
+            if self.tracer.enabled:
+                rep = self.tracer.report()
+                if rep:
+                    if self.manifest is not None:
+                        self.manifest.fold_stages(rep)
+                    if self.profile:
+                        print(f'--- stage timing: {video_path}')
+                        print(self.tracer.summary())
+                    self.tracer.reset()
+            if self.manifest is not None:
+                self.manifest.video_done(video_path, outcome)
+            if recorder is not None:
+                recorder.span('video', t0_video, _time.perf_counter(),
+                              video=str(video_path), outcome=outcome)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         raise NotImplementedError

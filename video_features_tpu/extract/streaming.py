@@ -144,7 +144,12 @@ def stream_windows_across_videos(tasks: Iterable,
             raise
         except Exception:
             task.failed = True
-            log_extraction_error(task.path)
+            # structured fault report: the serve request id (None for CLI
+            # tasks) and the stage that died ride on the log record
+            log_extraction_error(
+                task.path, stage='decode',
+                request_id=getattr(getattr(task, 'request', None), 'id',
+                                   None))
         finally:
             task.exhausted = True
         if task.emitted == 0:

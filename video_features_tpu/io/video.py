@@ -106,7 +106,13 @@ class Cv2FrameDecoder:
             self.cap.release()
             self.cap = cv2.VideoCapture(self.path)
         else:
-            print('Detect missing frame')
+            # structured channel, not print: decode chatter must never
+            # interleave with the on_extraction=print feature stream
+            from video_features_tpu.obs.events import event
+            event(logging.WARNING,
+                  'first frame failed to decode (cv2 missing-frame '
+                  'quirk); continuing from the next readable frame',
+                  video=self.path)
         idx = 0
         while True:
             ok, bgr = self.cap.read()
@@ -211,9 +217,10 @@ class VideoLoader:
             try:
                 reencoded = reencode_fps_native(path, str(tmp_path), fps)
             except (RuntimeError, OSError) as e:
-                logging.warning(
-                    'native fps re-encode failed (%s); falling back to '
-                    'index resampling for %s', e, path)
+                from video_features_tpu.obs.events import event
+                event(logging.WARNING,
+                      f'native fps re-encode failed ({e}); falling back '
+                      'to index resampling', video=str(path))
         if fps is None:
             self.path = path
             self.fps = src_fps

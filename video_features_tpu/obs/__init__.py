@@ -1,0 +1,41 @@
+"""Flight recorder: one coherent telemetry layer for all three paths.
+
+The toolkit grew three execution paths (one-shot CLI, cross-video
+packing, the warm-pool serve daemon) plus a content cache, and each grew
+its own telemetry: ``utils/tracing.py`` aggregates stage wall-clock,
+``serve/metrics.py`` hand-rolled a JSON dict, and failures went through
+raw ``print``s — the reference's bare ``except``+print is exactly what
+silently ate the ``KeyError: 'rgb'`` that broke seven of eight
+extractors in the fork. This package unifies everything behind three
+exports:
+
+  * **Span timeline** (``obs.spans``): per-video / per-request span
+    events, recorded by a low-overhead bounded ring buffer that the
+    production :class:`utils.tracing.Tracer` feeds (the stage table is a
+    view over the same events), exported as Chrome trace-event JSON
+    viewable in Perfetto via the ``trace_out`` knob — all three paths.
+  * **Metrics registry** (``obs.metrics``): counters / gauges /
+    histograms with Prometheus text exposition; ``serve/metrics.py``'s
+    ad-hoc dict is now a view over one registry, and the CLI writes a
+    per-run JSON **run manifest** (``obs.manifest``) carrying config +
+    weights fingerprints, the per-stage table, per-video outcomes,
+    compile time, and XLA cost analysis per executable identity.
+  * **Structured event log** (``obs.events``): a ``logging``-based
+    error/warn channel (video path, request id, full traceback) that
+    replaces the swallowed-error prints while keeping
+    ``on_extraction: print`` stdout byte-clean — the feature stream owns
+    stdout; telemetry owns stderr.
+
+See ``docs/observability.md`` for the operator workflow.
+"""
+from video_features_tpu.obs.events import event, get_logger, log_extraction_error
+from video_features_tpu.obs.metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
+)
+from video_features_tpu.obs.spans import NULL_RECORDER, SpanRecorder
+
+__all__ = [
+    'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'REGISTRY',
+    'NULL_RECORDER', 'SpanRecorder',
+    'event', 'get_logger', 'log_extraction_error',
+]

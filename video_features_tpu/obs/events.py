@@ -1,0 +1,123 @@
+"""Structured event log: the error/warn channel for every execution path.
+
+The reference toolkit reports per-video failures with a bare
+``print(traceback)`` — on STDOUT, interleaved with the feature stream
+when ``on_extraction: print``, and invisible to any log pipeline. That
+is exactly how the fork's ``KeyError: 'rgb'`` broke seven of eight
+extractors silently. This module replaces those prints with one
+``logging`` channel:
+
+  * everything goes to **stderr** (stdout belongs to the feature stream
+    — ``on_extraction: print`` stays byte-clean by construction);
+  * every record carries structured context — video path, request id,
+    stage — as ``key=value`` pairs in the message AND as attributes on
+    the ``LogRecord`` (``record.video`` etc.), so both humans and log
+    scrapers get the fields without regex archaeology;
+  * failures keep the full traceback (``exc_info``), not a one-line
+    summary of it.
+
+``get_logger()`` returns the package logger with a stderr handler
+attached exactly once; it propagates, so ``pytest``'s ``caplog`` and any
+root configuration the embedding application installs see the records
+too.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+from typing import Any, Optional
+
+LOGGER_NAME = 'video_features_tpu'
+
+_FORMAT = '%(asctime)s %(levelname)s %(name)s: %(message)s'
+
+_configured = False
+_configure_lock = threading.Lock()
+
+
+class _StderrHandler(logging.StreamHandler):
+    """A StreamHandler that resolves ``sys.stderr`` at EMIT time.
+
+    Binding the stream at construction would pin whatever object
+    ``sys.stderr`` was when the first event fired — under pytest's
+    capsys (or any stderr redirection) that object is replaced per
+    scope, and a pinned handler would write into a dead capture buffer
+    for the rest of the process."""
+
+    def __init__(self) -> None:
+        super().__init__(sys.stderr)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):                   # StreamHandler.__init__ sets it
+        pass
+
+
+def get_logger(subsystem: Optional[str] = None) -> logging.Logger:
+    """The package logger (optionally ``video_features_tpu.<subsystem>``)
+    with the stderr handler installed once, lazily."""
+    global _configured
+    root = logging.getLogger(LOGGER_NAME)
+    if not _configured:
+        # under the lock: two threads logging their first event
+        # concurrently must not each install a handler (every record
+        # would print twice for the rest of the process)
+        with _configure_lock:
+            if not _configured:
+                # one stderr handler on the package root; never stdout
+                # (the feature stream owns it). propagate stays True so
+                # caplog and application-level logging config still
+                # observe the records.
+                handler = _StderrHandler()
+                handler.setFormatter(logging.Formatter(_FORMAT))
+                root.addHandler(handler)
+                if root.level == logging.NOTSET:
+                    root.setLevel(logging.INFO)
+                _configured = True
+    return root if subsystem is None else \
+        logging.getLogger(f'{LOGGER_NAME}.{subsystem}')
+
+
+def event(level: int, msg: str, subsystem: Optional[str] = None,
+          exc_info: bool = False, **fields: Any) -> None:
+    """Log one structured event: ``msg`` plus ``key=value`` context.
+
+    ``fields`` append to the message in deterministic order and ride on
+    the record (``record.<key>``) for structured handlers; None-valued
+    fields are dropped so call sites can pass optional context
+    (``request_id=getattr(task, 'request', None)``) unconditionally.
+    """
+    fields = {k: v for k, v in fields.items() if v is not None}
+    if fields:
+        ctx = ' '.join(f'{k}={v}' for k, v in fields.items())
+        msg = f'{msg} [{ctx}]'
+    get_logger(subsystem).log(level, msg, exc_info=exc_info, extra=fields)
+
+
+def log_extraction_error(video_path, request_id: Optional[str] = None,
+                         stage: Optional[str] = None) -> None:
+    """The one per-video failure report (fault-isolation contract):
+    every loop — per-video, cross-video windower, packed finalize, serve
+    worker — emits the same shape, so operators and log scrapers see one
+    format. Warning level (the worklist continues), full traceback, on
+    stderr — never stdout, where ``on_extraction: print`` streams
+    features."""
+    event(logging.WARNING,
+          'extraction failed; continuing with the next video',
+          exc_info=True, video=str(video_path), request_id=request_id,
+          stage=stage)
+
+
+def log_batch_error(video_paths, valid: int, batch: int) -> None:
+    """Packed device-step failure: one batch's geometry failed to
+    compile/fit — exactly the videos it carries fail, the worklist
+    continues (parallel/packing.py fault isolation)."""
+    event(logging.WARNING,
+          'packed device step failed; failing only the videos in this '
+          'batch and continuing',
+          exc_info=True, videos=sorted(str(p) for p in video_paths),
+          valid=valid, batch=batch)

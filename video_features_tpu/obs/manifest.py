@@ -1,0 +1,213 @@
+"""Per-run JSON run manifest: what ran, on what, and what it cost.
+
+A benchmark number without its recipe is a rumor. The manifest is the
+CLI's durable run record (``manifest_out=<path>``): one JSON document
+carrying
+
+  * the merged **config** plus the config / weights / run
+    **fingerprints** (``cache/key.py`` — the same identities that key
+    the content-addressed cache and config-aware resume, so a manifest
+    provably names the recipe that produced a directory of features);
+  * the aggregate per-**stage** table (``Tracer.report`` folded across
+    every video with ``merge_reports`` — identical semantics to the
+    serve metrics fleet view);
+  * per-**video outcomes** (saved / skipped / cached / failed /
+    printed), the honest completion record a 20K-video run needs;
+  * **compile** wall time, captured from ``jax.monitoring``'s
+    backend-compile duration events (the real XLA compile cost, not a
+    first-call-minus-steady estimate);
+  * **executables**: per executable identity (feature family × input
+    geometry × dtype), the XLA ``cost_analysis`` FLOPs / bytes-accessed
+    of the compiled step where the extractor's step function supports
+    AOT lowering — the denominator for MFU math.
+
+Collection is push-based: the extraction loops call ``video_done`` /
+``fold_stages`` / ``note_executable`` as they go; ``write`` publishes
+atomically. Every collector degrades to a no-op on failure — telemetry
+must never fail a run.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Mapping, Optional
+
+from video_features_tpu.obs.spans import _jsonable
+from video_features_tpu.utils.tracing import merge_reports
+
+# jax.monitoring event keys that measure XLA compilation; matched by
+# substring so minor renames across jax versions degrade to "unattributed"
+# rather than KeyError
+_COMPILE_EVENT_MARKERS = ('compile',)
+
+_listener_lock = threading.Lock()
+_listener_installed = False
+_compile_events: Dict[str, Dict[str, float]] = {}
+
+
+def _on_event_duration(name: str, secs: float, **kwargs) -> None:
+    if not any(m in name for m in _COMPILE_EVENT_MARKERS):
+        return
+    with _listener_lock:
+        rec = _compile_events.setdefault(name, {'count': 0, 'total_s': 0.0})
+        rec['count'] += 1
+        rec['total_s'] += float(secs)
+
+
+def _install_compile_listener() -> None:
+    """Register the jax.monitoring duration listener once per process.
+    Listeners cannot be unregistered individually, so the manifest reads
+    deltas against the snapshot taken at its construction."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        _listener_installed = True
+    try:
+        import jax.monitoring
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+    except Exception:
+        # telemetry never fails the run — the manifest simply carries an
+        # empty compile section on runtimes without jax.monitoring
+        pass
+
+
+def _compile_snapshot() -> Dict[str, Dict[str, float]]:
+    with _listener_lock:
+        return {k: dict(v) for k, v in _compile_events.items()}
+
+
+def xla_cost_analysis(jitted, *args, **kwargs) -> Optional[Dict[str, float]]:
+    """Best-effort FLOPs / bytes-accessed for one compiled executable.
+
+    AOT-lowers ``jitted`` at the given abstract shapes and reads the
+    compiled module's ``cost_analysis()``. With the persistent
+    compilation cache on (``enable_compilation_cache``) the second
+    compile is a cache read, not a recompile. Returns None when the
+    backend/step doesn't support it — cost analysis is an optimization
+    report, never a requirement."""
+    try:
+        import jax
+        shaped = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, 'shape') else x, (args, kwargs))
+        cost = jitted.lower(*shaped[0], **shaped[1]).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if not cost:
+            return None
+        out = {}
+        for key in ('flops', 'bytes accessed'):
+            if key in cost:
+                out[key.replace(' ', '_')] = float(cost[key])
+        return out or None
+    except Exception:
+        return None
+
+
+class RunManifest:
+    """Accumulates one run's outcomes/stages/costs; writes atomic JSON."""
+
+    def __init__(self, args: Mapping[str, Any]) -> None:
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        self._t0_perf = time.perf_counter()
+        self.config: Dict[str, Any] = {k: _jsonable(v)
+                                       for k, v in dict(args).items()}
+        self.fingerprints = self._fingerprints(args)
+        self.videos: Dict[str, Dict[str, Any]] = {}
+        self.stages: Dict[str, Dict[str, float]] = {}
+        self.executables: Dict[str, Dict[str, Any]] = {}
+        self._compile0 = _compile_snapshot()
+        _install_compile_listener()
+
+    @staticmethod
+    def _fingerprints(args: Mapping[str, Any]) -> Dict[str, Optional[str]]:
+        """The same identities the cache and config-aware resume key on;
+        each is best-effort (e.g. an unreadable checkpoint path must not
+        fail the manifest — the build itself reports that error)."""
+        out: Dict[str, Optional[str]] = {
+            'config': None, 'weights': None, 'run': None}
+        from video_features_tpu.cache.key import (
+            config_fingerprint, run_fingerprint, weights_fingerprint,
+        )
+        for name, fn in (('config', config_fingerprint),
+                         ('weights', weights_fingerprint),
+                         ('run', run_fingerprint)):
+            try:
+                out[name] = fn(args)
+            except Exception:
+                pass
+        return out
+
+    # -- collectors (called from the extraction loops) -----------------------
+
+    def video_done(self, video_path: str, outcome: str) -> None:
+        """Record one video's terminal state (saved / skipped / cached /
+        failed / printed / expired)."""
+        with self._lock:
+            self.videos[str(video_path)] = {'outcome': outcome}
+
+    def fold_stages(self, report: Dict[str, Dict[str, float]]) -> None:
+        """Merge one ``Tracer.report()`` into the run-wide stage table
+        (the per-video loop resets its tracer per video; the manifest
+        keeps the whole-run aggregate)."""
+        if not report:
+            return
+        with self._lock:
+            self.stages = merge_reports([self.stages, report])
+
+    def note_executable(self, identity: str,
+                        info: Dict[str, Any]) -> None:
+        """Attach cost/compile info for one executable identity (feature
+        family × batch geometry × dtype). Later notes for the same
+        identity merge over earlier ones."""
+        with self._lock:
+            self.executables.setdefault(identity, {}).update(
+                {k: _jsonable(v) for k, v in info.items()})
+
+    # -- publication ---------------------------------------------------------
+
+    def document(self) -> Dict[str, Any]:
+        compile_now = _compile_snapshot()
+        compile_delta: Dict[str, Dict[str, float]] = {}
+        for name, rec in compile_now.items():
+            base = self._compile0.get(name, {'count': 0, 'total_s': 0.0})
+            d_count = rec['count'] - base['count']
+            if d_count > 0:
+                compile_delta[name] = {
+                    'count': int(d_count),
+                    'total_s': round(rec['total_s'] - base['total_s'], 6)}
+        with self._lock:
+            videos = {p: dict(v) for p, v in self.videos.items()}
+            stages = {k: dict(v) for k, v in self.stages.items()}
+            executables = {k: dict(v) for k, v in self.executables.items()}
+        outcomes: Dict[str, int] = {}
+        for v in videos.values():
+            outcomes[v['outcome']] = outcomes.get(v['outcome'], 0) + 1
+        from video_features_tpu import __version__
+        return {
+            'schema': 'video_features_tpu.run_manifest/1',
+            'version': __version__,
+            'started_at_unix_s': round(self._t0, 3),
+            'wall_s': round(time.perf_counter() - self._t0_perf, 3),
+            'config': self.config,
+            'fingerprints': self.fingerprints,
+            'videos': videos,
+            'outcomes': outcomes,
+            'stages': stages,
+            'compile': compile_delta,
+            'executables': executables,
+        }
+
+    def write(self, path: str) -> str:
+        import json
+        import os
+
+        from video_features_tpu.utils.output import atomic_write
+        doc = self.document()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        atomic_write(path, lambda f: f.write(
+            json.dumps(doc, sort_keys=True, indent=1).encode('utf-8')))
+        return path

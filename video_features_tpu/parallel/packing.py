@@ -40,16 +40,25 @@ hostage until the next request happens to share its geometry.
 """
 from __future__ import annotations
 
-import traceback
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
 
 # Stream sentinel: "no more input for now — flush partial pools". Yielded
 # by dynamic sources (the serve request feed) between arrival bursts;
 # passes through the windower/prefetch layers untouched and is consumed
 # by ``packed_batches``. Identity-compared everywhere (``is FLUSH``).
 FLUSH = object()
+
+def _request_id(task) -> Optional[str]:
+    """The originating request id of a serve task (None for CLI tasks) —
+    threaded onto span/instant events so a Perfetto timeline groups by
+    request as well as by video."""
+    req = getattr(task, 'request', None)
+    return getattr(req, 'id', None)
+
 
 # Stream marker: "a video exhausted without emitting any window" (resume
 # skip, zero-window clip, failed open). It must REACH the consumer — all
@@ -101,6 +110,7 @@ class VideoTask:
 
 def packed_batches(windows: Iterable[tuple], batch: int,
                    max_pool_age_s: Optional[float] = None,
+                   tracer: Tracer = NULL_TRACER,
                    ) -> Iterator[Tuple[np.ndarray, list, int]]:
     """Group a cross-video ``(task, window, meta)`` stream into full
     fixed-size batches: ``(stacks, provenance, valid)`` where provenance is
@@ -137,10 +147,21 @@ def packed_batches(windows: Iterable[tuple], batch: int,
         pools[key] = []
         ages.pop(key, None)
         valid = len(pool)
-        wins = [w for _, w, _ in pool]
-        while len(wins) < batch:
-            wins.append(wins[-1])
-        return np.stack(wins), [(t, m) for t, _, m in pool], valid
+        # the batch-assembly copy is the packer's own cost — timed as its
+        # own 'pack' stage; the span attrs (videos in the batch) are
+        # built ONLY when tracing is on, so the default hot loop stays
+        # allocation-free. getattr, not t.path: unit tests drive the
+        # packer with plain task tokens.
+        attrs = ({'videos': sorted({str(getattr(t, 'path', t))
+                                    for t, _, _ in pool}),
+                  'valid': valid, 'capacity': batch}
+                 if tracer.enabled else {})
+        with tracer.stage('pack', **attrs):
+            wins = [w for _, w, _ in pool]
+            while len(wins) < batch:
+                wins.append(wins[-1])
+            stacked = np.stack(wins)
+        return stacked, [(t, m) for t, _, m in pool], valid
 
     for item in windows:
         if item is FLUSH:
@@ -215,6 +236,11 @@ def run_packed(ex, video_paths: Iterable,
 
     ex._packed_setup()
     batch = int(batch_size or ex.packed_batch_size())
+    recorder = getattr(ex.tracer, 'recorder', None)
+    manifest = getattr(ex, 'manifest', None)
+    # executable identity → (shape, dtype) seen on the device loop;
+    # cost-analyzed after the run so telemetry never stalls a batch
+    costed: Dict[str, tuple] = {}
 
     # open_q doubles as the lazy task registry: the decode thread appends
     # each task as the source yields it (list.append is atomic; only the
@@ -232,6 +258,9 @@ def run_packed(ex, video_paths: Iterable,
             task.video_id = n_started[0]
             n_started[0] += 1
             open_q.append(task)
+            if recorder is not None:
+                recorder.instant('video_start', video=str(task.path),
+                                 request_id=_request_id(task))
             yield task
 
     def open_windows(task: VideoTask):
@@ -275,22 +304,36 @@ def run_packed(ex, video_paths: Iterable,
         try:
             if not (t.failed or t.skipped):
                 feats_dict = ex._maybe_concat_streams(ex.packed_result(t))
-                with ex.tracer.stage('save'):
+                with ex.tracer.stage('save', video=str(t.path),
+                                     request_id=_request_id(t)):
                     if t.out_root is not None:
                         ex.action_on_extraction(feats_dict, t.path,
                                                 output_path=t.out_root)
                     else:
                         ex.action_on_extraction(feats_dict, t.path)
                 if getattr(ex, 'cache', None) is not None:
-                    with ex.tracer.stage('cache_publish'):
+                    with ex.tracer.stage('cache_publish',
+                                         video=str(t.path)):
                         ex.cache_publish(t.path, output_path=t.out_root)
         except KeyboardInterrupt:
             raise
         except Exception:
             t.failed = True           # a failed save IS a failed video
-            log_extraction_error(t.path)
+            log_extraction_error(t.path, request_id=_request_id(t),
+                                 stage='save')
         finally:
             t.rows = {}               # free feature memory as we go
+            from video_features_tpu.utils.output import ACTION_TO_EXT
+            outcome = ('failed' if t.failed else 'cached' if t.cached
+                       else 'skipped' if t.skipped
+                       else 'saved' if ex.on_extraction in ACTION_TO_EXT
+                       else 'printed')
+            if recorder is not None:
+                recorder.instant('video_done', video=str(t.path),
+                                 outcome=outcome,
+                                 request_id=_request_id(t))
+            if manifest is not None:
+                manifest.video_done(t.path, outcome)
             if on_video_done is not None:
                 on_video_done(t)
 
@@ -330,9 +373,19 @@ def run_packed(ex, video_paths: Iterable,
                 item = next(it)
             except StopIteration:
                 return
-            ex.tracer.add('queue_idle' if item is FLUSH
-                          else 'decode+preprocess',
-                          _time.perf_counter() - t0)
+            if item is FLUSH:
+                ex.tracer.add('queue_idle', _time.perf_counter() - t0,
+                              t0=t0)
+            elif item is NUDGE:
+                ex.tracer.add('decode+preprocess',
+                              _time.perf_counter() - t0, t0=t0)
+            else:
+                # span provenance: the video (and serve request) this
+                # decode slice worked for
+                ex.tracer.add('decode+preprocess',
+                              _time.perf_counter() - t0, t0=t0,
+                              video=str(item[0].path),
+                              request_id=_request_id(item[0]))
             yield item
 
     timed = timed_source() if ex.tracer.enabled else source
@@ -341,13 +394,19 @@ def run_packed(ex, video_paths: Iterable,
     with ex.precision_scope():
         # batch assembly + H2D of batch k+1 overlap the device running k
         for dev, _, prov, valid in transfer_batches(
-                packed_batches(ahead, batch, max_pool_age_s=max_pool_age_s),
+                packed_batches(ahead, batch, max_pool_age_s=max_pool_age_s,
+                               tracer=ex.tracer),
                 ex.put_input, tracer=ex.tracer):
             if dev is None:
                 sweep()           # NUDGE: zero-window videos finalize now
                 continue
+            # span provenance only when tracing is on (hot-loop hygiene);
+            # the error path below rebuilds the list lazily if needed
+            batch_videos = (sorted({str(t.path) for t, _ in prov})
+                            if ex.tracer.enabled else None)
             try:
-                with ex.tracer.stage('model'):
+                with ex.tracer.stage('model', videos=batch_videos,
+                                     valid=valid, capacity=batch):
                     out = ex.packed_step(dev)
             except KeyboardInterrupt:
                 raise
@@ -357,17 +416,29 @@ def run_packed(ex, video_paths: Iterable,
                 # (the per-video loop would likewise lose only them) and
                 # the worklist continues; their accounting still advances
                 # so the sweep never stalls
-                print('An error occurred in the packed device step '
-                      f'(batch of {valid} windows from '
-                      f'{sorted({t.path for t, _ in prov})}):')
-                traceback.print_exc()
-                print('Continuing...')
+                from video_features_tpu.obs.events import log_batch_error
+                log_batch_error(batch_videos if batch_videos is not None
+                                else sorted({str(t.path)
+                                             for t, _ in prov}),
+                                valid, batch)
                 for task, _ in prov:
                     task.failed = True
                     task.done += 1
                 sweep()
                 continue
             ex.tracer.add_occupancy('model', valid, batch)
+            if manifest is not None:
+                # record each executable identity's geometry (the unit
+                # XLA compiles per) — shape+dtype only; the expensive
+                # cost-analysis lowering runs AFTER the worklist, off
+                # the device loop's critical path
+                shape = getattr(dev, 'shape', None)
+                if shape is not None:
+                    identity = (f'{getattr(ex, "feature_type", "?")}:'
+                                f'{tuple(shape)}:{getattr(dev, "dtype", "")}')
+                    if identity not in costed:
+                        costed[identity] = (tuple(shape),
+                                            getattr(dev, 'dtype', None))
             for i, (task, meta) in enumerate(prov):
                 task.done += 1
                 if task.failed:       # already doomed: don't grow its rows
@@ -378,8 +449,26 @@ def run_packed(ex, video_paths: Iterable,
             sweep()
     sweep(final=True)
 
+    if manifest is not None and costed:
+        # deferred XLA cost analysis: lower the step at each recorded
+        # geometry (abstract shapes — no data needed) now that the
+        # worklist is done; with the persistent compilation cache on
+        # this is a cache read, and either way it is off the hot path
+        import jax
+        for identity, (shape, dtype) in costed.items():
+            info: Dict = {'batch': batch}
+            cost = ex.executable_cost(jax.ShapeDtypeStruct(shape, dtype)) \
+                if dtype is not None else None
+            if cost:
+                info.update(cost)
+            manifest.note_executable(identity, info)
+
     if ex.tracer.enabled and ex.tracer.report():
-        print(f'--- stage timing: packed worklist ({n_started[0]} videos, '
-              f'batch {batch})')
-        print(ex.tracer.summary())
+        if manifest is not None:
+            # fold BEFORE the reset: the manifest keeps the run aggregate
+            manifest.fold_stages(ex.tracer.report())
+        if getattr(ex, 'profile', True):
+            print(f'--- stage timing: packed worklist ({n_started[0]} '
+                  f'videos, batch {batch})')
+            print(ex.tracer.summary())
         ex.tracer.reset()

@@ -55,4 +55,6 @@ def create_extractor(args: 'Config') -> 'BaseExtractor':
         # run fingerprint (config-aware resume) + content-addressed
         # feature cache; duck-typed arg objects without .get stay legacy
         extractor.configure_cache(args)
+        # flight recorder (obs/): trace_out / manifest_out knobs
+        extractor.configure_obs(args)
     return extractor

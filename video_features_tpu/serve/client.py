@@ -93,6 +93,10 @@ class ServeClient:
     def metrics(self) -> Dict[str, Any]:
         return self._call({'cmd': 'metrics'})['metrics']
 
+    def metrics_prom(self) -> str:
+        """The same state as Prometheus text exposition format 0.0.4."""
+        return self._call({'cmd': 'metrics_prom'})['text']
+
     def drain(self) -> None:
         """Ask the server to drain (finish queued work, then exit)."""
         self._call({'cmd': 'drain'})

@@ -1,13 +1,23 @@
-"""Live metrics for the extraction service.
+"""Live metrics for the extraction service — a view over ONE registry.
 
-One JSON document (schema in ``docs/serving.md``) assembled on demand
-from sources that are each already thread-safe — the warm pool's
-counters, the admission gate's depth, per-request latency samples, and
-every pool entry's ``utils.tracing.Tracer`` report (stage latencies,
-batch occupancy, compile ramp). Exposed two ways: the ``metrics`` socket
-command, and — when ``serve_metrics_path`` is set — an atomically
-rewritten JSON file (``utils.output.atomic_write``: a scraper never
-reads a torn document).
+The metrics surface is the unified ``obs.metrics`` registry
+(PR 4: the flight recorder); this module is the serve-shaped projection
+of it. Two renderings of the same state:
+
+  * the JSON document (schema in ``docs/serving.md``) assembled on
+    demand from sources that are each already thread-safe — the warm
+    pool's counters, the admission gate's depth, per-request latency
+    samples, and every pool entry's ``utils.tracing.Tracer`` report
+    (stage latencies, batch occupancy, compile ramp);
+  * Prometheus text exposition (``prometheus_text``): the same values
+    as ``vft_*`` families — counters/histogram straight off the
+    registry, point-in-time document values mirrored into gauges — for
+    the ``metrics_prom`` socket command and the ``<path>.prom`` file
+    mirror.
+
+Both are exposed on the socket and — when ``serve_metrics_path`` is set
+— as atomically rewritten files (``utils.output.atomic_write``: a
+scraper never reads a torn document).
 """
 from __future__ import annotations
 
@@ -18,39 +28,57 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from video_features_tpu.obs.metrics import MetricsRegistry
 from video_features_tpu.utils.tracing import merge_reports
 
 # bounded latency window: p50/p99 over the most recent completions, not
 # an unbounded all-time list (a week-long server would otherwise grow
-# without bound and average away regressions)
+# without bound and average away regressions). The Prometheus histogram
+# alongside is cumulative-since-start by design — rate() windows it.
 LATENCY_WINDOW = 1024
+
+# counter key → (Prometheus family, labels): request-level outcomes and
+# video-level outcomes are separate families
+_COUNTER_SERIES = {
+    'submitted': ('vft_serve_requests_total', {'outcome': 'submitted'}),
+    'completed': ('vft_serve_requests_total', {'outcome': 'completed'}),
+    'failed': ('vft_serve_requests_total', {'outcome': 'failed'}),
+    'rejected': ('vft_serve_requests_total', {'outcome': 'rejected'}),
+    'expired_videos': ('vft_serve_videos_total', {'outcome': 'expired'}),
+    'cached_videos': ('vft_serve_videos_total', {'outcome': 'cached'}),
+}
 
 
 class RequestStats:
-    """Thread-safe request counters + completion-latency window."""
+    """Thread-safe request counters + completion-latency window, backed
+    by an ``obs.metrics`` registry (one per server instance, so several
+    servers in one process never bleed counts into each other)."""
 
-    def __init__(self) -> None:
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry if registry is not None else MetricsRegistry()
         self._lock = threading.Lock()
-        self.counts = {'submitted': 0, 'completed': 0, 'failed': 0,
-                       'rejected': 0, 'expired_videos': 0,
-                       # videos answered from the content-addressed
-                       # feature cache (pre-admission or in-worker hits)
-                       'cached_videos': 0}
+        self._counters = {
+            key: self.registry.counter(
+                family, 'request/video outcomes by type', labels=labels)
+            for key, (family, labels) in _COUNTER_SERIES.items()}
+        self._latency_hist = self.registry.histogram(
+            'vft_serve_request_latency_seconds',
+            'request completion latency (admission to terminal state)')
         self._latencies: List[float] = []
 
     def bump(self, key: str, n: int = 1) -> None:
-        with self._lock:
-            self.counts[key] += n
+        self._counters[key].inc(n)
 
     def observe_latency(self, seconds: float) -> None:
+        self._latency_hist.observe(float(seconds))
         with self._lock:
             self._latencies.append(float(seconds))
             if len(self._latencies) > LATENCY_WINDOW:
                 del self._latencies[:-LATENCY_WINDOW]
 
     def snapshot(self) -> Dict[str, Any]:
+        counts = {key: int(c.value) for key, c in self._counters.items()}
         with self._lock:
-            counts = dict(self.counts)
             lat = list(self._latencies)
         out: Dict[str, Any] = {'requests': counts}
         if lat:
@@ -99,8 +127,54 @@ def build_metrics(started_at: float,
     return doc
 
 
-def write_metrics_file(path: Optional[str], doc: Dict[str, Any]) -> None:
-    """Atomically mirror the metrics document to ``path`` (no-op if unset).
+def prometheus_text(doc: Dict[str, Any],
+                    registry: MetricsRegistry) -> str:
+    """Render the metrics state as Prometheus text exposition 0.0.4.
+
+    Counters and the latency histogram come straight off ``registry``
+    (``RequestStats`` writes them); the document's point-in-time values
+    — queue depth, warm-pool and cache counters, the merged stage table
+    — mirror into gauges on the same registry first, so one ``render``
+    emits the whole surface."""
+    g = registry.gauge
+    g('vft_serve_uptime_seconds',
+      'seconds since server start').set(doc.get('uptime_s', 0.0))
+    q = doc.get('queue') or {}
+    g('vft_serve_queue_depth',
+      'videos queued or in flight').set(q.get('depth', 0))
+    g('vft_serve_queue_capacity',
+      'admission bound (serve_queue_depth)').set(q.get('capacity', 0))
+    g('vft_serve_draining',
+      '1 while draining, else 0').set(1 if q.get('draining') else 0)
+    for key, value in (doc.get('warm_pool') or {}).items():
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            g(f'vft_warm_pool_{key}',
+              'warm extractor pool accounting').set(value)
+    for key, value in (doc.get('cache') or {}).items():
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            g(f'vft_cache_{key}',
+              'content-addressed feature cache accounting').set(value)
+    for stage, rep in (doc.get('stages_merged') or {}).items():
+        # gauge family names deliberately avoid the _total suffix
+        # (reserved for counter semantics): these mirror a point-in-time
+        # document, and tracer resets mean they are not monotonic
+        labels = {'stage': stage}
+        g('vft_stage_seconds', 'merged stage wall time',
+          labels=labels).set(rep.get('total_s', 0.0))
+        g('vft_stage_calls', 'merged stage call count',
+          labels=labels).set(rep.get('count', 0))
+        if rep.get('occupancy') is not None:
+            g('vft_stage_occupancy',
+              'valid batch slots / all slots for the stage',
+              labels=labels).set(rep['occupancy'])
+    return registry.render()
+
+
+def write_metrics_file(path: Optional[str], doc: Dict[str, Any],
+                       prom_text: Optional[str] = None) -> None:
+    """Atomically mirror the metrics document to ``path`` (no-op if
+    unset) and — when given — the Prometheus rendering to
+    ``<path>.prom`` (node_exporter textfile-collector friendly).
     Failures are swallowed — metrics mirroring must never take down the
     serving loop."""
     if not path:
@@ -109,5 +183,8 @@ def write_metrics_file(path: Optional[str], doc: Dict[str, Any]) -> None:
     try:
         atomic_write(path, lambda f: f.write(
             json.dumps(doc, sort_keys=True).encode('utf-8')))
+        if prom_text is not None:
+            atomic_write(path + '.prom',
+                         lambda f: f.write(prom_text.encode('utf-8')))
     except OSError:
         pass

@@ -78,6 +78,16 @@ class WarmPool:
                         excess -= 1
         for victim in evicted:
             victim.close()
+        if evicted:
+            # structured lifecycle event: evictions explain warm-pool
+            # misses and freed-HBM timing when reading logs post-hoc
+            import logging
+
+            from video_features_tpu.obs.events import event
+            event(logging.INFO, 'warm pool evicted entries (LRU)',
+                  subsystem='serve',
+                  labels=[getattr(v, 'label', '?') for v in evicted],
+                  size=len(self._entries), capacity=self.capacity)
         return evicted
 
     def entries(self) -> List[Any]:

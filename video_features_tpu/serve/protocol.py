@@ -17,6 +17,8 @@ Commands (the ``cmd`` field):
     states (see ``serve.server.Request.snapshot``).
   * ``metrics`` — ``{cmd}`` → the live metrics document
     (``docs/serving.md`` schema).
+  * ``metrics_prom`` — ``{cmd}`` → ``{ok, text}``: the same state as
+    Prometheus text exposition format 0.0.4 (``docs/observability.md``).
   * ``drain``   — stop admitting, finish everything queued, shut down.
   * ``ping``    — liveness probe.
 """
@@ -25,7 +27,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict
 
-COMMANDS = ('submit', 'status', 'metrics', 'drain', 'ping')
+COMMANDS = ('submit', 'status', 'metrics', 'metrics_prom', 'drain', 'ping')
 
 # submit() fields copied verbatim into the request (everything else in the
 # message is rejected — catches client/server schema drift loudly)

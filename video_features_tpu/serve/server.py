@@ -69,6 +69,13 @@ REQUEST_HISTORY = 4096
 _KEY_EXCLUDE = frozenset({
     'video_paths', 'file_with_video_paths', 'output_path',
     'profile', 'profile_dir', 'timeout_s',
+    # flight-recorder knobs (obs/): telemetry settings must not
+    # fragment the executable key space — two requests differing only in
+    # trace_out would otherwise transplant + compile twice. Like
+    # profile, the FIRST builder's obs settings win for a shared entry;
+    # a server-wide timeline comes from the trace_out base override
+    # (merged across every worker at drain).
+    'trace_out', 'trace_capacity', 'manifest_out',
 })
 
 
@@ -224,9 +231,20 @@ class _Worker:
 
     def _run(self) -> None:
         try:
-            self.ex.extract_packed(self._feed(),
-                                   on_video_done=self._on_video_done,
-                                   max_pool_age_s=self.max_batch_wait_s)
+            try:
+                self.ex.extract_packed(self._feed(),
+                                       on_video_done=self._on_video_done,
+                                       max_pool_age_s=self.max_batch_wait_s)
+            finally:
+                # publish this entry's telemetry artifacts (trace_out /
+                # manifest_out) on drain/crash; no-op without the knobs,
+                # never raises. When the server owns a merged export of
+                # the same trace path, the per-worker export is skipped
+                # — a worker outliving the drain grace period must not
+                # clobber the merged trace with its single-recorder view
+                shared = self.server.base_overrides.get('trace_out')
+                self.ex.finish_obs(export_trace=(
+                    shared is None or str(shared) != self.ex.trace_out))
         except Exception:
             # scheduler-level crash (bugs, OOM — NOT per-video faults,
             # which run_packed isolates): fail everything outstanding so
@@ -266,7 +284,16 @@ class ExtractionServer:
         self.metrics_path = metrics_path
 
         self.pool = WarmPool(pool_size)
-        self.stats = metrics_mod.RequestStats()
+        # one registry per server instance (obs.metrics): counters + the
+        # latency histogram live here; prometheus_text mirrors the
+        # point-in-time document values into gauges on the same registry
+        from video_features_tpu.obs.metrics import MetricsRegistry
+        self.registry = MetricsRegistry()
+        self.stats = metrics_mod.RequestStats(self.registry)
+        # prometheus_text sets shared gauges then renders; concurrent
+        # scrape + mirror writes must not interleave two documents'
+        # values into one exposition
+        self._prom_lock = threading.Lock()
         self._started_at = time.monotonic()
         # one coarse lock serializes admission + request-state mutation;
         # the hot path (device batches) never takes it
@@ -289,6 +316,10 @@ class ExtractionServer:
         # entry's history — per-entry retention would grow (and bloat
         # every metrics document) linearly with lifetime eviction count
         self._retired_stages: Dict[str, Dict] = {}
+        # every worker's span recorder (when obs is configured), for the
+        # merged drain export; bounded like the ring buffers themselves
+        # so lifetime churn can't grow it without limit
+        self._trace_recorders: 'deque' = deque(maxlen=32)
         self._draining = False
         self._drained = threading.Event()
         self._sock: Optional[socket.socket] = None
@@ -363,7 +394,10 @@ class ExtractionServer:
                     self._sock.close()
                 except OSError:
                     pass
-            metrics_mod.write_metrics_file(self.metrics_path, self.metrics())
+            doc = self.metrics()
+            metrics_mod.write_metrics_file(self.metrics_path, doc,
+                                           prom_text=self._prometheus(doc))
+            self._export_merged_trace()
             self._drained.set()
 
         if wait:
@@ -375,6 +409,35 @@ class ExtractionServer:
     @property
     def drained(self) -> bool:
         return self._drained.is_set()
+
+    def _prometheus(self, doc: Dict[str, Any]) -> str:
+        """One atomic mirror-gauges-and-render pass (see _prom_lock)."""
+        with self._prom_lock:
+            return metrics_mod.prometheus_text(doc, self.registry)
+
+    def _export_merged_trace(self) -> None:
+        """Stitch EVERY worker's span recorder into one Chrome trace at
+        the server-wide ``trace_out`` base override (drain path, after
+        the workers joined — so this merged write lands after, and
+        supersedes, each worker's own single-recorder export to the
+        shared path). Per-request trace_out paths keep the per-worker
+        exports. Never raises."""
+        path = self.base_overrides.get('trace_out')
+        if not path:
+            return
+        with self._lock:
+            recorders = list(self._trace_recorders)
+        if not recorders:
+            return
+        try:
+            from video_features_tpu.obs.spans import export_merged
+            export_merged(recorders, str(path))
+        except Exception:
+            import logging
+
+            from video_features_tpu.obs.events import event
+            event(logging.WARNING, 'merged trace export failed',
+                  subsystem='serve', exc_info=True, path=str(path))
 
     # -- admission + dispatch ------------------------------------------------
 
@@ -411,6 +474,20 @@ class ExtractionServer:
         except Exception as e:
             self.stats.bump('rejected')
             return protocol.error(f'invalid request: {e}')
+        if args.get('manifest_out'):
+            # the run manifest is a PER-RUN artifact (outcomes of one
+            # bounded worklist); a resident worker has no run end, its
+            # video table would grow unboundedly, and concurrent workers
+            # would clobber one shared path — the serve surfaces for the
+            # same data are the metrics document and the merged trace
+            import logging
+
+            from video_features_tpu.obs.events import event
+            event(logging.WARNING,
+                  'manifest_out is a per-run CLI knob; ignored by the '
+                  'serve daemon (use metrics / metrics_prom / trace_out)',
+                  subsystem='serve', path=str(args['manifest_out']))
+            args['manifest_out'] = None
         key = pool_key(args)
 
         # -- content-addressed cache: answer hits BEFORE admission -------
@@ -488,8 +565,11 @@ class ExtractionServer:
                                          self.idle_flush_s,
                                          self.max_batch_wait_s)
                         worker.start()
+                        rec = getattr(extractor.tracer, 'recorder', None)
                         with self._lock:
                             self._builds += 1
+                            if rec is not None:
+                                self._trace_recorders.append(rec)
                             self._retired.extend(self.pool.put(key, worker))
 
             with self._lock:
@@ -637,8 +717,9 @@ class ExtractionServer:
             # building the metrics document takes the server lock and
             # snapshots every tracer — skip it entirely when no
             # mirror is configured
-            metrics_mod.write_metrics_file(self.metrics_path,
-                                           self.metrics())
+            doc = self.metrics()
+            metrics_mod.write_metrics_file(self.metrics_path, doc,
+                                           prom_text=self._prometheus(doc))
 
     def _finish_video(self, task, state: str) -> None:
         req = task.request
@@ -721,6 +802,9 @@ class ExtractionServer:
             return self.status(msg.get('request_id'))
         if cmd == 'metrics':
             return protocol.ok(metrics=self.metrics())
+        if cmd == 'metrics_prom':
+            # Prometheus text exposition 0.0.4 of the same state
+            return protocol.ok(text=self._prometheus(self.metrics()))
         if cmd == 'drain':
             self.drain(wait=False)
             return protocol.ok(draining=True)

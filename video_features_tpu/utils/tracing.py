@@ -74,19 +74,38 @@ class _StageStat:
 
 
 class Tracer:
-    """Thread-safe named-stage wall-time accumulator."""
+    """Thread-safe named-stage wall-time accumulator.
 
-    def __init__(self, enabled: bool = True) -> None:
+    With a ``recorder`` (``obs.spans.SpanRecorder``) attached, every
+    timed stage ALSO lands as a span event on the flight-recorder
+    timeline — the aggregate table and the Perfetto trace are two views
+    over the same instrumentation sites. ``attrs`` passed to
+    ``stage``/``add`` (video path, request id, batch occupancy) ride on
+    the span's ``args``; the aggregate ignores them.
+    """
+
+    def __init__(self, enabled: bool = True, recorder=None) -> None:
         self.enabled = enabled
+        self.recorder = recorder
         self._lock = threading.Lock()
         self._stats: Dict[str, _StageStat] = {}
         self._order: List[str] = []
 
     # -- recording -----------------------------------------------------------
 
-    def add(self, name: str, dt: float) -> None:
+    def add(self, name: str, dt: float, t0: Optional[float] = None,
+            **attrs) -> None:
+        """Record ``dt`` seconds under ``name``. ``t0`` (the stage's
+        ``time.perf_counter`` start, when the caller knows it) places the
+        span on the timeline; without it the span is back-dated from
+        now."""
         if not self.enabled:
             return
+        rec = self.recorder
+        if rec is not None and rec.enabled:
+            if t0 is None:
+                t0 = time.perf_counter() - dt
+            rec.span(name, t0, t0 + dt, **attrs)
         with self._lock:
             stat = self._stats.get(name)
             if stat is None:
@@ -110,8 +129,10 @@ class Tracer:
             stat.occ_capacity += int(capacity)
 
     @contextmanager
-    def stage(self, name: str):
-        """Time a block under ``name`` (no-op when disabled)."""
+    def stage(self, name: str, **attrs):
+        """Time a block under ``name`` (no-op when disabled). ``attrs``
+        annotate the span on an attached recorder (the aggregate table
+        ignores them)."""
         if not self.enabled:
             yield
             return
@@ -119,7 +140,7 @@ class Tracer:
         try:
             yield
         finally:
-            self.add(name, time.perf_counter() - t0)
+            self.add(name, time.perf_counter() - t0, t0=t0, **attrs)
 
     def wrap_iter(self, name: str, iterable: Iterable) -> Iterator:
         """Yield from ``iterable``, timing each ``next()`` under ``name``.
@@ -139,7 +160,7 @@ class Tracer:
             except StopIteration:
                 return
             finally:
-                self.add(name, time.perf_counter() - t0)
+                self.add(name, time.perf_counter() - t0, t0=t0)
             yield item
 
     # -- reporting -----------------------------------------------------------
@@ -237,6 +258,16 @@ def merge_reports(reports: Iterable[Dict[str, Dict[str, float]]]
         if m.get('occ_capacity'):
             m['occupancy'] = m['occ_valid'] / m['occ_capacity']
     return merged
+
+
+def round_report(report: Dict[str, Dict[str, float]],
+                 ndigits: int = 6) -> Dict[str, Dict[str, float]]:
+    """A ``Tracer.report()`` with floats rounded for compact JSON
+    embedding (bench ``stage_reports``, worklist records) — one
+    serializer so every embedded report rounds identically."""
+    return {name: {k: (round(v, ndigits) if isinstance(v, float) else v)
+                   for k, v in rec.items()}
+            for name, rec in report.items()}
 
 
 @contextmanager
