@@ -29,12 +29,17 @@ native flow resolution — the production steps from
 tools/family_precision_study.py) record every BASELINE config's measured
 rate in ``rungs`` at the same precision stamp.
 
-The corpus-scale pair: ``worklist_clips_per_sec`` runs the per-video
+The corpus-scale trio: ``worklist_clips_per_sec`` runs the per-video
 outer loop over a multi-video worklist (resume contract + prefetch live),
-and ``worklist_packed_clips_per_sec`` runs the SAME worklist batch-major
+``worklist_packed_clips_per_sec`` runs the SAME worklist batch-major
 (``pack_across_videos=true`` — device batches fill across video
-boundaries, parallel/packing.py) in the same session, with
-``worklist_packed_batch_occupancy`` recording how full the compiled step
+boundaries, parallel/packing.py) with the device loop pinned SYNCHRONOUS
+(``inflight=1``: D2H after every dispatch), and
+``worklist_async_clips_per_sec`` repeats it with the deferred-D2H async
+loop (``inflight=2``: batch k-1's readback + scatter + save overlap the
+device computing batch k) — the packed/async delta isolates the
+readback-overlap win, every rung records its ``inflight`` depth, and
+``worklist_packed_batch_occupancy`` records how full the compiled step
 actually ran.
 
 The serving rung (``serve_*``): the same worklist submitted as dynamic
@@ -487,6 +492,11 @@ def run() -> dict:
             # + decode overlap live — the corpus-scale number, VERDICT r4
             # task 5); BENCH_WORKLIST=0/1 overrides.
             wl_paths = None
+            # the family the worklist trio measures: i3d (the flagship)
+            # by default; CPU smoke lanes (contract tests, the CI
+            # bench-diff job) override to a cheap family so the rung
+            # KEYS stay exercised without paying RAFT-on-CPU minutes
+            wl_feature = os.environ.get('BENCH_WORKLIST_FEATURE', 'i3d')
             if os.environ.get('BENCH_WORKLIST',
                               '1' if on_accel else '0') == '1':
                 try:
@@ -495,8 +505,9 @@ def run() -> dict:
                     )
                     wl_paths = make_worklist(tmp_dir, 4 if on_accel else 2,
                                              10 if on_accel else 2)
-                    wrec = run_worklist('i3d', wl_paths, tmp_dir, tmp_dir,
-                                        platform, batch_size=min(batch, 8),
+                    wrec = run_worklist(wl_feature, wl_paths, tmp_dir,
+                                        tmp_dir, platform,
+                                        batch_size=min(batch, 8),
                                         stack=stack, precision=precision)
                     rungs[f'worklist_videos_per_min_{precision}'] = \
                         wrec['videos_per_min']
@@ -511,14 +522,20 @@ def run() -> dict:
                 # stops running padded tails per video — measured in the
                 # same session, with its own output root (the unpacked
                 # pass's files would otherwise make it an all-skip no-op).
+                # inflight=1 pins the SYNCHRONOUS device loop so the
+                # async rung below is a clean A/B over one knob.
                 if wl_paths is not None:
                     try:
                         wrec_packed = run_worklist(
-                            'i3d', wl_paths, os.path.join(tmp_dir, 'packed'),
+                            wl_feature, wl_paths,
+                            os.path.join(tmp_dir, 'packed'),
                             tmp_dir, platform, batch_size=min(batch, 8),
-                            stack=stack, precision=precision, packed=True)
+                            stack=stack, precision=precision, packed=True,
+                            inflight=1)
                         rungs[f'worklist_packed_clips_per_sec_{precision}'] \
                             = wrec_packed['clips_per_sec']
+                        rungs['worklist_packed_inflight'] = \
+                            wrec_packed['inflight']
                         stage_reports[f'worklist_packed_{precision}'] = \
                             wrec_packed['stages']
                         if wrec_packed.get('batch_occupancy') is not None:
@@ -526,6 +543,32 @@ def run() -> dict:
                                 wrec_packed['batch_occupancy']
                     except Exception as e:
                         rungs['worklist_packed_error'] = \
+                            f'{type(e).__name__}: {e}'
+                # The async device loop (inflight=2): packed_step only
+                # dispatches, D2H + scatter + save of batch k-1 overlap
+                # the device computing batch k (parallel/packing.py) —
+                # same worklist, own output root, byte-identical outputs
+                # (tests/test_packing.py pins parity); the delta vs the
+                # inflight=1 rung above is the deferred-readback win.
+                if wl_paths is not None:
+                    try:
+                        wrec_async = run_worklist(
+                            wl_feature, wl_paths,
+                            os.path.join(tmp_dir, 'async'),
+                            tmp_dir, platform, batch_size=min(batch, 8),
+                            stack=stack, precision=precision, packed=True,
+                            inflight=2)
+                        rungs[f'worklist_async_clips_per_sec_{precision}'] \
+                            = wrec_async['clips_per_sec']
+                        rungs['worklist_async_inflight'] = \
+                            wrec_async['inflight']
+                        stage_reports[f'worklist_async_{precision}'] = \
+                            wrec_async['stages']
+                        if wrec_async.get('batch_occupancy') is not None:
+                            rungs['worklist_async_batch_occupancy'] = \
+                                wrec_async['batch_occupancy']
+                    except Exception as e:
+                        rungs['worklist_async_error'] = \
                             f'{type(e).__name__}: {e}'
             # The serving rung (serve/): the same worklist content
             # submitted as dynamic per-video requests against the

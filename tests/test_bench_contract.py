@@ -61,6 +61,44 @@ def test_bench_mode_both_keeps_contract():
                                    for s in rep.values())
 
 
+def test_bench_worklist_async_rung_emits_keys():
+    """BENCH_WORKLIST=1 runs the corpus trio: the per-video loop, the
+    packed loop pinned synchronous (inflight=1), and the async
+    deferred-D2H loop (inflight=2). The record must carry all three
+    clips/sec rungs, the inflight metadata naming which device loop each
+    packed rung ran, and stage reports in which the async rung shows the
+    d2h stage split out of model."""
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_WORKLIST': '1', 'BENCH_SERVE': '0',
+                      'BENCH_CACHE': '0',
+                      # rung KEYS are family-independent; resnet keeps
+                      # the CPU smoke off the RAFT-on-CPU cost cliff
+                      'BENCH_WORKLIST_FEATURE': 'resnet'})
+    rungs = rec['rungs']
+    for err in ('worklist_error', 'worklist_packed_error',
+                'worklist_async_error'):
+        assert err not in rungs, rungs.get(err)
+    assert any(k.startswith('worklist_clips_per_sec') for k in rungs)
+    assert any(k.startswith('worklist_packed_clips_per_sec')
+               for k in rungs)
+    assert any(k.startswith('worklist_async_clips_per_sec') for k in rungs)
+    # rung metadata: which device loop produced each number
+    assert rungs['worklist_packed_inflight'] == 1
+    assert rungs['worklist_async_inflight'] == 2
+    # the async rung's stage report splits d2h out of model; the shares
+    # are distinct stages, not one laundered span
+    async_rep = next(v for k, v in rec['stage_reports'].items()
+                     if k.startswith('worklist_async'))
+    assert 'model' in async_rep and 'd2h' in async_rep
+    assert async_rep['d2h']['count'] == async_rep['model']['count']
+    # the synchronous rung records them too (inflight=1 still fetches
+    # through the same d2h sync point, just immediately)
+    packed_rep = next(v for k, v in rec['stage_reports'].items()
+                     if k.startswith('worklist_packed'))
+    assert 'd2h' in packed_rep
+
+
 def test_bench_serve_rung_emits_keys():
     """BENCH_SERVE=1 drives the warm-pool service rung (serve/): the
     record must carry the sustained + cold clips/sec, the latency

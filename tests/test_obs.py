@@ -330,9 +330,19 @@ def test_packed_cli_trace_out_covers_every_video(obs_worklist, tmp_path,
         assert any(path in e['args'].get('videos', [])
                    for e in by_name.get('model', []) if 'args' in e), \
             f'no device-step span for {path}'
+        # the deferred readback is its own stage with the same
+        # provenance/occupancy attrs — the timeline must show model
+        # (dispatch+compute) and d2h (readback) as DISTINCT spans
+        assert any(path in e['args'].get('videos', [])
+                   and e['args'].get('capacity')
+                   for e in by_name.get('d2h', []) if 'args' in e), \
+            f'no d2h span for {path}'
         assert any(e['args'].get('video') == path
                    for e in by_name.get('save', []) if 'args' in e), \
             f'no save span for {path}'
+    # no time lost or double-counted: every dispatched batch has exactly
+    # one model span and one d2h span
+    assert len(by_name.get('d2h', [])) == len(by_name.get('model', []))
     # the validator tool accepts the real artifact (tier-1 exercise)
     assert trace_view_main([str(trace), '--quiet']) == 0
     capsys.readouterr()
@@ -396,6 +406,7 @@ def test_serve_prometheus_endpoint_and_mirror(tmp_path):
                        'vft_serve_queue_capacity 64',
                        'vft_warm_pool_hit_rate',
                        'vft_cache_hits',
+                       'vft_inflight_batches 0',
                        'vft_serve_request_latency_seconds_count',
                        'vft_serve_uptime_seconds'):
             assert needle in text, f'{needle!r} missing from:\n{text}'
@@ -490,11 +501,47 @@ def test_bench_diff_latency_improvement_is_not_regression(tmp_path):
 TRACER_RECORD_KEYS = {'count', 'total_s', 'mean_s', 'max_s', 'first_s',
                       'ramp', 'occupancy', 'occ_valid', 'occ_capacity'}
 METRICS_DOC_KEYS = {'uptime_s', 'queue', 'warm_pool', 'cache', 'requests',
-                    'latency', 'stages', 'stages_merged'}
+                    'latency', 'stages', 'stages_merged',
+                    'inflight_batches'}
 TRACE_EVENT_KEYS = {'name', 'ph', 'ts', 'dur', 'pid', 'tid', 'args', 's'}
 MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
                  'config', 'fingerprints', 'videos', 'outcomes', 'stages',
                  'compile', 'executables'}
+
+
+CANONICAL_STAGES = {'decode', 'decode+preprocess', 'queue_idle', 'pack',
+                    'h2d', 'model', 'd2h', 'save', 'cache_lookup',
+                    'cache_publish'}
+
+
+def test_stage_vocabulary_contract():
+    """Pin the canonical stage names (utils.tracing.STAGES): dashboards
+    key vft_stage_* families and bench stage_reports on them — renaming
+    or dropping one (e.g. folding d2h back into model) must be an
+    intentional, test-visible event."""
+    from video_features_tpu.utils.tracing import STAGES
+    assert set(STAGES) == CANONICAL_STAGES
+    assert 'model' in STAGES and 'd2h' in STAGES    # split, not aliased
+
+
+def test_merge_reports_keeps_model_and_d2h_distinct():
+    """Fleet-wide merges (serve metrics, retired-worker history) must
+    keep the dispatch and readback stages separate — their shares sum to
+    the old all-in 'model' share, so folding them would re-launder
+    readback into compute."""
+    from video_features_tpu.utils.tracing import merge_reports
+    a = {'model': {'count': 2, 'total_s': 1.0, 'max_s': 0.6,
+                   'first_s': 0.6},
+         'd2h': {'count': 2, 'total_s': 0.5, 'max_s': 0.3, 'first_s': 0.3,
+                 'occ_valid': 6, 'occ_capacity': 8}}
+    b = {'model': {'count': 1, 'total_s': 0.4, 'max_s': 0.4,
+                   'first_s': 0.4},
+         'd2h': {'count': 1, 'total_s': 0.1, 'max_s': 0.1, 'first_s': 0.1,
+                 'occ_valid': 4, 'occ_capacity': 4}}
+    merged = merge_reports([a, b])
+    assert merged['model']['total_s'] == pytest.approx(1.4)
+    assert merged['d2h']['total_s'] == pytest.approx(0.6)
+    assert merged['d2h']['occupancy'] == pytest.approx(10 / 12)
 
 
 def test_schema_contract_key_sets(tmp_path):

@@ -311,6 +311,126 @@ def test_packed_zero_window_video(tmp_path, tmp_path_factory):
     assert short_feats.shape == (0, 1024)
 
 
+# -- async device loop (inflight > 1): parity + deferred fault isolation ----
+
+def _output_bytes(out_path):
+    return {f.name: f.read_bytes()
+            for f in sorted(Path(out_path).rglob('*.npy'))}
+
+
+def test_async_parity_resnet_and_r21d(mixed_worklist,
+                                      mixed_geometry_worklist, tmp_path):
+    """The deferred-D2H loop must be externally invisible: packed outputs
+    at inflight=2 (and deeper) are BYTE-identical to the synchronous
+    inflight=1 loop — framewise (resnet) and stack (r21d, mixed
+    geometry) families."""
+    sync = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 's1', tmp_path / 'ts1', inflight=1))
+    sync.extract_packed(mixed_worklist)
+    deep = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 's2', tmp_path / 'ts2', inflight=3))
+    deep.extract_packed(mixed_worklist)
+    a, b = _output_bytes(sync.output_path), _output_bytes(deep.output_path)
+    assert a and a == b
+
+    paths = mixed_geometry_worklist
+    sync = create_extractor(_r21d_args(paths, tmp_path / 'r1',
+                                       tmp_path / 'tr1', inflight=1))
+    sync.extract_packed(paths)
+    deep = create_extractor(_r21d_args(paths, tmp_path / 'r2',
+                                       tmp_path / 'tr2', inflight=2))
+    deep.extract_packed(paths)
+    a, b = _output_bytes(sync.output_path), _output_bytes(deep.output_path)
+    assert a and a == b
+
+
+def test_async_parity_i3d_and_s3d(tmp_path, tmp_path_factory):
+    """The stack families with geometry-cached executables (i3d rgb,
+    s3d): async packed outputs byte-identical to the synchronous loop."""
+    d = tmp_path_factory.mktemp('asyncvids')
+    paths = [_write_clip(d / 'a.mp4', 25, seed=21),
+             _write_clip(d / 'b.mp4', 18, seed=22)]
+
+    def run(feature_type, tag, inflight, **kw):
+        over = dict(video_paths=paths, device='cpu',
+                    allow_random_weights=True, on_extraction='save_numpy',
+                    output_path=str(tmp_path / tag),
+                    tmp_path=str(tmp_path / f'tmp_{tag}'),
+                    inflight=inflight)
+        over.update(kw)
+        ex = create_extractor(load_config(feature_type, overrides=over))
+        ex.extract_packed(paths)
+        return _output_bytes(ex.output_path)
+
+    i3d_kw = dict(streams='rgb', stack_size=10, step_size=10, batch_size=2,
+                  concat_rgb_flow=False)
+    assert run('i3d', 'i1', 1, **i3d_kw) == run('i3d', 'i2', 2, **i3d_kw)
+    s3d_kw = dict(stack_size=16, step_size=16, batch_size=2)
+    a = run('s3d', 's1', 1, **s3d_kw)
+    assert a and a == run('s3d', 's2', 2, **s3d_kw)
+
+
+def test_async_fault_isolation_at_sync_point(mixed_geometry_worklist,
+                                             tmp_path):
+    """An execution fault that only surfaces at the DEFERRED sync point
+    (fetch_outputs — where async backends raise) must doom exactly the
+    videos of the batch that produced it; batch-mates and neighbors
+    still save, identical to a clean run."""
+    paths = mixed_geometry_worklist
+    clean = create_extractor(_r21d_args(paths, tmp_path / 'clean',
+                                        tmp_path / 'tmpc', inflight=2))
+    clean.extract_packed(paths)
+
+    ex = create_extractor(_r21d_args(paths, tmp_path / 'sync',
+                                     tmp_path / 'tmps', inflight=2))
+    orig_step, orig_fetch = ex.packed_step, ex.fetch_outputs
+    # strong references + identity checks (never id(): a freed array's
+    # address can be recycled by a later innocent batch)
+    poisoned = []
+
+    def marking_step(stacks):
+        out = orig_step(stacks)
+        if stacks.shape[2] == 64:         # the odd 80x64 clip's geometry
+            poisoned.append(out[ex.feature_type])
+        return out
+
+    def bad_fetch(out):
+        if any(out[ex.feature_type] is p for p in poisoned):
+            raise RuntimeError('async execution fault surfaced at D2H')
+        return orig_fetch(out)
+
+    ex.packed_step, ex.fetch_outputs = marking_step, bad_fetch
+    ex.extract_packed(paths)              # must not raise
+    assert poisoned                       # the bad batch really dispatched
+
+    victim = paths[1]
+    assert not Path(make_path(ex.output_path, victim, 'r21d',
+                              '.npy')).exists()
+    for p in paths:
+        if p == victim:
+            continue
+        got = np.load(make_path(ex.output_path, p, 'r21d', '.npy'))
+        ref = np.load(make_path(clean.output_path, p, 'r21d', '.npy'))
+        np.testing.assert_array_equal(got, ref, err_msg=p)
+
+
+def test_async_stage_split_model_plus_d2h(mixed_worklist, tmp_path):
+    """The stage table shows model (dispatch) and d2h (deferred
+    readback) as distinct stages with one record each per batch, and
+    both carry the batch-occupancy accounting."""
+    ex = create_extractor(_resnet_args(
+        mixed_worklist, tmp_path / 'st', tmp_path / 'tmpst',
+        profile=True, inflight=2))
+    rep = {}
+    real_reset = ex.tracer.reset
+    ex.tracer.reset = lambda: rep.update(ex.tracer.report()) or real_reset()
+    ex.extract_packed(mixed_worklist)
+    ex.tracer.reset = real_reset
+    assert rep['model']['count'] == rep['d2h']['count'] == 7
+    assert rep['model']['occupancy'] == pytest.approx(27 / 28)
+    assert rep['d2h']['occupancy'] == pytest.approx(27 / 28)
+
+
 def test_sanity_check_gates_packing(tmp_path):
     """pack_across_videos degrades (with a warning) for families without
     packed support and for the per-video show_pred debug surface."""
@@ -324,6 +444,21 @@ def test_sanity_check_gates_packing(tmp_path):
         pack_across_videos=True, show_pred=True,
         output_path=str(tmp_path / 'o2'), tmp_path=str(tmp_path / 't2')))
     assert args['pack_across_videos'] is False
+
+
+def test_inflight_knob_default_and_validation(tmp_path):
+    """The async-depth knob is injected into every merged config
+    (default 2) and sanity_check rejects non-positive depths."""
+    clip = _write_clip(tmp_path / 'k.mp4', 4)
+    common = dict(video_paths=clip, device='cpu', model_name='resnet18',
+                  output_path=str(tmp_path / 'o'),
+                  tmp_path=str(tmp_path / 't'))
+    args = load_config('resnet', overrides=dict(common))
+    assert args['inflight'] == 2
+    args = load_config('resnet', overrides=dict(common, inflight='1'))
+    assert args['inflight'] == 1              # coerced to int
+    with pytest.raises(ValueError):
+        load_config('resnet', overrides=dict(common, inflight=0))
 
 
 def test_cli_routes_packed(tmp_path, tmp_path_factory, capsys):

@@ -94,7 +94,9 @@ def test_warm_pool_lru_hit_rate_and_graceful_eviction():
 def test_packed_batches_flush_sentinel():
     """FLUSH forces partial geometry pools out padded — the latency bound
     for a lone request during an arrival lull — and later windows of the
-    same geometry pool afresh."""
+    same geometry pool afresh. Every FLUSH is followed by the batchless
+    drain marker ``(None, [], 0)`` so the consumer also materializes its
+    in-flight output queue (async device loop) on idle."""
     from video_features_tpu.parallel.packing import FLUSH, packed_batches
 
     w = np.zeros((2, 2), np.float32)
@@ -107,9 +109,14 @@ def test_packed_batches_flush_sentinel():
         yield ('t3', w, None)
 
     out = list(packed_batches(stream(), batch=2))
-    assert [(v, [t for t, _ in prov]) for _, prov, v in out] == \
+    markers = [item for item in out if item[0] is None]
+    assert markers == [(None, [], 0)] * 2      # one drain marker per FLUSH
+    batches = [item for item in out if item[0] is not None]
+    assert [(v, [t for t, _ in prov]) for _, prov, v in batches] == \
         [(1, ['t1']), (2, ['t2', 't3'])]
-    assert all(stacks.shape == (2, 2, 2) for stacks, _, _ in out)
+    # the first FLUSH's flushed batch precedes its drain marker
+    assert out[0][0] is not None and out[1][0] is None
+    assert all(stacks.shape == (2, 2, 2) for stacks, _, _ in batches)
 
 
 def test_packed_batches_pool_age_bound():
@@ -299,6 +306,45 @@ def test_serve_lifecycle_warm_parity_fault_sigterm_resume(
                 .stat().st_mtime_ns == mtimes[p]
     finally:
         server2.drain(wait=True, grace_s=60)
+
+
+def test_serve_async_loop_parity_and_inflight_gauge(serve_clips, tmp_path):
+    """The warm workers inherit the async device loop: a server pinned
+    synchronous (inflight=1 base override) and one running the
+    deferred-D2H loop (inflight=2) produce BYTE-identical outputs for
+    the same request, and the metrics document carries the
+    vft_inflight_batches gauge (0 once idle — every dispatched batch
+    was materialized)."""
+    from video_features_tpu.serve.client import ServeClient
+
+    roots = {}
+    for depth in (1, 2):
+        server = _start_server(
+            tmp_path, base_overrides=dict(_base_overrides(tmp_path),
+                                          inflight=depth))
+        try:
+            client = ServeClient(port=server.port)
+            out_root = str(tmp_path / f'async{depth}')
+            rid = client.submit('resnet', serve_clips,
+                                overrides={'output_path': out_root})
+            st = client.wait(rid, timeout_s=180)
+            assert st['state'] == 'done', st
+            m = client.metrics()
+            assert m['inflight_batches'] == 0   # drained back to idle
+            prom = client.metrics_prom()
+            assert 'vft_inflight_batches 0' in prom
+        finally:
+            server.drain(wait=True, grace_s=60)
+        roots[depth] = os.path.join(out_root, 'resnet', 'resnet18')
+
+    compared = 0
+    for p in serve_clips:
+        for key in RESNET_KEYS:
+            a = Path(make_path(roots[1], p, key, '.npy'))
+            b = Path(make_path(roots[2], p, key, '.npy'))
+            assert a.read_bytes() == b.read_bytes(), (p, key)
+            compared += 1
+    assert compared == len(serve_clips) * len(RESNET_KEYS)
 
 
 def test_serve_admission_deadline_and_protocol_errors(

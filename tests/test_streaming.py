@@ -1,11 +1,50 @@
 """Unit tests for the shared streaming helpers: window batching semantics
-(tail padding, valid counts, window indices) and the producer-thread
-transfer pipeline (ordering, keep_host, passthrough put)."""
+(tail padding, valid counts, window indices), the producer-thread
+transfer pipeline (ordering, keep_host, passthrough put), and the
+deferred-D2H fetch window (overlap_fetch)."""
 import numpy as np
 
 from video_features_tpu.extract.streaming import (
-    iter_batched_windows, stream_windows, transfer_batches,
+    iter_batched_windows, overlap_fetch, stream_windows, transfer_batches,
 )
+
+
+def test_overlap_fetch_defers_by_depth_and_preserves_order():
+    """At depth k the oldest dispatch is fetched only once k items are in
+    flight; results come back in dispatch order with meta intact, and
+    the tail drains at stream end. depth=1 is strictly alternating
+    (synchronous)."""
+    events = []
+
+    def dispatched(n):
+        for i in range(n):
+            events.append(('dispatch', i))
+            yield f'dev{i}', i * 10
+
+    def fetch(dev):
+        i = int(dev[3:])
+        events.append(('fetch', i))
+        return f'host{i}'
+
+    out = list(overlap_fetch(dispatched(4), fetch, depth=2))
+    assert out == [(f'host{i}', i * 10) for i in range(4)]
+    # fetch(0) happens only after dispatch(1); fetch(3) after the stream
+    assert events.index(('fetch', 0)) > events.index(('dispatch', 1))
+    assert events[-1] == ('fetch', 3)
+
+    events.clear()
+    list(overlap_fetch(dispatched(3), fetch, depth=1))
+    assert events == [('dispatch', 0), ('fetch', 0), ('dispatch', 1),
+                      ('fetch', 1), ('dispatch', 2), ('fetch', 2)]
+
+
+def test_overlap_fetch_records_d2h_stage():
+    from video_features_tpu.utils.tracing import Tracer
+    t = Tracer(enabled=True)
+    out = list(overlap_fetch(((x,) for x in 'ab'), lambda x: x.upper(),
+                             depth=3, tracer=t))
+    assert out == [('A',), ('B',)]
+    assert t.report()['d2h']['count'] == 2
 
 
 def _windows(n, shape=(2, 3)):

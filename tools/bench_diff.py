@@ -30,11 +30,22 @@ LOWER_IS_BETTER_MARKERS = ('latency', 'resume_pass')
 
 
 def load_record(path: str) -> Dict[str, Any]:
-    """A bench record: either the raw dict or the one-JSON-line file the
-    driver contract produces."""
+    """A bench record in any of its shipped shapes: the one-JSON-line
+    file the driver contract produces, a raw (possibly pretty-printed)
+    record dict, or the driver's round wrapper (``BENCH_r{N}.json``:
+    ``{n, cmd, rc, tail, parsed}`` with the record under ``parsed``)."""
     with open(path) as f:
         text = f.read().strip()
-    rec = json.loads(text.splitlines()[0]) if text else {}
+    if not text:
+        rec: Any = {}
+    else:
+        try:
+            rec = json.loads(text)            # whole file (pretty or flat)
+        except json.JSONDecodeError:
+            rec = json.loads(text.splitlines()[0])   # one-line contract
+    if isinstance(rec, dict) and 'rungs' not in rec \
+            and isinstance(rec.get('parsed'), dict):
+        rec = rec['parsed']                   # driver round wrapper
     if not isinstance(rec, dict):
         raise ValueError(f'{path}: not a JSON object')
     return rec

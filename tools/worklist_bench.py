@@ -21,9 +21,12 @@ Usage:
 
 Prints one JSON record per mode on stdout — the per-video loop first,
 then the packed corpus pipeline (``pack_across_videos=true``: batch-major
-across videos, parallel/packing.py) with its batch-occupancy figure;
-bench.py embeds them as the ``worklist_clips_per_sec`` and
-``worklist_packed_clips_per_sec`` rungs.
+across videos, parallel/packing.py) twice: at ``inflight=1`` (the
+synchronous pre-async baseline) and ``inflight=2`` (the deferred-D2H
+async device loop), each with its batch-occupancy figure; bench.py
+embeds them as the ``worklist_clips_per_sec``,
+``worklist_packed_clips_per_sec``, and ``worklist_async_clips_per_sec``
+rungs. Every record carries the ``inflight`` depth it ran at.
 """
 from __future__ import annotations
 
@@ -62,16 +65,19 @@ def make_worklist(tmp_dir: str, n_videos: int, seconds: float) -> list:
 def run_worklist(feature_type: str, paths: list, out_dir: str,
                  tmp_dir: str, platform: str, batch_size: int = 8,
                  stack: int = 16, precision: str = None,
-                 packed: bool = False):
+                 packed: bool = False, inflight: int = None):
     """One timed pass of the real worklist loop; returns the record.
 
     ``packed=False`` times the per-video loop cli.py runs by default;
     ``packed=True`` times the batch-major corpus pipeline
     (``pack_across_videos=true`` → ``extract_packed``, parallel/packing.py)
-    and additionally reports the compiled step's batch occupancy. The
-    extractor is created once (matching cli.py) so compile caches, weights,
-    and the decode service amortize across the worklist the way they do in
-    production."""
+    and additionally reports the compiled step's batch occupancy.
+    ``inflight`` pins the output-side pipelining depth (1 = synchronous
+    D2H after every dispatch; default = the config's async depth) — the
+    resolved value rides in the record so every rung names the loop it
+    measured. The extractor is created once (matching cli.py) so compile
+    caches, weights, and the decode service amortize across the worklist
+    the way they do in production."""
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
     from video_features_tpu.utils.tracing import round_report
@@ -92,6 +98,8 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
     }
     if feature_type in ('i3d', 'r21d', 's3d'):
         overrides.update({'stack_size': stack, 'step_size': stack})
+    if inflight is not None:
+        overrides['inflight'] = int(inflight)
     args = load_config(feature_type, overrides=overrides)
     ex = create_extractor(args)
 
@@ -152,6 +160,10 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         'feature_type': feature_type,
         'precision': precision,
         'packed': packed,
+        # the output-side pipelining depth this rung actually ran at
+        # (1 = synchronous loop) — rung metadata, so a BENCH_*.json
+        # says which device loop produced its number
+        'inflight': int(args.get('inflight', 1)),
         'n_videos': len(paths),
         'videos_per_min': round(len(paths) / elapsed * 60, 3),
         'clips_total': int(clips),
@@ -197,15 +209,24 @@ def main() -> int:
         # families with packed support run it — an unsupported feature
         # must still emit its per-video record, not crash the tool
         from video_features_tpu.registry import PACKED_FEATURES
-        rec_packed = None
+        rec_packed = rec_async = None
         if feature_type in PACKED_FEATURES:
+            # inflight=1 pins the SYNCHRONOUS packed loop (D2H after
+            # every dispatch — the pre-async baseline)...
             rec_packed = run_worklist(feature_type, paths,
                                       os.path.join(td, 'packed'), td,
                                       platform, batch_size=batch,
-                                      stack=stack, packed=True)
+                                      stack=stack, packed=True, inflight=1)
+            # ...and the async record runs the same worklist with the
+            # deferred-D2H loop so the two are directly comparable
+            rec_async = run_worklist(feature_type, paths,
+                                     os.path.join(td, 'packed_async'), td,
+                                     platform, batch_size=batch,
+                                     stack=stack, packed=True, inflight=2)
     print(json.dumps(rec), file=stdout)
-    if rec_packed is not None:
-        print(json.dumps(rec_packed), file=stdout)
+    for extra in (rec_packed, rec_async):
+        if extra is not None:
+            print(json.dumps(extra), file=stdout)
     return 0
 
 

@@ -37,6 +37,19 @@ CACHE_DEFAULTS: Dict[str, Any] = {
     'cache_max_bytes': None,
 }
 
+# -- device-loop pipelining (parallel/packing.py; docs/benchmarks.md) --------
+# Same injection policy as CACHE_DEFAULTS: one source of truth, older
+# user YAMLs pick the knobs up automatically, CLI dotlist wins.
+PIPELINE_DEFAULTS: Dict[str, Any] = {
+    # in-flight device batches on the output side of the device loop:
+    # batch k-1's results are only materialized (D2H + row scatter +
+    # save) AFTER batch k has been dispatched, so readback and host
+    # finalization overlap device compute. 1 = fully synchronous
+    # (today's behavior); each extra unit keeps one more output batch
+    # resident on device. Outputs are byte-identical at any depth.
+    'inflight': 2,
+}
+
 # -- flight recorder (obs/; docs/observability.md) ---------------------------
 # Same injection policy as CACHE_DEFAULTS: one source of truth, older
 # user YAMLs pick the knobs up automatically, CLI dotlist wins.
@@ -142,6 +155,8 @@ def load_config(
         args.setdefault(key, value)
     for key, value in OBS_DEFAULTS.items():
         args.setdefault(key, value)
+    for key, value in PIPELINE_DEFAULTS.items():
+        args.setdefault(key, value)
     args.update(overrides)
     if run_sanity_check:
         sanity_check(args)
@@ -227,6 +242,16 @@ def sanity_check(args: Config) -> None:
             warnings.warn('cache_enabled has no effect with '
                           'on_extraction=print — disabling the cache')
             args['cache_enabled'] = False
+
+    # device-loop pipelining: the in-flight depth must be a positive int
+    # (1 = synchronous; each extra unit pins one more output batch on
+    # device). ValueError, not assert — survives `python -O`.
+    if args.get('inflight') is not None:
+        args['inflight'] = int(args['inflight'])
+        if args['inflight'] < 1:
+            raise ValueError(
+                f'inflight must be >= 1 (1 = synchronous device loop); '
+                f'got {args["inflight"]}')
 
     # flight-recorder knobs (obs/): paths coerce to str; the ring-buffer
     # bound must be a positive int or the recorder silently records nothing

@@ -64,6 +64,7 @@ class BaseExtractor:
         concat_rgb_flow: bool = False,
         profile: bool = False,
         precision: str = 'highest',
+        inflight: int = 2,
     ) -> None:
         self.feature_type = feature_type
         self.on_extraction = on_extraction
@@ -73,6 +74,11 @@ class BaseExtractor:
         self.device = device
         self.concat_rgb_flow = concat_rgb_flow
         self.precision = precision
+        # output-side pipelining depth: the device loop keeps up to this
+        # many dispatched batches in flight before materializing the
+        # oldest one's results (D2H + scatter + save overlap compute);
+        # 1 = fully synchronous, outputs byte-identical at any depth
+        self.inflight = max(int(inflight or 1), 1)
         # profile controls the PRINTED stage tables; the tracer may also
         # be enabled (tables off) by configure_obs for trace/manifest runs
         self.profile = profile
@@ -112,6 +118,19 @@ class BaseExtractor:
             from video_features_tpu.ops.precision import MIXED_PINS
             return MIXED_PINS
         return None
+
+    def fetch_outputs(self, out):
+        """Materialize one dispatched device step's outputs on the host —
+        the deferred D2H + host copy of the async device loop. ``out`` is
+        whatever the step returned (a device array or any pytree of
+        them); the result is the same structure as numpy arrays. This is
+        the SYNC POINT: an asynchronously raised execution error (OOM, a
+        geometry that won't run) surfaces here, not at dispatch, which is
+        why the packed scheduler's fault isolation wraps this call too.
+        Host arrays pass through unchanged, so legacy ``packed_step``
+        overrides that still return numpy keep working."""
+        import jax
+        return jax.device_get(out)
 
     def put_input(self, batch):
         """Place one host input batch on the device(s): sharded over the
@@ -365,9 +384,13 @@ class BaseExtractor:
         """
         raise NotImplementedError
 
-    def packed_step(self, batch) -> Dict[str, np.ndarray]:
+    def packed_step(self, batch) -> Dict:
         """One compiled device step on a packed ``(B, ...)`` batch →
-        ``{key: (B, D) ndarray}``. Geometry-dependent state (pads, resize,
+        ``{key: (B, D) DEVICE array}`` — the step DISPATCHES and returns
+        without forcing a device→host readback (no ``np.asarray``); the
+        scheduler materializes results later via :meth:`fetch_outputs`,
+        k batches behind dispatch, so D2H and host finalization overlap
+        device compute. Geometry-dependent state (pads, resize,
         per-shape executables) is derived from ``batch.shape`` and cached
         by the implementation."""
         raise NotImplementedError
@@ -380,7 +403,8 @@ class BaseExtractor:
 
     def extract_packed(self, video_paths, decode_ahead: int = 2,
                        batch_size: int = None, on_video_done=None,
-                       max_pool_age_s: float = None) -> None:
+                       max_pool_age_s: float = None,
+                       inflight: int = None) -> None:
         """Run the whole worklist batch-major (see parallel.packing).
 
         ``video_paths`` may be any (lazily consumed, possibly blocking)
@@ -389,14 +413,15 @@ class BaseExtractor:
         ``on_video_done(task)`` fires as each video finalizes;
         ``max_pool_age_s`` bounds how long a partial geometry pool may
         wait for batch-mates (dynamic sources only — a static worklist
-        wants maximally full batches)."""
+        wants maximally full batches); ``inflight`` overrides the
+        extractor's output-side pipelining depth (1 = synchronous)."""
         if not self.supports_packing:
             raise NotImplementedError(
                 f'{type(self).__name__} does not support pack_across_videos')
         from video_features_tpu.parallel.packing import run_packed
         run_packed(self, video_paths, batch_size=batch_size,
                    decode_ahead=decode_ahead, on_video_done=on_video_done,
-                   max_pool_age_s=max_pool_age_s)
+                   max_pool_age_s=max_pool_age_s, inflight=inflight)
 
 
     def _maybe_concat_streams(self, feats_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

@@ -17,7 +17,9 @@ import jax
 import numpy as np
 
 from video_features_tpu.extract.base import BaseExtractor
-from video_features_tpu.extract.streaming import transfer_batches
+from video_features_tpu.extract.streaming import (
+    overlap_fetch, transfer_batches,
+)
 from video_features_tpu.io.video import VideoLoader
 
 
@@ -33,6 +35,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
             device=args.device,
             profile=args.get('profile', False),
             precision=args.get('precision', 'highest'),
+            inflight=args.get('inflight', 2),
         )
         self.batch_size = args.batch_size
         self.decode_workers = int(args.get('decode_workers', 1))
@@ -94,8 +97,10 @@ class BaseFrameWiseExtractor(BaseExtractor):
             for frame, t_ms in zip(batch, times):
                 yield np.asarray(frame), t_ms
 
-    def packed_step(self, batch) -> Dict[str, np.ndarray]:
-        return {self.feature_type: np.asarray(self.device_step(batch))}
+    def packed_step(self, batch) -> Dict:
+        # dispatch only (device array out); the scheduler's deferred
+        # fetch_outputs owns the D2H readback
+        return {self.feature_type: self.device_step(batch)}
 
     def packed_result(self, task) -> Dict[str, np.ndarray]:
         rows = task.rows.get(self.feature_type, [])
@@ -125,13 +130,22 @@ class BaseFrameWiseExtractor(BaseExtractor):
                     batch = np.concatenate([batch, pad], axis=0)
                 yield batch, valid, times
 
-        with self.precision_scope():
+        depth = 1 if self.show_pred else self.inflight
+
+        def dispatched():
             # transfer of batch k+1 overlaps the device running batch k
-            # (see streaming.transfer_batches)
+            # (see streaming.transfer_batches); 'model' is dispatch only,
+            # the deferred readback is the 'd2h' stage in overlap_fetch
             for batch, _, valid, times in transfer_batches(
                     assembled(), self.put_input, tracer=self.tracer):
                 with self.tracer.stage('model'):
-                    out = np.asarray(self.device_step(batch))[:valid]
+                    dev = self.device_step(batch)
+                yield dev, valid, times
+
+        with self.precision_scope():
+            for out, valid, times in overlap_fetch(
+                    dispatched(), self.fetch_outputs, depth, self.tracer):
+                out = out[:valid]
                 feats.append(out)
                 timestamps.extend(times)
                 if self.show_pred:

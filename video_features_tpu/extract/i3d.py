@@ -169,6 +169,7 @@ class ExtractI3D(BaseExtractor):
             concat_rgb_flow=args.get('concat_rgb_flow', False),
             profile=args.get('profile', False),
             precision=args.get('precision', 'highest'),
+            inflight=args.get('inflight', 2),
         )
         self.streams: List[str] = (['rgb', 'flow'] if args.streams is None
                                    else [args.streams])
@@ -322,32 +323,43 @@ class ExtractI3D(BaseExtractor):
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         from video_features_tpu.extract.streaming import (
-            iter_batched_windows, transfer_batches,
+            iter_batched_windows, overlap_fetch, transfer_batches,
         )
 
         loader = self._make_loader(video_path)
         feats: Dict[str, list] = {s: [] for s in self.streams}
+        # show_pred narrates windows as they compute (and needs the input
+        # batch alive at fetch time) — keep the debug surface synchronous
+        depth = 1 if self.show_pred else self.inflight
 
-        def run(stacks, valid, window_idx):
-            pads, resize_to = self._geometry(*stacks.shape[2:4])
-            with self.tracer.stage('model'):
-                out = self._step(self.params, stacks, pads=pads,
-                                 streams=tuple(self.streams),
-                                 resize_to=resize_to)
-                for s in self.streams:
-                    feats[s].append(np.asarray(out[s])[:valid])
-            if self.show_pred:
-                self.maybe_show_pred(stacks[:valid], pads, window_idx,
-                                     resize_to)
+        def dispatched():
+            # decode thread assembles + transfers batch k+1 while the
+            # device runs batch k (see streaming.transfer_batches); the
+            # 'model' stage is DISPATCH only — the deferred readback is
+            # its own 'd2h' stage inside overlap_fetch
+            for stacks, _, valid, window_idx in transfer_batches(
+                    iter_batched_windows(self._stream_windows(loader),
+                                         self.batch_size),
+                    self.put_input, tracer=self.tracer):
+                pads, resize_to = self._geometry(*stacks.shape[2:4])
+                with self.tracer.stage('model'):
+                    out = self._step(self.params, stacks, pads=pads,
+                                     streams=tuple(self.streams),
+                                     resize_to=resize_to)
+                # carry the input batch only for show_pred — holding it
+                # across the in-flight window would pin input HBM
+                yield (out, stacks if self.show_pred else None,
+                       valid, window_idx, pads, resize_to)
 
         with self.precision_scope():
-            # decode thread assembles + transfers batch k+1 while the
-            # device runs batch k (see streaming.transfer_batches)
-            batches = iter_batched_windows(
-                self._stream_windows(loader), self.batch_size)
-            for stacks, _, valid, window_idx in transfer_batches(
-                    batches, self.put_input, tracer=self.tracer):
-                run(stacks, valid, window_idx)
+            for out, stacks, valid, window_idx, pads, resize_to in \
+                    overlap_fetch(dispatched(), self.fetch_outputs, depth,
+                                  self.tracer):
+                for s in self.streams:
+                    feats[s].append(out[s][:valid])
+                if self.show_pred:
+                    self.maybe_show_pred(stacks[:valid], pads, window_idx,
+                                         resize_to)
 
         return {
             s: (np.concatenate(v, axis=0) if v
@@ -365,10 +377,13 @@ class ExtractI3D(BaseExtractor):
             yield window, None
 
     def packed_step(self, stacks):
+        # device arrays out — dispatch only; the scheduler materializes
+        # results k batches later (fetch_outputs), overlapping D2H +
+        # scatter + save with device compute
         pads, resize_to = self._geometry(*stacks.shape[2:4])
         out = self._step(self.params, stacks, pads=pads,
                          streams=tuple(self.streams), resize_to=resize_to)
-        return {s: np.asarray(out[s]) for s in self.streams}
+        return {s: out[s] for s in self.streams}
 
     def packed_result(self, task):
         return {

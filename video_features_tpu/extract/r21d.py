@@ -50,6 +50,7 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
             device=args.device,
             profile=args.get('profile', False),
             precision=args.get('precision', 'highest'),
+            inflight=args.get('inflight', 2),
         )
         self.model_name = args.model_name
         self.model_def = MODEL_CFGS[self.model_name]
@@ -99,8 +100,9 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
     packed_feat_dim = 512
 
     def packed_step(self, stacks):
-        return {self.feature_type: np.asarray(self._step(self.params,
-                                                         stacks))}
+        # dispatch only (device array out); the scheduler's deferred
+        # fetch_outputs owns the D2H readback
+        return {self.feature_type: self._step(self.params, stacks)}
 
     # -- extraction ---------------------------------------------------------
 
@@ -114,19 +116,27 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
                                  self.tracer, 'decode')
 
         from video_features_tpu.extract.streaming import (
-            iter_batched_windows, transfer_batches,
+            iter_batched_windows, overlap_fetch, transfer_batches,
         )
 
         feats: list = []
+        depth = 1 if self.show_pred else self.inflight
 
-        with self.precision_scope():
+        def dispatched():
             # decode thread assembles + transfers stack batch k+1 while
-            # the device runs k (see streaming.transfer_batches)
+            # the device runs k (see streaming.transfer_batches); 'model'
+            # is dispatch only, the deferred readback is the 'd2h' stage
             for stacks, _, valid, window_idx in transfer_batches(
                     iter_batched_windows(windows, self.stack_batch),
                     self.put_input, tracer=self.tracer):
                 with self.tracer.stage('model'):
-                    out = np.asarray(self._step(self.params, stacks))[:valid]
+                    dev = self._step(self.params, stacks)
+                yield dev, valid, window_idx
+
+        with self.precision_scope():
+            for out, valid, window_idx in overlap_fetch(
+                    dispatched(), self.fetch_outputs, depth, self.tracer):
+                out = out[:valid]
                 feats.append(out)
                 if self.show_pred:
                     for k in range(valid):

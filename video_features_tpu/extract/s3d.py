@@ -35,6 +35,7 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
             device=args.device,
             profile=args.get('profile', False),
             precision=args.get('precision', 'highest'),
+            inflight=args.get('inflight', 2),
         )
         self.stack_size = args.stack_size
         self.step_size = args.step_size
@@ -101,8 +102,10 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
     packed_feat_dim = s3d_model.FEAT_DIM
 
     def packed_step(self, stacks):
+        # dispatch only (device array out); the scheduler's deferred
+        # fetch_outputs owns the D2H readback
         step, _, _ = self._geometry_step(*stacks.shape[2:4])
-        return {self.feature_type: np.asarray(step(self.params, stacks))}
+        return {self.feature_type: step(self.params, stacks)}
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         from video_features_tpu.extract.streaming import stream_windows
@@ -114,32 +117,39 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
                                  self.tracer, 'decode')
 
         from video_features_tpu.extract.streaming import (
-            iter_batched_windows, transfer_batches,
+            iter_batched_windows, overlap_fetch, transfer_batches,
         )
 
         feats: list = []
+        depth = 1 if self.show_pred else self.inflight
 
-        def run(stacks, host_stacks, valid, window_idx):
-            step, resize_hw, scale = self._geometry_step(*stacks.shape[2:4])
-            with self.tracer.stage('model'):
-                out = np.asarray(step(self.params, stacks))[:valid]
-            feats.append(out)
-            if self.show_pred:
-                for k in range(valid):
-                    start = (window_idx + k) * self.step_size
-                    self.maybe_show_pred(host_stacks[k:k + 1], start,
-                                         start + self.stack_size,
-                                         resize_hw, scale)
-
-        with self.precision_scope():
+        def dispatched():
             # decode thread assembles + transfers stack batch k+1 while
             # the device runs k; the host batch rides along for show_pred
-            # (see streaming.transfer_batches)
+            # (see streaming.transfer_batches). 'model' is dispatch only;
+            # the deferred readback is the 'd2h' stage in overlap_fetch.
             for stacks, host_stacks, valid, window_idx in transfer_batches(
                     iter_batched_windows(windows, self.stack_batch),
                     self.put_input, keep_host=self.show_pred,
                     tracer=self.tracer):
-                run(stacks, host_stacks, valid, window_idx)
+                step, resize_hw, scale = \
+                    self._geometry_step(*stacks.shape[2:4])
+                with self.tracer.stage('model'):
+                    dev = step(self.params, stacks)
+                yield dev, host_stacks, valid, window_idx, resize_hw, scale
+
+        with self.precision_scope():
+            for out, host_stacks, valid, window_idx, resize_hw, scale in \
+                    overlap_fetch(dispatched(), self.fetch_outputs, depth,
+                                  self.tracer):
+                out = out[:valid]
+                feats.append(out)
+                if self.show_pred:
+                    for k in range(valid):
+                        start = (window_idx + k) * self.step_size
+                        self.maybe_show_pred(host_stacks[k:k + 1], start,
+                                             start + self.stack_size,
+                                             resize_hw, scale)
 
         feats = (np.concatenate(feats, axis=0) if feats
                  else np.zeros((0, s3d_model.FEAT_DIM), np.float32))

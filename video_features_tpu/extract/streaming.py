@@ -97,6 +97,43 @@ def transfer_batches(items: Iterable[tuple], put, keep_host: bool = False,
     return prefetch(map(to_device, items), depth=1)
 
 
+def overlap_fetch(dispatched: Iterable[tuple], fetch, depth: int,
+                  tracer: Tracer = NULL_TRACER) -> Iterator[tuple]:
+    """Defer device→host readback ``depth`` dispatches behind compute.
+
+    ``dispatched`` yields ``(device_out, *meta)`` where ``device_out``
+    is a just-dispatched step's output (device arrays — no forced
+    readback yet); items queue until ``depth`` of them are in flight,
+    then the OLDEST is materialized with ``fetch`` (timed as the ``d2h``
+    stage) and yielded as ``(host_out, *meta)`` — so on async backends
+    the readback + whatever the consumer does with the results (feature
+    append, save) overlap the device computing the next batches.
+    ``depth=1`` is the old synchronous order: every dispatch is
+    immediately followed by its fetch. Results always come back in
+    dispatch order, so consumers are unchanged beyond the deferral.
+    The per-video extract loops drive their device steps through here;
+    the packed scheduler (``parallel.packing.run_packed``) implements
+    the same policy inline because its sync point also owns scatter and
+    fault isolation.
+    """
+    from collections import deque
+    depth = max(int(depth or 1), 1)
+    pending: 'deque' = deque()
+
+    def materialize():
+        item = pending.popleft()
+        with tracer.stage('d2h'):
+            host = fetch(item[0])
+        return (host,) + tuple(item[1:])
+
+    for item in dispatched:
+        pending.append(item)
+        if len(pending) >= depth:
+            yield materialize()
+    while pending:
+        yield materialize()
+
+
 def stream_windows_across_videos(tasks: Iterable,
                                  open_windows: Callable) -> Iterator[tuple]:
     """The corpus-mode windower: yield ``(task, window, meta)`` across video

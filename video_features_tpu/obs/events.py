@@ -112,12 +112,16 @@ def log_extraction_error(video_path, request_id: Optional[str] = None,
           stage=stage)
 
 
-def log_batch_error(video_paths, valid: int, batch: int) -> None:
-    """Packed device-step failure: one batch's geometry failed to
-    compile/fit — exactly the videos it carries fail, the worklist
-    continues (parallel/packing.py fault isolation)."""
+def log_batch_error(video_paths, valid: int, batch: int,
+                    stage: Optional[str] = None) -> None:
+    """Packed device-step failure: one batch failed — at dispatch
+    (``stage='model'``: a geometry that won't compile/fit) or at the
+    deferred sync point (``stage='d2h'``: an asynchronously raised
+    execution fault surfacing in ``fetch_outputs``) — and exactly the
+    videos it carries fail while the worklist continues
+    (parallel/packing.py fault isolation)."""
     event(logging.WARNING,
           'packed device step failed; failing only the videos in this '
           'batch and continuing',
           exc_info=True, videos=sorted(str(p) for p in video_paths),
-          valid=valid, batch=batch)
+          valid=valid, batch=batch, stage=stage)

@@ -16,6 +16,12 @@ This module inverts the loop — batch-major over the whole worklist:
   * the packer fills every device batch to capacity with
     (video, window_idx) provenance, grouping by window geometry so mixed
     corpora still feed fixed-shape executables;
+  * the device loop is asynchronous on BOTH sides: ``packed_step`` only
+    DISPATCHES (device arrays out, no forced readback), and a bounded
+    in-flight queue (the ``inflight`` knob, default 2; 1 = synchronous)
+    defers each batch's D2H readback until the next batch has
+    dispatched — so readback, row scatter, and output writes overlap
+    device compute instead of stalling it;
   * features scatter back into per-video accumulators that flush as each
     video completes (NOT necessarily in worklist order — a video whose
     geometry pool can't fill must not block videos behind it) through the
@@ -128,7 +134,12 @@ def packed_batches(windows: Iterable[tuple], batch: int,
     A ``FLUSH`` item in the stream forces that tail flush early, for
     dynamic sources whose "worklist" has momentarily run dry: a serving
     queue must bound a lone request's latency by batch-padding now rather
-    than waiting for future arrivals to fill the pool.
+    than waiting for future arrivals to fill the pool. Every ``FLUSH``
+    (and every ``NUDGE``) is forwarded as the batchless drain marker
+    ``(None, [], 0)`` after its pools flush, telling the consumer to
+    materialize its in-flight output queue too — the async device loop
+    defers D2H until the NEXT dispatch, and on an idle dynamic source
+    that next dispatch may be hours away.
 
     ``max_pool_age_s`` (serving: ``serve_max_batch_wait_s``) additionally
     ages pools OUT-OF-BAND of the source: any pool whose oldest window
@@ -168,6 +179,12 @@ def packed_batches(windows: Iterable[tuple], batch: int,
             for key in list(pools):
                 if pools[key]:
                     yield flush(key)
+            # always follow with the batchless drain marker: the source
+            # is momentarily idle, so the consumer must ALSO materialize
+            # its in-flight output queue (async device loop) — without
+            # this, a lone request's LAST dispatched batch would wait on
+            # future traffic to push it through the deferred-D2H window
+            yield None, [], 0
             continue
         if item is NUDGE:
             # batchless marker: lets the consumer sweep for zero-window
@@ -197,7 +214,8 @@ def run_packed(ex, video_paths: Iterable,
                batch_size: Optional[int] = None,
                decode_ahead: int = 2,
                on_video_done: Optional[Callable] = None,
-               max_pool_age_s: Optional[float] = None) -> None:
+               max_pool_age_s: Optional[float] = None,
+               inflight: Optional[int] = None) -> None:
     """Drive one extractor over the whole worklist, batch-major.
 
     ``video_paths`` yields ``str`` paths, pre-built :class:`VideoTask`
@@ -228,6 +246,21 @@ def run_packed(ex, video_paths: Iterable,
     ``decode_ahead`` bounds the cross-video decode lookahead at
     ``decode_ahead × batch`` windows (see ``io.video.
     prefetch_across_videos``).
+
+    ``inflight`` (default: the extractor's ``inflight`` attribute, 2) is
+    the OUTPUT-side pipelining depth: ``packed_step`` only dispatches
+    (it returns device arrays), and the loop keeps up to ``inflight``
+    dispatched batches queued before materializing the oldest one's
+    results with ``ex.fetch_outputs`` — so the D2H readback, row
+    scatter, ``sweep()`` finalization, and output writes of batch k-1
+    all overlap the device computing batch k. ``inflight=1`` is exactly
+    the old synchronous loop (dispatch, then immediately fetch), and
+    outputs are byte-identical at any depth. Cost: each extra unit keeps
+    one more output batch (B × feat_dim per stream) resident on device.
+    Fault isolation covers BOTH failure sites — a dispatch-time error
+    (e.g. a geometry that won't compile) and a sync-time error (an
+    asynchronously raised execution fault surfacing in ``fetch_outputs``)
+    each doom exactly the videos of the batch that produced them.
     """
     from video_features_tpu.extract.streaming import (
         stream_windows_across_videos, transfer_batches,
@@ -391,6 +424,60 @@ def run_packed(ex, video_paths: Iterable,
     timed = timed_source() if ex.tracer.enabled else source
     ahead = prefetch_across_videos(timed, decode_ahead * batch)
 
+    # the in-flight queue: dispatched-but-unmaterialized batches, oldest
+    # first. ``depth=1`` degenerates to the old synchronous loop (every
+    # dispatch is immediately followed by its fetch); deeper queues let
+    # the D2H readback + scatter + save of batch k-1 overlap the device
+    # computing batch k. ``ex._inflight_now`` mirrors the live depth for
+    # the serve metrics gauge (vft_inflight_batches) — a plain attribute
+    # store, no locking needed for a monitoring read.
+    from collections import deque
+    depth = max(int(inflight if inflight is not None
+                    else getattr(ex, 'inflight', 1) or 1), 1)
+    pending: 'deque' = deque()   # (out_dev, prov, valid, batch_videos)
+    ex._inflight_now = 0
+
+    def doom_batch(prov, batch_videos, valid, stage):
+        # fault isolation (shared by the dispatch and sync sites): a
+        # failing batch fails exactly the videos it carries (the
+        # per-video loop would likewise lose only them) and the worklist
+        # continues; their accounting still advances so the sweep never
+        # stalls
+        from video_features_tpu.obs.events import log_batch_error
+        log_batch_error(batch_videos if batch_videos is not None
+                        else sorted({str(t.path) for t, _ in prov}),
+                        valid, batch, stage=stage)
+        for task, _ in prov:
+            task.failed = True
+            task.done += 1
+
+    def sync_oldest() -> None:
+        """Materialize the OLDEST in-flight batch: the deferred D2H (its
+        own ``d2h`` stage — readback must not launder into compute time)
+        plus row scatter; asynchronously raised execution faults surface
+        here and doom only this batch's videos."""
+        out_dev, prov, valid, batch_videos = pending.popleft()
+        ex._inflight_now = len(pending)
+        try:
+            with ex.tracer.stage('d2h', videos=batch_videos,
+                                 valid=valid, capacity=batch):
+                out = ex.fetch_outputs(out_dev)
+        except KeyboardInterrupt:
+            raise
+        except Exception:
+            doom_batch(prov, batch_videos, valid, 'd2h')
+            sweep()
+            return
+        ex.tracer.add_occupancy('d2h', valid, batch)
+        for i, (task, meta) in enumerate(prov):
+            task.done += 1
+            if task.failed:       # already doomed: don't grow its rows
+                continue
+            for key, arr in out.items():
+                task.rows.setdefault(key, []).append(arr[i])
+            task.meta_rows.append(meta)
+        sweep()
+
     with ex.precision_scope():
         # batch assembly + H2D of batch k+1 overlap the device running k
         for dev, _, prov, valid in transfer_batches(
@@ -398,32 +485,34 @@ def run_packed(ex, video_paths: Iterable,
                                tracer=ex.tracer),
                 ex.put_input, tracer=ex.tracer):
             if dev is None:
-                sweep()           # NUDGE: zero-window videos finalize now
+                # batchless drain marker (NUDGE / post-FLUSH): the source
+                # is idle or a video finished without windows — finalize
+                # everything finishable NOW. That means materializing the
+                # whole in-flight queue first (a dynamic source may not
+                # dispatch another batch for hours, and a deferred batch
+                # must not hold its requests' completions hostage).
+                while pending:
+                    sync_oldest()
+                sweep()
                 continue
             # span provenance only when tracing is on (hot-loop hygiene);
             # the error path below rebuilds the list lazily if needed
             batch_videos = (sorted({str(t.path) for t, _ in prov})
                             if ex.tracer.enabled else None)
             try:
+                # 'model' times dispatch + any compute the backend runs
+                # synchronously; the wait-for-results tail lands on the
+                # 'd2h' stage at the sync point (their shares sum to the
+                # old all-in 'model' share)
                 with ex.tracer.stage('model', videos=batch_videos,
                                      valid=valid, capacity=batch):
                     out = ex.packed_step(dev)
             except KeyboardInterrupt:
                 raise
             except Exception:
-                # device-step fault isolation: a batch whose geometry
-                # can't compile/fit fails exactly the videos it carries
-                # (the per-video loop would likewise lose only them) and
-                # the worklist continues; their accounting still advances
-                # so the sweep never stalls
-                from video_features_tpu.obs.events import log_batch_error
-                log_batch_error(batch_videos if batch_videos is not None
-                                else sorted({str(t.path)
-                                             for t, _ in prov}),
-                                valid, batch)
-                for task, _ in prov:
-                    task.failed = True
-                    task.done += 1
+                # dispatch-time fault (e.g. a geometry that won't
+                # compile/fit): in-flight predecessors are unaffected
+                doom_batch(prov, batch_videos, valid, 'model')
                 sweep()
                 continue
             ex.tracer.add_occupancy('model', valid, batch)
@@ -439,14 +528,13 @@ def run_packed(ex, video_paths: Iterable,
                     if identity not in costed:
                         costed[identity] = (tuple(shape),
                                             getattr(dev, 'dtype', None))
-            for i, (task, meta) in enumerate(prov):
-                task.done += 1
-                if task.failed:       # already doomed: don't grow its rows
-                    continue
-                for key, arr in out.items():
-                    task.rows.setdefault(key, []).append(arr[i])
-                task.meta_rows.append(meta)
-            sweep()
+            pending.append((out, prov, valid, batch_videos))
+            ex._inflight_now = len(pending)
+            while len(pending) >= depth:
+                sync_oldest()
+        while pending:            # stream drained: materialize the tail
+            sync_oldest()
+    ex._inflight_now = 0
     sweep(final=True)
 
     if manifest is not None and costed:

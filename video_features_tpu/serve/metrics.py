@@ -102,6 +102,7 @@ def build_metrics(started_at: float,
                   request_stats: RequestStats,
                   stage_reports: Dict[str, Dict],
                   cache_stats: Optional[Dict[str, Any]] = None,
+                  inflight_batches: int = 0,
                   ) -> Dict[str, Any]:
     """Assemble the one metrics document. ``stage_reports`` maps a
     human-readable pool-entry label → that entry's ``Tracer.report()``;
@@ -116,6 +117,9 @@ def build_metrics(started_at: float,
         'queue': {'depth': queue_depth, 'capacity': queue_capacity,
                   'draining': draining},
         'warm_pool': pool_stats,
+        # async device loop: dispatched-but-unmaterialized device batches
+        # across every warm worker (0 when idle or fully synchronous)
+        'inflight_batches': int(inflight_batches),
     }
     if cache_stats is None:
         from video_features_tpu.cache.store import merge_cache_stats
@@ -146,6 +150,9 @@ def prometheus_text(doc: Dict[str, Any],
       'admission bound (serve_queue_depth)').set(q.get('capacity', 0))
     g('vft_serve_draining',
       '1 while draining, else 0').set(1 if q.get('draining') else 0)
+    g('vft_inflight_batches',
+      'device batches dispatched but not yet materialized (async '
+      'device loop)').set(doc.get('inflight_batches', 0))
     for key, value in (doc.get('warm_pool') or {}).items():
         if isinstance(value, (int, float)) and not isinstance(value, bool):
             g(f'vft_warm_pool_{key}',

@@ -76,6 +76,11 @@ _KEY_EXCLUDE = frozenset({
     # a server-wide timeline comes from the trace_out base override
     # (merged across every worker at drain).
     'trace_out', 'trace_capacity', 'manifest_out',
+    # output-side pipelining depth (async device loop): how deep D2H
+    # defers behind dispatch, never what the step computes (outputs are
+    # byte-identical by contract) — two requests differing only in
+    # inflight must share one warm entry; the FIRST builder's depth wins
+    'inflight',
 })
 
 
@@ -684,6 +689,12 @@ class ExtractionServer:
             if self._retired_stages:
                 reports['retired'] = dict(self._retired_stages)
             caches = list(self._caches.values())
+            # live async-loop depth: dispatched-but-unmaterialized device
+            # batches across every warm worker (run_packed maintains the
+            # per-extractor attribute; a monitoring read needs no lock)
+            inflight_batches = sum(
+                int(getattr(w.ex, '_inflight_now', 0) or 0)
+                for w in self.pool.entries() + self._retired)
         pool_stats = self.pool.stats()
         # builds ≤ misses: concurrent cold submits for one key all count
         # misses but transplant exactly once (the per-key build lock)
@@ -692,7 +703,8 @@ class ExtractionServer:
         return metrics_mod.build_metrics(
             self._started_at, depth, self.queue_depth, draining,
             pool_stats, self.stats, reports,
-            cache_stats=merge_cache_stats(c.stats() for c in caches))
+            cache_stats=merge_cache_stats(c.stats() for c in caches),
+            inflight_batches=inflight_batches)
 
     # -- completion callbacks (worker threads) -------------------------------
 

@@ -28,6 +28,27 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Iterable, Iterator, List, Optional
 
+# Canonical pipeline stage names — the shared vocabulary across the stage
+# table, the span timeline (obs/spans), the serve metrics families
+# (vft_stage_*), and bench stage_reports. A stage either appears under
+# one of these names everywhere or under its own new name everywhere; in
+# particular `model` is DISPATCH + compute-up-to-sync only, and `d2h` is
+# the deferred device→host readback + host copy (split out so readback
+# can overlap compute without laundering into compute time — the async
+# device loop, parallel/packing.py). Pinned by tests/test_obs.py.
+STAGES = (
+    'decode',             # raw decode (stack families without preprocess)
+    'decode+preprocess',  # decode + host transform on the prefetch thread
+    'queue_idle',         # serve: blocking waits on an idle request feed
+    'pack',               # packed batch assembly (pool flush + np.stack)
+    'h2d',                # host→device input transfer (producer thread)
+    'model',              # device-step dispatch + compute until the sync
+    'd2h',                # deferred device→host readback of step outputs
+    'save',               # output materialization (.npy/.pkl writes)
+    'cache_lookup',       # content-addressed cache consult
+    'cache_publish',      # content-addressed cache publish
+)
+
 
 class _StageStat:
     __slots__ = ('count', 'total_s', 'max_s', 'first_s',
