@@ -526,12 +526,15 @@ def run() -> dict:
                 # async rung below is a clean A/B over one knob.
                 if wl_paths is not None:
                     try:
+                        # decode_workers=1 pins the input side in-process
+                        # (single decode process) so the packed → async →
+                        # farm ladder attributes each delta to one knob
                         wrec_packed = run_worklist(
                             wl_feature, wl_paths,
                             os.path.join(tmp_dir, 'packed'),
                             tmp_dir, platform, batch_size=min(batch, 8),
                             stack=stack, precision=precision, packed=True,
-                            inflight=1)
+                            inflight=1, decode_workers=1)
                         rungs[f'worklist_packed_clips_per_sec_{precision}'] \
                             = wrec_packed['clips_per_sec']
                         rungs['worklist_packed_inflight'] = \
@@ -557,7 +560,7 @@ def run() -> dict:
                             os.path.join(tmp_dir, 'async'),
                             tmp_dir, platform, batch_size=min(batch, 8),
                             stack=stack, precision=precision, packed=True,
-                            inflight=2)
+                            inflight=2, decode_workers=1)
                         rungs[f'worklist_async_clips_per_sec_{precision}'] \
                             = wrec_async['clips_per_sec']
                         rungs['worklist_async_inflight'] = \
@@ -569,6 +572,35 @@ def run() -> dict:
                                 wrec_async['batch_occupancy']
                     except Exception as e:
                         rungs['worklist_async_error'] = \
+                            f'{type(e).__name__}: {e}'
+                # The decode farm (farm/): same worklist, same async
+                # loop, but decode runs in N worker PROCESSES feeding
+                # the packer over shared-memory rings — the full
+                # pipeline, and the rung the host-decode wall shows up
+                # on. Outputs stay byte-identical (tests/test_farm.py);
+                # the delta vs the async rung is the farm's win.
+                if wl_paths is not None:
+                    try:
+                        from tools.worklist_bench import \
+                            bench_decode_workers
+                        n_decode = bench_decode_workers(on_accel)
+                        wrec_farm = run_worklist(
+                            wl_feature, wl_paths,
+                            os.path.join(tmp_dir, 'farm'),
+                            tmp_dir, platform, batch_size=min(batch, 8),
+                            stack=stack, precision=precision, packed=True,
+                            inflight=2, decode_workers=n_decode)
+                        rungs[f'worklist_farm_clips_per_sec_{precision}'] \
+                            = wrec_farm['clips_per_sec']
+                        rungs['worklist_farm_decode_workers'] = \
+                            wrec_farm['decode_workers']
+                        stage_reports[f'worklist_farm_{precision}'] = \
+                            wrec_farm['stages']
+                        if wrec_farm.get('batch_occupancy') is not None:
+                            rungs['worklist_farm_batch_occupancy'] = \
+                                wrec_farm['batch_occupancy']
+                    except Exception as e:
+                        rungs['worklist_farm_error'] = \
                             f'{type(e).__name__}: {e}'
             # The serving rung (serve/): the same worklist content
             # submitted as dynamic per-video requests against the

@@ -62,11 +62,12 @@ def test_bench_mode_both_keeps_contract():
 
 
 def test_bench_worklist_async_rung_emits_keys():
-    """BENCH_WORKLIST=1 runs the corpus trio: the per-video loop, the
-    packed loop pinned synchronous (inflight=1), and the async
-    deferred-D2H loop (inflight=2). The record must carry all three
-    clips/sec rungs, the inflight metadata naming which device loop each
-    packed rung ran, and stage reports in which the async rung shows the
+    """BENCH_WORKLIST=1 runs the corpus ladder: the per-video loop, the
+    packed loop pinned synchronous (inflight=1 decode_workers=1), the
+    async deferred-D2H loop (inflight=2), and the decode-farm loop
+    (decode_workers>1 — multi-process decode over SHM rings). The record
+    must carry all four clips/sec rungs, the metadata naming which loop
+    each rung ran, and stage reports in which the async rung shows the
     d2h stage split out of model."""
     rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
                       'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
@@ -77,15 +78,23 @@ def test_bench_worklist_async_rung_emits_keys():
                       'BENCH_WORKLIST_FEATURE': 'resnet'})
     rungs = rec['rungs']
     for err in ('worklist_error', 'worklist_packed_error',
-                'worklist_async_error'):
+                'worklist_async_error', 'worklist_farm_error'):
         assert err not in rungs, rungs.get(err)
     assert any(k.startswith('worklist_clips_per_sec') for k in rungs)
     assert any(k.startswith('worklist_packed_clips_per_sec')
                for k in rungs)
     assert any(k.startswith('worklist_async_clips_per_sec') for k in rungs)
-    # rung metadata: which device loop produced each number
+    # the decode-farm rung (farm/): same async loop, decode in worker
+    # PROCESSES over shared-memory rings
+    assert any(k.startswith('worklist_farm_clips_per_sec') for k in rungs)
+    # rung metadata: which device loop / input side produced each number
     assert rungs['worklist_packed_inflight'] == 1
     assert rungs['worklist_async_inflight'] == 2
+    assert rungs['worklist_farm_decode_workers'] >= 2
+    # the farm rung's stage report carries the workers' own decode spans
+    farm_rep = next(v for k, v in rec['stage_reports'].items()
+                    if k.startswith('worklist_farm'))
+    assert 'decode' in farm_rep and 'model' in farm_rep
     # the async rung's stage report splits d2h out of model; the shares
     # are distinct stages, not one laundered span
     async_rep = next(v for k, v in rec['stage_reports'].items()

@@ -13,7 +13,10 @@ Direction-aware: throughput-like rungs (``*clips_per_sec*``,
 (``*latency*``, ``*_s`` suffixed) regress when they RISE. Non-numeric
 rungs (error strings) and rungs present on only one side are listed but
 never counted as regressions — an absent rung usually means a different
-BENCH_* env, not a slowdown.
+BENCH_* env, not a slowdown. Config-metadata rungs (``*_inflight``,
+``*_decode_workers`` — they name the loop configuration a number ran
+under) are flagged ``config-changed`` when they differ, never counted
+as regressions.
 
 ``--fail-on-regression PCT`` exits 1 if any shared numeric rung
 regressed by more than PCT percent (CI gate); exit 0 otherwise; exit 2
@@ -27,6 +30,15 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 LOWER_IS_BETTER_MARKERS = ('latency', 'resume_pass')
+
+# rungs that NAME the loop configuration a number was measured under
+# (async depth, decode-farm worker count) rather than measuring anything
+# — a change there is a config change to flag, never a perf regression
+CONFIG_METADATA_SUFFIXES = ('_inflight', '_decode_workers')
+
+
+def is_config_metadata(name: str) -> bool:
+    return name.endswith(CONFIG_METADATA_SUFFIXES)
 
 
 def load_record(path: str) -> Dict[str, Any]:
@@ -80,7 +92,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any]
         reg: Optional[float] = None
         if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
                 and not isinstance(a, bool) and not isinstance(b, bool) \
-                and a != 0:
+                and a != 0 and not is_config_metadata(name):
             change = (b - a) / abs(a) * 100.0
             reg = change if lower_is_better(name) else -change
         rows.append((name, a, b, reg))
@@ -111,7 +123,10 @@ def main(argv: List[str] = None) -> int:
     for name, a, b, reg in rows:
         if reg is None:
             note = ('only-old' if name not in new
-                    else 'only-new' if name not in old else 'n/a')
+                    else 'only-new' if name not in old
+                    else 'config-changed' if is_config_metadata(name)
+                    and a != b else
+                    'config' if is_config_metadata(name) else 'n/a')
             print(f'{name.ljust(width)} | {str(a):>12} | {str(b):>12} '
                   f'| {note}')
             continue

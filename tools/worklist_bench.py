@@ -21,12 +21,16 @@ Usage:
 
 Prints one JSON record per mode on stdout — the per-video loop first,
 then the packed corpus pipeline (``pack_across_videos=true``: batch-major
-across videos, parallel/packing.py) twice: at ``inflight=1`` (the
-synchronous pre-async baseline) and ``inflight=2`` (the deferred-D2H
-async device loop), each with its batch-occupancy figure; bench.py
-embeds them as the ``worklist_clips_per_sec``,
-``worklist_packed_clips_per_sec``, and ``worklist_async_clips_per_sec``
-rungs. Every record carries the ``inflight`` depth it ran at.
+across videos, parallel/packing.py) three times, pinning one knob per
+step so every delta is attributable: ``inflight=1 decode_workers=1``
+(the synchronous single-process baseline), ``inflight=2`` (the
+deferred-D2H async device loop), and ``inflight=2 decode_workers=N``
+(the multi-process decode farm, farm/ — N = ``BENCH_DECODE_WORKERS``,
+default 4 on accelerators / 2 on CPU), each with its batch-occupancy
+figure; bench.py embeds them as the ``worklist_clips_per_sec``,
+``worklist_packed_clips_per_sec``, ``worklist_async_clips_per_sec``,
+and ``worklist_farm_clips_per_sec`` rungs. Every record carries the
+``inflight`` depth and ``decode_workers`` count it ran at.
 """
 from __future__ import annotations
 
@@ -41,6 +45,15 @@ import numpy as np
 
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+
+
+def bench_decode_workers(on_accel: bool) -> int:
+    """The ONE place the ``worklist_farm_*`` rung's worker count comes
+    from (``BENCH_DECODE_WORKERS`` override, else 4 on accelerators /
+    2 on CPU) — bench.py imports this so both tools' farm rungs always
+    run the same configuration under the same rung name."""
+    return int(os.environ.get('BENCH_DECODE_WORKERS',
+                              4 if on_accel else 2))
 
 
 def make_worklist(tmp_dir: str, n_videos: int, seconds: float) -> list:
@@ -65,7 +78,8 @@ def make_worklist(tmp_dir: str, n_videos: int, seconds: float) -> list:
 def run_worklist(feature_type: str, paths: list, out_dir: str,
                  tmp_dir: str, platform: str, batch_size: int = 8,
                  stack: int = 16, precision: str = None,
-                 packed: bool = False, inflight: int = None):
+                 packed: bool = False, inflight: int = None,
+                 decode_workers: int = None):
     """One timed pass of the real worklist loop; returns the record.
 
     ``packed=False`` times the per-video loop cli.py runs by default;
@@ -75,9 +89,12 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
     ``inflight`` pins the output-side pipelining depth (1 = synchronous
     D2H after every dispatch; default = the config's async depth) — the
     resolved value rides in the record so every rung names the loop it
-    measured. The extractor is created once (matching cli.py) so compile
-    caches, weights, and the decode service amortize across the worklist
-    the way they do in production."""
+    measured. ``decode_workers`` pins the input side (1 = in-process
+    decode; >1 on the packed path = the multi-process decode farm,
+    farm/) and likewise rides in the record. The extractor is created
+    once (matching cli.py) so compile caches, weights, and the decode
+    service amortize across the worklist the way they do in
+    production."""
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
     from video_features_tpu.utils.tracing import round_report
@@ -100,6 +117,8 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         overrides.update({'stack_size': stack, 'step_size': stack})
     if inflight is not None:
         overrides['inflight'] = int(inflight)
+    if decode_workers is not None:
+        overrides['decode_workers'] = int(decode_workers)
     args = load_config(feature_type, overrides=overrides)
     ex = create_extractor(args)
 
@@ -164,6 +183,9 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         # (1 = synchronous loop) — rung metadata, so a BENCH_*.json
         # says which device loop produced its number
         'inflight': int(args.get('inflight', 1)),
+        # the input side's decode parallelism (1 = in-process; >1 packed
+        # = the decode farm) — rung metadata like inflight
+        'decode_workers': int(args.get('decode_workers', 1)),
         'n_videos': len(paths),
         'videos_per_min': round(len(paths) / elapsed * 60, 3),
         'clips_total': int(clips),
@@ -209,22 +231,34 @@ def main() -> int:
         # families with packed support run it — an unsupported feature
         # must still emit its per-video record, not crash the tool
         from video_features_tpu.registry import PACKED_FEATURES
-        rec_packed = rec_async = None
+        rec_packed = rec_async = rec_farm = None
         if feature_type in PACKED_FEATURES:
-            # inflight=1 pins the SYNCHRONOUS packed loop (D2H after
-            # every dispatch — the pre-async baseline)...
+            # the packed ladder pins ONE knob per record so each delta
+            # is attributable: sync in-process → async in-process →
+            # async + decode farm.
+            # inflight=1 decode_workers=1 pins the fully SYNCHRONOUS
+            # single-process packed loop (the pre-async baseline)...
             rec_packed = run_worklist(feature_type, paths,
                                       os.path.join(td, 'packed'), td,
                                       platform, batch_size=batch,
-                                      stack=stack, packed=True, inflight=1)
-            # ...and the async record runs the same worklist with the
-            # deferred-D2H loop so the two are directly comparable
+                                      stack=stack, packed=True, inflight=1,
+                                      decode_workers=1)
+            # ...the async record adds only the deferred-D2H loop...
             rec_async = run_worklist(feature_type, paths,
                                      os.path.join(td, 'packed_async'), td,
                                      platform, batch_size=batch,
-                                     stack=stack, packed=True, inflight=2)
+                                     stack=stack, packed=True, inflight=2,
+                                     decode_workers=1)
+            # ...and the farm record adds the multi-process decode farm
+            # (farm/) on top — the full pipeline
+            n_decode = bench_decode_workers(on_accel)
+            rec_farm = run_worklist(feature_type, paths,
+                                    os.path.join(td, 'packed_farm'), td,
+                                    platform, batch_size=batch,
+                                    stack=stack, packed=True, inflight=2,
+                                    decode_workers=n_decode)
     print(json.dumps(rec), file=stdout)
-    for extra in (rec_packed, rec_async):
+    for extra in (rec_packed, rec_async, rec_farm):
         if extra is not None:
             print(json.dumps(extra), file=stdout)
     return 0

@@ -1,3 +1,8 @@
 from video_features_tpu.cli import main
 
-raise SystemExit(main())
+# the __name__ guard matters: decode-farm workers (farm/) are SPAWNED
+# processes, and multiprocessing re-imports the parent's main module in
+# the child — an unguarded SystemExit(main()) would re-run the whole CLI
+# inside every decode worker
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -52,6 +52,10 @@ CONFIG_KEY_EXCLUDE = frozenset({
     'device', 'device_ids', 'data_parallel', 'multihost',
     'coordinator_address', 'num_processes', 'process_id',
     'pack_across_videos', 'pack_decode_ahead', 'decode_workers',
+    # decode-farm transport sizing: where decoded bytes travel, never
+    # what they are (farm outputs are byte-identical by contract —
+    # tests/test_farm.py pins it)
+    'decode_farm_ring_mb',
     # output-side pipelining depth: how deep D2H defers behind dispatch,
     # never what the step computes (async parity is byte-identical by
     # contract — tests/test_packing.py pins it)

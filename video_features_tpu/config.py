@@ -50,6 +50,25 @@ PIPELINE_DEFAULTS: Dict[str, Any] = {
     'inflight': 2,
 }
 
+# -- decode farm (farm/; docs/decode_farm.md) --------------------------------
+# Same injection policy as CACHE_DEFAULTS: one source of truth, older
+# user YAMLs pick the knobs up automatically, CLI dotlist wins. Families
+# whose YAML already carries decode_workers (i3d ships 2) keep their
+# tuned value.
+FARM_DEFAULTS: Dict[str, Any] = {
+    # host decode/preprocess parallelism. 1 = in-process decode exactly
+    # as before. >1 on the per-video loop = the in-process transform
+    # thread pool; >1 on the packed/serve paths = the multi-process
+    # decode farm (N worker processes feeding the packer over
+    # shared-memory rings — GIL- and swscale-unbound). Outputs are
+    # byte-identical at any value.
+    'decode_workers': 1,
+    # per-worker shared-memory ring size (MiB): bounds decoded bytes in
+    # flight per worker; a slow consumer stalls decode instead of
+    # growing memory. See docs/decode_farm.md for sizing.
+    'decode_farm_ring_mb': 64,
+}
+
 # -- flight recorder (obs/; docs/observability.md) ---------------------------
 # Same injection policy as CACHE_DEFAULTS: one source of truth, older
 # user YAMLs pick the knobs up automatically, CLI dotlist wins.
@@ -157,6 +176,8 @@ def load_config(
         args.setdefault(key, value)
     for key, value in PIPELINE_DEFAULTS.items():
         args.setdefault(key, value)
+    for key, value in FARM_DEFAULTS.items():
+        args.setdefault(key, value)
     args.update(overrides)
     if run_sanity_check:
         sanity_check(args)
@@ -252,6 +273,21 @@ def sanity_check(args: Config) -> None:
             raise ValueError(
                 f'inflight must be >= 1 (1 = synchronous device loop); '
                 f'got {args["inflight"]}')
+
+    # decode-farm knobs (farm/): worker count and per-worker SHM ring
+    # size must be positive ints. ValueError, not assert — survives -O.
+    if args.get('decode_workers') is not None:
+        args['decode_workers'] = int(args['decode_workers'])
+        if args['decode_workers'] < 1:
+            raise ValueError(
+                f'decode_workers must be >= 1 (1 = in-process decode); '
+                f'got {args["decode_workers"]}')
+    if args.get('decode_farm_ring_mb') is not None:
+        args['decode_farm_ring_mb'] = int(args['decode_farm_ring_mb'])
+        if args['decode_farm_ring_mb'] < 1:
+            raise ValueError(
+                'decode_farm_ring_mb must be >= 1 (MiB per worker ring); '
+                f'got {args["decode_farm_ring_mb"]}')
 
     # flight-recorder knobs (obs/): paths coerce to str; the ring-buffer
     # bound must be a positive int or the recorder silently records nothing

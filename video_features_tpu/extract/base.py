@@ -95,6 +95,11 @@ class BaseExtractor:
         self.trace_out = None
         self.manifest = None
         self.manifest_out = None
+        # decode farm (farm/) — the live DecodeFarm handle while (and
+        # after) a farm-backed packed run, for the serve metrics surface;
+        # run_packed installs it when decode_workers > 1 takes the
+        # multi-process input path
+        self._farm = None
 
     def precision_scope(self):
         """Matmul-precision context for the device loop. ``highest`` (the
@@ -185,6 +190,30 @@ class BaseExtractor:
             except Exception:
                 log_cache_error(f'open ({args.get("cache_dir")})')
                 self.cache = None
+
+    # -- decode farm (farm/) ------------------------------------------------
+
+    def configure_farm(self, args) -> None:
+        """Normalize the decode-farm knobs onto the extractor. Every
+        extractor gets ``decode_workers`` (families that already read it
+        for their in-process transform thread pool keep the same value —
+        one knob, one meaning: how much host-decode parallelism to buy)
+        and ``decode_farm_ring_mb`` (per-worker SHM ring size). Called by
+        ``registry.create_extractor``; extractors constructed directly
+        keep the in-process default (``decode_workers=1``)."""
+        self.decode_workers = max(
+            int(args.get('decode_workers', 1) or 1), 1)
+        self.decode_farm_ring_mb = max(
+            int(args.get('decode_farm_ring_mb', 64) or 64), 1)
+
+    def farm_recipe(self):
+        """Picklable decode recipe (``farm/recipes.py``) replaying this
+        extractor's decode + host-preprocess stack in a worker PROCESS
+        with byte-exact parity, or None when the preprocessing can't be
+        described as a spec (the packed scheduler then falls back to
+        in-process decode with a structured warning). Families override
+        via :class:`StackPackingMixin`/``BaseFrameWiseExtractor``."""
+        return None
 
     # -- flight recorder (obs/) ---------------------------------------------
 
@@ -404,7 +433,8 @@ class BaseExtractor:
     def extract_packed(self, video_paths, decode_ahead: int = 2,
                        batch_size: int = None, on_video_done=None,
                        max_pool_age_s: float = None,
-                       inflight: int = None) -> None:
+                       inflight: int = None,
+                       decode_workers: int = None) -> None:
         """Run the whole worklist batch-major (see parallel.packing).
 
         ``video_paths`` may be any (lazily consumed, possibly blocking)
@@ -414,14 +444,17 @@ class BaseExtractor:
         ``max_pool_age_s`` bounds how long a partial geometry pool may
         wait for batch-mates (dynamic sources only — a static worklist
         wants maximally full batches); ``inflight`` overrides the
-        extractor's output-side pipelining depth (1 = synchronous)."""
+        extractor's output-side pipelining depth (1 = synchronous);
+        ``decode_workers`` overrides the input side's parallelism (>1 =
+        the multi-process decode farm, 1 = in-process decode)."""
         if not self.supports_packing:
             raise NotImplementedError(
                 f'{type(self).__name__} does not support pack_across_videos')
         from video_features_tpu.parallel.packing import run_packed
         run_packed(self, video_paths, batch_size=batch_size,
                    decode_ahead=decode_ahead, on_video_done=on_video_done,
-                   max_pool_age_s=max_pool_age_s, inflight=inflight)
+                   max_pool_age_s=max_pool_age_s, inflight=inflight,
+                   decode_workers=decode_workers)
 
 
     def _maybe_concat_streams(self, feats_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -557,3 +590,14 @@ class StackPackingMixin:
         return {self.feature_type: (np.stack(rows) if rows
                                     else np.zeros((0, self.packed_feat_dim),
                                                   np.float32))}
+
+    def farm_recipe(self):
+        """The stack families decode RAW frames (no host transform), so
+        the farm recipe is fully described by the window geometry plus
+        the loader knobs ``_make_loader`` passes."""
+        from video_features_tpu.farm.recipes import StackRecipe
+        return StackRecipe(
+            win=self.stack_size, step=self.step_size, batch_size=64,
+            fps=self.extraction_fps, total=None, tmp_path=self.tmp_path,
+            keep_tmp=self.keep_tmp_files, backend=self.decode_backend,
+            transform=None)

@@ -102,6 +102,10 @@ class ExtractCLIP(BaseFrameWiseExtractor):
         frame = resize_pil(frame, n_px, interpolation='bicubic')
         return center_crop_host(frame, n_px)
 
+    def host_transform_spec(self):
+        n_px = self.input_resolution
+        return ('edge_resize_crop', n_px, n_px, 'bicubic')
+
     def device_step(self, batch: np.ndarray) -> jax.Array:
         return self._step(self.params, batch)
 

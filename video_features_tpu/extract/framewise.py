@@ -97,6 +97,25 @@ class BaseFrameWiseExtractor(BaseExtractor):
             for frame, t_ms in zip(batch, times):
                 yield np.asarray(frame), t_ms
 
+    def host_transform_spec(self):
+        """Named-spec form of :meth:`host_transform` (``farm/recipes.py``
+        vocabulary), or None when the transform can't be specced — which
+        disables the decode farm for this extractor (in-process decode
+        keeps working). Subclasses whose ``host_transform`` is the
+        standard edge-resize + center-crop pair override this."""
+        return None
+
+    def farm_recipe(self):
+        spec = self.host_transform_spec()
+        if spec is None:
+            return None
+        from video_features_tpu.farm.recipes import FramewiseRecipe
+        return FramewiseRecipe(
+            batch_size=self.batch_size, fps=self.extraction_fps,
+            total=self.extraction_total, tmp_path=self.tmp_path,
+            keep_tmp=self.keep_tmp_files, backend=self.decode_backend,
+            transform=spec)
+
     def packed_step(self, batch) -> Dict:
         # dispatch only (device array out); the scheduler's deferred
         # fetch_outputs owns the D2H readback

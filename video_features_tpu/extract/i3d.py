@@ -392,6 +392,18 @@ class ExtractI3D(BaseExtractor):
             for s in self.streams
         }
 
+    def farm_recipe(self):
+        # one extra frame per window (B+1 frames → B flow pairs); the
+        # host short-side resize rides as a spec unless device_resize
+        # lifted it into the fused graph (raw frames ship then)
+        from video_features_tpu.farm.recipes import StackRecipe
+        return StackRecipe(
+            win=self.stack_size + 1, step=self.step_size, batch_size=64,
+            fps=self.extraction_fps, total=None, tmp_path=self.tmp_path,
+            keep_tmp=self.keep_tmp_files, backend=self.decode_backend,
+            transform=(None if self.device_resize
+                       else ('edge_resize', MIN_SIDE_SIZE, 'bilinear')))
+
     def maybe_show_pred(self, stacks, pads, stack_counter, resize_to=None):
         """Kinetics top-5 per STREAM, like the reference (extract_i3d.py:
         212-216 runs the classifier head on each stream's transformed

@@ -56,6 +56,11 @@ class ExtractResNet(BaseFrameWiseExtractor):
             frame, RESIZE_OVERRIDES.get(self.model_name, RESIZE_SIZE))
         return center_crop_host(frame, CROP_SIZE)
 
+    def host_transform_spec(self):
+        return ('edge_resize_crop',
+                RESIZE_OVERRIDES.get(self.model_name, RESIZE_SIZE),
+                CROP_SIZE, 'bilinear')
+
     def device_step(self, batch: np.ndarray) -> jax.Array:
         return self._step(self.params, batch)
 

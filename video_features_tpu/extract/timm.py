@@ -277,6 +277,10 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                            interpolation=self.data_cfg['interpolation'])
         return center_crop_host(frame, self.data_cfg['crop'])
 
+    def host_transform_spec(self):
+        return ('edge_resize_crop', self.data_cfg['resize'],
+                self.data_cfg['crop'], self.data_cfg['interpolation'])
+
     def device_step(self, batch: np.ndarray) -> jax.Array:
         return self._step(self.params, batch)
 

@@ -1,0 +1,119 @@
+"""Picklable decode recipes: what a farm worker runs per video.
+
+A recipe is the farm's contract with the extractor families: a small,
+picklable description of the decode + host-preprocess stack that a
+worker PROCESS can replay with byte-exact parity to the in-process path
+— without ever holding (or pickling) the extractor itself, whose device
+params and compiled executables must stay in the parent.
+
+``recipe.open(path)`` → ``(info, iterator)`` where ``info`` is the
+video-level metadata dict the scheduler folds into ``task.info`` (e.g.
+``fps`` for the frame-wise families) and the iterator yields
+``(window, meta)`` exactly like ``BaseExtractor.packed_windows``.
+
+Transforms are named specs (``('edge_resize', ...)`` /
+``('edge_resize_crop', ...)``) resolved against the jax-free
+``ops.host_transforms`` primitives, so workers import cv2/PIL/numpy and
+nothing heavier. An extractor whose preprocessing can't be described
+this way simply returns None from ``farm_recipe()`` and the scheduler
+falls back to in-process decode.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+TransformSpec = Tuple  # ('edge_resize', size, interp) | ('edge_resize_crop', resize, crop, interp)
+
+
+def resolve_transform(spec: Optional[TransformSpec]
+                      ) -> Optional[Callable[[np.ndarray], np.ndarray]]:
+    """Materialize a transform spec into a per-frame callable."""
+    if spec is None:
+        return None
+    from video_features_tpu.ops.host_transforms import (
+        center_crop_host, resize_pil,
+    )
+    kind = spec[0]
+    if kind == 'edge_resize':
+        _, size, interp = spec
+        return lambda f: resize_pil(f, size, interpolation=interp)
+    if kind == 'edge_resize_crop':
+        _, resize, crop, interp = spec
+        return lambda f: center_crop_host(
+            resize_pil(f, resize, interpolation=interp), crop)
+    raise ValueError(f'unknown transform spec {spec!r}')
+
+
+class _LoaderRecipe:
+    """Shared loader plumbing: builds the same ``io.video.VideoLoader``
+    the in-process path builds (fps retiming backends, decode backend
+    fallback, tmp-file lifecycle included) and guarantees ``close()``
+    runs when iteration ends or is abandoned."""
+
+    def __init__(self, batch_size: int, fps, total, tmp_path: str,
+                 keep_tmp: bool, backend: str,
+                 transform: Optional[TransformSpec]) -> None:
+        self.batch_size = int(batch_size)
+        self.fps = fps
+        self.total = total
+        self.tmp_path = str(tmp_path)
+        self.keep_tmp = bool(keep_tmp)
+        self.backend = backend
+        self.transform = transform
+
+    def _make_loader(self, path: str):
+        from video_features_tpu.io.video import VideoLoader
+        return VideoLoader(
+            path, batch_size=self.batch_size, fps=self.fps,
+            total=self.total, tmp_path=self.tmp_path,
+            keep_tmp=self.keep_tmp,
+            transform=resolve_transform(self.transform),
+            backend=self.backend)
+
+
+class FramewiseRecipe(_LoaderRecipe):
+    """One window = one host-transformed frame; meta = its timestamp —
+    mirrors ``BaseFrameWiseExtractor.packed_windows`` byte for byte."""
+
+    def open(self, path: str) -> Tuple[Dict, Iterator]:
+        loader = self._make_loader(path)
+
+        def windows():
+            try:
+                for batch, times, _ in loader:
+                    for frame, t_ms in zip(batch, times):
+                        yield np.asarray(frame), t_ms
+            finally:
+                loader.close()
+
+        return {'fps': loader.fps}, windows()
+
+
+class StackRecipe(_LoaderRecipe):
+    """One window = a ``(win, H, W, 3)`` frame stack stepped by ``step``
+    — mirrors the stack families' ``packed_windows`` (r21d/s3d: raw
+    frames, win = stack_size; i3d: win = stack_size + 1 and the host
+    short-side resize unless ``device_resize`` lifted it in-graph)."""
+
+    def __init__(self, win: int, step: int, batch_size: int, fps, total,
+                 tmp_path: str, keep_tmp: bool, backend: str,
+                 transform: Optional[TransformSpec]) -> None:
+        super().__init__(batch_size, fps, total, tmp_path, keep_tmp,
+                         backend, transform)
+        self.win = int(win)
+        self.step = int(step)
+
+    def open(self, path: str) -> Tuple[Dict, Iterator]:
+        from video_features_tpu.extract.streaming import stream_windows
+        loader = self._make_loader(path)
+
+        def windows():
+            try:
+                for window in stream_windows(loader, self.win, self.step):
+                    yield window, None
+            finally:
+                loader.close()
+
+        return {}, windows()

@@ -1,0 +1,100 @@
+"""Bounded shared-memory byte ring: one producer (a decode worker
+process), one consumer (the farm's scheduler-side drain loop).
+
+The ring is a plain byte arena over one ``multiprocessing.shared_memory``
+segment. Positions are MONOTONIC byte counters (they never wrap); the
+physical offset is ``pos % capacity``. The producer owns ``write_pos``;
+the consumer reports consumed bytes back over a queue and the producer
+folds them into ``read_pos`` — so neither side shares mutable state
+beyond the segment bytes themselves, and a crashed producer can never
+corrupt another worker's ring (each worker has its own segment and its
+own queues).
+
+Variable-size windows are handled with contiguous-region allocation: a
+region never wraps mid-window; when the tail of the arena is too short,
+the producer skips it and the skip rides along in the region's ``adv``
+(total byte advance) so the consumer's in-order frees keep both sides'
+arithmetic identical. Backpressure falls out of the arithmetic: when
+``capacity - (write_pos - read_pos)`` can't fit the next window, the
+producer blocks draining the free queue — a slow consumer stalls decode
+instead of growing memory.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+
+class RingFull(Exception):
+    """Raised by :meth:`RingProducer.alloc` when ``wait_free`` gives up."""
+
+
+class RingProducer:
+    """Producer-side allocator over a SharedMemory segment's buffer."""
+
+    def __init__(self, buf: memoryview, capacity: int) -> None:
+        self.buf = buf
+        self.capacity = int(capacity)
+        self.write_pos = 0      # monotonic bytes allocated
+        self.read_pos = 0       # monotonic bytes freed by the consumer
+
+    def free_space(self) -> int:
+        return self.capacity - (self.write_pos - self.read_pos)
+
+    def freed(self, nbytes: int) -> None:
+        """Fold a consumer free report (an ``adv`` value) into read_pos."""
+        self.read_pos += int(nbytes)
+
+    def alloc(self, nbytes: int,
+              wait_free: Optional[Callable[[], None]] = None,
+              ) -> Optional[Tuple[int, int]]:
+        """Reserve a contiguous ``nbytes`` region → ``(offset, adv)``.
+
+        ``adv`` is the total byte advance (region + any skipped arena
+        tail) the consumer must report back verbatim. Returns None when
+        the window can never fit (``nbytes > capacity``) — the caller
+        falls back to shipping those bytes through the message queue.
+        ``wait_free`` is called (blocking, typically draining the free
+        queue) until space is available; it may raise to abort.
+        """
+        nbytes = int(nbytes)
+        if nbytes * 2 > self.capacity:
+            # a wrap's skipped tail can approach the window size, so a
+            # window over half the arena could need adv > capacity —
+            # unsatisfiable by any amount of freeing. Such windows take
+            # the queue-transport fallback instead of deadlocking here.
+            return None
+        off = self.write_pos % self.capacity
+        skip = self.capacity - off if off + nbytes > self.capacity else 0
+        adv = skip + nbytes
+        while self.free_space() < adv:
+            if wait_free is None:
+                raise RingFull(nbytes)
+            wait_free()
+        self.write_pos += adv
+        return (self.write_pos - nbytes) % self.capacity, adv
+
+    def write(self, offset: int, arr: np.ndarray) -> None:
+        """Copy a C-contiguous array's bytes into the segment."""
+        flat = arr.reshape(-1).view(np.uint8)
+        dst = np.frombuffer(self.buf, dtype=np.uint8,
+                            count=arr.nbytes, offset=offset)
+        dst[:] = flat
+
+
+def read_window(buf: memoryview, offset: int, shape: tuple,
+                dtype: str) -> np.ndarray:
+    """Consumer-side copy of one window out of the segment.
+
+    The copy is deliberate: it frees the ring slot immediately (the
+    producer can reuse it as soon as the ``adv`` free is reported), so
+    ring capacity bounds only the *transport*, while the downstream
+    prefetch/pool buffers keep their own existing bounds. The memcpy is
+    ~three orders of magnitude cheaper than the decode it replaces and
+    runs on the consumer's prefetch thread, overlapped with device
+    compute.
+    """
+    n = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    src = np.frombuffer(buf, dtype=np.uint8, count=n, offset=offset)
+    return src.copy().view(np.dtype(dtype)).reshape(shape)

@@ -1,0 +1,159 @@
+"""Decode-farm worker process: decode videos, ship windows over SHM.
+
+Spawned (never forked — the parent holds live XLA/jax state that must
+not cross a fork) with a picklable recipe (``farm/recipes.py``). The
+import footprint is deliberately tiny: numpy, cv2/PIL via ``io.video``,
+and the jax-free host transforms — a worker never imports jax, so spawn
+cost stays at interpreter + cv2 startup.
+
+Wire protocol (all messages on this worker's own ``out_q``; every
+message leads with ``(kind, widx, epoch, ...)`` and stale epochs are
+dropped by the consumer after a respawn):
+
+  ('start', widx, epoch, seq, info)                    video opened
+  ('win',   widx, epoch, seq, off, adv, shape, dtype, meta, t0, dt,
+            ring_used)
+  ('winq',  widx, epoch, seq, bytes, shape, dtype, meta, t0, dt)
+                           queue-transport fallback (window > ring/2)
+  ('end',   widx, epoch, seq, n_windows)               video drained
+  ('err',   widx, epoch, seq, traceback)               video failed
+
+Control (``ctrl_q``, consumer → worker): ('abort', seq) stops decoding
+that video early (device-side fault made its windows worthless);
+('winq_ack',) credits back one consumed queue-transport window — the
+worker holds at most ``MAX_UNACKED_WINQ`` unacked 'winq' messages, so
+the oversized-window fallback is as backpressured as the ring (a slow
+consumer stalls decode instead of growing the parent's queue);
+('stop',) on ``task_q`` ends the process after the queued videos.
+
+Fault model: any exception inside one video's decode is that video's
+'err' — the worker moves on (the per-video error contract). A crash
+(segfault, OOM-kill) takes the process; the farm supervisor fails the
+in-flight video, re-dispatches the queued ones to a respawned worker
+with a FRESH ring epoch, and unlinks the dead ring.
+"""
+from __future__ import annotations
+
+import queue as queue_mod
+import time
+import traceback
+
+
+class _Abort(Exception):
+    """Current video's windows are no longer wanted."""
+
+
+# in-flight cap for queue-transport windows (> ring/2, so potentially
+# ~100 MiB each): one being consumed + one buffered per worker
+MAX_UNACKED_WINQ = 2
+
+
+def worker_main(widx: int, epoch: int, recipe, ring_name: str,
+                ring_bytes: int, task_q, out_q, free_q, ctrl_q) -> None:
+    import numpy as np
+    from multiprocessing import shared_memory
+
+    from video_features_tpu.farm.ring import RingProducer
+
+    # NOTE on the resource tracker: attaching registers the segment with
+    # the (inherited, shared) tracker a second time — a set, so the
+    # parent's unlink on shutdown/respawn still unregisters cleanly. Do
+    # NOT unregister here: the tracker would then KeyError on the
+    # parent's legitimate unlink.
+    shm = shared_memory.SharedMemory(name=ring_name)
+    ring = RingProducer(shm.buf, ring_bytes)
+    aborted = set()
+    winq_unacked = [0]                   # queue-transport credit counter
+
+    def poll_ctrl() -> None:
+        while True:
+            try:
+                msg = ctrl_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            if msg[0] == 'abort':
+                aborted.add(msg[1])
+            elif msg[0] == 'winq_ack':
+                winq_unacked[0] -= 1
+
+    def wait_free_for(seq):
+        def wait_free():
+            poll_ctrl()
+            if seq in aborted:
+                raise _Abort
+            try:
+                ring.freed(free_q.get(timeout=0.1))
+            except queue_mod.Empty:
+                pass
+        return wait_free
+
+    def drain_frees() -> None:
+        while True:
+            try:
+                ring.freed(free_q.get_nowait())
+            except queue_mod.Empty:
+                return
+
+    try:
+        while True:
+            msg = task_q.get()
+            if msg[0] == 'stop':
+                break
+            _, seq, path = msg
+            n = 0
+            try:
+                info, windows = recipe.open(path)
+                out_q.put(('start', widx, epoch, seq, info))
+                it = iter(windows)
+                wait_free = wait_free_for(seq)
+                while True:
+                    poll_ctrl()
+                    if seq in aborted:
+                        if hasattr(it, 'close'):
+                            it.close()     # recipe finally → loader.close
+                        break
+                    t0 = time.perf_counter()
+                    try:
+                        window, meta = next(it)
+                    except StopIteration:
+                        break
+                    dt = time.perf_counter() - t0
+                    window = np.ascontiguousarray(window)
+                    drain_frees()
+                    region = ring.alloc(window.nbytes, wait_free)
+                    if region is None:
+                        # window larger than half the ring: correctness
+                        # valve — ship the bytes through the queue, but
+                        # bounded by consumer acks so a slow consumer
+                        # stalls decode here exactly like the ring does
+                        while winq_unacked[0] >= MAX_UNACKED_WINQ \
+                                and seq not in aborted:
+                            poll_ctrl()
+                            time.sleep(0.005)
+                        if seq in aborted:
+                            continue   # loop top closes the iterator
+                        winq_unacked[0] += 1
+                        out_q.put(('winq', widx, epoch, seq,
+                                   window.tobytes(), window.shape,
+                                   window.dtype.str, meta, t0, dt))
+                    else:
+                        off, adv = region
+                        ring.write(off, window)
+                        out_q.put(('win', widx, epoch, seq, off, adv,
+                                   window.shape, window.dtype.str, meta,
+                                   t0, dt,
+                                   ring.write_pos - ring.read_pos))
+                    n += 1
+                out_q.put(('end', widx, epoch, seq, n))
+            except _Abort:
+                out_q.put(('end', widx, epoch, seq, n))
+            except Exception:
+                # one video's decode failure is that video's error; the
+                # worker stays up for the rest of the worklist
+                out_q.put(('err', widx, epoch, seq,
+                           traceback.format_exc()))
+    finally:
+        try:
+            shm.close()
+        except Exception:
+            pass

@@ -126,8 +126,8 @@ def reencode_fps_native(video_path: str, tmp_path: str,
     if load_library() is None:   # build once here; child just dlopens
         raise RuntimeError('native decode library unavailable')
     os.makedirs(tmp_path, exist_ok=True)
-    new_path = os.path.join(tmp_path,
-                            f'{Path(video_path).stem}_new_fps.mp4')
+    from video_features_tpu.io.video import reencode_out_path
+    new_path = reencode_out_path(video_path, tmp_path)
     # The package may not be pip-installed: make the child resolve THIS
     # checkout's package regardless of the caller's cwd. Invoking the
     # entry point by file path puts the io/ dir (no package inside) at

@@ -22,8 +22,11 @@ Contract parity with the reference loader:
 """
 from __future__ import annotations
 
+import hashlib
+import itertools
 import logging
 import os
+import shutil
 import subprocess
 from pathlib import Path
 from typing import Callable, Dict, Iterator, List, Optional, Tuple, Union
@@ -31,15 +34,41 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple, Union
 import cv2
 import numpy as np
 
+# memoized which_ffmpeg result; None = not probed yet ('' = no binary).
+# Reset to None in tests that monkeypatch the PATH.
+_FFMPEG_PATH: Optional[str] = None
+
+_REENCODE_SEQ = itertools.count()
+
+
+def reencode_out_path(video_path: Union[str, os.PathLike],
+                      tmp_path: Union[str, os.PathLike]) -> str:
+    """Collision-free re-encode target in ``tmp_path``. The stem alone
+    is not enough: decode-farm worker processes (and the threaded
+    decode-ahead pool) re-encode CONCURRENTLY into one shared tmp_path,
+    so same-stem videos — or the same video open in two processes —
+    would clobber each other's tmp file mid-read and delete each
+    other's on close(). Path digest separates same-stem sources; pid +
+    a per-process counter separate concurrent opens of one source."""
+    digest = hashlib.sha1(
+        os.path.abspath(os.fspath(video_path)).encode()).hexdigest()[:8]
+    return os.path.join(
+        os.fspath(tmp_path),
+        f'{Path(video_path).stem}_{digest}_{os.getpid()}'
+        f'_{next(_REENCODE_SEQ)}_new_fps.mp4')
+
 
 def which_ffmpeg() -> str:
-    """Path to an ffmpeg binary, or '' (reference utils/utils.py:181-194)."""
-    try:
-        result = subprocess.run(['which', 'ffmpeg'], stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT)
-        return result.stdout.decode('utf-8').strip()
-    except OSError:
-        return ''
+    """Path to an ffmpeg binary, or '' (reference utils/utils.py:181-194).
+
+    ``shutil.which``, memoized: the old ``subprocess.run(['which', ...])``
+    probe spawned a process per VideoLoader (twice when fps retiming was
+    requested) and broke on hosts without a ``which`` binary.
+    """
+    global _FFMPEG_PATH
+    if _FFMPEG_PATH is None:
+        _FFMPEG_PATH = shutil.which('ffmpeg') or ''
+    return _FFMPEG_PATH
 
 
 def get_video_props(path: Union[str, os.PathLike]) -> Dict[str, float]:
@@ -59,14 +88,24 @@ def get_video_props(path: Union[str, os.PathLike]) -> Dict[str, float]:
 
 def reencode_video_with_diff_fps(video_path: str, tmp_path: str,
                                  extraction_fps: float) -> str:
-    """ffmpeg CFR re-encode to ``extraction_fps`` (reference io.py:14-36)."""
+    """ffmpeg CFR re-encode to ``extraction_fps`` (reference io.py:14-36).
+
+    Raises ``RuntimeError`` when ffmpeg exits non-zero or writes no
+    output — the old ``subprocess.call`` ignored the exit code and the
+    missing file surfaced later as an opaque cv2 probe error; the caller
+    (``VideoLoader``) degrades to index resampling instead.
+    """
     ffmpeg = which_ffmpeg()
     assert ffmpeg != '', 'ffmpeg is not installed'
     os.makedirs(tmp_path, exist_ok=True)
-    new_path = os.path.join(tmp_path, f'{Path(video_path).stem}_new_fps.mp4')
+    new_path = reencode_out_path(video_path, tmp_path)
     cmd = [ffmpeg, '-hide_banner', '-loglevel', 'panic', '-y', '-i', video_path,
            '-filter:v', f'fps=fps={extraction_fps}', new_path]
-    subprocess.call(cmd)
+    rc = subprocess.call(cmd)
+    if rc != 0 or not os.path.isfile(new_path):
+        raise RuntimeError(
+            f'ffmpeg re-encode of {video_path} exited {rc} '
+            f'({"no output written" if not os.path.isfile(new_path) else new_path})')
     return new_path
 
 
@@ -208,8 +247,22 @@ class VideoLoader:
                 native_reencode = native_mod.available()
 
         self._index_map: Optional[np.ndarray] = None
+        self._decoder = None
         reencoded = None
-        if fps is not None and native_reencode and not use_ffmpeg:
+        if fps is not None and use_ffmpeg:
+            # a failed ffmpeg run (non-zero exit, no output) degrades to
+            # index resampling like a host without the binary would —
+            # the old code ignored the exit code and the missing output
+            # surfaced downstream as an opaque cv2 probe error
+            try:
+                reencoded = reencode_video_with_diff_fps(
+                    path, str(tmp_path), fps)
+            except (RuntimeError, OSError) as e:
+                from video_features_tpu.obs.events import event
+                event(logging.WARNING,
+                      f'ffmpeg fps re-encode failed ({e}); falling back '
+                      'to index resampling', video=str(path))
+        elif fps is not None and native_reencode:
             # The native encoder hard-rejects inputs it can't handle (e.g.
             # non-yuv420p); degrade to index resampling like a host with
             # neither backend would, rather than killing extraction.
@@ -225,12 +278,8 @@ class VideoLoader:
             self.path = path
             self.fps = src_fps
             self.num_frames = src_frames
-        elif use_ffmpeg or reencoded is not None:
-            if use_ffmpeg:
-                self.path = reencode_video_with_diff_fps(
-                    path, str(tmp_path), fps)
-            else:
-                self.path = reencoded
+        elif reencoded is not None:
+            self.path = reencoded
             self._tmp_file = self.path
             new_props = get_video_props(self.path)
             self.fps = new_props['fps']
@@ -290,22 +339,32 @@ class VideoLoader:
         return Cv2FrameDecoder(self.path)
 
     def _retimed_frames(self) -> Iterator[np.ndarray]:
-        """Decoded frames in output order, honoring the index map (dup/drop)."""
+        """Decoded frames in output order, honoring the index map (dup/drop).
+
+        try/finally, not an exhausted-path-only ``release()``: a consumer
+        that abandons iteration mid-stream (generator ``close()`` or GC)
+        must still release the decoder handle, or every early-stopped
+        video leaks a demuxer/codec context until interpreter exit.
+        """
         decoder = self._make_decoder()
-        if self._index_map is None:
-            for _, frame in decoder:
-                yield frame
-            return
-        # index map is sorted; stream the source once, duplicating/dropping.
-        pos = 0
-        n = len(self._index_map)
-        for src_idx, frame in decoder:
-            while pos < n and self._index_map[pos] == src_idx:
-                yield frame
-                pos += 1
-            if pos >= n:
-                decoder.release()
+        self._decoder = decoder
+        try:
+            if self._index_map is None:
+                for _, frame in decoder:
+                    yield frame
                 return
+            # index map is sorted; stream the source once, dup/dropping.
+            pos = 0
+            n = len(self._index_map)
+            for src_idx, frame in decoder:
+                while pos < n and self._index_map[pos] == src_idx:
+                    yield frame
+                    pos += 1
+                if pos >= n:
+                    return
+        finally:
+            decoder.release()
+            self._decoder = None
 
     def __next__(self):
         if self._exhausted:
@@ -347,12 +406,43 @@ class VideoLoader:
     def __len__(self) -> int:
         return self.num_frames
 
-    def __del__(self):
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Release the decoder handle and delete the re-encode temp file.
+
+        Idempotent and safe at any point of iteration; ``with
+        VideoLoader(...) as loader:`` and the decode-farm workers call it
+        deterministically instead of waiting on ``__del__`` (GC timing is
+        an unreliable place to hold codec contexts and tmp-file cleanup).
+        """
+        frames = getattr(self, '_frames', None)
+        if frames is not None and hasattr(frames, 'close'):
+            # runs the generator's finally → decoder.release()
+            frames.close()
+            self._frames = None
+        decoder = getattr(self, '_decoder', None)
+        if decoder is not None:
+            decoder.release()
+            self._decoder = None
         if getattr(self, '_tmp_file', None) and not self.keep_tmp:
             try:
                 os.remove(self._tmp_file)
             except OSError:
                 pass
+            self._tmp_file = None
+
+    def __enter__(self) -> 'VideoLoader':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def iter_frame_batches(loader: VideoLoader) -> Iterator[Tuple[np.ndarray, List[float], List[int]]]:

@@ -119,6 +119,7 @@ class RunManifest:
         self.videos: Dict[str, Dict[str, Any]] = {}
         self.stages: Dict[str, Dict[str, float]] = {}
         self.executables: Dict[str, Dict[str, Any]] = {}
+        self.farm: Dict[str, Any] = {}
         self._compile0 = _compile_snapshot()
         _install_compile_listener()
 
@@ -167,6 +168,15 @@ class RunManifest:
             self.executables.setdefault(identity, {}).update(
                 {k: _jsonable(v) for k, v in info.items()})
 
+    def note_farm(self, info: Dict[str, Any]) -> None:
+        """Record the decode farm's configuration + lifetime stats
+        (worker count, ring sizing, windows/bytes shipped, respawns) for
+        a farm-backed packed run; the section stays ``{}`` on in-process
+        runs. Later notes merge over earlier ones (a serve worker's farm
+        persists across request waves)."""
+        with self._lock:
+            self.farm.update({k: _jsonable(v) for k, v in info.items()})
+
     # -- publication ---------------------------------------------------------
 
     def document(self) -> Dict[str, Any]:
@@ -183,6 +193,7 @@ class RunManifest:
             videos = {p: dict(v) for p, v in self.videos.items()}
             stages = {k: dict(v) for k, v in self.stages.items()}
             executables = {k: dict(v) for k, v in self.executables.items()}
+            farm = dict(self.farm)
         outcomes: Dict[str, int] = {}
         for v in videos.values():
             outcomes[v['outcome']] = outcomes.get(v['outcome'], 0) + 1
@@ -199,6 +210,9 @@ class RunManifest:
             'stages': stages,
             'compile': compile_delta,
             'executables': executables,
+            # decode farm (farm/): config + lifetime stats for
+            # farm-backed runs, {} on in-process decode
+            'farm': farm,
         }
 
     def write(self, path: str) -> str:

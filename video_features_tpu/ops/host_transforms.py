@@ -1,0 +1,67 @@
+"""Host-side (PIL/NumPy) frame transforms — deliberately jax-free.
+
+These are the preprocessing primitives that run on decode threads and in
+the decode-farm worker PROCESSES (``farm/``): a farm worker imports this
+module (plus cv2/PIL) and nothing else, so spawning a worker never pays
+the jax/XLA import or risks initializing a backend in a child process.
+``ops.transforms`` re-exports everything here, so existing device-side
+import sites are unchanged.
+
+Numerics: exact parity with the reference's PIL-based ``ResizeImproved``
+and torchvision's ``CenterCrop`` — see the per-function notes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def pil_edge_resize_geometry(h: int, w: int, size: int,
+                             to_smaller_edge: bool = True):
+    """(oh, ow) of a PIL edge resize, or None when it no-ops — the ONE
+    home of the edge-selection + ``int(size * other/edge)`` truncation
+    arithmetic (reference ResizeImproved, models/transforms.py:191-242),
+    shared by :func:`resize_pil` and the device-resize path
+    (extract/i3d.py)."""
+    if (w <= h and w == size) or (h <= w and h == size):
+        return None
+    if (w < h) == to_smaller_edge:
+        return int(size * h / w), size
+    return size, int(size * w / h)
+
+
+def resize_pil(frame: np.ndarray, size: int,
+               to_smaller_edge: bool = True,
+               interpolation: str = 'bilinear') -> np.ndarray:
+    """Host-side PIL edge resize, aspect preserved.
+
+    Exact parity with the reference's PIL-based `ResizeImproved`
+    (reference models/transforms.py:191-242): no-op when the matched edge
+    already equals ``size``; the scaled side uses ``int(size * other/edge)``
+    (truncation, PIL convention). ``interpolation='bicubic'`` gives the
+    torchvision Resize(BICUBIC) used by CLIP (reference clip_src/clip.py
+    transform).
+    """
+    from PIL import Image
+
+    modes = {'bilinear': Image.BILINEAR, 'bicubic': Image.BICUBIC}
+    h, w = frame.shape[:2]
+    geom = pil_edge_resize_geometry(h, w, size, to_smaller_edge)
+    if geom is None:
+        return frame
+    oh, ow = geom
+    img = Image.fromarray(frame)
+    return np.asarray(img.resize((ow, oh), modes[interpolation]))
+
+
+def short_side_resize_pil(frame: np.ndarray, size: int) -> np.ndarray:
+    """min(H, W) → ``size`` via PIL bilinear (see :func:`resize_pil`)."""
+    return resize_pil(frame, size, to_smaller_edge=True)
+
+
+def center_crop_host(frame: np.ndarray, size: int) -> np.ndarray:
+    """Host-side HWC center crop with torchvision's round-to-even offsets
+    (the reference's CenterCrop behavior across all frame-wise extractors)."""
+    h, w = frame.shape[:2]
+    i = int(round((h - size) / 2.0))
+    j = int(round((w - size) / 2.0))
+    return frame[i:i + size, j:j + size]

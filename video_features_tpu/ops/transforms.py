@@ -222,53 +222,11 @@ def flow_to_uint8_levels(x: Array, bound: float = 20.0) -> Array:
     return jnp.round(128.0 + x * (255.0 / (2.0 * bound)))
 
 
-def pil_edge_resize_geometry(h: int, w: int, size: int,
-                             to_smaller_edge: bool = True):
-    """(oh, ow) of a PIL edge resize, or None when it no-ops — the ONE
-    home of the edge-selection + ``int(size * other/edge)`` truncation
-    arithmetic (reference ResizeImproved, models/transforms.py:191-242),
-    shared by :func:`resize_pil` and the device-resize path
-    (extract/i3d.py)."""
-    if (w <= h and w == size) or (h <= w and h == size):
-        return None
-    if (w < h) == to_smaller_edge:
-        return int(size * h / w), size
-    return size, int(size * w / h)
-
-
-def resize_pil(frame: np.ndarray, size: int,
-               to_smaller_edge: bool = True,
-               interpolation: str = 'bilinear') -> np.ndarray:
-    """Host-side PIL edge resize, aspect preserved.
-
-    Exact parity with the reference's PIL-based `ResizeImproved`
-    (reference models/transforms.py:191-242): no-op when the matched edge
-    already equals ``size``; the scaled side uses ``int(size * other/edge)``
-    (truncation, PIL convention). ``interpolation='bicubic'`` gives the
-    torchvision Resize(BICUBIC) used by CLIP (reference clip_src/clip.py
-    transform).
-    """
-    from PIL import Image
-
-    modes = {'bilinear': Image.BILINEAR, 'bicubic': Image.BICUBIC}
-    h, w = frame.shape[:2]
-    geom = pil_edge_resize_geometry(h, w, size, to_smaller_edge)
-    if geom is None:
-        return frame
-    oh, ow = geom
-    img = Image.fromarray(frame)
-    return np.asarray(img.resize((ow, oh), modes[interpolation]))
-
-
-def short_side_resize_pil(frame: np.ndarray, size: int) -> np.ndarray:
-    """min(H, W) → ``size`` via PIL bilinear (see :func:`resize_pil`)."""
-    return resize_pil(frame, size, to_smaller_edge=True)
-
-
-def center_crop_host(frame: np.ndarray, size: int) -> np.ndarray:
-    """Host-side HWC center crop with torchvision's round-to-even offsets
-    (the reference's CenterCrop behavior across all frame-wise extractors)."""
-    h, w = frame.shape[:2]
-    i = int(round((h - size) / 2.0))
-    j = int(round((w - size) / 2.0))
-    return frame[i:i + size, j:j + size]
+# Host-side (PIL/NumPy) transforms live in the jax-free
+# ``ops.host_transforms`` module so decode-farm worker processes can
+# import them without pulling jax; re-exported here so every existing
+# device-side import site keeps working.
+from video_features_tpu.ops.host_transforms import (  # noqa: F401,E402
+    center_crop_host, pil_edge_resize_geometry, resize_pil,
+    short_side_resize_pil,
+)

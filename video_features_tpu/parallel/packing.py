@@ -93,7 +93,7 @@ class VideoTask:
 
     __slots__ = ('path', 'video_id', 'rows', 'meta_rows', 'info',
                  'emitted', 'done', 'exhausted', 'failed', 'skipped',
-                 'cached', 'out_root')
+                 'cached', 'out_root', 'finalized')
 
     def __init__(self, path: str, video_id: int = -1,
                  out_root: Optional[str] = None) -> None:
@@ -112,6 +112,11 @@ class VideoTask:
         # from the cache rather than found on disk) — consumers that care
         # about the difference (serve per-video states, metrics) read it
         self.cached = False
+        # terminal: finalize() ran (saved/failed/skipped, cache published,
+        # on_video_done fired). The decode farm's dedupe reads it — a
+        # parked duplicate waits for its twin's publish, never a
+        # mid-flight state.
+        self.finalized = False
 
 
 def packed_batches(windows: Iterable[tuple], batch: int,
@@ -215,7 +220,8 @@ def run_packed(ex, video_paths: Iterable,
                decode_ahead: int = 2,
                on_video_done: Optional[Callable] = None,
                max_pool_age_s: Optional[float] = None,
-               inflight: Optional[int] = None) -> None:
+               inflight: Optional[int] = None,
+               decode_workers: Optional[int] = None) -> None:
     """Drive one extractor over the whole worklist, batch-major.
 
     ``video_paths`` yields ``str`` paths, pre-built :class:`VideoTask`
@@ -261,6 +267,16 @@ def run_packed(ex, video_paths: Iterable,
     (e.g. a geometry that won't compile) and a sync-time error (an
     asynchronously raised execution fault surfacing in ``fetch_outputs``)
     each doom exactly the videos of the batch that produced them.
+
+    ``decode_workers`` (default: the extractor's ``decode_workers``
+    attribute) selects the INPUT side's parallelism: ``1`` is the
+    in-process cross-video windower exactly as before; ``>1`` routes
+    decode through the multi-process decode farm (``farm/``) — N worker
+    processes running the extractor's published decode recipe, feeding
+    this scheduler over shared-memory rings with the same stream
+    contract, per-video fault isolation, and byte-identical outputs.
+    Falls back to in-process decode (with a structured warning) when the
+    extractor has no farm recipe or the host can't spawn workers.
     """
     from video_features_tpu.extract.streaming import (
         stream_windows_across_videos, transfer_batches,
@@ -296,12 +312,14 @@ def run_packed(ex, video_paths: Iterable,
                                  request_id=_request_id(task))
             yield task
 
-    def open_windows(task: VideoTask):
+    def admit(task: VideoTask) -> bool:
         # The resume check runs here — lazily, as the decode side reaches
         # each video — NOT as an up-front scan: is_already_exist loads
         # every output file, and an eager pass over a mostly-done 20K
         # worklist would block for minutes before the first batch packs.
         # Amortized across the run it costs what the per-video loop paid.
+        # (The farm's dispatcher keeps the same property via its bounded
+        # assignment runahead.)
         # the output_path kwarg is passed only when a task carries a
         # per-request root: hooks monkeypatched/overridden with the
         # classic (self, video_path) signature keep working for CLI runs
@@ -310,7 +328,7 @@ def run_packed(ex, video_paths: Iterable,
                   else ex.is_already_exist(task.path))
         if exists:
             task.skipped = True
-            return iter(())
+            return False
         # content-addressed cache: a hit materializes this video's outputs
         # right here and drops it from batch planning entirely — it never
         # decodes, never occupies batch slots, and finalizes through the
@@ -319,6 +337,11 @@ def run_packed(ex, video_paths: Iterable,
                 ex.cache_fetch(task.path, output_path=task.out_root):
             task.skipped = True
             task.cached = True
+            return False
+        return True
+
+    def open_windows(task: VideoTask):
+        if not admit(task):
             return iter(())
         return ex.packed_windows(task)
 
@@ -356,6 +379,7 @@ def run_packed(ex, video_paths: Iterable,
                                  stage='save')
         finally:
             t.rows = {}               # free feature memory as we go
+            t.finalized = True        # the farm's dedupe unparks twins now
             from video_features_tpu.utils.output import ACTION_TO_EXT
             outcome = ('failed' if t.failed else 'cached' if t.cached
                        else 'skipped' if t.skipped
@@ -388,7 +412,70 @@ def run_packed(ex, video_paths: Iterable,
                 f'packed scheduler lost windows for {t.path}: '
                 f'{t.done}/{t.emitted} scattered, exhausted={t.exhausted}')
 
-    source = stream_windows_across_videos(task_stream(), open_windows)
+    # -- input side: in-process windower, or the decode farm ----------------
+    # decode_workers > 1 routes the decode+preprocess work through N
+    # worker PROCESSES (farm/) feeding this scheduler over shared-memory
+    # rings — same stream contract ((task, window, meta) + FLUSH/NUDGE,
+    # per-video fault isolation, task accounting), so everything below
+    # this point is identical on both paths and outputs stay
+    # byte-identical at any worker count.
+    n_decode = max(int(decode_workers if decode_workers is not None
+                       else getattr(ex, 'decode_workers', 1) or 1), 1)
+    farm = None
+    if n_decode > 1:
+        from video_features_tpu.farm import farm_available
+        recipe = None
+        recipe_err: Optional[BaseException] = None
+        try:
+            recipe = ex.farm_recipe()
+        except Exception as e:
+            recipe_err = e                     # a BROKEN recipe, not a
+            recipe = None                      # family without one
+        if recipe is None or not farm_available():
+            import logging as _logging
+
+            from video_features_tpu.obs.events import event
+            event(_logging.WARNING,
+                  f'decode_workers={n_decode} requested but '
+                  + (f'building its decode recipe failed '
+                     f'({type(recipe_err).__name__}: {recipe_err})'
+                     if recipe_err is not None else
+                     'this extractor publishes no decode recipe'
+                     if recipe is None else
+                     'the host cannot spawn shared-memory workers')
+                  + ' — running in-process decode', subsystem='farm')
+        else:
+            from video_features_tpu.farm import DecodeFarm, FarmUnavailable
+            ring_mb = int(getattr(ex, 'decode_farm_ring_mb', 64) or 64)
+            farm = DecodeFarm(
+                recipe, workers=n_decode,
+                ring_bytes=ring_mb * (1 << 20), tracer=ex.tracer,
+                cache_key_fn=(ex._video_cache_key
+                              if getattr(ex, 'cache', None) is not None
+                              else None))
+            # start eagerly: a RUNTIME start failure (SHM creation on a
+            # full /dev/shm, a spawn refused by the container) must
+            # degrade to in-process decode like every other farm
+            # unavailability, not abort the whole worklist run
+            try:
+                farm.start()
+            except FarmUnavailable as e:
+                import logging as _logging
+
+                from video_features_tpu.obs.events import event
+                event(_logging.WARNING,
+                      f'decode_workers={n_decode} requested but {e} '
+                      '— running in-process decode', subsystem='farm')
+                farm = None
+            else:
+                # live handle for the serve metrics surface (vft_farm_*);
+                # stats stay readable after the run ends
+                ex._farm = farm
+
+    if farm is not None:
+        source = farm.stream(task_stream(), admit)
+    else:
+        source = stream_windows_across_videos(task_stream(), open_windows)
 
     def timed_source():
         # decode (and host preprocessing) runs on the prefetch producer
@@ -421,7 +508,10 @@ def run_packed(ex, video_paths: Iterable,
                               request_id=_request_id(item[0]))
             yield item
 
-    timed = timed_source() if ex.tracer.enabled else source
+    # the farm traces per-worker 'decode' spans from the workers' own
+    # timings; the consumer-side wrapper would only launder queue waits
+    # into decode time, so it stays on the in-process path
+    timed = timed_source() if ex.tracer.enabled and farm is None else source
     ahead = prefetch_across_videos(timed, decode_ahead * batch)
 
     # the in-flight queue: dispatched-but-unmaterialized batches, oldest
@@ -550,6 +640,14 @@ def run_packed(ex, video_paths: Iterable,
             if cost:
                 info.update(cost)
             manifest.note_executable(identity, info)
+
+    if farm is not None and manifest is not None:
+        # farm config + lifetime stats land in the run manifest (the
+        # 'farm' section) so a farm-backed BENCH/run record names the
+        # decode parallelism that produced it
+        manifest.note_farm({'decode_workers': farm.n_workers,
+                            'ring_bytes_per_worker': farm.ring_bytes,
+                            'stats': farm.stats()})
 
     if ex.tracer.enabled and ex.tracer.report():
         if manifest is not None:

@@ -57,4 +57,6 @@ def create_extractor(args: 'Config') -> 'BaseExtractor':
         extractor.configure_cache(args)
         # flight recorder (obs/): trace_out / manifest_out knobs
         extractor.configure_obs(args)
+        # decode farm (farm/): decode_workers / decode_farm_ring_mb
+        extractor.configure_farm(args)
     return extractor

@@ -103,6 +103,7 @@ def build_metrics(started_at: float,
                   stage_reports: Dict[str, Dict],
                   cache_stats: Optional[Dict[str, Any]] = None,
                   inflight_batches: int = 0,
+                  farm_stats: Optional[Dict[str, Any]] = None,
                   ) -> Dict[str, Any]:
     """Assemble the one metrics document. ``stage_reports`` maps a
     human-readable pool-entry label → that entry's ``Tracer.report()``;
@@ -111,7 +112,10 @@ def build_metrics(started_at: float,
     (``cache.store.merge_cache_stats`` over every cache dir requests have
     named) — always present in the document so scrapers see hit/miss/
     bytes-saved counters next to the warm-pool hit rate even before the
-    first cache-enabled request."""
+    first cache-enabled request. ``farm_stats`` is the merged decode-farm
+    view (``farm.merge_farm_stats`` over every warm worker's farm) —
+    likewise always present (all-zero before the first farm-backed
+    request)."""
     doc: Dict[str, Any] = {
         'uptime_s': round(time.monotonic() - started_at, 3),
         'queue': {'depth': queue_depth, 'capacity': queue_capacity,
@@ -125,6 +129,10 @@ def build_metrics(started_at: float,
         from video_features_tpu.cache.store import merge_cache_stats
         cache_stats = merge_cache_stats(())
     doc['cache'] = cache_stats
+    if farm_stats is None:
+        from video_features_tpu.farm.farm import merge_farm_stats
+        farm_stats = merge_farm_stats(())
+    doc['farm'] = farm_stats
     doc.update(request_stats.snapshot())
     doc['stages'] = {label: rep for label, rep in stage_reports.items()}
     doc['stages_merged'] = merge_reports(stage_reports.values())
@@ -161,6 +169,11 @@ def prometheus_text(doc: Dict[str, Any],
         if isinstance(value, (int, float)) and not isinstance(value, bool):
             g(f'vft_cache_{key}',
               'content-addressed feature cache accounting').set(value)
+    for key, value in (doc.get('farm') or {}).items():
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            g(f'vft_farm_{key}',
+              'decode farm accounting (merged across warm workers)'
+              ).set(value)
     for stage, rep in (doc.get('stages_merged') or {}).items():
         # gauge family names deliberately avoid the _total suffix
         # (reserved for counter semantics): these mirror a point-in-time

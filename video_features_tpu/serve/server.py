@@ -81,6 +81,10 @@ _KEY_EXCLUDE = frozenset({
     # byte-identical by contract) — two requests differing only in
     # inflight must share one warm entry; the FIRST builder's depth wins
     'inflight',
+    # input-side decode parallelism (decode farm): worker processes and
+    # ring sizing change where decode runs, never the bytes produced —
+    # same policy as inflight, the FIRST builder's farm settings win
+    'decode_workers', 'decode_farm_ring_mb',
 })
 
 
@@ -695,16 +699,25 @@ class ExtractionServer:
             inflight_batches = sum(
                 int(getattr(w.ex, '_inflight_now', 0) or 0)
                 for w in self.pool.entries() + self._retired)
+            # decode-farm view: each farm-backed warm worker keeps a
+            # live DecodeFarm handle on its extractor; the merged stats
+            # (busy workers, ring bytes, respawns, dedupes) are the
+            # 'farm' section / vft_farm_* families
+            farms = [w.ex._farm.stats()
+                     for w in self.pool.entries() + self._retired
+                     if getattr(w.ex, '_farm', None) is not None]
         pool_stats = self.pool.stats()
         # builds ≤ misses: concurrent cold submits for one key all count
         # misses but transplant exactly once (the per-key build lock)
         pool_stats['builds'] = builds
         from video_features_tpu.cache.store import merge_cache_stats
+        from video_features_tpu.farm.farm import merge_farm_stats
         return metrics_mod.build_metrics(
             self._started_at, depth, self.queue_depth, draining,
             pool_stats, self.stats, reports,
             cache_stats=merge_cache_stats(c.stats() for c in caches),
-            inflight_batches=inflight_batches)
+            inflight_batches=inflight_batches,
+            farm_stats=merge_farm_stats(farms))
 
     # -- completion callbacks (worker threads) -------------------------------
 
