@@ -40,7 +40,11 @@ loop (``inflight=2``: batch k-1's readback + scatter + save overlap the
 device computing batch k) — the packed/async delta isolates the
 readback-overlap win, every rung records its ``inflight`` depth, and
 ``worklist_packed_batch_occupancy`` records how full the compiled step
-actually ran.
+actually ran. ``worklist_mesh_clips_per_sec`` repeats the async rung
+with the device loop mesh-sharded over N chips (``mesh_devices=N``:
+batches plan at capacity × N and shard over the data axis,
+parallel/mesh.py) — the pod-scale rung, expected to scale
+near-linearly with ``worklist_mesh_devices``.
 
 The serving rung (``serve_*``): the same worklist submitted as dynamic
 per-video requests over the warm-pool daemon's socket (serve/) —
@@ -601,6 +605,37 @@ def run() -> dict:
                                 wrec_farm['batch_occupancy']
                     except Exception as e:
                         rungs['worklist_farm_error'] = \
+                            f'{type(e).__name__}: {e}'
+                # The mesh rung (parallel/mesh.py): same async loop,
+                # same in-process decode, but the packed batches plan at
+                # capacity × N and shard over the data axis of an
+                # N-chip mesh — serve/worklist throughput should scale
+                # near-linearly with N, with byte-identical outputs
+                # (tests/test_mesh_packed.py pins parity). On a
+                # single-device host the rung runs at N=1 and the
+                # worklist_mesh_devices metadata says so; CPU CI forces
+                # 2 virtual host devices to exercise the sharded path.
+                if wl_paths is not None:
+                    try:
+                        from tools.worklist_bench import bench_mesh_devices
+                        wrec_mesh = run_worklist(
+                            wl_feature, wl_paths,
+                            os.path.join(tmp_dir, 'mesh'),
+                            tmp_dir, platform, batch_size=min(batch, 8),
+                            stack=stack, precision=precision, packed=True,
+                            inflight=2, decode_workers=1,
+                            mesh_devices=bench_mesh_devices())
+                        rungs[f'worklist_mesh_clips_per_sec_{precision}'] \
+                            = wrec_mesh['clips_per_sec']
+                        rungs['worklist_mesh_devices'] = \
+                            wrec_mesh['mesh_devices']
+                        stage_reports[f'worklist_mesh_{precision}'] = \
+                            wrec_mesh['stages']
+                        if wrec_mesh.get('batch_occupancy') is not None:
+                            rungs['worklist_mesh_batch_occupancy'] = \
+                                wrec_mesh['batch_occupancy']
+                    except Exception as e:
+                        rungs['worklist_mesh_error'] = \
                             f'{type(e).__name__}: {e}'
             # The serving rung (serve/): the same worklist content
             # submitted as dynamic per-video requests against the

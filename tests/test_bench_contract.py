@@ -73,12 +73,17 @@ def test_bench_worklist_async_rung_emits_keys():
                       'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
                       'BENCH_WORKLIST': '1', 'BENCH_SERVE': '0',
                       'BENCH_CACHE': '0',
+                      # pin the mesh rung's width: the conftest's forced
+                      # 8 host devices would auto-detect to an 8-wide
+                      # mesh, pointlessly heavy for a contract smoke
+                      'BENCH_MESH_DEVICES': '2',
                       # rung KEYS are family-independent; resnet keeps
                       # the CPU smoke off the RAFT-on-CPU cost cliff
                       'BENCH_WORKLIST_FEATURE': 'resnet'})
     rungs = rec['rungs']
     for err in ('worklist_error', 'worklist_packed_error',
-                'worklist_async_error', 'worklist_farm_error'):
+                'worklist_async_error', 'worklist_farm_error',
+                'worklist_mesh_error'):
         assert err not in rungs, rungs.get(err)
     assert any(k.startswith('worklist_clips_per_sec') for k in rungs)
     assert any(k.startswith('worklist_packed_clips_per_sec')
@@ -87,10 +92,14 @@ def test_bench_worklist_async_rung_emits_keys():
     # the decode-farm rung (farm/): same async loop, decode in worker
     # PROCESSES over shared-memory rings
     assert any(k.startswith('worklist_farm_clips_per_sec') for k in rungs)
+    # the mesh rung (parallel/mesh.py): the async loop's batches planned
+    # at capacity × ndev and sharded over the data axis
+    assert any(k.startswith('worklist_mesh_clips_per_sec') for k in rungs)
     # rung metadata: which device loop / input side produced each number
     assert rungs['worklist_packed_inflight'] == 1
     assert rungs['worklist_async_inflight'] == 2
     assert rungs['worklist_farm_decode_workers'] >= 2
+    assert rungs['worklist_mesh_devices'] == 2
     # the farm rung's stage report carries the workers' own decode spans
     farm_rep = next(v for k, v in rec['stage_reports'].items()
                     if k.startswith('worklist_farm'))

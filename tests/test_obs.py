@@ -499,14 +499,16 @@ def test_bench_diff_latency_improvement_is_not_regression(tmp_path):
 # -- schema contracts --------------------------------------------------------
 
 TRACER_RECORD_KEYS = {'count', 'total_s', 'mean_s', 'max_s', 'first_s',
-                      'ramp', 'occupancy', 'occ_valid', 'occ_capacity'}
+                      'ramp', 'occupancy', 'occ_valid', 'occ_capacity',
+                      # mesh-sharded batches: per-device slot ledger
+                      'occ_device'}
 METRICS_DOC_KEYS = {'uptime_s', 'queue', 'warm_pool', 'cache', 'farm',
                     'requests', 'latency', 'stages', 'stages_merged',
                     'inflight_batches'}
 TRACE_EVENT_KEYS = {'name', 'ph', 'ts', 'dur', 'pid', 'tid', 'args', 's'}
 MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
                  'config', 'fingerprints', 'videos', 'outcomes', 'stages',
-                 'compile', 'executables', 'farm'}
+                 'compile', 'executables', 'farm', 'mesh'}
 
 
 CANONICAL_STAGES = {'decode', 'decode+preprocess', 'queue_idle', 'pack',

@@ -14,9 +14,9 @@ Direction-aware: throughput-like rungs (``*clips_per_sec*``,
 rungs (error strings) and rungs present on only one side are listed but
 never counted as regressions — an absent rung usually means a different
 BENCH_* env, not a slowdown. Config-metadata rungs (``*_inflight``,
-``*_decode_workers`` — they name the loop configuration a number ran
-under) are flagged ``config-changed`` when they differ, never counted
-as regressions.
+``*_decode_workers``, ``*_mesh_devices`` — they name the loop
+configuration a number ran under) are flagged ``config-changed`` when
+they differ, never counted as regressions.
 
 ``--fail-on-regression PCT`` exits 1 if any shared numeric rung
 regressed by more than PCT percent (CI gate); exit 0 otherwise; exit 2
@@ -32,9 +32,11 @@ from typing import Any, Dict, List, Optional, Tuple
 LOWER_IS_BETTER_MARKERS = ('latency', 'resume_pass')
 
 # rungs that NAME the loop configuration a number was measured under
-# (async depth, decode-farm worker count) rather than measuring anything
-# — a change there is a config change to flag, never a perf regression
-CONFIG_METADATA_SUFFIXES = ('_inflight', '_decode_workers')
+# (async depth, decode-farm worker count, mesh width) rather than
+# measuring anything — a change there is a config change to flag, never
+# a perf regression
+CONFIG_METADATA_SUFFIXES = ('_inflight', '_decode_workers',
+                            '_mesh_devices')
 
 
 def is_config_metadata(name: str) -> bool:

@@ -26,11 +26,15 @@ step so every delta is attributable: ``inflight=1 decode_workers=1``
 (the synchronous single-process baseline), ``inflight=2`` (the
 deferred-D2H async device loop), and ``inflight=2 decode_workers=N``
 (the multi-process decode farm, farm/ — N = ``BENCH_DECODE_WORKERS``,
-default 4 on accelerators / 2 on CPU), each with its batch-occupancy
+default 4 on accelerators / 2 on CPU), then ``mesh_devices=N`` (the
+mesh-sharded device loop: batches plan at capacity × N and shard over
+N chips — ``BENCH_MESH_DEVICES``, default every local device), each
+with its batch-occupancy
 figure; bench.py embeds them as the ``worklist_clips_per_sec``,
 ``worklist_packed_clips_per_sec``, ``worklist_async_clips_per_sec``,
-and ``worklist_farm_clips_per_sec`` rungs. Every record carries the
-``inflight`` depth and ``decode_workers`` count it ran at.
+``worklist_farm_clips_per_sec``, and ``worklist_mesh_clips_per_sec``
+rungs. Every record carries the ``inflight`` depth, ``decode_workers``
+count, and resolved ``mesh_devices`` width it ran at.
 """
 from __future__ import annotations
 
@@ -56,6 +60,20 @@ def bench_decode_workers(on_accel: bool) -> int:
                               4 if on_accel else 2))
 
 
+def bench_mesh_devices() -> int:
+    """The ONE place the ``worklist_mesh_*`` rung's device count comes
+    from: ``BENCH_MESH_DEVICES`` override, else every local device (the
+    near-linear-scaling headline wants the whole slice; CPU CI forces 2
+    virtual host devices via ``--xla_force_host_platform_device_count``).
+    Returns at least 1 — on a single-device host the rung still runs,
+    its metadata naming the degenerate width."""
+    n = int(os.environ.get('BENCH_MESH_DEVICES', 0))
+    if n == 0:
+        import jax
+        n = len(jax.local_devices())
+    return max(n, 1)
+
+
 def make_worklist(tmp_dir: str, n_videos: int, seconds: float) -> list:
     """N distinct-stem byte-copies of the source clip.
 
@@ -79,7 +97,7 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
                  tmp_dir: str, platform: str, batch_size: int = 8,
                  stack: int = 16, precision: str = None,
                  packed: bool = False, inflight: int = None,
-                 decode_workers: int = None):
+                 decode_workers: int = None, mesh_devices: int = None):
     """One timed pass of the real worklist loop; returns the record.
 
     ``packed=False`` times the per-video loop cli.py runs by default;
@@ -91,7 +109,10 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
     resolved value rides in the record so every rung names the loop it
     measured. ``decode_workers`` pins the input side (1 = in-process
     decode; >1 on the packed path = the multi-process decode farm,
-    farm/) and likewise rides in the record. The extractor is created
+    farm/) and likewise rides in the record. ``mesh_devices`` pins the
+    packed loop's data-parallel mesh width (1 = single chip; N shards
+    capacity × N batches over N chips, parallel/mesh.py) — the RESOLVED
+    width rides in the record. The extractor is created
     once (matching cli.py) so compile caches, weights, and the decode
     service amortize across the worklist the way they do in
     production."""
@@ -119,6 +140,8 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         overrides['inflight'] = int(inflight)
     if decode_workers is not None:
         overrides['decode_workers'] = int(decode_workers)
+    if mesh_devices is not None:
+        overrides['mesh_devices'] = int(mesh_devices)
     args = load_config(feature_type, overrides=overrides)
     ex = create_extractor(args)
 
@@ -186,6 +209,10 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         # the input side's decode parallelism (1 = in-process; >1 packed
         # = the decode farm) — rung metadata like inflight
         'decode_workers': int(args.get('decode_workers', 1)),
+        # the RESOLVED mesh width the packed loop sharded over (1 =
+        # single chip; mesh_devices=0 auto-detect resolves here) —
+        # config metadata naming the device set behind the number
+        'mesh_devices': int(getattr(ex, '_packed_mesh_ndev', 1) or 1),
         'n_videos': len(paths),
         'videos_per_min': round(len(paths) / elapsed * 60, 3),
         'clips_total': int(clips),
@@ -231,7 +258,7 @@ def main() -> int:
         # families with packed support run it — an unsupported feature
         # must still emit its per-video record, not crash the tool
         from video_features_tpu.registry import PACKED_FEATURES
-        rec_packed = rec_async = rec_farm = None
+        rec_packed = rec_async = rec_farm = rec_mesh = None
         if feature_type in PACKED_FEATURES:
             # the packed ladder pins ONE knob per record so each delta
             # is attributable: sync in-process → async in-process →
@@ -257,8 +284,18 @@ def main() -> int:
                                     platform, batch_size=batch,
                                     stack=stack, packed=True, inflight=2,
                                     decode_workers=n_decode)
+            # ...and the mesh record shards the async loop's batches
+            # over N chips (capacity × N planning, parallel/mesh.py) —
+            # the pod-scale rung; outputs stay byte-identical
+            # (tests/test_mesh_packed.py)
+            rec_mesh = run_worklist(feature_type, paths,
+                                    os.path.join(td, 'packed_mesh'), td,
+                                    platform, batch_size=batch,
+                                    stack=stack, packed=True, inflight=2,
+                                    decode_workers=1,
+                                    mesh_devices=bench_mesh_devices())
     print(json.dumps(rec), file=stdout)
-    for extra in (rec_packed, rec_async, rec_farm):
+    for extra in (rec_packed, rec_async, rec_farm, rec_mesh):
         if extra is not None:
             print(json.dumps(extra), file=stdout)
     return 0

@@ -52,6 +52,12 @@ CONFIG_KEY_EXCLUDE = frozenset({
     'device', 'device_ids', 'data_parallel', 'multihost',
     'coordinator_address', 'num_processes', 'process_id',
     'pack_across_videos', 'pack_decode_ahead', 'decode_workers',
+    # mesh-sharded packed execution: how many chips the batch spreads
+    # over, never what each row computes (outputs are byte-identical at
+    # any device count by contract — tests/test_mesh_packed.py pins it).
+    # NOTE: mesh_devices stays IN the serve pool key (serve/server.py)
+    # because it changes the compiled program's sharding.
+    'mesh_devices',
     # decode-farm transport sizing: where decoded bytes travel, never
     # what they are (farm outputs are byte-identical by contract —
     # tests/test_farm.py pins it)

@@ -48,6 +48,16 @@ PIPELINE_DEFAULTS: Dict[str, Any] = {
     # (today's behavior); each extra unit keeps one more output batch
     # resident on device. Outputs are byte-identical at any depth.
     'inflight': 2,
+    # mesh-sharded packed execution (parallel/mesh.py): the packed
+    # worklist / serve device loop plans batches at capacity × ndev and
+    # shards each stacked batch over the data axis of an N-device mesh
+    # (params replicated per chip). 1 = single-device (today's loop);
+    # 0 = auto-detect every local device of the platform; N = exactly N
+    # chips (a clear error if fewer exist). Outputs are byte-identical
+    # at any device count; per-video fault isolation is unchanged. The
+    # knob only drives the PACKED paths (pack_across_videos / serve) —
+    # the per-video loop keeps data_parallel for in-graph DP.
+    'mesh_devices': 1,
 }
 
 # -- decode farm (farm/; docs/decode_farm.md) --------------------------------
@@ -273,6 +283,24 @@ def sanity_check(args: Config) -> None:
             raise ValueError(
                 f'inflight must be >= 1 (1 = synchronous device loop); '
                 f'got {args["inflight"]}')
+
+    # mesh-sharded packed execution: device count must be a non-negative
+    # int (0 = auto-detect, 1 = single device). data_parallel owns its
+    # own mesh (per-extractor in-graph DP with batch rounding), so the
+    # two knobs must not both claim the device set — data_parallel wins
+    # as the legacy spelling and mesh_devices degrades with a warning.
+    if args.get('mesh_devices') is not None:
+        args['mesh_devices'] = int(args['mesh_devices'])
+        if args['mesh_devices'] < 0:
+            raise ValueError(
+                'mesh_devices must be >= 0 (0 = auto-detect local '
+                f'devices, 1 = single device); got {args["mesh_devices"]}')
+        if args['mesh_devices'] != 1 and args.get('data_parallel'):
+            warnings.warn(
+                'mesh_devices and data_parallel both requested — '
+                'data_parallel already owns the device mesh, so '
+                'mesh_devices is ignored (running mesh_devices=1)')
+            args['mesh_devices'] = 1
 
     # decode-farm knobs (farm/): worker count and per-worker SHM ring
     # size must be positive ints. ValueError, not assert — survives -O.

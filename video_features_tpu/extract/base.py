@@ -84,6 +84,17 @@ class BaseExtractor:
         self.profile = profile
         self.tracer = Tracer(enabled=True) if profile else NULL_TRACER
         self._mesh = None  # set by _ensure_mesh for data_parallel extractors
+        # mesh-sharded packed execution (mesh_devices=): resolved device
+        # count for the packed loop's data-parallel mesh; 1 = today's
+        # single-device loop. configure_mesh resolves 0 (auto) at build
+        # time; extractors constructed directly stay single-device.
+        self.mesh_devices = 1
+        self._packed_mesh_ndev = 1
+        # serve placement (serve/pool.DevicePlacer): the specific local
+        # chip(s) this extractor is resident on — place_on pins them
+        # right after build, before any batch flows; None = default
+        # (first local device / every local device for a packed mesh)
+        self._placement_devices = None
         # content-addressed feature cache + run identity — attached by
         # configure_cache (registry.create_extractor calls it with the
         # full merged config); None = legacy behavior everywhere
@@ -144,6 +155,8 @@ class BaseExtractor:
         and thread-safe), which is how extractors overlap the H2D transfer
         of batch k+1 with the device computing batch k."""
         if self._mesh is not None:
+            from video_features_tpu.parallel.mesh import require_shardable
+            require_shardable(len(batch), self._mesh)
             return self._put_batch(batch)
         import jax
         return jax.device_put(batch, self._device)
@@ -163,6 +176,91 @@ class BaseExtractor:
             self.device, getattr(self, batch_attr), self.params)
         self._mesh, self.params, self._put_batch = mesh, params, put
         setattr(self, batch_attr, global_batch)
+
+    # -- mesh-sharded packed execution (mesh_devices=) ----------------------
+
+    def configure_mesh(self, args) -> None:
+        """Resolve the ``mesh_devices`` knob against this host's local
+        devices: ``0`` auto-detects every local device of the extractor's
+        platform, an over-ask raises a clear error at BUILD time (a serve
+        submit then fails with 'extractor build failed', not a worker
+        crash mid-batch). Called by ``registry.create_extractor``;
+        extractors constructed directly stay single-device."""
+        n = args.get('mesh_devices', 1)
+        n = 1 if n is None else int(n)
+        if n != 1:
+            from video_features_tpu.utils.device import jax_devices_all
+            local = jax_devices_all(self.device)
+            if n == 0:
+                n = len(local)
+            elif n > len(local):
+                raise ValueError(
+                    f'mesh_devices={n} but this host has only '
+                    f'{len(local)} local {local[0].platform} device(s) — '
+                    'lower mesh_devices (or 0 to auto-detect)')
+        self.mesh_devices = max(n, 1)
+
+    # names of extra device-committed array attributes (beyond
+    # ``params``) that ``place_on`` must migrate with the extractor —
+    # subclasses that commit auxiliary buffers at build time (vggish's
+    # PCA matrices) list them here, or a placed entry would feed a jit
+    # call operands committed to two different chips
+    _device_buffer_attrs: tuple = ()
+
+    def place_on(self, devices) -> None:
+        """Pin this extractor's residency to specific local chip(s) —
+        the serve placement layer calls it right after build, BEFORE any
+        batch flows, so different model families can be resident on
+        different chips. One device: params (and every declared
+        ``_device_buffer_attrs`` buffer) move there and every
+        ``put_input`` commits there; several devices: the packed mesh
+        (``mesh_devices``) builds over exactly these chips."""
+        devices = list(devices)
+        if not devices:
+            return
+        self._placement_devices = devices
+        if self._mesh is None and len(devices) == 1 \
+                and getattr(self, 'params', None) is not None:
+            import jax
+            self._device = devices[0]
+            self.params = jax.device_put(self.params, devices[0])
+            for attr in self._device_buffer_attrs:
+                buf = getattr(self, attr, None)
+                if buf is not None:
+                    setattr(self, attr, jax.device_put(buf, devices[0]))
+
+    def _ensure_packed_mesh(self) -> int:
+        """Build the packed loop's data-parallel mesh when
+        ``mesh_devices > 1``: an N-device data-only mesh (over the
+        placement devices when the serve placer pinned some, else the
+        platform's local devices), params replicated per chip, and the
+        data-axis batch placement installed so ``put_input`` shards each
+        stacked batch. Returns the data-axis size (1 = single-device
+        loop, unchanged). Idempotent — a second ``run_packed`` over the
+        same extractor (serve workers, bench warm passes) reuses the
+        mesh. A ``data_parallel`` extractor already owns a mesh (with
+        its batch attr rounded to the global batch), so this defers to
+        it and leaves batch planning alone."""
+        n = int(getattr(self, 'mesh_devices', 1) or 1)
+        if n <= 1:
+            return 1
+        if self._mesh is not None:
+            return self._packed_mesh_ndev
+        from functools import partial
+
+        from video_features_tpu.parallel.mesh import make_mesh
+        from video_features_tpu.parallel.pipeline import (
+            put_batch, put_replicated,
+        )
+        from video_features_tpu.utils.device import jax_devices_all
+        devices = self._placement_devices or jax_devices_all(self.device)
+        mesh = make_mesh(n_devices=n, time_parallel=1, devices=devices)
+        self._mesh = mesh
+        if getattr(self, 'params', None) is not None:
+            self.params = put_replicated(mesh, self.params)
+        self._put_batch = partial(put_batch, mesh)
+        self._packed_mesh_ndev = n
+        return n
 
     # -- content-addressed feature cache (cache/) ---------------------------
 

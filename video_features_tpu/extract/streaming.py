@@ -56,22 +56,30 @@ def iter_batched_windows(windows: Iterable[np.ndarray],
 
 
 def transfer_batches(items: Iterable[tuple], put, keep_host: bool = False,
-                     tracer: Tracer = NULL_TRACER) -> Iterator[tuple]:
+                     tracer: Tracer = NULL_TRACER,
+                     depth: int = 2) -> Iterator[tuple]:
     """Overlap host→device input transfer with device compute.
 
     ``items`` yields ``(host_batch, *meta)``; ``put`` places one batch on
     the device(s) (``BaseExtractor.put_input``). Returns a prefetched
     iterator of ``(device_batch, host_batch | None, *meta)`` where the
     async copy of batch k+1 starts on the producer thread while the
-    consumer runs batch k. ``depth=1`` bounds the extra device-resident
-    input buffers to ~2 batches (queued + mid-transfer) — deeper queues
-    pin more HBM for no additional overlap. ``keep_host=True`` carries the
-    host array alongside (debug surfaces like show_pred read pixels
-    without paying a D2H round trip). The single home for this transfer
-    policy — every batched extractor drives its device loop through here.
-    ``tracer`` attributes the producer-thread transfer time to an ``h2d``
-    stage (it runs outside the extract loop, so without this it would be
-    invisible in the profile table).
+    consumer runs batch k. ``depth`` (default 2) is how many transferred
+    batches the producer thread STAGES ahead of the consumer: at 2 the
+    next batch's ``device_put`` is always already issued while the
+    current batch runs, so the transfer never lands on the dispatch
+    critical path even when the consumer momentarily outruns the
+    producer (h2d was a 6–11.5% share serialized before dispatch in
+    BENCH_r05). Each staged unit keeps one more input batch resident on
+    device; ``depth=1`` restores the minimal single-buffer overlap.
+    ``keep_host=True`` carries the host array alongside (debug surfaces
+    like show_pred read pixels without paying a D2H round trip). The
+    single home for this transfer policy — every batched extractor
+    drives its device loop through here. ``tracer`` attributes the
+    producer-thread transfer time to the ``h2d`` stage (it runs outside
+    the extract loop, so without this it would be invisible in the
+    profile table); the span's ``staged`` attr records whether the
+    transfer was issued ahead of need (depth > 1) or on demand.
 
     Backend caveat (measured on the axon remote-TPU tunnel): some remote
     backends DEFER the physical copy of an async ``device_put`` until a
@@ -84,17 +92,20 @@ def transfer_batches(items: Iterable[tuple], put, keep_host: bool = False,
     """
     from video_features_tpu.io.video import prefetch
 
+    depth = max(int(depth or 1), 1)
+    staged = depth > 1
+
     def to_device(item):
         batch = item[0]
         if batch is None:
             # batchless scheduler marker (packed NUDGE): nothing to copy
             return (None, None) + tuple(item[1:])
         host = batch if keep_host else None
-        with tracer.stage('h2d'):
+        with tracer.stage('h2d', staged=staged):
             dev = put(batch)
         return (dev, host) + tuple(item[1:])
 
-    return prefetch(map(to_device, items), depth=1)
+    return prefetch(map(to_device, items), depth=depth)
 
 
 def overlap_fetch(dispatched: Iterable[tuple], fetch, depth: int,

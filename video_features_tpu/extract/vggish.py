@@ -28,6 +28,12 @@ BATCH = 32  # compiled example-batch size (a 30 s clip is ~31 examples)
 
 class ExtractVGGish(BaseExtractor):
 
+    # the PCA postprocess matrices are committed to the build device;
+    # serve placement (place_on) must migrate them with the params or a
+    # placed entry would feed the jitted postprocess operands committed
+    # to two different chips
+    _device_buffer_attrs = ('_pca_eig', '_pca_means')
+
     def __init__(self, args) -> None:
         super().__init__(
             feature_type=args.feature_type,

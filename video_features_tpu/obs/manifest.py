@@ -120,6 +120,7 @@ class RunManifest:
         self.stages: Dict[str, Dict[str, float]] = {}
         self.executables: Dict[str, Dict[str, Any]] = {}
         self.farm: Dict[str, Any] = {}
+        self.mesh: Dict[str, Any] = {}
         self._compile0 = _compile_snapshot()
         _install_compile_listener()
 
@@ -177,6 +178,14 @@ class RunManifest:
         with self._lock:
             self.farm.update({k: _jsonable(v) for k, v in info.items()})
 
+    def note_mesh(self, info: Dict[str, Any]) -> None:
+        """Record the device mesh a mesh-sharded packed run executed on
+        (``mesh_devices``, the (data, time) shape, per-device labels,
+        per-device capacity vs global batch); the section stays ``{}``
+        on single-device runs. Later notes merge over earlier ones."""
+        with self._lock:
+            self.mesh.update({k: _jsonable(v) for k, v in info.items()})
+
     # -- publication ---------------------------------------------------------
 
     def document(self) -> Dict[str, Any]:
@@ -194,6 +203,7 @@ class RunManifest:
             stages = {k: dict(v) for k, v in self.stages.items()}
             executables = {k: dict(v) for k, v in self.executables.items()}
             farm = dict(self.farm)
+            mesh = dict(self.mesh)
         outcomes: Dict[str, int] = {}
         for v in videos.values():
             outcomes[v['outcome']] = outcomes.get(v['outcome'], 0) + 1
@@ -213,6 +223,9 @@ class RunManifest:
             # decode farm (farm/): config + lifetime stats for
             # farm-backed runs, {} on in-process decode
             'farm': farm,
+            # mesh-sharded packed execution (mesh_devices > 1): the
+            # device mesh the run executed on, {} single-device
+            'mesh': mesh,
         }
 
     def write(self, path: str) -> str:

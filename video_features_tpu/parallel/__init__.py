@@ -10,7 +10,8 @@ from video_features_tpu.parallel.distributed import (  # noqa: F401
 )
 from video_features_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS, TIME_AXIS, batch_sharding, factor_mesh_shape, make_mesh,
-    pair_sharding, replicated, round_batch_to_data_axis,
+    pair_sharding, plan_device_batch, replicated, require_shardable,
+    round_batch_to_data_axis,
 )
 from video_features_tpu.parallel.packing import (  # noqa: F401
     VideoTask, packed_batches, run_packed,

@@ -45,10 +45,16 @@ def factor_mesh_shape(n: int, time_parallel: Optional[int] = None) -> Tuple[int,
 def make_mesh(n_devices: Optional[int] = None,
               time_parallel: Optional[int] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """A 2-D (data, time) mesh over the available (or given) devices."""
+    """A 2-D (data, time) mesh over the available (or given) devices.
+
+    ``n_devices=0`` auto-detects: the mesh spans EVERY available (or
+    given) device — the ``mesh_devices=0`` config spelling for "use the
+    whole slice". An over-ask raises here with the device counts named,
+    instead of surfacing later as an opaque XLA placement error.
+    """
     if devices is None:
         devices = jax.devices()
-    if n_devices is not None:
+    if n_devices is not None and n_devices != 0:
         if n_devices > len(devices):
             raise ValueError(
                 f'requested {n_devices} devices, have {len(devices)}')
@@ -74,6 +80,38 @@ def round_batch_to_data_axis(batch_size: int, mesh: Mesh) -> int:
     the global batch an in-graph data-parallel extractor compiles for."""
     d = mesh.shape[DATA_AXIS]
     return -(-batch_size // d) * d
+
+
+def plan_device_batch(capacity: int, mesh: Mesh) -> int:
+    """Global packed batch for a data-parallel mesh: ``capacity`` window
+    slots PER device shard (the per-device batch the family's step was
+    tuned for), so the packer plans ``capacity × ndev`` slots and every
+    device runs at its single-chip batch shape. Raises a clear error —
+    not a downstream XLA shape error — when the plan can't fill a shard.
+    """
+    ndev = mesh.shape[DATA_AXIS]
+    capacity = int(capacity)
+    if capacity < 1:
+        raise ValueError(
+            f'mesh-sharded packed batch planning needs capacity >= 1 per '
+            f'device shard (got capacity={capacity} over {ndev} '
+            f'data-parallel devices): capacity × ndev is the global device '
+            f'batch — raise batch_size or lower mesh_devices')
+    return capacity * ndev
+
+
+def require_shardable(batch: int, mesh: Mesh) -> int:
+    """Validate that a GLOBAL batch splits evenly over the data axis,
+    raising a named error instead of letting ``device_put`` fail with an
+    XLA sharding/shape error. Returns the per-shard capacity."""
+    ndev = mesh.shape[DATA_AXIS]
+    if batch % ndev != 0 or batch // ndev < 1:
+        raise ValueError(
+            f'packed batch {batch} cannot shard over {ndev} data-parallel '
+            f'devices: the global batch must be a positive multiple of the '
+            f'device count (capacity × ndev planning — see '
+            f'plan_device_batch)')
+    return batch // ndev
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

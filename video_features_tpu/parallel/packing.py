@@ -253,6 +253,21 @@ def run_packed(ex, video_paths: Iterable,
     ``decode_ahead × batch`` windows (see ``io.video.
     prefetch_across_videos``).
 
+    ``batch_size`` (default: the extractor's ``packed_batch_size``) is
+    the PER-DEVICE capacity. With ``mesh_devices > 1`` the loop is
+    mesh-sharded: batches plan at ``capacity × ndev``, ``put_input``
+    shards each stacked batch over the data axis of the extractor's
+    mesh (params replicated per chip — ``_ensure_packed_mesh``), and
+    every device runs the family's unchanged packed program at its
+    single-chip batch shape, so outputs are byte-identical at any
+    device count. Uneven tails pad (and mask at scatter-back) exactly
+    like single-device tails — a lone window never stalls the batch —
+    and fault isolation is untouched: a poisoned window fails its
+    video, not its shard. The ``model``/``d2h`` spans carry
+    ``mesh_devices`` + per-shard valid counts, occupancy records both
+    the global aggregate and each device's share, and the run manifest
+    records the mesh shape.
+
     ``inflight`` (default: the extractor's ``inflight`` attribute, 2) is
     the OUTPUT-side pipelining depth: ``packed_step`` only dispatches
     (it returns device arrays), and the loop keeps up to ``inflight``
@@ -284,7 +299,51 @@ def run_packed(ex, video_paths: Iterable,
     from video_features_tpu.io.video import prefetch_across_videos
 
     ex._packed_setup()
-    batch = int(batch_size or ex.packed_batch_size())
+    # mesh-sharded execution (mesh_devices > 1): the device loop plans
+    # batches at capacity × ndev, put_input shards each stacked batch
+    # over the data axis of the extractor's mesh (params replicated per
+    # chip), and the in-flight queue / scatter-back below run UNCHANGED —
+    # fetch_outputs gathers the sharded output, each row scatters to its
+    # video, and a poisoned window still fails only its video. Per-shard
+    # capacity equals the single-chip batch, so every device runs the
+    # exact program the family was tuned for and outputs stay
+    # byte-identical at any device count.
+    ndev = ex._ensure_packed_mesh()
+    capacity = int(batch_size or ex.packed_batch_size())
+    if ndev > 1:
+        from video_features_tpu.parallel.mesh import plan_device_batch
+        batch = plan_device_batch(capacity, ex._mesh)
+    else:
+        batch = capacity
+
+    def shard_valids(valid: int) -> list:
+        """Per-device valid-slot counts for a ``valid``-row global batch:
+        shard i holds rows [i·capacity, (i+1)·capacity) — uneven tails
+        leave later shards partially (or fully) padded, masked at
+        scatter-back like any other padding."""
+        return [max(0, min(valid - i * capacity, capacity))
+                for i in range(ndev)]
+
+    # per-device telemetry labels ('d<jax device id>'), data-axis order
+    dev_labels = ([f'd{d.id}' for d in ex._mesh.devices.flat]
+                  if ndev > 1 else [])
+
+    def mesh_attrs(valid: int) -> Dict:
+        """Extra span attrs for mesh-sharded model/d2h stages: the mesh
+        width and each shard's valid-slot count (empty single-device)."""
+        if ndev <= 1 or not ex.tracer.enabled:
+            return {}
+        return {'mesh_devices': ndev, 'shard_valid': shard_valids(valid)}
+
+    def record_occupancy(name: str, valid: int) -> None:
+        """Aggregate occupancy at the GLOBAL capacity plus — on a mesh —
+        one record per device shard at the per-device capacity; the two
+        views never double-count (tracing.add_occupancy)."""
+        ex.tracer.add_occupancy(name, valid, batch)
+        if ndev > 1:
+            for label, v in zip(dev_labels, shard_valids(valid)):
+                ex.tracer.add_occupancy(name, v, capacity, device=label)
+
     recorder = getattr(ex.tracer, 'recorder', None)
     manifest = getattr(ex, 'manifest', None)
     # executable identity → (shape, dtype) seen on the device loop;
@@ -550,7 +609,8 @@ def run_packed(ex, video_paths: Iterable,
         ex._inflight_now = len(pending)
         try:
             with ex.tracer.stage('d2h', videos=batch_videos,
-                                 valid=valid, capacity=batch):
+                                 valid=valid, capacity=batch,
+                                 **mesh_attrs(valid)):
                 out = ex.fetch_outputs(out_dev)
         except KeyboardInterrupt:
             raise
@@ -558,7 +618,7 @@ def run_packed(ex, video_paths: Iterable,
             doom_batch(prov, batch_videos, valid, 'd2h')
             sweep()
             return
-        ex.tracer.add_occupancy('d2h', valid, batch)
+        record_occupancy('d2h', valid)
         for i, (task, meta) in enumerate(prov):
             task.done += 1
             if task.failed:       # already doomed: don't grow its rows
@@ -595,7 +655,8 @@ def run_packed(ex, video_paths: Iterable,
                 # 'd2h' stage at the sync point (their shares sum to the
                 # old all-in 'model' share)
                 with ex.tracer.stage('model', videos=batch_videos,
-                                     valid=valid, capacity=batch):
+                                     valid=valid, capacity=batch,
+                                     **mesh_attrs(valid)):
                     out = ex.packed_step(dev)
             except KeyboardInterrupt:
                 raise
@@ -605,7 +666,7 @@ def run_packed(ex, video_paths: Iterable,
                 doom_batch(prov, batch_videos, valid, 'model')
                 sweep()
                 continue
-            ex.tracer.add_occupancy('model', valid, batch)
+            record_occupancy('model', valid)
             if manifest is not None:
                 # record each executable identity's geometry (the unit
                 # XLA compiles per) — shape+dtype only; the expensive
@@ -641,6 +702,17 @@ def run_packed(ex, video_paths: Iterable,
                 info.update(cost)
             manifest.note_executable(identity, info)
 
+    if manifest is not None and ndev > 1:
+        # the run manifest names the mesh that produced these numbers:
+        # device count, (data, time) shape, and the per-device labels the
+        # stage table / metrics key their occupancy records on
+        manifest.note_mesh({
+            'mesh_devices': ndev,
+            'shape': {str(k): int(v) for k, v in ex._mesh.shape.items()},
+            'devices': dev_labels,
+            'capacity_per_device': capacity,
+            'global_batch': batch})
+
     if farm is not None and manifest is not None:
         # farm config + lifetime stats land in the run manifest (the
         # 'farm' section) so a farm-backed BENCH/run record names the
@@ -654,7 +726,9 @@ def run_packed(ex, video_paths: Iterable,
             # fold BEFORE the reset: the manifest keeps the run aggregate
             manifest.fold_stages(ex.tracer.report())
         if getattr(ex, 'profile', True):
+            mesh_note = (f' = {capacity} x {ndev} devices'
+                         if ndev > 1 else '')
             print(f'--- stage timing: packed worklist ({n_started[0]} '
-                  f'videos, batch {batch})')
+                  f'videos, batch {batch}{mesh_note})')
             print(ex.tracer.summary())
         ex.tracer.reset()

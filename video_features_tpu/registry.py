@@ -59,4 +59,7 @@ def create_extractor(args: 'Config') -> 'BaseExtractor':
         extractor.configure_obs(args)
         # decode farm (farm/): decode_workers / decode_farm_ring_mb
         extractor.configure_farm(args)
+        # mesh-sharded packed execution (parallel/mesh.py): mesh_devices
+        # resolves against this host's local devices at build time
+        extractor.configure_mesh(args)
     return extractor

@@ -165,6 +165,12 @@ def prometheus_text(doc: Dict[str, Any],
         if isinstance(value, (int, float)) and not isinstance(value, bool):
             g(f'vft_warm_pool_{key}',
               'warm extractor pool accounting').set(value)
+    for dev, count in (doc.get('warm_pool') or {}
+                       ).get('device_residents', {}).items():
+        # placement-aware pool: how many warm entries each chip carries
+        g('vft_device_resident_entries',
+          'warm-pool entries resident per device',
+          labels={'device': dev}).set(count)
     for key, value in (doc.get('cache') or {}).items():
         if isinstance(value, (int, float)) and not isinstance(value, bool):
             g(f'vft_cache_{key}',
@@ -187,6 +193,13 @@ def prometheus_text(doc: Dict[str, Any],
             g('vft_stage_occupancy',
               'valid batch slots / all slots for the stage',
               labels=labels).set(rep['occupancy'])
+        for dev, drec in (rep.get('occ_device') or {}).items():
+            # mesh-sharded batches: the same family grows a device
+            # label, one series per chip (aggregate stays label-free)
+            g('vft_stage_occupancy',
+              'valid batch slots / all slots for the stage',
+              labels={'stage': stage, 'device': dev}
+              ).set(drec.get('occupancy', 0.0))
     return registry.render()
 
 

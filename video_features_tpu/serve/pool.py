@@ -15,12 +15,22 @@ hard-kills — it calls ``entry.close()`` (stop accepting, drain, exit) and
 hands the entry back to the caller to join. Busy entries are passed over
 in favor of idle ones; if every entry is busy the pool temporarily runs
 over capacity rather than stalling admission behind a drain.
+
+Placement (:class:`DevicePlacer`): on a multi-chip host each entry is
+additionally assigned a device set at build time — one chip for a
+single-device extractor, N chips for a ``mesh_devices=N`` packed mesh —
+chosen least-loaded so different model families spread over different
+chips instead of all pinning HBM on device 0. The pool key already IS
+the routing layer: a request's executable identity maps to exactly one
+entry, and that entry's extractor is resident on its assigned chip(s),
+so admission steers every request's windows to the silicon holding its
+program.
 """
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 
 class WarmPool:
@@ -126,3 +136,52 @@ class WarmPool:
                 'hit_rate': (self.hits / total) if total else 0.0,
                 'evictions': self.evictions,
             }
+
+
+class DevicePlacer:
+    """Least-loaded device placement for warm-pool entries.
+
+    Tracks how many resident entries each local chip carries and assigns
+    every newly built extractor the least-loaded chip(s) — one for a
+    single-device entry, N for a ``mesh_devices=N`` packed mesh — so
+    different model families end up resident on DIFFERENT chips and a
+    multi-family server uses the whole host instead of stacking every
+    params copy on device 0. Release on entry retirement (eviction reap,
+    crash) returns the chips to the free side of the ranking. Ties break
+    by device id for deterministic placement; on a single-device host
+    every assignment degenerates to that device (today's behavior).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._load: Dict[int, int] = {}      # jax device id → entries
+
+    def assign(self, devices: Sequence, n: int) -> list:
+        """Pick the ``n`` least-loaded of ``devices`` (all local chips of
+        the extractor's platform) and count them as occupied. ``n`` is
+        clamped to what exists — build-time validation
+        (``configure_mesh``) already rejected genuine over-asks."""
+        n = max(1, min(int(n or 1), len(devices)))
+        with self._lock:
+            ranked = sorted(devices,
+                            key=lambda d: (self._load.get(d.id, 0), d.id))
+            chosen = ranked[:n]
+            for d in chosen:
+                self._load[d.id] = self._load.get(d.id, 0) + 1
+        return chosen
+
+    def release(self, devices: Optional[Sequence]) -> None:
+        with self._lock:
+            for d in devices or ():
+                # keep zero counts instead of popping: the metrics mirror
+                # only writes gauges for labels in snapshot(), so a popped
+                # device would leave its last nonzero
+                # vft_device_resident_entries reading sticky forever
+                self._load[d.id] = max(self._load.get(d.id, 0) - 1, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        """device id label → resident entry count (metrics surface;
+        zero counts persist so a drained chip's gauge reads 0, not its
+        last nonzero scrape)."""
+        with self._lock:
+            return {f'd{i}': c for i, c in sorted(self._load.items())}

@@ -46,7 +46,7 @@ from video_features_tpu.parallel.packing import FLUSH, VideoTask
 from video_features_tpu.registry import PACKED_FEATURES, create_extractor
 from video_features_tpu.serve import metrics as metrics_mod
 from video_features_tpu.serve import protocol
-from video_features_tpu.serve.pool import WarmPool
+from video_features_tpu.serve.pool import DevicePlacer, WarmPool
 
 _CLOSE = object()
 
@@ -65,7 +65,10 @@ REQUEST_HISTORY = 4096
 # cache_* namespace also stays IN the key: the worker's extractor
 # publishes/consults the cache configured at build time, so requests
 # with different cache settings must not share an entry (they'd inherit
-# the first builder's cache behavior silently).
+# the first builder's cache behavior silently). mesh_devices likewise
+# stays IN the key (it is NOT listed below): it changes the compiled
+# program's sharding and how many chips the entry is resident on, so a
+# 1-chip and a 4-chip request each get their own warm entry.
 _KEY_EXCLUDE = frozenset({
     'video_paths', 'file_with_video_paths', 'output_path',
     'profile', 'profile_dir', 'timeout_s',
@@ -92,6 +95,21 @@ def pool_key(args: Config) -> tuple:
     """Executable identity of a sanity-checked request config."""
     return tuple(sorted((k, repr(v)) for k, v in args.items()
                         if k not in _KEY_EXCLUDE))
+
+
+def resolve_mesh_devices(args: Config) -> Config:
+    """Resolve ``mesh_devices=0`` (auto-detect) to the explicit local
+    device count IN PLACE, before ``pool_key`` runs: 0 and the
+    equivalent explicit width must share one warm entry — keying on the
+    raw 0 would build (and place) a duplicate of the identical sharded
+    program. Same resolution ``configure_mesh`` applies at build time,
+    just early enough for routing."""
+    n = args.get('mesh_devices', 1)
+    if n is not None and int(n) == 0:
+        from video_features_tpu.utils.device import jax_devices_all
+        args['mesh_devices'] = len(jax_devices_all(
+            args.get('device', 'cpu')))
+    return args
 
 
 class _ServeTask(VideoTask):
@@ -154,6 +172,9 @@ class _Worker:
         self.idle_flush_s = idle_flush_s
         self.max_batch_wait_s = max_batch_wait_s
         self.queue: 'queue.Queue' = queue.Queue()
+        # chips this entry's extractor is resident on (DevicePlacer
+        # assignment; None after release so retirement is idempotent)
+        self.devices: Optional[List] = None
         self.outstanding: set = set()
         self._lock = threading.Lock()
         self.closed = False
@@ -293,6 +314,12 @@ class ExtractionServer:
         self.metrics_path = metrics_path
 
         self.pool = WarmPool(pool_size)
+        # placement-aware residency: every built entry gets the
+        # least-loaded local chip(s) — one for a single-device config, N
+        # for mesh_devices=N — so different families land on different
+        # silicon; the pool-key lookup then routes each request's windows
+        # to the chip(s) holding its executable
+        self._placer = DevicePlacer()
         # one registry per server instance (obs.metrics): counters + the
         # latency histogram live here; prometheus_text mirrors the
         # point-in-time document values into gauges on the same registry
@@ -389,6 +416,10 @@ class ExtractionServer:
                 for w in pending:
                     if w.thread.is_alive():
                         w.thread.join(max(0.0, deadline - time.monotonic()))
+                    # the drain's final metrics document must show the
+                    # chips freed, not the pre-drain residency (idempotent
+                    # with the reap/crash release paths)
+                    self._release_placement(w)
                 # re-sweep: a cold submit racing the drain may have
                 # inserted a freshly built worker after the first
                 # pop_all snapshot
@@ -497,7 +528,7 @@ class ExtractionServer:
                   'serve daemon (use metrics / metrics_prom / trace_out)',
                   subsystem='serve', path=str(args['manifest_out']))
             args['manifest_out'] = None
-        key = pool_key(args)
+        key = pool_key(resolve_mesh_devices(args))
 
         # -- content-addressed cache: answer hits BEFORE admission -------
         # A hit is an O(read) file copy — it must not occupy a queue slot
@@ -573,6 +604,10 @@ class ExtractionServer:
                         worker = _Worker(self, key, label, extractor,
                                          self.idle_flush_s,
                                          self.max_batch_wait_s)
+                        # pin residency BEFORE the first batch flows:
+                        # least-loaded chip(s) via the placer (a mesh
+                        # entry takes mesh_devices chips)
+                        worker.devices = self._place_extractor(extractor)
                         worker.start()
                         rec = getattr(extractor.tracer, 'recorder', None)
                         with self._lock:
@@ -628,6 +663,42 @@ class ExtractionServer:
         self.stats.bump('rejected')
         return protocol.error('worker churn outpaced admission; retry')
 
+    def _place_extractor(self, extractor) -> Optional[List]:
+        """Assign a fresh entry's extractor its resident chip(s): the
+        least-loaded local device(s) of its platform — ``mesh_devices``
+        of them for a mesh-sharded entry. Best-effort: placement must
+        never fail a build (a placement error just leaves the extractor
+        on its default device 0 residency)."""
+        try:
+            from video_features_tpu.utils.device import jax_devices_all
+            local = jax_devices_all(extractor.device)
+            n = int(getattr(extractor, 'mesh_devices', 1) or 1)
+            devices = self._placer.assign(local, n)
+            try:
+                extractor.place_on(devices)
+            except Exception:
+                # assign() already counted these chips — give them back,
+                # or the failed placement skews every future least-loaded
+                # decision for the server's lifetime
+                self._placer.release(devices)
+                raise
+            return devices
+        except Exception:
+            import logging
+
+            from video_features_tpu.obs.events import event
+            event(logging.WARNING, 'device placement failed; entry stays '
+                  'on the default device', subsystem='serve',
+                  exc_info=True)
+            return None
+
+    def _release_placement(self, worker: '_Worker') -> None:
+        """Return a retired entry's chips to the placer (idempotent —
+        retirement paths can race: crash vs reap)."""
+        devices, worker.devices = worker.devices, None
+        if devices:
+            self._placer.release(devices)
+
     def _answer_cache_hits(self, args: Config,
                            paths: List[str]) -> List[str]:
         """Materialize every video the feature cache already holds for
@@ -678,6 +749,7 @@ class ExtractionServer:
             if not w.thread.is_alive():
                 self._fold_retired_locked(w.ex.tracer.report())
                 self._retired.remove(w)
+                self._release_placement(w)
 
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
@@ -686,10 +758,15 @@ class ExtractionServer:
             draining = self._draining
             builds = self._builds
             reports = {}
+            placements = {}
             for i, w in enumerate(self.pool.entries() + self._retired):
                 label = w.label if w.label not in reports \
                     else f'{w.label}#{i}'
                 reports[label] = w.ex.tracer.report()
+                if w.devices:
+                    # which chip(s) this entry is resident on — the
+                    # routing table a multi-family server actually uses
+                    placements[label] = [f'd{d.id}' for d in w.devices]
             if self._retired_stages:
                 reports['retired'] = dict(self._retired_stages)
             caches = list(self._caches.values())
@@ -710,6 +787,10 @@ class ExtractionServer:
         # builds ≤ misses: concurrent cold submits for one key all count
         # misses but transplant exactly once (the per-key build lock)
         pool_stats['builds'] = builds
+        # placement view: entry label → resident chips, plus per-device
+        # resident-entry counts (the vft_device_resident_entries gauges)
+        pool_stats['placements'] = placements
+        pool_stats['device_residents'] = self._placer.snapshot()
         from video_features_tpu.cache.store import merge_cache_stats
         from video_features_tpu.farm.farm import merge_farm_stats
         return metrics_mod.build_metrics(
@@ -780,6 +861,7 @@ class ExtractionServer:
             # this key — removing by key alone would evict IT instead
             self.pool.remove(worker.key, worker)
             self._fold_retired_locked(worker.ex.tracer.report())
+            self._release_placement(worker)
 
     # -- endpoint ------------------------------------------------------------
 

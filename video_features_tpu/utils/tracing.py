@@ -52,7 +52,7 @@ STAGES = (
 
 class _StageStat:
     __slots__ = ('count', 'total_s', 'max_s', 'first_s',
-                 'occ_valid', 'occ_capacity')
+                 'occ_valid', 'occ_capacity', 'occ_device')
 
     def __init__(self) -> None:
         self.count = 0
@@ -67,6 +67,12 @@ class _StageStat:
         # as real work
         self.occ_valid = 0
         self.occ_capacity = 0
+        # per-DEVICE slot accounting for mesh-sharded batches
+        # (add_occupancy(..., device=)): device label → [valid, capacity]
+        # raw counts, kept SEPARATE from the aggregate above so the two
+        # views never double-count (the aggregate is recorded once per
+        # batch at the global capacity; each shard's slice lands here)
+        self.occ_device: Optional[Dict[str, list]] = None
 
     def add(self, dt: float) -> None:
         if self.count == 0:
@@ -134,11 +140,18 @@ class Tracer:
                 self._order.append(name)
             stat.add(dt)
 
-    def add_occupancy(self, name: str, valid: int, capacity: int) -> None:
+    def add_occupancy(self, name: str, valid: int, capacity: int,
+                      device: Optional[str] = None) -> None:
         """Record that a ``capacity``-slot batch under ``name`` carried
         ``valid`` real items (the rest was padding). The summary table then
         reports the stage's aggregate batch occupancy — the fraction of
-        compiled-step slots that did useful work."""
+        compiled-step slots that did useful work.
+
+        With ``device`` given (mesh-sharded packed batches), the counts
+        land in the stage's PER-DEVICE map instead of the aggregate: the
+        device loop records the aggregate once per batch at the global
+        capacity and each shard's slice under its device label, so neither
+        view double-counts the other (see ``merge_reports``)."""
         if not self.enabled:
             return
         with self._lock:
@@ -146,8 +159,15 @@ class Tracer:
             if stat is None:
                 stat = self._stats[name] = _StageStat()
                 self._order.append(name)
-            stat.occ_valid += int(valid)
-            stat.occ_capacity += int(capacity)
+            if device is not None:
+                if stat.occ_device is None:
+                    stat.occ_device = {}
+                rec = stat.occ_device.setdefault(str(device), [0, 0])
+                rec[0] += int(valid)
+                rec[1] += int(capacity)
+            else:
+                stat.occ_valid += int(valid)
+                stat.occ_capacity += int(capacity)
 
     @contextmanager
     def stage(self, name: str, **attrs):
@@ -202,6 +222,14 @@ class Tracer:
             # averaging the derived ratios would weight batches wrongly)
             rec['occ_valid'] = s.occ_valid
             rec['occ_capacity'] = s.occ_capacity
+        if s.occ_device:
+            # mesh-sharded batches: each device's slot accounting, raw
+            # counts + derived ratio (the serve metrics surface renders
+            # these as vft_stage_occupancy{device=...})
+            rec['occ_device'] = {
+                dev: {'occ_valid': v, 'occ_capacity': c,
+                      'occupancy': (v / c) if c else 0.0}
+                for dev, (v, c) in s.occ_device.items()}
         return rec
 
     def report(self) -> Dict[str, Dict[str, float]]:
@@ -259,6 +287,16 @@ def merge_reports(reports: Iterable[Dict[str, Dict[str, float]]]
     ``first_s`` keeps the worst cold-start, occupancy recombines from the
     raw slot counts. ``ramp`` is per-tracer by construction (first call vs
     ITS steady state) and is dropped rather than faked.
+
+    Per-device slot accounting (``occ_device`` — mesh-sharded packed
+    batches) merges DEVICE-WISE, each device's raw counts summing with
+    the same device's counts from other reports. The per-device counts
+    are deliberately NEVER folded into the flat ``occ_valid`` /
+    ``occ_capacity``: the aggregate is already recorded once per batch
+    at the global capacity, so adding the shard slices again would
+    double-count valid slots against per-shard capacities and push the
+    merged occupancy past 100% (regression-pinned by
+    tests/test_mesh_packed.py).
     """
     merged: Dict[str, Dict[str, float]] = {}
     for rep in reports:
@@ -274,10 +312,19 @@ def merge_reports(reports: Iterable[Dict[str, Dict[str, float]]]
                 m['occ_valid'] = m.get('occ_valid', 0) + r['occ_valid']
                 m['occ_capacity'] = (m.get('occ_capacity', 0)
                                      + r['occ_capacity'])
+            for dev, d in (r.get('occ_device') or {}).items():
+                by_dev = m.setdefault('occ_device', {})
+                md = by_dev.setdefault(dev, {'occ_valid': 0,
+                                             'occ_capacity': 0})
+                md['occ_valid'] += d.get('occ_valid', 0)
+                md['occ_capacity'] += d.get('occ_capacity', 0)
     for m in merged.values():
         m['mean_s'] = m['total_s'] / max(m['count'], 1)
         if m.get('occ_capacity'):
             m['occupancy'] = m['occ_valid'] / m['occ_capacity']
+        for md in (m.get('occ_device') or {}).values():
+            md['occupancy'] = (md['occ_valid'] / md['occ_capacity']
+                               if md['occ_capacity'] else 0.0)
     return merged
 
 
@@ -286,8 +333,14 @@ def round_report(report: Dict[str, Dict[str, float]],
     """A ``Tracer.report()`` with floats rounded for compact JSON
     embedding (bench ``stage_reports``, worklist records) — one
     serializer so every embedded report rounds identically."""
-    return {name: {k: (round(v, ndigits) if isinstance(v, float) else v)
-                   for k, v in rec.items()}
+    def _round(v):
+        if isinstance(v, float):
+            return round(v, ndigits)
+        if isinstance(v, dict):             # occ_device's nested records
+            return {k: _round(x) for k, x in v.items()}
+        return v
+
+    return {name: {k: _round(v) for k, v in rec.items()}
             for name, rec in report.items()}
 
 
