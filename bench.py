@@ -250,6 +250,82 @@ def bench_serve(precision: str, batch: int, stack: int, tmp_dir: str,
         server.drain(wait=True, grace_s=120)
 
 
+def bench_serve_ingress(tmp_dir: str, platform: str,
+                        wl_paths: list) -> dict:
+    """The ingress rung (ingress/): the HTTP front door's overhead vs
+    the loopback socket, plus one real segment query driven through it.
+
+    One resnet segment request goes through the whole network path
+    (auth → quota → admission → windower range filter → saved files),
+    then the SAME completed request is status-polled N times over each
+    surface — ingress ``GET /v1/requests/<id>`` vs loopback ``status``
+    — one connection per call on both sides (the ingress speaks one
+    request per connection by design, so the loopback comparator must
+    pay its connect too or the diff measures connection reuse, not the
+    HTTP layer). Reports p50/p99 RTT for both.
+    """
+    import http.client
+
+    from video_features_tpu.ingress.auth import ApiKeyAuth, Tenant
+    from video_features_tpu.ingress.gateway import IngressGateway
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+
+    base = {
+        'device': platform, 'model_name': 'resnet18', 'batch_size': 8,
+        'allow_random_weights': True, 'on_extraction': 'save_numpy',
+        'tmp_path': os.path.join(tmp_dir, 'ing_tmp'),
+        'output_path': os.path.join(tmp_dir, 'ing_out'),
+    }
+    server = ExtractionServer(base_overrides=base, queue_depth=64).start()
+    gateway = IngressGateway(
+        server, auth=ApiKeyAuth({'bench': Tenant('bench')})).start()
+    try:
+        def api(method, path, body=None):
+            c = http.client.HTTPConnection('127.0.0.1', gateway.port,
+                                           timeout=600)
+            c.request(method, path,
+                      body=json.dumps(body) if body is not None else None,
+                      headers={'Authorization': 'Bearer bench'})
+            r = c.getresponse()
+            out = json.loads(r.read())
+            c.close()
+            assert r.status == 200, (r.status, out)
+            return out
+
+        # one real segment query end-to-end through the front door
+        doc = api('POST', '/v1/extract', {
+            'feature_type': 'resnet', 'video_paths': [wl_paths[0]],
+            'range': [0.0, 0.4]})
+        rid = doc['request_id']
+        while api('GET', f'/v1/requests/{rid}')['state'] == 'running':
+            time.sleep(0.05)
+
+        n = int(os.environ.get('BENCH_INGRESS_RTT_N', '100'))
+        ing_rtts, loop_rtts = [], []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            api('GET', f'/v1/requests/{rid}')
+            ing_rtts.append(time.perf_counter() - t0)
+        client = ServeClient(port=server.port)
+        for _ in range(n):
+            t0 = time.perf_counter()
+            client.status(rid)
+            loop_rtts.append(time.perf_counter() - t0)
+
+        def pct(xs, p):
+            return round(float(np.percentile(xs, p)), 6)
+
+        return {
+            'serve_ingress_p50_latency_s': pct(ing_rtts, 50),
+            'serve_ingress_p99_latency_s': pct(ing_rtts, 99),
+            'serve_ingress_loopback_p50_latency_s': pct(loop_rtts, 50),
+            'serve_ingress_loopback_p99_latency_s': pct(loop_rtts, 99),
+        }
+    finally:
+        server.drain(wait=True, grace_s=120)
+
+
 def bench_cache(precision: str, batch: int, stack: int, tmp_dir: str,
                 platform: str, wl_paths: list) -> dict:
     """The content-addressed cache rung (cache/): the SAME worklist run
@@ -665,6 +741,22 @@ def run() -> dict:
                         srec['serve_warm_hit_rate']
                 except Exception as e:
                     rungs['serve_error'] = f'{type(e).__name__}: {e}'
+            # The ingress rung (ingress/): the HTTP front door's RTT
+            # percentiles vs the loopback socket, through one real
+            # segment query. BENCH_INGRESS=0/1 overrides.
+            if os.environ.get('BENCH_INGRESS',
+                              '1' if on_accel else '0') == '1':
+                try:
+                    if wl_paths is None:
+                        from tools.worklist_bench import make_worklist
+                        wl_paths = make_worklist(
+                            tmp_dir, 4 if on_accel else 2,
+                            10 if on_accel else 2)
+                    irec = bench_serve_ingress(tmp_dir, platform, wl_paths)
+                    rungs.update(irec)
+                except Exception as e:
+                    rungs['serve_ingress_error'] = \
+                        f'{type(e).__name__}: {e}'
             # The content-addressed cache rung (cache/): cold extraction
             # vs warm O(read) hits over the same worklist — the dedupe
             # win a corpus with repeated/duplicated videos sees per

@@ -136,6 +136,29 @@ def test_bench_serve_rung_emits_keys():
     assert rungs['serve_warm_hit_rate'] > 0
 
 
+def test_bench_serve_ingress_rung_emits_keys():
+    """BENCH_INGRESS=1 drives the network-front-door rung (ingress/):
+    one real segment query through HTTP auth/quota/admission, then RTT
+    percentiles over the ingress vs the loopback socket — the record
+    must carry both pairs (direction-aware: they are *latency* rungs),
+    all while keeping the one-JSON-line stdout contract."""
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_SERVE': '0', 'BENCH_WORKLIST': '0',
+                      'BENCH_CACHE': '0', 'BENCH_INGRESS': '1',
+                      'BENCH_INGRESS_RTT_N': '25'})
+    rungs = rec['rungs']
+    assert 'serve_ingress_error' not in rungs, \
+        rungs.get('serve_ingress_error')
+    for key in ('serve_ingress_p50_latency_s',
+                'serve_ingress_p99_latency_s',
+                'serve_ingress_loopback_p50_latency_s',
+                'serve_ingress_loopback_p99_latency_s'):
+        assert rungs[key] > 0, (key, rungs)
+    assert rungs['serve_ingress_p99_latency_s'] >= \
+        rungs['serve_ingress_p50_latency_s']
+
+
 def test_bench_cache_rung_emits_keys():
     """BENCH_CACHE=1 drives the content-addressed cache rung (cache/):
     the record must carry cold vs warm-hit clips/sec, the per-video hit

@@ -504,11 +504,14 @@ TRACER_RECORD_KEYS = {'count', 'total_s', 'mean_s', 'max_s', 'first_s',
                       'occ_device'}
 METRICS_DOC_KEYS = {'uptime_s', 'queue', 'warm_pool', 'cache', 'farm',
                     'requests', 'latency', 'stages', 'stages_merged',
-                    'inflight_batches'}
+                    'inflight_batches',
+                    # network front door (ingress/): per-tenant view,
+                    # {'enabled': False, ...} on loopback-only servers
+                    'ingress'}
 TRACE_EVENT_KEYS = {'name', 'ph', 'ts', 'dur', 'pid', 'tid', 'args', 's'}
 MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
                  'config', 'fingerprints', 'videos', 'outcomes', 'stages',
-                 'compile', 'executables', 'farm', 'mesh'}
+                 'compile', 'executables', 'farm', 'mesh', 'ingress'}
 
 
 CANONICAL_STAGES = {'decode', 'decode+preprocess', 'queue_idle', 'pack',

@@ -192,7 +192,23 @@ def run_fingerprint(args: Mapping[str, Any]) -> str:
         .encode()).hexdigest()
 
 
-def video_cache_key(video_path: str, fingerprint: str) -> str:
-    """The content-addressed store key for one (video, recipe) pair."""
+def video_cache_key(video_path: str, fingerprint: str,
+                    segment=None) -> str:
+    """The content-addressed store key for one (video, recipe) pair.
+
+    ``segment`` is an optional ``(start_s, end_s)`` time range (ingress
+    segment queries): a partial-range extraction is DIFFERENT work from
+    the full video, so the range is part of the key — a full extraction
+    can never answer a segment query (or vice versa) from the cache.
+    Millisecond-quantized, matching the output-file naming
+    (``parallel.packing.segment_name``), so two requests for the same
+    range always share one entry.
+    """
+    seg = ''
+    if segment is not None:
+        start_s, end_s = segment
+        seg = (f'|seg:{int(round(float(start_s) * 1000))}'
+               f'-{int(round(float(end_s) * 1000))}')
     return hashlib.sha256(
-        f'{fingerprint}|video:{hash_file(video_path)}'.encode()).hexdigest()
+        f'{fingerprint}|video:{hash_file(video_path)}{seg}'
+        .encode()).hexdigest()

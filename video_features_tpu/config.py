@@ -424,6 +424,25 @@ SERVE_DEFAULTS: Dict[str, Any] = {
     # optional metrics mirror: the live metrics JSON is atomically
     # rewritten here on every request completion (scrape without a socket)
     'serve_metrics_path': None,
+    # priority-class admission (protocol 'priority' field / ingress
+    # tenant classes): 'batch' requests only see this fraction of
+    # serve_queue_depth, so a saturated queue sheds batch before
+    # interactive. 1.0 = no distinction.
+    'serve_batch_shed_fraction': 0.5,
+    # -- ingress (ingress/; docs/ingress.md): the network front door ----
+    # HTTP/1.1 + chunked endpoint port: null = DISABLED (loopback-only
+    # server, today's behavior), 0 = ephemeral (printed at startup)
+    'serve_ingress_port': None,
+    'serve_ingress_host': '127.0.0.1',
+    # API-key file (JSON/YAML: key → {tenant, priority, rate_rps, burst,
+    # max_concurrent}) — REQUIRED when the ingress is enabled; there is
+    # deliberately no anonymous mode on a network-facing endpoint
+    'serve_ingress_auth_file': None,
+    # request-body bound (MiB): oversized bodies get a structured
+    # 413-style rejection instead of crashing (or OOMing) the reader
+    'serve_ingress_max_body_mb': 64,
+    # concurrent-connection bound: excess connects get an immediate 503
+    'serve_ingress_max_connections': 64,
 }
 
 
@@ -456,6 +475,23 @@ def split_serve_config(cli_args: Dict[str, Any]) -> Tuple[Config, Config]:
     if serve['serve_default_timeout_s'] is not None:
         serve['serve_default_timeout_s'] = \
             float(serve['serve_default_timeout_s'])
+    serve['serve_batch_shed_fraction'] = \
+        float(serve['serve_batch_shed_fraction'])
+    if not (0 < serve['serve_batch_shed_fraction'] <= 1):
+        raise ValueError('serve_batch_shed_fraction must be in (0, 1]; '
+                         f'got {serve["serve_batch_shed_fraction"]}')
+    if serve['serve_ingress_port'] is not None:
+        serve['serve_ingress_port'] = int(serve['serve_ingress_port'])
+        if not serve['serve_ingress_auth_file']:
+            raise ValueError(
+                'serve_ingress_port requires serve_ingress_auth_file '
+                '(an API-key file; see docs/ingress.md) — the network '
+                'front door has no anonymous mode')
+    for key in ('serve_ingress_max_body_mb',
+                'serve_ingress_max_connections'):
+        serve[key] = int(serve[key])
+        if serve[key] < 1:
+            raise ValueError(f'{key} must be >= 1; got {serve[key]}')
     return serve, base
 
 
@@ -482,7 +518,9 @@ def form_list_from_user_input(
             path_list = [line.strip() for line in f if line.strip()]
 
     for path in path_list:
-        if not Path(path).exists():
+        # '.live' paths are VIRTUAL — live-session pseudo-identities
+        # (serve/server.submit_live); nothing exists (or should) at them
+        if not path.endswith('.live') and not Path(path).exists():
             print(f'The path does not exist: {path}')
 
     if to_shuffle:

@@ -381,24 +381,30 @@ class BaseExtractor:
         from video_features_tpu.obs.manifest import xla_cost_analysis
         return xla_cost_analysis(step, params, batch)
 
-    def _video_cache_key(self, video_path: str) -> str:
+    def _video_cache_key(self, video_path: str, segment=None) -> str:
         from video_features_tpu.cache import video_cache_key
-        return video_cache_key(video_path, self.run_fingerprint)
+        return video_cache_key(video_path, self.run_fingerprint,
+                               segment=segment)
 
-    def cache_fetch(self, video_path: str, output_path: str = None) -> bool:
+    def cache_fetch(self, video_path: str, output_path: str = None,
+                    segment=None, name_path: str = None) -> bool:
         """Serve this video's outputs from the cache if present: a hit
         atomically materializes byte-identical files under the output
         root (plus the resume sidecar) WITHOUT decoding or running the
         network. Cache failures degrade to a miss, never to a failed
-        video."""
+        video. ``segment`` keys a range extraction separately from the
+        full video; ``name_path`` (the segment-suffixed pseudo-path)
+        names the materialized files — content hashing always uses the
+        real ``video_path``."""
         if self.cache is None or self.run_fingerprint is None:
             return False
         out_root = output_path or self.output_path
         from video_features_tpu.cache import log_cache_error
         try:
-            hit = self.cache.fetch_to(self._video_cache_key(video_path),
-                                      out_root, video_path,
-                                      fingerprint=self.run_fingerprint)
+            hit = self.cache.fetch_to(
+                self._video_cache_key(video_path, segment),
+                out_root, name_path or video_path,
+                fingerprint=self.run_fingerprint)
         except Exception:
             log_cache_error(f'lookup for {video_path}')
             return False
@@ -407,21 +413,24 @@ class BaseExtractor:
                   f'{Path(out_root).absolute()}/ - skipping extraction..')
         return hit
 
-    def cache_publish(self, video_path: str, output_path: str = None) -> None:
+    def cache_publish(self, video_path: str, output_path: str = None,
+                      segment=None, name_path: str = None) -> None:
         """Publish the just-saved output files into the cache (exact
         bytes, so every future hit is byte-identical to this cold run)."""
         if self.cache is None or self.run_fingerprint is None:
             return
         out_root = output_path or self.output_path
         ext = ACTION_TO_EXT[self.on_extraction]
-        files = {key: (make_path(out_root, video_path, key, ext), ext)
+        name = name_path or video_path
+        files = {key: (make_path(out_root, name, key, ext), ext)
                  for key in self._saved_feat_keys()}
         if not all(os.path.exists(src) for src, _ in files.values()):
             return                       # partial save (failed video): skip
         from video_features_tpu.cache import log_cache_error
         try:
-            self.cache.put(self._video_cache_key(video_path), files,
-                           meta={'video': Path(video_path).name,
+            self.cache.put(self._video_cache_key(video_path, segment),
+                           files,
+                           meta={'video': Path(name).name,
                                  'feature_type': self.feature_type})
         except Exception:
             log_cache_error(f'publish for {video_path}')
@@ -507,9 +516,23 @@ class BaseExtractor:
         ``window`` is the host array one batch slot carries (a frame stack
         or a single frame); ``meta`` is per-window metadata scattered back
         alongside the features (e.g. a timestamp), or None. Video-level
-        metadata goes in ``task.info``.
+        metadata goes in ``task.info``. ``task.segment`` (when set) is a
+        ``(start_s, end_s)`` time range: implementations must emit only
+        the windows overlapping it and stop decoding past its end.
         """
         raise NotImplementedError
+
+    def live_window_spec(self):
+        """How to window RAW network frames for a live session, or None
+        when the family can't (``registry.LIVE_FEATURES`` mirrors this).
+        Returns ``(win, step, transform, timed)``: window length / stride
+        in frames, an optional per-frame host transform (HWC uint8 →
+        model-ready frame), and whether per-window meta is a timestamp
+        (frame-wise families) or None (stack families). The live-session
+        layer (``ingress/live.py``) replays the exact windowing the
+        packed path applies to decoded files, so a live session's windows
+        feed the same compiled step."""
+        return None
 
     def packed_step(self, batch) -> Dict:
         """One compiled device step on a packed ``(B, ...)`` batch →
@@ -678,10 +701,25 @@ class StackPackingMixin:
             backend=self.decode_backend)
 
     def packed_windows(self, task):
-        from video_features_tpu.extract.streaming import stream_windows
-        for window in stream_windows(self._make_loader(task.path),
-                                     self.stack_size, self.step_size):
-            yield window, None
+        from video_features_tpu.extract.streaming import (
+            segment_frame_range, stream_windows,
+        )
+        loader = self._make_loader(task.path)
+        # deterministic close (segment early-stop abandons the stream
+        # mid-decode; GC-timed release would strand codec contexts and
+        # re-encode temps in a long-lived serve worker)
+        try:
+            for window in stream_windows(
+                    loader, self.stack_size, self.step_size,
+                    frame_range=segment_frame_range(task.segment,
+                                                    loader.fps)):
+                yield window, None
+        finally:
+            loader.close()
+
+    def live_window_spec(self):
+        # raw-frame stacks: live frames window exactly like decoded ones
+        return (self.stack_size, self.step_size, None, False)
 
     def packed_result(self, task) -> Dict[str, np.ndarray]:
         rows = task.rows.get(self.feature_type, [])

@@ -91,11 +91,24 @@ class BaseFrameWiseExtractor(BaseExtractor):
             self._ensure_mesh('batch_size')
 
     def packed_windows(self, task):
+        from video_features_tpu.extract.streaming import (
+            framewise_segment_windows, segment_frame_range,
+        )
         loader = self._make_loader(task.path)
         task.info['fps'] = loader.fps
-        for batch, times, _ in loader:
-            for frame, t_ms in zip(batch, times):
-                yield np.asarray(frame), t_ms
+        # deterministic close (segment early-stop abandons the loader
+        # mid-decode; GC-timed release would strand codec contexts and
+        # re-encode temps in a long-lived serve worker)
+        try:
+            yield from framewise_segment_windows(
+                loader, segment_frame_range(task.segment, loader.fps))
+        finally:
+            loader.close()
+
+    def live_window_spec(self):
+        # one window = one host-transformed frame; meta is a timestamp
+        # (the live layer synthesizes it from the session's declared fps)
+        return (1, 1, self.host_transform, True)
 
     def host_transform_spec(self):
         """Named-spec form of :meth:`host_transform` (``farm/recipes.py``

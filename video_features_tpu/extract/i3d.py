@@ -270,13 +270,14 @@ class ExtractI3D(BaseExtractor):
 
     # -- extraction ---------------------------------------------------------
 
-    def _stream_windows(self, loader, tracer=None):
+    def _stream_windows(self, loader, tracer=None, frame_range=None):
         """(stack_size+1)-frame windows (B+1 frames → B flow pairs) streamed
         off the decoder; see extract.streaming for the semantics."""
         from video_features_tpu.extract.streaming import stream_windows
         tracer = self.tracer if tracer is None else tracer
         return stream_windows(loader, self.stack_size + 1, self.step_size,
-                              tracer, 'decode+preprocess')
+                              tracer, 'decode+preprocess',
+                              frame_range=frame_range)
 
     def _make_loader(self, video_path: str) -> VideoLoader:
         # frames stay uint8 until they are on the device: values are exact
@@ -372,9 +373,26 @@ class ExtractI3D(BaseExtractor):
     supports_packing = True
 
     def packed_windows(self, task):
-        for window in self._stream_windows(self._make_loader(task.path),
-                                           tracer=NULL_TRACER):
-            yield window, None
+        from video_features_tpu.extract.streaming import segment_frame_range
+        loader = self._make_loader(task.path)
+        # deterministic close (segment early-stop abandons the stream
+        # mid-decode; GC-timed release would strand codec contexts and
+        # re-encode temps in a long-lived serve worker)
+        try:
+            for window in self._stream_windows(
+                    loader, tracer=NULL_TRACER,
+                    frame_range=segment_frame_range(task.segment,
+                                                    loader.fps)):
+                yield window, None
+        finally:
+            loader.close()
+
+    def live_window_spec(self):
+        # B+1 raw frames → B flow pairs; the host short-side resize
+        # applies per frame unless device_resize lifted it in-graph
+        return (self.stack_size + 1, self.step_size,
+                (None if self.device_resize
+                 else lambda f: resize_pil(f, MIN_SIDE_SIZE)), False)
 
     def packed_step(self, stacks):
         # device arrays out — dispatch only; the scheduler materializes

@@ -18,7 +18,8 @@ with windows from several videos instead of padding at every video's tail.
 """
 from __future__ import annotations
 
-from typing import Callable, Iterable, Iterator, List
+import math
+from typing import Callable, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -145,6 +146,43 @@ def overlap_fetch(dispatched: Iterable[tuple], fetch, depth: int,
         yield materialize()
 
 
+def segment_frame_range(segment, fps) -> Optional[Tuple[int, int]]:
+    """Map a ``(start_s, end_s)`` time range onto retimed frame indices.
+
+    The half-open frame range ``[start_f, end_f)`` covers every frame
+    whose timestamp falls inside the segment at the loader's OUTPUT
+    frame rate (post-retiming — the timebase ``timestamps_ms`` and the
+    windower both live in). Conservative rounding (floor start, ceil
+    end) so a window that merely touches the boundary is still covered.
+    """
+    if segment is None:
+        return None
+    start_s, end_s = float(segment[0]), float(segment[1])
+    fps = float(fps)
+    return (int(math.floor(start_s * fps)),
+            max(int(math.ceil(end_s * fps)), 0))
+
+
+def framewise_segment_windows(batches: Iterable,
+                              frame_range: Optional[Tuple[int, int]],
+                              ) -> Iterator[tuple]:
+    """Per-frame ``(frame, t_ms)`` windows from a loader's batch stream,
+    honoring an optional half-open frame range with early decode stop —
+    the ONE home for the frame-wise segment filter, shared by
+    ``BaseFrameWiseExtractor.packed_windows`` and the farm's
+    ``FramewiseRecipe`` so the in-process and worker-process paths can
+    never diverge on the boundary rule (byte-parity is tested, but only
+    a shared implementation makes it structural)."""
+    for batch, times, indices in batches:
+        for frame, t_ms, idx in zip(batch, times, indices):
+            if frame_range is not None:
+                if idx < frame_range[0]:
+                    continue                  # before the range: drop
+                if idx >= frame_range[1]:
+                    return                    # past it: stop decoding
+            yield np.asarray(frame), t_ms
+
+
 def stream_windows_across_videos(tasks: Iterable,
                                  open_windows: Callable) -> Iterator[tuple]:
     """The corpus-mode windower: yield ``(task, window, meta)`` across video
@@ -178,7 +216,16 @@ def stream_windows_across_videos(tasks: Iterable,
             yield FLUSH
             continue
         try:
-            for window, meta in open_windows(task):
+            for item in open_windows(task):
+                if item is FLUSH:
+                    # a LIVE window source (ingress live sessions) marks
+                    # an arrival lull mid-video: pass it through so the
+                    # packer flushes partial pools and the async loop
+                    # materializes — already-computed windows stream back
+                    # to the client instead of waiting on future frames
+                    yield FLUSH
+                    continue
+                window, meta = item
                 if task.failed:
                     # the consumer failed this video mid-run (device-step
                     # fault): stop decoding the rest of it — only the few
@@ -209,16 +256,48 @@ def stream_windows_across_videos(tasks: Iterable,
 
 def stream_windows(batches: Iterable, win: int, step: int,
                    tracer: Tracer = NULL_TRACER,
-                   stage: str = 'decode') -> Iterator[np.ndarray]:
+                   stage: str = 'decode',
+                   frame_range: Optional[Tuple[int, int]] = None,
+                   ) -> Iterator[np.ndarray]:
     """Yield (win, ...)-shaped frame windows from a loader's batch stream.
 
     ``batches`` iterates ``(batch, times, indices)`` tuples (the VideoLoader
     protocol); decode work inside ``next()`` is timed under ``stage``.
+
+    ``frame_range`` (segment queries) restricts the emitted windows to
+    those OVERLAPPING the half-open frame range ``[start_f, end_f)``:
+    window k spans frames ``[k·step, k·step + win)``, and the first /
+    last covered k follow from that. The iterator stops pulling decode
+    batches as soon as the last covered window completes, so decode cost
+    is proportional to the covered range's END, never the whole video
+    (sequential decoders can't seek, so frames BEFORE the range still
+    decode but are dropped without stacking).
+
+    A bare ``parallel.packing.FLUSH`` item in ``batches`` passes through
+    untouched (live sessions mark arrival lulls mid-stream) — this is
+    what lets the live-session layer run its network frames through THIS
+    windower, so live and file-backed windowing can never diverge.
     """
+    from video_features_tpu.parallel.packing import FLUSH
     buf: List[np.ndarray] = []
     offset = 0          # absolute frame index of buf[0]
     next_start = 0      # absolute start of the next window
-    for batch, _, _ in tracer.wrap_iter(stage, batches):
+    end_f = None
+    if frame_range is not None:
+        start_f, end_f = frame_range
+        if start_f >= end_f:
+            return          # empty range: no window overlaps it
+        # first window whose span reaches into the range:
+        # k·step + win > start_f
+        k_min = max(0, (start_f - win) // step + 1)
+        next_start = k_min * step
+        if next_start >= end_f:
+            return
+    for item in tracer.wrap_iter(stage, batches):
+        if item is FLUSH:
+            yield FLUSH
+            continue
+        batch = item[0]
         buf.extend(batch)
         # drop frames the next window can no longer touch
         d = min(next_start - offset, len(buf))
@@ -229,6 +308,8 @@ def stream_windows(batches: Iterable, win: int, step: int,
             s = next_start - offset
             yield np.stack(buf[s:s + win])
             next_start += step
+            if end_f is not None and next_start >= end_f:
+                return      # past the range: stop decoding the tail
             d = min(next_start - offset, len(buf))
             if d > 0:
                 del buf[:d]

@@ -109,8 +109,9 @@ class DecodeFarm:
     def __init__(self, recipe, workers: int = 2,
                  ring_bytes: int = 64 * _MB,
                  tracer: Tracer = NULL_TRACER,
-                 cache_key_fn: Optional[Callable[[str], str]] = None,
-                 respawn_limit: int = RESPAWN_LIMIT) -> None:
+                 cache_key_fn: Optional[Callable] = None,
+                 respawn_limit: int = RESPAWN_LIMIT,
+                 live_open: Optional[Callable] = None) -> None:
         import multiprocessing
         self.recipe = recipe
         self.n_workers = max(int(workers), 1)
@@ -134,6 +135,14 @@ class DecodeFarm:
                        'videos_assigned': 0, 'videos_done': 0,
                        'videos_failed': 0, 'deduped': 0}
         self._workers: List[_Worker] = []
+        # live tasks (ingress live sessions): windows arrive over the
+        # network in the PARENT, so they never ship to a worker process.
+        # ``live_open(task)`` returns the task's window iterator; each
+        # live task gets a feeder thread appending to _live_out, which
+        # the drain loop yields alongside worker windows.
+        self._live_open = live_open
+        self._live_out: 'deque' = deque()
+        self._live_threads: List[threading.Thread] = []
         self._admit: Optional[Callable] = None
         self._dispatch_done = False
         self._dispatch_error: Optional[BaseException] = None
@@ -174,7 +183,8 @@ class DecodeFarm:
         for seq in requeue:
             task = self._tasks[seq]
             w.pending.append(seq)
-            w.task_q.put(('video', seq, str(task.path)))
+            w.task_q.put(('video', seq, str(task.path),
+                          getattr(task, 'segment', None)))
         return w
 
     def start(self) -> 'DecodeFarm':
@@ -262,12 +272,25 @@ class DecodeFarm:
                     self._append_flush()
                     continue
                 task = item
+                if getattr(task, 'windows_override', None) is not None \
+                        and self._live_open is not None:
+                    # live session: no file to decode — run its window
+                    # source on a parent-side feeder thread
+                    self._start_live(task)
+                    continue
                 if not self._gate(task, admit):
                     continue
                 key = None
                 if self.cache_key_fn is not None:
+                    seg = getattr(task, 'segment', None)
                     try:
-                        key = self.cache_key_fn(str(task.path))
+                        # segment passed only when set: a range task must
+                        # never dedupe against its full-video twin, and
+                        # pre-segment key fns keep working for whole
+                        # videos
+                        key = (self.cache_key_fn(str(task.path), seg)
+                               if seg is not None
+                               else self.cache_key_fn(str(task.path)))
                     except Exception:
                         key = None             # unhashable → no dedupe
                 with self._lock:
@@ -291,7 +314,9 @@ class DecodeFarm:
                 self._resolve_parked(admit)
                 with self._lock:
                     busy = (self._outstanding > 0
-                            or any(self._parked.values()))
+                            or any(self._parked.values())
+                            or any(t.is_alive()
+                                   for t in self._live_threads))
                 if not busy:
                     break
                 if any(self._parked.values()) \
@@ -305,6 +330,59 @@ class DecodeFarm:
             self._dispatch_error = e
         finally:
             self._dispatch_done = True
+
+    def _prune_live(self) -> None:
+        """Drop finished feeder threads — a serve farm lives for the
+        server's lifetime, so an append-only list would retain a dead
+        Thread (and an is_alive scan) per live session forever."""
+        with self._lock:
+            self._live_threads = [t for t in self._live_threads
+                                  if t.is_alive()]
+
+    def _start_live(self, task) -> None:
+        t = threading.Thread(target=self._feed_live, args=(task,),
+                             daemon=True, name='vft-farm-live')
+        self._prune_live()
+        with self._lock:
+            self._live_threads.append(t)
+        t.start()
+
+    def _feed_live(self, task) -> None:
+        """Feeder thread for one live task: runs its window source (the
+        session's network-fed windower) and hands windows to the drain
+        loop via ``_live_out``, bounded so a stalled consumer
+        backpressures the session instead of growing parent memory. The
+        per-video error contract holds: a feeder failure dooms exactly
+        this task."""
+        from video_features_tpu.extract.base import log_extraction_error
+        from video_features_tpu.parallel.packing import FLUSH
+        try:
+            for item in self._live_open(task):
+                if self._stopping or task.failed:
+                    break
+                if item is FLUSH:
+                    # arrival lull: flush partial pools so computed
+                    # windows stream back (watermarked like any FLUSH,
+                    # so it never overtakes windows still decoding)
+                    self._append_flush()
+                    continue
+                window, meta = item
+                task.emitted += 1
+                while len(self._live_out) >= 64 and not self._stopping:
+                    time.sleep(0.005)
+                self._live_out.append((task, window, meta))
+        except Exception:
+            task.failed = True
+            log_extraction_error(task.path, stage='decode',
+                                 request_id=_request_id(task))
+        finally:
+            task.exhausted = True
+            if task.emitted == 0:
+                self._ctrl.append(('nudge', task))
+            else:
+                # flush the session's tail windows out of the pools now —
+                # the stream may not see another FLUSH for a long time
+                self._append_flush()
 
     def _append_flush(self) -> None:
         """Queue a FLUSH marker with a watermark: the in-process windower
@@ -378,7 +456,8 @@ class DecodeFarm:
             self._unfinished.add(seq)
             target.pending.append(seq)
             self._stats['videos_assigned'] += 1
-        target.task_q.put(('video', seq, str(task.path)))
+        target.task_q.put(('video', seq, str(task.path),
+                           getattr(task, 'segment', None)))
         return True
 
     def _resolve_parked(self, admit: Callable,
@@ -447,6 +526,11 @@ class DecodeFarm:
         from video_features_tpu.parallel.packing import FLUSH, NUDGE
         last_supervise = 0.0
         while True:
+            # live-session windows first: produced parent-side, they
+            # should reach the packer before any lull FLUSH queued after
+            # them flushes the pools
+            while self._live_out:
+                yield self._live_out.popleft()
             while self._ctrl:
                 marker = self._ctrl[0]
                 if marker[0] == 'flush':
@@ -467,7 +551,9 @@ class DecodeFarm:
                     yield NUDGE
             with self._lock:
                 drained = (self._dispatch_done and self._outstanding == 0
-                           and not self._ctrl)
+                           and not self._ctrl and not self._live_out
+                           and not any(t.is_alive()
+                                       for t in self._live_threads))
             if drained and not self._ctrl:
                 if self._dispatch_error is None:
                     # surface any last accounting before ending
@@ -499,6 +585,7 @@ class DecodeFarm:
                 # (non-blocking: this thread must never wait on the
                 # runahead window it is responsible for shrinking)
                 self._resolve_parked(self._admit, block=False)
+                self._prune_live()
                 self._update_gauges()
 
     def _drain_worker(self, w: _Worker) -> Iterator:
@@ -709,7 +796,8 @@ class DecodeFarm:
                         if target is not None:
                             target.pending.append(seq)
                     if target is not None:
-                        target.task_q.put(('video', seq, str(task.path)))
+                        target.task_q.put(('video', seq, str(task.path),
+                                           getattr(task, 'segment', None)))
                     else:
                         task.failed = True
                         task.exhausted = True

@@ -75,16 +75,19 @@ class _LoaderRecipe:
 
 class FramewiseRecipe(_LoaderRecipe):
     """One window = one host-transformed frame; meta = its timestamp —
-    mirrors ``BaseFrameWiseExtractor.packed_windows`` byte for byte."""
+    mirrors ``BaseFrameWiseExtractor.packed_windows`` byte for byte
+    (segment ranges included: same frame-index filter + early stop)."""
 
-    def open(self, path: str) -> Tuple[Dict, Iterator]:
+    def open(self, path: str, segment=None) -> Tuple[Dict, Iterator]:
+        from video_features_tpu.extract.streaming import (
+            framewise_segment_windows, segment_frame_range,
+        )
         loader = self._make_loader(path)
+        frame_range = segment_frame_range(segment, loader.fps)
 
         def windows():
             try:
-                for batch, times, _ in loader:
-                    for frame, t_ms in zip(batch, times):
-                        yield np.asarray(frame), t_ms
+                yield from framewise_segment_windows(loader, frame_range)
             finally:
                 loader.close()
 
@@ -105,13 +108,17 @@ class StackRecipe(_LoaderRecipe):
         self.win = int(win)
         self.step = int(step)
 
-    def open(self, path: str) -> Tuple[Dict, Iterator]:
-        from video_features_tpu.extract.streaming import stream_windows
+    def open(self, path: str, segment=None) -> Tuple[Dict, Iterator]:
+        from video_features_tpu.extract.streaming import (
+            segment_frame_range, stream_windows,
+        )
         loader = self._make_loader(path)
+        frame_range = segment_frame_range(segment, loader.fps)
 
         def windows():
             try:
-                for window in stream_windows(loader, self.win, self.step):
+                for window in stream_windows(loader, self.win, self.step,
+                                             frame_range=frame_range):
                     yield window, None
             finally:
                 loader.close()

@@ -99,10 +99,19 @@ def worker_main(widx: int, epoch: int, recipe, ring_name: str,
             msg = task_q.get()
             if msg[0] == 'stop':
                 break
-            _, seq, path = msg
+            # ('video', seq, path[, segment]) — segment is the optional
+            # (start_s, end_s) range of a segment query, replayed by the
+            # recipe with the exact frame filter the in-process path uses
+            _, seq, path = msg[:3]
+            segment = msg[3] if len(msg) > 3 else None
             n = 0
             try:
-                info, windows = recipe.open(path)
+                # keyword only when a range is actually set: recipes
+                # predating the segment contract keep working for
+                # whole-video tasks
+                info, windows = (recipe.open(path, segment=segment)
+                                 if segment is not None
+                                 else recipe.open(path))
                 out_q.put(('start', widx, epoch, seq, info))
                 it = iter(windows)
                 wait_free = wait_free_for(seq)

@@ -1,0 +1,27 @@
+"""Ingress: the network front door for the warm-pool extraction service.
+
+The loopback JSON-lines socket (``serve/protocol.py``) is a LOCAL
+control surface; this package is what external traffic hits. Stdlib-only
+HTTP/1.1 (+ chunked transfer both ways), because the container bakes no
+HTTP framework and the endpoint needs exactly four things a
+hand-rolled transport gives us precise control over: bounded
+concurrency, streaming request/response bodies for live sessions,
+structured over-limit rejections, and a drain that composes with the
+serve daemon's SIGTERM path.
+
+Modules:
+
+  * ``http``    — transport: request framing/validation, chunked
+    streaming, bounded-concurrency accept loop, connection reaping;
+  * ``auth``    — API-key tenancy: keys file → :class:`auth.Tenant`
+    (name, priority class, quota parameters);
+  * ``quota``   — per-tenant token-bucket rate limits + concurrent
+    request quotas;
+  * ``live``    — live sessions: network frames → the extractor's
+    window geometry → per-window streamed feature chunks;
+  * ``gateway`` — routes + the vft_ingress_* metrics surface, glued to
+    :class:`serve.server.ExtractionServer`.
+"""
+from video_features_tpu.ingress.auth import ApiKeyAuth, Tenant  # noqa: F401
+from video_features_tpu.ingress.gateway import IngressGateway  # noqa: F401
+from video_features_tpu.ingress.quota import QuotaManager  # noqa: F401

@@ -121,6 +121,7 @@ class RunManifest:
         self.executables: Dict[str, Dict[str, Any]] = {}
         self.farm: Dict[str, Any] = {}
         self.mesh: Dict[str, Any] = {}
+        self.ingress: Dict[str, Any] = {}
         self._compile0 = _compile_snapshot()
         _install_compile_listener()
 
@@ -178,6 +179,15 @@ class RunManifest:
         with self._lock:
             self.farm.update({k: _jsonable(v) for k, v in info.items()})
 
+    def note_ingress(self, info: Dict[str, Any]) -> None:
+        """Record the ingress view of a run (per-tenant request/shed
+        counts, live sessions) — written by tooling that drives a run
+        THROUGH the front door (the ingress smoke/bench); the section
+        stays ``{}`` on loopback/CLI runs. Later notes merge over
+        earlier ones."""
+        with self._lock:
+            self.ingress.update({k: _jsonable(v) for k, v in info.items()})
+
     def note_mesh(self, info: Dict[str, Any]) -> None:
         """Record the device mesh a mesh-sharded packed run executed on
         (``mesh_devices``, the (data, time) shape, per-device labels,
@@ -204,6 +214,7 @@ class RunManifest:
             executables = {k: dict(v) for k, v in self.executables.items()}
             farm = dict(self.farm)
             mesh = dict(self.mesh)
+            ingress = dict(self.ingress)
         outcomes: Dict[str, int] = {}
         for v in videos.values():
             outcomes[v['outcome']] = outcomes.get(v['outcome'], 0) + 1
@@ -226,6 +237,9 @@ class RunManifest:
             # mesh-sharded packed execution (mesh_devices > 1): the
             # device mesh the run executed on, {} single-device
             'mesh': mesh,
+            # network front door (ingress/): per-tenant request/shed
+            # view for runs driven through it, {} otherwise
+            'ingress': ingress,
         }
 
     def write(self, path: str) -> str:

@@ -75,6 +75,22 @@ def _request_id(task) -> Optional[str]:
 NUDGE = object()
 
 
+def segment_name(path: str, segment) -> str:
+    """Output-naming path for a ``(start_s, end_s)`` segment extraction:
+    the video's stem gains a ``_seg<start>-<end>ms`` suffix (millisecond
+    ints — dots in a stem would truncate under ``Path(...).stem``), so a
+    partial-range extraction NEVER collides with the full video's output
+    files (or another range's) in a shared output root. The same
+    quantization keys the cache (``cache.key.video_cache_key``)."""
+    if segment is None:
+        return str(path)
+    from pathlib import Path as _Path
+    p = _Path(path)
+    start_ms = int(round(float(segment[0]) * 1000))
+    end_ms = int(round(float(segment[1]) * 1000))
+    return str(p.with_name(f'{p.stem}_seg{start_ms}-{end_ms}ms{p.suffix}'))
+
+
 class VideoTask:
     """Per-video scheduling + scatter-back state for the packed pipeline.
 
@@ -93,13 +109,25 @@ class VideoTask:
 
     __slots__ = ('path', 'video_id', 'rows', 'meta_rows', 'info',
                  'emitted', 'done', 'exhausted', 'failed', 'skipped',
-                 'cached', 'out_root', 'finalized')
+                 'cached', 'out_root', 'finalized', 'segment')
 
     def __init__(self, path: str, video_id: int = -1,
-                 out_root: Optional[str] = None) -> None:
+                 out_root: Optional[str] = None,
+                 segment: Optional[tuple] = None) -> None:
         self.path = path
         self.video_id = video_id
         self.out_root = out_root
+        # optional (start_s, end_s) time range (segment queries): the
+        # windower decodes/extracts only the covered windows, outputs
+        # are named via name_path, and the cache keys on the range.
+        # Quantized to MILLISECONDS here — the one choke point — so the
+        # frame filter, the output name, and the cache key all derive
+        # from the same value: two sub-ms-different ranges must never
+        # share a cache key while selecting different frames.
+        if segment is not None:
+            segment = (round(float(segment[0]), 3),
+                       round(float(segment[1]), 3))
+        self.segment = segment
         self.rows: Dict[str, List[np.ndarray]] = {}
         self.meta_rows: List = []
         self.info: Dict = {}
@@ -117,6 +145,14 @@ class VideoTask:
         # parked duplicate waits for its twin's publish, never a
         # mid-flight state.
         self.finalized = False
+
+    @property
+    def name_path(self) -> str:
+        """The path output files are NAMED after: the real path, or the
+        segment-suffixed pseudo-path for a range extraction (so partial
+        and full outputs never collide in one root). Decode and content
+        hashing always use the real ``path``."""
+        return segment_name(self.path, self.segment)
 
 
 def packed_batches(windows: Iterable[tuple], batch: int,
@@ -372,6 +408,10 @@ def run_packed(ex, video_paths: Iterable,
             yield task
 
     def admit(task: VideoTask) -> bool:
+        # ephemeral tasks (ingress live sessions) have no file behind
+        # them: nothing to resume, nothing to content-hash — always run
+        if getattr(task, 'ephemeral', False):
+            return True
         # The resume check runs here — lazily, as the decode side reaches
         # each video — NOT as an up-front scan: is_already_exist loads
         # every output file, and an eager pass over a mostly-done 20K
@@ -381,10 +421,14 @@ def run_packed(ex, video_paths: Iterable,
         # assignment runahead.)
         # the output_path kwarg is passed only when a task carries a
         # per-request root: hooks monkeypatched/overridden with the
-        # classic (self, video_path) signature keep working for CLI runs
-        exists = (ex.is_already_exist(task.path, output_path=task.out_root)
+        # classic (self, video_path) signature keep working for CLI runs.
+        # name_path (== path unless the task carries a segment range)
+        # keys both resume and the cache materialization target, so a
+        # range extraction never reuses — or clobbers — full outputs.
+        name = task.name_path
+        exists = (ex.is_already_exist(name, output_path=task.out_root)
                   if task.out_root is not None
-                  else ex.is_already_exist(task.path))
+                  else ex.is_already_exist(name))
         if exists:
             task.skipped = True
             return False
@@ -393,7 +437,8 @@ def run_packed(ex, video_paths: Iterable,
         # decodes, never occupies batch slots, and finalizes through the
         # same sweep/on_video_done path as a resume skip
         if getattr(ex, 'cache', None) is not None and \
-                ex.cache_fetch(task.path, output_path=task.out_root):
+                ex.cache_fetch(task.path, output_path=task.out_root,
+                               segment=task.segment, name_path=name):
             task.skipped = True
             task.cached = True
             return False
@@ -402,6 +447,12 @@ def run_packed(ex, video_paths: Iterable,
     def open_windows(task: VideoTask):
         if not admit(task):
             return iter(())
+        # live tasks (ingress live sessions) carry their own window
+        # source — frames arriving over the network, windowed to the
+        # extractor's geometry — instead of decoding task.path
+        override = getattr(task, 'windows_override', None)
+        if override is not None:
+            return override(ex)
         return ex.packed_windows(task)
 
     # flush each video as soon as its last window's features land. NOT
@@ -417,19 +468,24 @@ def run_packed(ex, video_paths: Iterable,
     def finalize(t: VideoTask) -> None:
         from video_features_tpu.extract.base import log_extraction_error
         try:
-            if not (t.failed or t.skipped):
+            if not (t.failed or t.skipped
+                    or getattr(t, 'stream_only', False)):
+                # stream_only (live sessions) already delivered every
+                # window through on_window — nothing to save or publish
                 feats_dict = ex._maybe_concat_streams(ex.packed_result(t))
                 with ex.tracer.stage('save', video=str(t.path),
                                      request_id=_request_id(t)):
                     if t.out_root is not None:
-                        ex.action_on_extraction(feats_dict, t.path,
+                        ex.action_on_extraction(feats_dict, t.name_path,
                                                 output_path=t.out_root)
                     else:
-                        ex.action_on_extraction(feats_dict, t.path)
+                        ex.action_on_extraction(feats_dict, t.name_path)
                 if getattr(ex, 'cache', None) is not None:
                     with ex.tracer.stage('cache_publish',
                                          video=str(t.path)):
-                        ex.cache_publish(t.path, output_path=t.out_root)
+                        ex.cache_publish(t.path, output_path=t.out_root,
+                                         segment=t.segment,
+                                         name_path=t.name_path)
         except KeyboardInterrupt:
             raise
         except Exception:
@@ -511,7 +567,11 @@ def run_packed(ex, video_paths: Iterable,
                 ring_bytes=ring_mb * (1 << 20), tracer=ex.tracer,
                 cache_key_fn=(ex._video_cache_key
                               if getattr(ex, 'cache', None) is not None
-                              else None))
+                              else None),
+                # live tasks (windows_override) never ship to a worker
+                # process — their frames arrive over the network in the
+                # parent; the farm runs them on a feeder thread instead
+                live_open=lambda task: task.windows_override(ex))
             # start eagerly: a RUNTIME start failure (SHM creation on a
             # full /dev/shm, a spawn refused by the container) must
             # degrade to in-process decode like every other farm
@@ -623,6 +683,20 @@ def run_packed(ex, video_paths: Iterable,
             task.done += 1
             if task.failed:       # already doomed: don't grow its rows
                 continue
+            on_window = getattr(task, 'on_window', None)
+            if on_window is not None:
+                # per-window streaming (live sessions): deliver this
+                # row NOW instead of waiting for the video to finalize.
+                # A delivery failure (client hung up) fails the task —
+                # which also tells the decode side to stop feeding it.
+                try:
+                    on_window({key: arr[i] for key, arr in out.items()},
+                              meta)
+                except Exception:
+                    task.failed = True
+                    continue
+            if getattr(task, 'stream_only', False):
+                continue          # don't pin a live session's rows in RAM
             for key, arr in out.items():
                 task.rows.setdefault(key, []).append(arr[i])
             task.meta_rows.append(meta)

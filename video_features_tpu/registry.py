@@ -37,6 +37,14 @@ DATA_PARALLEL_FEATURES = frozenset(
 PACKED_FEATURES = frozenset(
     {'i3d', 'r21d', 's3d', 'resnet', 'clip', 'timm'})
 
+# feature types whose extractor can consume a LIVE session (ingress/):
+# raw network frames windowed to the family's packed geometry
+# (BaseExtractor.live_window_spec). Same deliberate-literal policy: a
+# family must opt in here AND return a spec, or the ingress rejects the
+# session up front with a clear error instead of failing mid-stream.
+LIVE_FEATURES = frozenset(
+    {'i3d', 'r21d', 's3d', 'resnet', 'clip', 'timm'})
+
 
 def create_extractor(args: 'Config') -> 'BaseExtractor':
     feature_type = args['feature_type']

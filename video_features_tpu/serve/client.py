@@ -8,6 +8,7 @@ exceptions, not dicts to inspect.
 """
 from __future__ import annotations
 
+import random
 import socket
 import time
 from typing import Any, Dict, List, Optional
@@ -20,16 +21,50 @@ class ServeError(RuntimeError):
 
 
 class ServeClient:
+    """``connect_timeout_s`` is a DEADLINE, not a single attempt: a
+    refused connect (daemon still warming up, supervisor restart window)
+    retries with bounded exponential backoff + jitter until the deadline
+    passes — so ``start daemon & client.submit(...)`` just works without
+    the caller hand-rolling a poll loop. Unreachable-host errors
+    (timeouts, routing) are NOT retried; only connection-refused is,
+    because that is the one error a late-binding listener cures.
+
+    Every message carries the protocol version (``v``). Compatibility is
+    deliberately one-way: an OLD client against a NEW server keeps
+    working (missing ``v`` = v1), while a NEW client against a
+    pre-versioning server fails LOUDLY on submit (its strict field check
+    rejects ``v`` with a structured error naming the field) — the
+    version field must flow for major-version negotiation to exist at
+    all, and a clear rejection beats silently dropping the handshake."""
+
+    # backoff: 50ms doubling to 1s, each delay jittered ±50% so a
+    # thundering herd of clients doesn't re-refuse in lockstep
+    _BACKOFF_BASE_S = 0.05
+    _BACKOFF_CAP_S = 1.0
+
     def __init__(self, port: int, host: str = '127.0.0.1',
                  connect_timeout_s: float = 10.0) -> None:
         self.host, self.port = host, int(port)
         self.connect_timeout_s = connect_timeout_s
 
     def _connect(self) -> socket.socket:
-        conn = socket.create_connection((self.host, self.port),
-                                        timeout=self.connect_timeout_s)
-        conn.settimeout(None)                 # extraction can take a while
-        return conn
+        deadline = time.monotonic() + self.connect_timeout_s
+        delay = self._BACKOFF_BASE_S
+        while True:
+            remaining = deadline - time.monotonic()
+            try:
+                conn = socket.create_connection(
+                    (self.host, self.port), timeout=max(remaining, 0.001))
+                conn.settimeout(None)         # extraction can take a while
+                return conn
+            except ConnectionRefusedError:
+                if time.monotonic() + delay >= deadline:
+                    raise
+                # clamp the jittered sleep to the remaining budget so
+                # the deadline is honored even at the jitter's top end
+                time.sleep(max(0.0, min(delay * random.uniform(0.5, 1.5),
+                                        deadline - time.monotonic())))
+                delay = min(delay * 2, self._BACKOFF_CAP_S)
 
     @staticmethod
     def _read_response(rfile) -> Dict[str, Any]:
@@ -42,6 +77,7 @@ class ServeClient:
         return resp
 
     def _call(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        msg.setdefault('v', protocol.VERSION)
         with self._connect() as conn:
             conn.sendall(protocol.encode(msg))
             with conn.makefile('rb') as rfile:
@@ -54,16 +90,26 @@ class ServeClient:
 
     def submit(self, feature_type: str, video_paths: List[str],
                overrides: Optional[Dict[str, Any]] = None,
-               timeout_s: Optional[float] = None) -> str:
+               timeout_s: Optional[float] = None,
+               range_s: Optional[List[float]] = None,
+               priority: Optional[str] = None) -> str:
         """Enqueue one extraction request; returns its request_id.
         Raises :class:`ServeError` on rejection (queue_full, draining,
-        invalid config, …) — backpressure is the caller's to handle."""
+        invalid config, …) — backpressure is the caller's to handle.
+        ``range_s=[start_s, end_s]`` makes it a segment query (only the
+        covered windows decode; outputs named ``_seg<a>-<b>ms``);
+        ``priority`` ('interactive' | 'batch') feeds admission — a
+        saturated queue sheds batch before interactive."""
         msg: Dict[str, Any] = {'cmd': 'submit', 'feature_type': feature_type,
                                'video_paths': list(video_paths)}
         if overrides:
             msg['overrides'] = dict(overrides)
         if timeout_s is not None:
             msg['timeout_s'] = float(timeout_s)
+        if range_s is not None:
+            msg['range'] = [float(range_s[0]), float(range_s[1])]
+        if priority is not None:
+            msg['priority'] = str(priority)
         return self._call(msg)['request_id']
 
     def status(self, request_id: str) -> Dict[str, Any]:

@@ -104,6 +104,7 @@ def build_metrics(started_at: float,
                   cache_stats: Optional[Dict[str, Any]] = None,
                   inflight_batches: int = 0,
                   farm_stats: Optional[Dict[str, Any]] = None,
+                  ingress_stats: Optional[Dict[str, Any]] = None,
                   ) -> Dict[str, Any]:
     """Assemble the one metrics document. ``stage_reports`` maps a
     human-readable pool-entry label → that entry's ``Tracer.report()``;
@@ -133,6 +134,14 @@ def build_metrics(started_at: float,
         from video_features_tpu.farm.farm import merge_farm_stats
         farm_stats = merge_farm_stats(())
     doc['farm'] = farm_stats
+    # the network front door's view: per-tenant request/shed counters,
+    # live-session + connection gauges (ingress/gateway.stats()) —
+    # always present, {'enabled': False} on a loopback-only server, so
+    # scrapers see one stable schema
+    doc['ingress'] = (ingress_stats if ingress_stats is not None
+                      else {'enabled': False, 'requests_total': 0,
+                            'shed_total': 0, 'live_sessions': 0,
+                            'open_connections': 0, 'tenants': {}})
     doc.update(request_stats.snapshot())
     doc['stages'] = {label: rep for label, rep in stage_reports.items()}
     doc['stages_merged'] = merge_reports(stage_reports.values())

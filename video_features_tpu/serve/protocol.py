@@ -7,12 +7,25 @@ survives partial reads, and stays debuggable with ``nc``/``telnet``. The
 endpoint binds loopback only; this is a LOCAL control surface (same
 trust domain as the process), not an internet-facing API.
 
+Versioning: every message MAY carry a ``v`` field (``'<major>.<minor>'``;
+:data:`VERSION` is what this build speaks, :data:`MAJOR` the compatible
+major). A missing ``v`` is treated as v1 (pre-versioning clients keep
+working); an unknown MAJOR is rejected with a structured error that
+echoes the message's ``request_id`` (when present) instead of a silent
+parse failure — see :func:`check_version`. Minor-version skew is always
+accepted (additive fields only).
+
 Commands (the ``cmd`` field):
 
   * ``submit``  — ``{cmd, feature_type, video_paths: [..],
-    overrides: {..}, timeout_s}`` → ``{ok, request_id}`` or
-    ``{ok: false, error}``. ``overrides`` merge over the server's base
-    overrides and the feature YAML exactly like CLI dotlist keys.
+    overrides: {..}, timeout_s, range: [start_s, end_s], priority}`` →
+    ``{ok, request_id}`` or ``{ok: false, error}``. ``overrides`` merge
+    over the server's base overrides and the feature YAML exactly like
+    CLI dotlist keys. ``range`` (optional) makes this a SEGMENT query:
+    only the windows overlapping the time range are decoded/extracted,
+    and outputs are named ``<stem>_seg<start>-<end>ms``. ``priority``
+    (``interactive``, the default, or ``batch``) feeds admission
+    control: a saturated queue sheds ``batch`` before ``interactive``.
   * ``status``  — ``{cmd, request_id}`` → per-request state + per-video
     states (see ``serve.server.Request.snapshot``).
   * ``metrics`` — ``{cmd}`` → the live metrics document
@@ -29,10 +42,17 @@ from typing import Any, Dict
 
 COMMANDS = ('submit', 'status', 'metrics', 'metrics_prom', 'drain', 'ping')
 
+# wire protocol version this build speaks; MAJOR is the compatibility
+# gate (minor bumps are additive-fields-only and never rejected)
+VERSION = '1.0'
+MAJOR = 1
+
 # submit() fields copied verbatim into the request (everything else in the
 # message is rejected — catches client/server schema drift loudly)
-SUBMIT_FIELDS = ('cmd', 'feature_type', 'video_paths', 'overrides',
-                 'timeout_s')
+SUBMIT_FIELDS = ('cmd', 'v', 'feature_type', 'video_paths', 'overrides',
+                 'timeout_s', 'range', 'priority')
+
+PRIORITIES = ('interactive', 'batch')
 
 
 def encode(msg: Dict[str, Any]) -> bytes:
@@ -49,6 +69,29 @@ def decode(line: bytes) -> Dict[str, Any]:
     if not isinstance(msg, dict):
         raise ValueError('protocol messages must be JSON objects')
     return msg
+
+
+def check_version(msg: Dict[str, Any]) -> 'Dict[str, Any] | None':
+    """None when the message's protocol version is compatible, else the
+    structured rejection to send back: names the offered and supported
+    versions and echoes the message's ``request_id`` (when it carries
+    one) so a multiplexing client can correlate the failure. A missing
+    ``v`` is v1 (pre-versioning clients); a malformed one is rejected
+    like an unknown major — both fail LOUDLY, never as a parse error."""
+    v = msg.get('v')
+    if v is None:
+        return None
+    try:
+        major = int(str(v).split('.', 1)[0])
+    except (TypeError, ValueError):
+        return error(f'malformed protocol version {v!r} '
+                     f'(server speaks {VERSION})',
+                     v=VERSION, request_id=msg.get('request_id'))
+    if major != MAJOR:
+        return error(f'unsupported protocol major version {v!r}; '
+                     f'server speaks {VERSION}',
+                     v=VERSION, request_id=msg.get('request_id'))
+    return None
 
 
 def error(message: str, **extra: Any) -> Dict[str, Any]:

@@ -43,7 +43,9 @@ from typing import Any, Dict, List, Optional
 
 from video_features_tpu.config import Config, load_config, split_serve_config
 from video_features_tpu.parallel.packing import FLUSH, VideoTask
-from video_features_tpu.registry import PACKED_FEATURES, create_extractor
+from video_features_tpu.registry import (
+    LIVE_FEATURES, PACKED_FEATURES, create_extractor,
+)
 from video_features_tpu.serve import metrics as metrics_mod
 from video_features_tpu.serve import protocol
 from video_features_tpu.serve.pool import DevicePlacer, WarmPool
@@ -118,21 +120,48 @@ class _ServeTask(VideoTask):
     __slots__ = ('request',)
 
     def __init__(self, path: str, request: 'Request',
-                 out_root: str) -> None:
-        super().__init__(path, out_root=out_root)
+                 out_root: str, segment=None) -> None:
+        super().__init__(path, out_root=out_root, segment=segment)
         self.request = request
+
+
+class _LiveServeTask(_ServeTask):
+    """One live session's task: windows come from the session's
+    network-fed windower (``windows_override``), every scattered row
+    streams back through ``on_window``, and nothing is saved or cached
+    (``stream_only``) — the chunked response IS the output."""
+
+    __slots__ = ('session',)
+
+    ephemeral = True          # no file behind it: skip resume/cache
+    stream_only = True        # rows stream out; never accumulate/save
+
+    def __init__(self, path: str, request: 'Request', out_root: str,
+                 session) -> None:
+        super().__init__(path, request, out_root)
+        self.session = session
+
+    def windows_override(self, ex):
+        return self.session.windows(ex)
+
+    def on_window(self, feats: Dict[str, Any], meta) -> None:
+        self.session.send_window(feats, meta)
 
 
 class Request:
     """Admission-to-completion state for one submit."""
 
     def __init__(self, request_id: str, feature_type: str, paths: List[str],
-                 deadline: Optional[float]) -> None:
+                 deadline: Optional[float],
+                 segment: Optional[tuple] = None,
+                 priority: str = 'interactive') -> None:
         self.id = request_id
         self.feature_type = feature_type
         self.videos: Dict[str, str] = {p: 'pending' for p in paths}
         self.pending = len(paths)
         self.deadline = deadline          # monotonic, None = no deadline
+        self.segment = segment            # (start_s, end_s) | None
+        self.priority = priority
         self.t0 = time.monotonic()
         self.done_t: Optional[float] = None
 
@@ -153,6 +182,10 @@ class Request:
         out = {'request_id': self.id, 'state': self.state(),
                'feature_type': self.feature_type,
                'videos': dict(self.videos)}
+        if self.segment is not None:
+            out['range'] = [float(self.segment[0]), float(self.segment[1])]
+        if self.priority != 'interactive':
+            out['priority'] = self.priority
         if self.done_t is not None:
             out['latency_s'] = round(self.done_t - self.t0, 4)
         return out
@@ -304,7 +337,8 @@ class ExtractionServer:
                  idle_flush_s: float = 0.05,
                  max_batch_wait_s: float = 2.0,
                  default_timeout_s: Optional[float] = None,
-                 metrics_path: Optional[str] = None) -> None:
+                 metrics_path: Optional[str] = None,
+                 batch_shed_fraction: float = 0.5) -> None:
         self.base_overrides = dict(base_overrides or {})
         self.host, self._port_req = host, port
         self.queue_depth = queue_depth
@@ -312,6 +346,19 @@ class ExtractionServer:
         self.max_batch_wait_s = max_batch_wait_s
         self.default_timeout_s = default_timeout_s
         self.metrics_path = metrics_path
+        # priority-class admission: 'batch' requests only see this
+        # fraction of the queue, so a saturated queue sheds batch first
+        # and keeps headroom for interactive traffic
+        self.batch_shed_fraction = float(batch_shed_fraction)
+        self._batch_capacity = max(
+            1, int(queue_depth * self.batch_shed_fraction))
+        # the network front door (ingress/), when enabled: attached via
+        # attach_ingress so drain can stop it (reap half-open
+        # connections, end live sessions) in the right order
+        self.ingress = None
+        # fired (with the terminal Request) after every completion —
+        # the ingress gateway releases per-tenant concurrency here
+        self.completion_listeners: List = []
 
         self.pool = WarmPool(pool_size)
         # placement-aware residency: every built entry gets the
@@ -402,6 +449,14 @@ class ExtractionServer:
             if wait:
                 self._drained.wait(grace_s)
             return
+        if self.ingress is not None:
+            # FIRST: stop accepting network traffic and end every live
+            # session's frame input, so the workers' feeds can actually
+            # drain (a live task otherwise blocks on future frames)
+            try:
+                self.ingress.begin_drain()
+            except Exception:
+                pass
         with self._lock:
             # snapshot under the lock: _reap_retired_locked mutates
             # _retired concurrently
@@ -433,6 +488,14 @@ class ExtractionServer:
                 try:
                     self._sock.close()
                 except OSError:
+                    pass
+            if self.ingress is not None:
+                # LAST: force-close whatever connections are still open
+                # (half-open clients that never finished their request
+                # must not pin handler threads past the drain)
+                try:
+                    self.ingress.finish_drain()
+                except Exception:
                     pass
             doc = self.metrics()
             metrics_mod.write_metrics_file(self.metrics_path, doc,
@@ -481,12 +544,60 @@ class ExtractionServer:
 
     # -- admission + dispatch ------------------------------------------------
 
+    def _admission_capacity(self, priority: str) -> int:
+        """The queue capacity this priority class sees: interactive gets
+        the full depth, batch only ``batch_shed_fraction`` of it — so
+        under saturation batch is shed first and never starves
+        interactive headroom. A shed submit is REJECTED before any
+        accounting: it never occupies an admission slot."""
+        return (self._batch_capacity if priority == 'batch'
+                else self.queue_depth)
+
+    @staticmethod
+    def _check_range(range_s) -> Optional[tuple]:
+        """Validated (start_s, end_s) segment, or raises ValueError."""
+        if range_s is None:
+            return None
+        if not isinstance(range_s, (list, tuple)) or len(range_s) != 2:
+            raise ValueError('range must be [start_s, end_s]')
+        import math
+        if not all(math.isfinite(float(v)) for v in range_s):
+            # JSON happily parses 1e999 → inf, which would sail through
+            # the ordering check below and blow up as an OverflowError
+            # deep in the decode thread instead of a structured reject
+            raise ValueError(f'range values must be finite; got {range_s}')
+        # millisecond quantization up front (same as VideoTask's): the
+        # wire value, the frame filter, the output name, and the cache
+        # key must all agree on ONE range
+        start_s = round(float(range_s[0]), 3)
+        end_s = round(float(range_s[1]), 3)
+        if not (0 <= start_s < end_s):
+            raise ValueError(
+                f'range must satisfy 0 <= start < end (at millisecond '
+                f'resolution); got {range_s}')
+        return (start_s, end_s)
+
     def submit(self, feature_type: str, video_paths: List[str],
                overrides: Optional[Dict[str, Any]] = None,
-               timeout_s: Optional[float] = None) -> Dict[str, Any]:
+               timeout_s: Optional[float] = None,
+               range_s=None,
+               priority: str = 'interactive',
+               _live_session=None) -> Dict[str, Any]:
         if not isinstance(video_paths, (list, tuple)) or not video_paths:
             self.stats.bump('rejected')
             return protocol.error('video_paths must be a non-empty list')
+        if priority is None:
+            priority = 'interactive'
+        if priority not in protocol.PRIORITIES:
+            self.stats.bump('rejected')
+            return protocol.error(
+                f'unknown priority {priority!r}; known: '
+                f'{", ".join(protocol.PRIORITIES)}')
+        try:
+            segment = self._check_range(range_s)
+        except (TypeError, ValueError) as e:
+            self.stats.bump('rejected')
+            return protocol.error(f'invalid range: {e}')
         paths = [str(p) for p in video_paths]
         if len(set(paths)) != len(paths):
             # Request.videos is keyed by path: a duplicate would collapse
@@ -500,6 +611,11 @@ class ExtractionServer:
             return protocol.error(
                 f'feature_type {feature_type!r} has no packed/serving '
                 f'support; serveable: {", ".join(sorted(PACKED_FEATURES))}')
+        if _live_session is not None and feature_type not in LIVE_FEATURES:
+            self.stats.bump('rejected')
+            return protocol.error(
+                f'feature_type {feature_type!r} has no live-session '
+                f'support; live-capable: {", ".join(sorted(LIVE_FEATURES))}')
         # config resolution is LOCK-FREE: the YAML read + sanity_check
         # must not stall completion callbacks or status/metrics — the
         # admission lock guards only server state (the block below)
@@ -538,8 +654,9 @@ class ExtractionServer:
         # and take the normal extraction path, where the standard
         # per-video fault isolation reports them.
         cache_hits: List[str] = []
-        if args.get('cache_enabled') and not self._draining:
-            cache_hits = self._answer_cache_hits(args, paths)
+        if args.get('cache_enabled') and not self._draining \
+                and _live_session is None:
+            cache_hits = self._answer_cache_hits(args, paths, segment)
             if cache_hits:
                 self.stats.bump('cached_videos', len(cache_hits))
         miss_paths = ([p for p in paths if p not in set(cache_hits)]
@@ -549,7 +666,7 @@ class ExtractionServer:
             with self._lock:
                 self._next_id += 1
                 req = Request(f'r{self._next_id:06d}', feature_type, paths,
-                              None)
+                              None, segment=segment, priority=priority)
                 for p in paths:
                     req.videos[p] = 'cached'
                 req.pending = 0
@@ -563,11 +680,12 @@ class ExtractionServer:
             if self._draining:
                 self.stats.bump('rejected')
                 return protocol.error('draining')
-            if self._inflight_videos + len(miss_paths) > self.queue_depth:
+            capacity = self._admission_capacity(priority)
+            if self._inflight_videos + len(miss_paths) > capacity:
                 self.stats.bump('rejected')
                 return protocol.error(
                     'queue_full', depth=self._inflight_videos,
-                    capacity=self.queue_depth)
+                    capacity=capacity, priority=priority)
             worker = self.pool.get(key)
             build_lock = self._build_locks.setdefault(
                 key, threading.Lock())
@@ -625,14 +743,16 @@ class ExtractionServer:
                     worker.close()
                     self.stats.bump('rejected')
                     return protocol.error('draining')
-                if self._inflight_videos + len(miss_paths) > self.queue_depth:
+                if self._inflight_videos + len(miss_paths) > \
+                        self._admission_capacity(priority):
                     # re-check after the lockless build window; the
                     # freshly built worker stays pooled, warm for the
                     # caller's retry
                     self.stats.bump('rejected')
                     return protocol.error(
                         'queue_full', depth=self._inflight_videos,
-                        capacity=self.queue_depth)
+                        capacity=self._admission_capacity(priority),
+                        priority=priority)
                 if worker.closed or worker.crashed:
                     worker = None         # evicted/crashed in the window
                     continue
@@ -644,7 +764,7 @@ class ExtractionServer:
                             if timeout_s is not None else None)
                 self._next_id += 1
                 req = Request(f'r{self._next_id:06d}', feature_type, paths,
-                              deadline)
+                              deadline, segment=segment, priority=priority)
                 for p in cache_hits:
                     # already answered from cache above: terminal before
                     # the misses even enqueue
@@ -652,8 +772,17 @@ class ExtractionServer:
                     req.pending -= 1
                 self._requests[req.id] = req
                 self._inflight_videos += len(miss_paths)
-                tasks = [_ServeTask(p, req, out_root=args['output_path'])
-                         for p in miss_paths]
+                if _live_session is not None:
+                    tasks: List[_ServeTask] = [_LiveServeTask(
+                        miss_paths[0], req,
+                        out_root=args['output_path'],
+                        session=_live_session)]
+                    _live_session.bind(req)
+                else:
+                    tasks = [_ServeTask(p, req,
+                                        out_root=args['output_path'],
+                                        segment=segment)
+                             for p in miss_paths]
                 # enqueue under the admission lock: eviction (pool.put)
                 # also runs under it, so a worker can't be judged idle
                 # and closed between admission and enqueue
@@ -662,6 +791,28 @@ class ExtractionServer:
             return protocol.ok(request_id=req.id)
         self.stats.bump('rejected')
         return protocol.error('worker churn outpaced admission; retry')
+
+    def submit_live(self, feature_type: str, session,
+                    overrides: Optional[Dict[str, Any]] = None,
+                    timeout_s: Optional[float] = None,
+                    priority: str = 'interactive') -> Dict[str, Any]:
+        """Admit one LIVE session: a long-lived request whose frames
+        arrive over time (``session`` is an ``ingress.live.LiveSession``
+        — or anything with ``pseudo_path``/``bind``/``windows``/
+        ``send_window``). Takes the same admission path as
+        :meth:`submit` (deadline, priority shed, queue depth: a session
+        occupies ONE admission slot until it ends), but its task decodes
+        nothing and saves nothing — windows stream in from the session
+        and features stream back out through it, per window."""
+        return self.submit(feature_type, [session.pseudo_path],
+                           overrides=overrides, timeout_s=timeout_s,
+                           priority=priority, _live_session=session)
+
+    def attach_ingress(self, ingress) -> None:
+        """Register the network front door (``ingress/``) so drain can
+        quiesce it: stop accepting, end live sessions, reap half-open
+        connections."""
+        self.ingress = ingress
 
     def _place_extractor(self, extractor) -> Optional[List]:
         """Assign a fresh entry's extractor its resident chip(s): the
@@ -699,15 +850,17 @@ class ExtractionServer:
         if devices:
             self._placer.release(devices)
 
-    def _answer_cache_hits(self, args: Config,
-                           paths: List[str]) -> List[str]:
+    def _answer_cache_hits(self, args: Config, paths: List[str],
+                           segment=None) -> List[str]:
         """Materialize every video the feature cache already holds for
         this request's recipe into its output root; returns the hit
         paths. Never raises — any cache-side failure is a miss, and the
-        normal extraction path owns reporting it."""
+        normal extraction path owns reporting it. ``segment`` keys (and
+        names) a range extraction separately from the full video."""
         from video_features_tpu.cache import (
             FeatureCache, log_cache_error, run_fingerprint, video_cache_key,
         )
+        from video_features_tpu.parallel.packing import segment_name
         hits: List[str] = []
         try:
             cache = FeatureCache.get(args.get('cache_dir'),
@@ -720,8 +873,10 @@ class ExtractionServer:
             return hits
         for p in paths:
             try:
-                if cache.fetch_to(video_cache_key(p, fp),
-                                  args['output_path'], p, fingerprint=fp):
+                if cache.fetch_to(video_cache_key(p, fp, segment=segment),
+                                  args['output_path'],
+                                  segment_name(p, segment),
+                                  fingerprint=fp):
                     hits.append(p)
             except Exception:
                 # e.g. the video file itself is unreadable (can't be
@@ -793,12 +948,19 @@ class ExtractionServer:
         pool_stats['device_residents'] = self._placer.snapshot()
         from video_features_tpu.cache.store import merge_cache_stats
         from video_features_tpu.farm.farm import merge_farm_stats
+        ingress_stats = None
+        if self.ingress is not None:
+            try:
+                ingress_stats = self.ingress.stats()
+            except Exception:
+                ingress_stats = None
         return metrics_mod.build_metrics(
             self._started_at, depth, self.queue_depth, draining,
             pool_stats, self.stats, reports,
             cache_stats=merge_cache_stats(c.stats() for c in caches),
             inflight_batches=inflight_batches,
-            farm_stats=merge_farm_stats(farms))
+            farm_stats=merge_farm_stats(farms),
+            ingress_stats=ingress_stats)
 
     # -- completion callbacks (worker threads) -------------------------------
 
@@ -819,6 +981,13 @@ class ExtractionServer:
         if req.state() in ('partial', 'failed'):
             self.stats.bump('failed')
         self.stats.observe_latency(req.done_t - req.t0)
+        for listener in list(self.completion_listeners):
+            # e.g. the ingress gateway releasing this request's tenant
+            # concurrency slot; a listener bug must not lose completions
+            try:
+                listener(req)
+            except Exception:
+                pass
         if self.metrics_path:
             # building the metrics document takes the server lock and
             # snapshots every tracer — skip it entirely when no
@@ -893,9 +1062,15 @@ class ExtractionServer:
                     return                    # client went away
 
     def _dispatch(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        # version gate first: an incompatible client gets a structured
+        # rejection naming both versions (and echoing its request_id),
+        # never a field-validation error about a schema it doesn't speak
+        bad_version = protocol.check_version(msg)
+        if bad_version is not None:
+            return bad_version
         cmd = msg.get('cmd')
         if cmd == 'ping':
-            return protocol.ok(draining=self._draining)
+            return protocol.ok(draining=self._draining, v=protocol.VERSION)
         if cmd == 'submit':
             unknown = set(msg) - set(protocol.SUBMIT_FIELDS)
             if unknown:
@@ -904,7 +1079,9 @@ class ExtractionServer:
             return self.submit(msg.get('feature_type'),
                                msg.get('video_paths'),
                                overrides=msg.get('overrides'),
-                               timeout_s=msg.get('timeout_s'))
+                               timeout_s=msg.get('timeout_s'),
+                               range_s=msg.get('range'),
+                               priority=msg.get('priority', 'interactive'))
         if cmd == 'status':
             return self.status(msg.get('request_id'))
         if cmd == 'metrics':
@@ -933,6 +1110,7 @@ def serve_main(argv: List[str]) -> int:
         max_batch_wait_s=serve_cfg['serve_max_batch_wait_s'],
         default_timeout_s=serve_cfg['serve_default_timeout_s'],
         metrics_path=serve_cfg['serve_metrics_path'],
+        batch_shed_fraction=serve_cfg['serve_batch_shed_fraction'],
     ).start()
     server.install_signal_handlers()
     # machine-greppable endpoint line (tests and tooling scrape it)
@@ -940,6 +1118,22 @@ def serve_main(argv: List[str]) -> int:
           f'(pid {os.getpid()}; queue_depth='
           f'{serve_cfg["serve_queue_depth"]}, warm_pool='
           f'{serve_cfg["serve_warm_pool_size"]})', flush=True)
+    if serve_cfg['serve_ingress_port'] is not None:
+        # the network front door (ingress/): HTTP/1.1 + chunked, API-key
+        # tenancy, quotas/priorities, segment queries, live sessions
+        from video_features_tpu.ingress.gateway import IngressGateway
+        gateway = IngressGateway(
+            server,
+            host=serve_cfg['serve_ingress_host'],
+            port=serve_cfg['serve_ingress_port'],
+            auth_file=serve_cfg['serve_ingress_auth_file'],
+            max_body_bytes=(serve_cfg['serve_ingress_max_body_mb']
+                            * (1 << 20)),
+            max_connections=serve_cfg['serve_ingress_max_connections'],
+        ).start()
+        # second machine-greppable endpoint line (same scraping contract)
+        print(f'ingress on {gateway.host}:{gateway.port} '
+              f'(tenants={gateway.n_tenants})', flush=True)
     server.serve_forever()
     print('serve: drained, exiting', flush=True)
     sys.stdout.flush()
