@@ -1,0 +1,644 @@
+"""vft-lint (video_features_tpu/analysis): the checker suite itself.
+
+Two layers:
+
+  * fixture packages with PLANTED violations, one per rule — the suite
+    must catch each (and must NOT fire on the matching clean variant);
+  * the live codebase: running every rule over the real package with the
+    shipped (empty) baseline must be clean — this is the same gate CI's
+    ``lint`` job enforces, pinned here so a tier-1 run catches a new
+    violation even without the lint job.
+
+The analyzer is pure-AST by contract: the subprocess test asserts the
+CLI process never imports jax and finishes well inside the 10 s budget.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from video_features_tpu.analysis import (
+    Package, analyze, filter_suppressed, load_baseline, new_findings,
+    run_checks, write_baseline,
+)
+from video_features_tpu.analysis.checks import (
+    check_contract_keys, check_knob_classification,
+    check_knob_registry_single_source, check_recipe_picklable,
+    check_spawn_purity, check_stage_vocabulary, check_stdout_purity,
+    check_swallowed_exceptions, check_thread_discipline,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PKG_ROOT = REPO_ROOT / 'video_features_tpu'
+
+
+def make_pkg(tmp_path, files, name='fixpkg', tests=None):
+    root = tmp_path / name
+    root.mkdir(exist_ok=True)
+    (root / '__init__.py').write_text('')
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        init = p.parent / '__init__.py'
+        if not init.exists():
+            init.write_text('')
+    tests_dir = None
+    if tests:
+        tests_dir = tmp_path / 'tests'
+        tests_dir.mkdir(exist_ok=True)
+        for fname, src in tests.items():
+            (tests_dir / fname).write_text(textwrap.dedent(src))
+    return Package(root, name, tests_dir=tests_dir)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- spawn-purity ------------------------------------------------------------
+
+def test_spawn_purity_detects_planted_jax(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'farm/worker.py': '''
+            def worker_main():
+                from fixpkg.io.video import load
+        ''',
+        'io/video.py': '''
+            import numpy as np
+            import jax
+
+            def load():
+                return np.zeros(1)
+        ''',
+    })
+    findings = check_spawn_purity(pkg)
+    assert len(findings) == 1
+    assert findings[0].file == 'io/video.py'
+    assert 'jax' in findings[0].key
+
+
+def test_spawn_purity_allows_gated_function_level_jax(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'farm/worker.py': '''
+            from fixpkg.utils.tracing import trace
+        ''',
+        'utils/tracing.py': '''
+            def trace():
+                import jax      # gated: never runs in a worker
+                return jax
+        ''',
+    })
+    assert check_spawn_purity(pkg) == []
+
+
+def test_spawn_purity_class_body_import_is_module_level(tmp_path):
+    # class bodies execute at module import time: a jax import hidden in
+    # one runs in every spawned worker and must be flagged
+    pkg = make_pkg(tmp_path, {
+        'ops/host_transforms.py': '''
+            class Helper:
+                import jax
+        ''',
+    })
+    findings = check_spawn_purity(pkg)
+    assert len(findings) == 1 and 'jax' in findings[0].key
+
+
+def test_spawn_purity_resolves_relative_imports(tmp_path):
+    # `from ..io import video` must expand the closure, not silently
+    # shrink it (a dropped edge would blind the rule)
+    pkg = make_pkg(tmp_path, {
+        'farm/recipes.py': '''
+            from ..io import video
+        ''',
+        'io/video.py': '''
+            import jax
+        ''',
+    })
+    findings = check_spawn_purity(pkg)
+    assert len(findings) == 1
+    assert findings[0].file == 'io/video.py'
+
+
+def test_spawn_purity_relative_import_in_package_init(tmp_path):
+    # `from . import transforms` in ops/__init__.py resolves against
+    # ops ITSELF (a package), not its parent — getting this wrong drops
+    # the edge and silently blinds the rule
+    pkg = make_pkg(tmp_path, {
+        'farm/worker.py': '''
+            import fixpkg.ops
+        ''',
+        'ops/__init__.py': '''
+            from . import transforms
+        ''',
+        'ops/transforms.py': '''
+            import jax
+        ''',
+    })
+    findings = check_spawn_purity(pkg)
+    assert len(findings) == 1
+    assert findings[0].file == 'ops/transforms.py'
+
+
+def test_spawn_purity_deep_lazy_imports_do_not_expand_closure(tmp_path):
+    # a non-root closure module lazily importing a jax-heavy module is
+    # the package's gating idiom, not part of the spawn footprint
+    pkg = make_pkg(tmp_path, {
+        'farm/recipes.py': '''
+            def open_video():
+                from fixpkg.streaming import windows
+        ''',
+        'streaming.py': '''
+            def other_path():
+                from fixpkg.heavy import step
+        ''',
+        'heavy.py': '''
+            import jax
+        ''',
+    })
+    assert check_spawn_purity(pkg) == []
+
+
+# -- recipe-picklable --------------------------------------------------------
+
+def test_recipe_picklable_flags_lambda_in_init(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'farm/recipes.py': '''
+            class StackRecipe:
+                def __init__(self, size):
+                    self.transform = lambda f: f[:size]
+        ''',
+    })
+    findings = check_recipe_picklable(pkg)
+    assert rules_of(findings) == {'recipe-picklable'}
+    assert findings[0].key == 'init:StackRecipe'
+
+
+def test_recipe_picklable_flags_lambda_at_call_site(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'farm/recipes.py': '''
+            class StackRecipe:
+                def __init__(self, transform):
+                    self.transform = transform
+        ''',
+        'extract/i3d.py': '''
+            from fixpkg.farm.recipes import StackRecipe
+
+            def farm_recipe():
+                return StackRecipe(transform=lambda f: f)
+        ''',
+    })
+    findings = check_recipe_picklable(pkg)
+    assert any(f.file == 'extract/i3d.py' for f in findings)
+
+
+def test_recipe_picklable_allows_spec_fields_and_open_closures(tmp_path):
+    # nested defs in open() run AFTER unpickling, worker-side — legal
+    pkg = make_pkg(tmp_path, {
+        'farm/recipes.py': '''
+            class StackRecipe:
+                def __init__(self, spec):
+                    self.spec = tuple(spec)
+
+                def open(self, path):
+                    def windows():
+                        yield path
+                    return {}, windows()
+        ''',
+    })
+    assert check_recipe_picklable(pkg) == []
+
+
+# -- knob-classification -----------------------------------------------------
+
+_CLEAN_CONFIG = '''
+    KNOB_CLASSIFICATION = {
+        'foo_knob': 'neither',
+    }
+
+    FOO_DEFAULTS = {'foo_knob': 1}
+
+    def knob_exclude(axis):
+        return frozenset()
+
+    def sanity_check(args):
+        if args.get('foo_knob') is not None:
+            args['foo_knob'] = int(args['foo_knob'])
+'''
+
+
+def test_knob_classification_clean_fixture(tmp_path):
+    pkg = make_pkg(tmp_path, {'config.py': _CLEAN_CONFIG})
+    assert check_knob_classification(pkg) == []
+
+
+def test_knob_classification_flags_unclassified_and_unvalidated(tmp_path):
+    pkg = make_pkg(tmp_path, {'config.py': '''
+        KNOB_CLASSIFICATION = {}
+
+        FOO_DEFAULTS = {'foo_knob': 1}
+
+        def sanity_check(args):
+            pass
+    '''})
+    keys = {f.key for f in check_knob_classification(pkg)}
+    assert keys == {'unclassified:foo_knob', 'unvalidated:foo_knob'}
+
+
+def test_knob_classification_rejects_unknown_class_value(tmp_path):
+    pkg = make_pkg(tmp_path, {'config.py': '''
+        KNOB_CLASSIFICATION = {'foo_knob': 'sometimes'}
+
+        def sanity_check(args):
+            pass
+    '''})
+    assert any(f.key == 'class:foo_knob'
+               for f in check_knob_classification(pkg))
+
+
+def test_knob_registry_rejects_local_exclusion_list(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'config.py': _CLEAN_CONFIG,
+        'cache/key.py': '''
+            CONFIG_KEY_EXCLUDE = frozenset({'a', 'b', 'c'})
+        ''',
+        'serve/server.py': '''
+            from fixpkg.config import knob_exclude
+
+            _KEY_EXCLUDE = knob_exclude('pool_key')
+        ''',
+    })
+    findings = check_knob_registry_single_source(pkg)
+    assert {f.file for f in findings} == {'cache/key.py'}
+    assert any(f.key == 'literal:CONFIG_KEY_EXCLUDE' for f in findings)
+    assert any(f.key == 'registry:unused' for f in findings)
+
+
+# -- swallowed-exception -----------------------------------------------------
+
+def test_swallowed_exception_flags_silent_pass(tmp_path):
+    pkg = make_pkg(tmp_path, {'a.py': '''
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+    '''})
+    assert rules_of(check_swallowed_exceptions(pkg)) \
+        == {'swallowed-exception'}
+
+
+@pytest.mark.parametrize('body', [
+    'raise',
+    'event(1, "boom", exc_info=True)',
+    'log_extraction_error(p)',
+    'warnings.warn("boom")',
+])
+def test_swallowed_exception_allows_reporting_bodies(tmp_path, body):
+    pkg = make_pkg(tmp_path, {'a.py': f'''
+        def f():
+            try:
+                risky()
+            except Exception:
+                {body}
+    '''})
+    assert check_swallowed_exceptions(pkg) == []
+
+
+def test_swallowed_exception_one_hop_helper_indirection(tmp_path):
+    # packing.py idiom: the handler delegates to doom_batch, which reports
+    pkg = make_pkg(tmp_path, {'a.py': '''
+        def doom(v):
+            log_batch_error(v)
+
+        def f():
+            try:
+                risky()
+            except Exception:
+                doom(1)
+    '''})
+    assert check_swallowed_exceptions(pkg) == []
+
+
+def test_swallowed_exception_suppression_comment(tmp_path):
+    pkg = make_pkg(tmp_path, {'a.py': '''
+        def f():
+            try:
+                risky()
+            except Exception:
+                # vft-lint: ok=swallowed-exception — fixture teardown
+                pass
+    '''})
+    findings = filter_suppressed(pkg, check_swallowed_exceptions(pkg))
+    assert findings == []
+
+
+def test_narrow_exceptions_are_fine(tmp_path):
+    pkg = make_pkg(tmp_path, {'a.py': '''
+        def f():
+            try:
+                risky()
+            except (OSError, ValueError):
+                pass
+    '''})
+    assert check_swallowed_exceptions(pkg) == []
+
+
+# -- stdout-purity -----------------------------------------------------------
+
+def test_stdout_purity_flags_bare_print(tmp_path):
+    pkg = make_pkg(tmp_path, {'a.py': 'print("hello")\n'})
+    assert rules_of(check_stdout_purity(pkg)) == {'stdout-purity'}
+
+
+def test_stdout_purity_allows_explicit_stream_and_cli(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'a.py': 'import sys\nprint("x", file=sys.stderr)\n',
+        'cli.py': 'print("usage: ...")\n',
+    })
+    assert check_stdout_purity(pkg) == []
+
+
+def test_stdout_purity_whitelists_print_mode_branch_only(tmp_path):
+    pkg = make_pkg(tmp_path, {'a.py': '''
+        def act(self, key):
+            if self.on_extraction == 'print':
+                print(key)          # the feature stream itself: allowed
+            else:
+                print('saving')     # save mode: flagged
+    '''})
+    findings = check_stdout_purity(pkg)
+    assert len(findings) == 1
+    assert 'saving' in pkg.get('a.py').lines[findings[0].line - 1]
+
+
+# -- contract-key-sync -------------------------------------------------------
+
+def test_contract_keys_clean_and_both_drift_directions(tmp_path):
+    metrics = '''
+        def build_metrics():
+            doc = {'uptime_s': 1}
+            doc['queue'] = {}
+            return doc
+    '''
+    pkg = make_pkg(tmp_path, {'serve/metrics.py': metrics}, tests={
+        'test_obs.py': "METRICS_DOC_KEYS = {'uptime_s', 'queue'}\n"})
+    assert check_contract_keys(pkg) == []
+
+    pkg = make_pkg(tmp_path, {'serve/metrics.py': metrics}, tests={
+        'test_obs.py': "METRICS_DOC_KEYS = {'uptime_s', 'stale_key'}\n"})
+    keys = {f.key for f in check_contract_keys(pkg)}
+    assert keys == {'serve metrics document:unpinned:queue',
+                    'serve metrics document:stale:stale_key'}
+
+
+def test_contract_keys_skip_without_tests_dir(tmp_path):
+    pkg = make_pkg(tmp_path, {'serve/metrics.py': 'def build_metrics():\n'
+                                                  '    return {}\n'})
+    assert check_contract_keys(pkg) == []
+
+
+# -- stage-vocabulary --------------------------------------------------------
+
+def test_stage_vocabulary_flags_unknown_stage_literal(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'utils/tracing.py': "STAGES = ('decode', 'model')\n",
+        'extract/x.py': '''
+            def f(tracer):
+                with tracer.stage('warp_drive'):
+                    pass
+                with tracer.stage('model'):
+                    pass
+        ''',
+    })
+    findings = check_stage_vocabulary(pkg)
+    assert [f.key for f in findings] == ['stage:warp_drive']
+
+
+def test_stage_vocabulary_contract_drift(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'utils/tracing.py': "STAGES = ('decode', 'model')\n",
+    }, tests={'test_obs.py': "CANONICAL_STAGES = {'decode'}\n"})
+    assert any(f.key == 'stages:contract'
+               for f in check_stage_vocabulary(pkg))
+
+
+# -- thread-discipline -------------------------------------------------------
+
+def test_thread_discipline_requires_locked_by(tmp_path):
+    pkg = make_pkg(tmp_path, {'serve/state.py': '''
+        import threading
+
+        _PENDING = {}
+        _PENDING_LOCK = threading.Lock()
+    '''})
+    findings = check_thread_discipline(pkg)
+    assert [f.key for f in findings] == ['unlocked:_PENDING']
+
+
+def test_thread_discipline_accepts_declared_lock_or_immutable(tmp_path):
+    pkg = make_pkg(tmp_path, {'serve/state.py': '''
+        import threading
+
+        _LOCKED_BY = {'_PENDING': '_PENDING_LOCK', '_NAMES': 'immutable'}
+        _PENDING = {}
+        _PENDING_LOCK = threading.Lock()
+        _NAMES = {1: 'a'}
+    '''})
+    assert check_thread_discipline(pkg) == []
+
+
+def test_thread_discipline_rejects_missing_lock_name(tmp_path):
+    pkg = make_pkg(tmp_path, {'farm/state.py': '''
+        _LOCKED_BY = {'_PENDING': '_NO_SUCH_LOCK'}
+        _PENDING = {}
+    '''})
+    assert [f.key for f in check_thread_discipline(pkg)] \
+        == ['missing-lock:_PENDING']
+
+
+def test_thread_discipline_scope_is_concurrent_dirs_only(tmp_path):
+    pkg = make_pkg(tmp_path, {'utils/memo.py': '_MEMO = {}\n'})
+    assert check_thread_discipline(pkg) == []
+
+
+# -- baseline ----------------------------------------------------------------
+
+def test_baseline_identity_survives_line_drift(tmp_path):
+    src = 'def f():\n    try:\n        g()\n    except Exception:\n' \
+          '        pass\n'
+    pkg = make_pkg(tmp_path, {'a.py': src}, name='drift1')
+    findings = check_swallowed_exceptions(pkg)
+    baseline_path = tmp_path / 'baseline.json'
+    write_baseline(baseline_path, findings)
+
+    shifted = '# pushed\n# down\n# by\n# comments\n' + src
+    pkg2 = make_pkg(tmp_path, {'a.py': shifted}, name='drift2')
+    fresh = new_findings(check_swallowed_exceptions(pkg2),
+                         load_baseline(baseline_path))
+    assert fresh == []
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(tmp_path / 'nope.json') == set()
+
+
+def test_ordinals_assigned_after_suppression_filtering(tmp_path):
+    # a suppressed sibling must not consume an ordinal: removing it
+    # later must not rename (and resurface) a baselined neighbor
+    src = '''
+        def f():
+            # vft-lint: ok=stdout-purity — fixture
+            print("suppressed")
+            print("live one")
+            print("live two")
+    '''
+    pkg = make_pkg(tmp_path, {'a.py': src})
+    keys = [f.key for f in analyze(pkg)]
+    assert keys == ['print:f', 'print:f#2']
+
+    without_suppressed = make_pkg(
+        tmp_path, {'a.py': src.replace('print("suppressed")', 'pass')},
+        name='fix2')
+    assert [f.key for f in analyze(without_suppressed)] == keys
+
+
+# -- CLI contract ------------------------------------------------------------
+
+def _run_cli(args):
+    from video_features_tpu.analysis.__main__ import main
+    return main(args)
+
+
+def test_cli_exit_0_on_clean_fixture(tmp_path, capsys):
+    make_pkg(tmp_path, {'a.py': 'x = 1\n'})
+    assert _run_cli(['--root', str(tmp_path / 'fixpkg'),
+                     '--package-name', 'fixpkg',
+                     '--baseline', str(tmp_path / 'b.json')]) == 0
+
+
+def test_cli_exit_2_on_planted_violation(tmp_path, capsys):
+    make_pkg(tmp_path, {'a.py': 'print("boom")\n'})
+    rc = _run_cli(['--root', str(tmp_path / 'fixpkg'),
+                   '--package-name', 'fixpkg',
+                   '--baseline', str(tmp_path / 'b.json')])
+    assert rc == 2
+    out = capsys.readouterr().out
+    assert 'stdout-purity' in out and 'a.py:1' in out
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    make_pkg(tmp_path, {'a.py': 'print("boom")\n'})
+    base = ['--root', str(tmp_path / 'fixpkg'), '--package-name', 'fixpkg',
+            '--baseline', str(tmp_path / 'b.json')]
+    assert _run_cli(base + ['--write-baseline']) == 0
+    doc = json.loads((tmp_path / 'b.json').read_text())
+    assert doc and doc[0]['rule'] == 'stdout-purity'
+    assert _run_cli(base + ['--fail-on-new']) == 0
+
+
+# -- the live codebase -------------------------------------------------------
+
+def test_live_tree_is_clean_against_shipped_baseline():
+    """The same gate CI's ``lint`` job enforces: every rule over the
+    real package, minus inline suppressions, minus the (empty) shipped
+    baseline, must report nothing."""
+    pkg = Package(PKG_ROOT, 'video_features_tpu',
+                  tests_dir=REPO_ROOT / 'tests')
+    fresh = new_findings(analyze(pkg), load_baseline(
+        REPO_ROOT / 'tools' / 'vft_lint_baseline.json'))
+    assert fresh == [], '\n'.join(f.render() for f in fresh)
+
+
+def test_analyzer_entry_chain_is_jax_free():
+    """The import chain `python -m video_features_tpu.analysis`
+    traverses (package __init__ -> config/registry) must never gain a
+    module-level jax import — this static check is what keeps the CLI's
+    exit-3 guard meaningful even on hosts where jax is preloaded."""
+    from video_features_tpu.analysis.checks import closure_forbidden_imports
+    pkg = Package(PKG_ROOT, 'video_features_tpu')
+    assert closure_forbidden_imports(
+        pkg, ('__init__.py',), 'analyzer-purity', 'analyzer entry') == []
+
+
+def test_live_spawn_closure_covers_the_farm_surface():
+    """The worker/recipe closure must actually include the modules the
+    farm contract names (a rename that silently empties the closure
+    would turn rule spawn-purity into a no-op)."""
+    from video_features_tpu.analysis.checks import SPAWN_ROOTS
+    from video_features_tpu.analysis.imports import spawn_closure
+    pkg = Package(PKG_ROOT, 'video_features_tpu')
+    closure = spawn_closure(pkg, SPAWN_ROOTS)
+    assert {'farm/worker.py', 'farm/recipes.py', 'ops/host_transforms.py',
+            'farm/ring.py', 'io/video.py',
+            'extract/streaming.py'} <= set(closure)
+
+
+def test_analyzer_subprocess_never_imports_jax_and_is_fast():
+    """Acceptance criteria: the analyzer process never imports jax and
+    the whole run fits comfortably in CI's <10 s budget."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / 'tools' / 'vft_lint.py')],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=60)
+    wall = time.monotonic() - t0
+    # exit 3 is the analyzer's own "I imported jax" self-violation code
+    assert proc.returncode == 0, (proc.returncode, proc.stdout, proc.stderr)
+    assert wall < 10, f'vft-lint took {wall:.1f}s (budget: 10s)'
+
+
+def test_knob_registry_is_behavior_preserving():
+    """The derived exclusion sets must match the PRE-refactor
+    hand-maintained lists exactly (fingerprint/pool-key parity tests
+    depend on membership; this pins the full sets)."""
+    from video_features_tpu.config import knob_exclude
+    assert knob_exclude('fingerprint') == {
+        'video_paths', 'file_with_video_paths', 'output_path', 'tmp_path',
+        'keep_tmp_files', 'device', 'device_ids', 'data_parallel',
+        'multihost', 'coordinator_address', 'num_processes', 'process_id',
+        'pack_across_videos', 'pack_decode_ahead', 'decode_workers',
+        'mesh_devices', 'decode_farm_ring_mb', 'inflight',
+        'compilation_cache_dir', 'profile', 'profile_dir', 'show_pred',
+        'trace_out', 'trace_capacity', 'manifest_out', 'cache_enabled',
+        'cache_dir', 'cache_max_bytes', 'allow_random_weights',
+        'timeout_s', 'config'}
+    assert knob_exclude('pool_key') == {
+        'video_paths', 'file_with_video_paths', 'output_path', 'profile',
+        'profile_dir', 'timeout_s', 'trace_out', 'trace_capacity',
+        'manifest_out', 'inflight', 'decode_workers',
+        'decode_farm_ring_mb'}
+
+
+def test_deleting_a_knob_from_the_registry_breaks_both_consumers():
+    """Acceptance criterion: the registry is the single source of truth
+    — removing a knob's classification changes BOTH the cache
+    fingerprint and the serve pool key."""
+    from unittest import mock
+
+    from video_features_tpu import config as config_mod
+    from video_features_tpu.cache.key import config_fingerprint
+    from video_features_tpu.serve.server import pool_key
+
+    args = {'feature_type': 'resnet', 'batch_size': 4, 'inflight': 2}
+    fp_before = config_fingerprint(args)
+    pk_before = pool_key(args)
+
+    pruned = {k: v for k, v in config_mod.KNOB_CLASSIFICATION.items()
+              if k != 'inflight'}
+    with mock.patch.dict(config_mod.KNOB_CLASSIFICATION, pruned,
+                         clear=True):
+        # consumers bound their frozensets at import time — re-derive the
+        # way they do, and verify the derivation now disagrees
+        assert 'inflight' not in config_mod.knob_exclude('fingerprint')
+        assert 'inflight' not in config_mod.knob_exclude('pool_key')
+        with mock.patch('video_features_tpu.cache.key.CONFIG_KEY_EXCLUDE',
+                        config_mod.knob_exclude('fingerprint')), \
+                mock.patch('video_features_tpu.serve.server._KEY_EXCLUDE',
+                           config_mod.knob_exclude('pool_key')):
+            assert config_fingerprint(args) != fp_before
+            assert pool_key(args) != pk_before

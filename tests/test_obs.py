@@ -514,9 +514,9 @@ MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
                  'compile', 'executables', 'farm', 'mesh', 'ingress'}
 
 
-CANONICAL_STAGES = {'decode', 'decode+preprocess', 'queue_idle', 'pack',
-                    'h2d', 'model', 'd2h', 'save', 'cache_lookup',
-                    'cache_publish'}
+CANONICAL_STAGES = {'decode', 'decode+preprocess', 'audio_dsp',
+                    'queue_idle', 'pack', 'h2d', 'model', 'd2h', 'save',
+                    'cache_lookup', 'cache_publish'}
 
 
 def test_stage_vocabulary_contract():

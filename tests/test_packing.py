@@ -281,9 +281,13 @@ def test_packed_batch_occupancy_reported(mixed_worklist, tmp_path, capsys):
         or real_reset()
     ex.extract_packed(mixed_worklist)
     ex.tracer.reset = real_reset
-    out = capsys.readouterr().out
-    assert 'occ%' in out and 'ramp' in out
-    assert 'packed worklist' in out
+    captured = capsys.readouterr()
+    # the stage table is a diagnostic and prints to STDERR — stdout
+    # belongs to the feature stream (vft-lint: stdout-purity)
+    err = captured.err
+    assert 'occ%' in err and 'ramp' in err
+    assert 'packed worklist' in err
+    assert 'occ%' not in captured.out
 
     model = real_summary['model']
     assert model['count'] == 7                # vs 9 in the per-video loop

@@ -1,0 +1,26 @@
+#!/usr/bin/env python3
+"""vft-lint launcher: ``python tools/vft_lint.py [flags]``.
+
+A thin wrapper over ``python -m video_features_tpu.analysis`` that works
+from a source checkout without installation (it prepends the repo root
+to ``sys.path``). The analyzer is pure-AST: it parses the package, never
+imports it, and exits 3 if jax lands in the process — the snapshot below
+is taken BEFORE any package import, so even a jax import sneaking into
+``video_features_tpu/__init__.py``'s chain trips the check (the bare
+``-m`` spelling can only catch imports that happen after the package
+loaded).
+
+Exit codes: 0 clean, 1 analyzer error, 2 new findings, 3 jax imported.
+"""
+import sys
+from pathlib import Path
+
+# honest purity probe: BEFORE the package (or anything else) is imported
+_JAX_PRELOADED = 'jax' in sys.modules
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from video_features_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == '__main__':
+    sys.exit(main(jax_preloaded=_JAX_PRELOADED))

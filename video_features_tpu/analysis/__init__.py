@@ -1,0 +1,24 @@
+"""vft-lint: AST/import-graph invariant checker for this codebase.
+
+``python -m video_features_tpu.analysis`` (or ``tools/vft_lint.py``)
+parses the whole package with :mod:`ast` — never importing it — and
+enforces the contracts the repo states in prose but previously checked
+nowhere: spawn-worker jax-freedom, the knob-classification registry,
+no silently swallowed exceptions, stdout purity, export-schema /
+stage-vocabulary sync, recipe picklability, and thread-discipline
+declarations. Rule catalog and suppression syntax:
+``docs/static_analysis.md``.
+"""
+from video_features_tpu.analysis.checks import (
+    ALL_CHECKS, RULES, analyze, run_checks,
+)
+from video_features_tpu.analysis.core import (
+    Finding, Module, Package, filter_suppressed, load_baseline,
+    new_findings, write_baseline,
+)
+
+__all__ = [
+    'ALL_CHECKS', 'RULES', 'analyze', 'run_checks', 'Finding', 'Module',
+    'Package', 'filter_suppressed', 'load_baseline', 'new_findings',
+    'write_baseline',
+]

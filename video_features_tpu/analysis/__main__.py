@@ -1,0 +1,137 @@
+"""vft-lint CLI: ``python -m video_features_tpu.analysis``.
+
+Exit-code contract (CI gates on it — .github/workflows/ci.yml ``lint``
+job):
+
+  0  no findings beyond the baseline (and beyond inline suppressions)
+  1  analyzer error (unparseable file, bad flags)
+  2  at least one NEW finding
+  3  the analyzer process imported jax (self-violation: the lint must
+     be runnable on a jax-free host and must never pay XLA startup)
+
+There is deliberately no ``--fix``: every fix is a reviewed code change.
+``--write-baseline`` exists for adopting the suite on a dirty tree; this
+repo ships an EMPTY baseline — accepted sites carry inline
+``# vft-lint: ok=<rule>`` suppressions with their rationale instead.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from video_features_tpu.analysis.checks import (
+    RULES, analyze, closure_forbidden_imports,
+)
+from video_features_tpu.analysis.core import (
+    Package, load_baseline, new_findings, write_baseline,
+)
+
+DEFAULT_BASELINE = 'tools/vft_lint_baseline.json'
+
+# The purity contract is about what the ANALYZER pulls in: a host
+# process (pytest with a jax-using conftest) may legitimately embed
+# main() with jax already loaded — only an import that appears during
+# the run is a self-violation. CAVEAT: under `python -m`, the parent
+# package __init__ (config.py, registry.py) executes before this
+# module, so a jax import sneaking into THAT chain would read as
+# "preloaded" here. tools/vft_lint.py closes the gap: it snapshots
+# sys.modules BEFORE importing anything of the package and passes the
+# honest value via `jax_preloaded` — which is why the CI lint job's
+# strong exit-3 guarantee is tested through the wrapper.
+_JAX_PRELOADED = 'jax' in sys.modules
+
+
+def _default_roots():
+    pkg_root = Path(__file__).resolve().parent.parent
+    repo_root = pkg_root.parent
+    tests_dir = repo_root / 'tests'
+    return pkg_root, tests_dir if tests_dir.is_dir() else None, repo_root
+
+
+def main(argv=None, jax_preloaded=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='vft-lint',
+        description='AST/import-graph invariant checker for '
+                    'video_features_tpu (docs/static_analysis.md)')
+    parser.add_argument('--root', help='package root to analyze '
+                        '(default: the installed video_features_tpu/)')
+    parser.add_argument('--package-name', default='video_features_tpu',
+                        help='import name absolute imports resolve '
+                        'against (fixture trees use their own)')
+    parser.add_argument('--tests-dir', help='directory holding the '
+                        'pinned contract sets (default: <repo>/tests)')
+    parser.add_argument('--baseline', help='accepted-findings file '
+                        f'(default: <repo>/{DEFAULT_BASELINE})')
+    parser.add_argument('--write-baseline', action='store_true',
+                        help='accept every current finding and exit 0')
+    parser.add_argument('--fail-on-new', action='store_true',
+                        help='exit 2 on findings not in the baseline '
+                        '(the default behavior, spelled out for CI)')
+    parser.add_argument('--list-rules', action='store_true')
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES:
+            print(rule)
+        return 0
+
+    pkg_root, tests_dir, repo_root = _default_roots()
+    if args.root:
+        pkg_root = Path(args.root)
+        tests_dir = None
+        repo_root = pkg_root.parent
+    if args.tests_dir:
+        tests_dir = Path(args.tests_dir)
+    baseline_path = Path(args.baseline) if args.baseline \
+        else repo_root / DEFAULT_BASELINE
+
+    try:
+        package = Package(pkg_root, args.package_name, tests_dir=tests_dir)
+        findings = analyze(package)
+    except SyntaxError as e:
+        print(f'vft-lint: parse error: {e}', file=sys.stderr)
+        return 1
+
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f'vft-lint: wrote {len(findings)} accepted finding(s) to '
+              f'{baseline_path}')
+        return 0
+
+    fresh = new_findings(findings, load_baseline(baseline_path))
+    for f in fresh:
+        print(f.render(pkg_root))
+    known = len(findings) - len(fresh)
+    status = (f'vft-lint: {len(fresh)} new finding(s)'
+              + (f', {known} baselined' if known else '')
+              + f' across {len(package.modules)} modules')
+    print(status, file=sys.stderr)
+
+    # self-enforcement: the analyzer's own purity contract, two ways.
+    # (a) STATIC, preload-proof: the import chain `python -m` traverses
+    # before this module runs (package __init__ -> config/registry) must
+    # never gain a module-level jax import — checked on the AST of the
+    # INSTALLED package, so it trips even on hosts where jax is already
+    # resident and the dynamic probe below reads "preloaded".
+    own_pkg_root, own_tests, _ = _default_roots()
+    own = package if pkg_root == own_pkg_root else \
+        Package(own_pkg_root, 'video_features_tpu', tests_dir=own_tests)
+    chain_violations = closure_forbidden_imports(
+        own, ('__init__.py',), 'analyzer-purity',
+        "analyzer entry (the `-m` import chain must stay jax-free)")
+    # (b) DYNAMIC: if jax appeared in sys.modules during this run —
+    # everything above is pure ast over source text — the lint itself
+    # has a spawn-purity-class bug.
+    preloaded = _JAX_PRELOADED if jax_preloaded is None else jax_preloaded
+    if chain_violations or ('jax' in sys.modules and not preloaded):
+        for v in chain_violations:
+            print(v.render(own_pkg_root), file=sys.stderr)
+        print('vft-lint: FATAL: the analyzer process imported jax',
+              file=sys.stderr)
+        return 3
+    return 2 if fresh else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -35,49 +35,20 @@ import json
 import threading
 from typing import Any, Dict, Mapping
 
+from video_features_tpu.config import knob_exclude
+
 _CHUNK = 1 << 20  # 1 MiB streaming-read granularity
 
-# Keys that cannot change the extracted bytes. Everything NOT listed here
+# Keys that cannot change the extracted bytes. Everything NOT listed
 # lands in the fingerprint (fail-closed: unknown knobs fragment the key
-# space rather than risking a stale hit). Checkpoint paths are excluded
-# from the CONFIG fingerprint because the WEIGHTS fingerprint covers
-# their content (a path string is not an identity — the file behind it
-# can change).
-CONFIG_KEY_EXCLUDE = frozenset({
-    # payload / routing
-    'video_paths', 'file_with_video_paths', 'output_path', 'tmp_path',
-    'keep_tmp_files',
-    # device & parallelism: where the program runs, not what it computes
-    # (numerics are pinned by `precision`, which stays IN the key)
-    'device', 'device_ids', 'data_parallel', 'multihost',
-    'coordinator_address', 'num_processes', 'process_id',
-    'pack_across_videos', 'pack_decode_ahead', 'decode_workers',
-    # mesh-sharded packed execution: how many chips the batch spreads
-    # over, never what each row computes (outputs are byte-identical at
-    # any device count by contract — tests/test_mesh_packed.py pins it).
-    # NOTE: mesh_devices stays IN the serve pool key (serve/server.py)
-    # because it changes the compiled program's sharding.
-    'mesh_devices',
-    # decode-farm transport sizing: where decoded bytes travel, never
-    # what they are (farm outputs are byte-identical by contract —
-    # tests/test_farm.py pins it)
-    'decode_farm_ring_mb',
-    # output-side pipelining depth: how deep D2H defers behind dispatch,
-    # never what the step computes (async parity is byte-identical by
-    # contract — tests/test_packing.py pins it)
-    'inflight',
-    'compilation_cache_dir',
-    # observability / debug surfaces (the flight recorder's obs/ knobs
-    # record telemetry; they cannot change the extracted bytes)
-    'profile', 'profile_dir', 'show_pred',
-    'trace_out', 'trace_capacity', 'manifest_out',
-    # the cache's own namespace must not fragment its key space
-    'cache_enabled', 'cache_dir', 'cache_max_bytes',
-    # covered by the weights fingerprint
-    'allow_random_weights',
-    # serve-side per-request plumbing
-    'timeout_s', 'config',
-})
+# space rather than risking a stale hit). The per-knob classification —
+# with its rationale — lives in ONE place, ``config.KNOB_CLASSIFICATION``
+# (the serve pool key derives its own exclusion set from the same
+# registry; vft-lint rejects hand-maintained copies). Checkpoint paths
+# are additionally excluded from the CONFIG fingerprint below because
+# the WEIGHTS fingerprint covers their content (a path string is not an
+# identity — the file behind it can change).
+CONFIG_KEY_EXCLUDE = knob_exclude('fingerprint')
 
 # (realpath, size, mtime_ns) → hex digest; bounded so a week-long serving
 # process over a rotating corpus can't grow it without limit

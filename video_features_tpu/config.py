@@ -98,6 +98,117 @@ OBS_DEFAULTS: Dict[str, Any] = {
 }
 
 
+# -- knob classification registry (vft-lint: knob-classification) -----------
+# The ONE declarative answer to "what does this config key change?" along
+# the two identity axes consumers key on:
+#
+#   * the cache CONFIG FINGERPRINT (cache/key.py): does the knob change
+#     the extracted BYTES? Excluded knobs don't fragment the cache key
+#     space; anything NOT listed here stays IN the fingerprint
+#     (fail-closed: an unknown future knob costs a redundant miss, never
+#     a wrong hit).
+#   * the serve POOL KEY (serve/server.py): does the knob change the
+#     compiled program / weights / residency, or the worker's run
+#     behavior? Excluded knobs share a warm entry (the FIRST builder's
+#     setting wins); anything NOT listed stays IN the key (fail-closed:
+#     an unknown knob builds a redundant entry, never shares a wrong one).
+#
+# Classes:
+#   'neither'          — changes neither the bytes nor the program:
+#                        excluded from fingerprint AND pool key
+#   'pool_only'        — changes the program/residency/run behavior but
+#                        never the bytes: excluded from the fingerprint,
+#                        IN the pool key
+#   'fingerprint_only' — (unused today; supported for completeness)
+#   'both'             — relevant everywhere (same as not listing it,
+#                        but explicit for injected knobs)
+#
+# Consumers derive their exclusion sets via knob_exclude() — there are
+# deliberately NO hand-maintained copies of these lists anywhere else;
+# vft-lint (analysis/, rule 'knob-registry') rejects any that reappear,
+# and rule 'knob-classification' rejects any injected *_DEFAULTS knob
+# missing from this table. PRs 5-8 each re-fixed a drift between the
+# three hand-synced copies this replaces.
+KNOB_CLASSIFICATION: Dict[str, str] = {
+    # payload / routing: the work list and where outputs land are
+    # per-request concerns, never identity
+    'video_paths': 'neither',
+    'file_with_video_paths': 'neither',
+    'output_path': 'neither',
+    # tmp_path is pool-key relevant: loaders read the ENTRY's tmp root,
+    # so a request with a different tmp_path must get its own entry
+    # rather than silently writing re-encode temps under another
+    # request's root
+    'tmp_path': 'pool_only',
+    'keep_tmp_files': 'pool_only',
+    # device & parallelism: where the program runs, not what it computes
+    # (numerics are pinned by `precision`, which stays IN both keys)
+    'device': 'pool_only',
+    'device_ids': 'pool_only',
+    'data_parallel': 'pool_only',
+    'multihost': 'pool_only',
+    'coordinator_address': 'pool_only',
+    'num_processes': 'pool_only',
+    'process_id': 'pool_only',
+    'pack_across_videos': 'pool_only',
+    'pack_decode_ahead': 'pool_only',
+    # mesh-sharded packed execution: how many chips the batch spreads
+    # over, never what each row computes (byte-identical at any device
+    # count — tests/test_mesh_packed.py pins it). Pool-key RELEVANT: it
+    # changes the compiled program's sharding and how many chips the
+    # entry is resident on, so a 1-chip and a 4-chip request each get
+    # their own warm entry.
+    'mesh_devices': 'pool_only',
+    'compilation_cache_dir': 'pool_only',
+    # input-side decode parallelism (decode farm): where decode runs,
+    # never the bytes produced (tests/test_farm.py pins byte-identity);
+    # the FIRST builder's farm settings win for a shared warm entry
+    'decode_workers': 'neither',
+    'decode_farm_ring_mb': 'neither',
+    # output-side pipelining depth (async device loop): how deep D2H
+    # defers behind dispatch, never what the step computes
+    # (tests/test_packing.py pins byte-identity); FIRST builder wins
+    'inflight': 'neither',
+    # observability / debug surfaces: telemetry can't change the bytes,
+    # and fragmenting the executable key space on trace settings would
+    # transplant + compile twice for a trace_out difference. show_pred
+    # and profile change the worker's RUN behavior → pool-key relevant
+    # is deliberately NOT claimed for trace knobs, but profile is forced
+    # on for the serve metrics surface → excluded from the pool key too.
+    'profile': 'neither',
+    'profile_dir': 'neither',
+    'show_pred': 'pool_only',
+    'trace_out': 'neither',
+    'trace_capacity': 'neither',
+    'manifest_out': 'neither',
+    # the cache's own namespace must not fragment its key space; pool-key
+    # RELEVANT: a worker's extractor publishes/consults the cache
+    # configured at build time, so requests with different cache
+    # settings must not share an entry
+    'cache_enabled': 'pool_only',
+    'cache_dir': 'pool_only',
+    'cache_max_bytes': 'pool_only',
+    # covered by the weights fingerprint (checkpoint CONTENT is hashed)
+    'allow_random_weights': 'pool_only',
+    # serve-side per-request plumbing
+    'timeout_s': 'neither',
+    'config': 'pool_only',
+}
+
+_KNOB_AXIS_EXCLUDES = {
+    'fingerprint': ('neither', 'pool_only'),
+    'pool_key': ('neither', 'fingerprint_only'),
+}
+
+
+def knob_exclude(axis: str) -> frozenset:
+    """The keys excluded from ``axis`` (``'fingerprint'`` |
+    ``'pool_key'``), derived from :data:`KNOB_CLASSIFICATION`."""
+    excluded_classes = _KNOB_AXIS_EXCLUDES[axis]
+    return frozenset(k for k, cls in KNOB_CLASSIFICATION.items()
+                     if cls in excluded_classes)
+
+
 class Config(dict):
     """A flat dict with attribute access — the shape every extractor consumes.
 
@@ -218,8 +329,10 @@ def resolve_device(device: str) -> str:
     if device.startswith(('cuda', 'tpu', 'gpu', 'accel')):
         if accel is not None:
             return accel
-        print('An accelerator was requested but the system does not have one. '
-              'Going to use CPU...')
+        # warnings.warn (→ stderr), not print: with on_extraction=print
+        # the feature stream owns stdout (vft-lint: stdout-purity)
+        warnings.warn('An accelerator was requested but the system does '
+                      'not have one. Going to use CPU...')
         return 'cpu'
     return 'cpu'
 
@@ -239,9 +352,11 @@ def sanity_check(args: Config) -> None:
         paths (:122-135).
     """
     if 'device_ids' in args:
-        print('WARNING: multi-device single-process extraction is not supported. '
-              'Scale out by sharding the video list across workers/hosts '
-              f'(device_ids={args["device_ids"]} ignored; using one accelerator).')
+        warnings.warn(
+            'multi-device single-process extraction is not supported. '
+            'Scale out by sharding the video list across workers/hosts '
+            f'(device_ids={args["device_ids"]} ignored; using one '
+            'accelerator).')
         args['device'] = 'tpu'
     args['device'] = resolve_device(args.get('device', 'cpu'))
 
@@ -339,13 +454,15 @@ def sanity_check(args: Config) -> None:
 
     ft = args.get('feature_type')
     if args.get('show_pred') and ft == 'vggish':
-        print('Showing class predictions is not implemented for VGGish')
+        warnings.warn('Showing class predictions is not implemented '
+                      'for VGGish')
     if args.get('data_parallel'):
         from video_features_tpu.registry import DATA_PARALLEL_FEATURES
         if ft not in DATA_PARALLEL_FEATURES:
-            print(f'WARNING: data_parallel is not implemented for {ft} — '
-                  'running single-device (scale out with multihost=true / '
-                  'sharded worklists instead)')
+            warnings.warn(
+                f'data_parallel is not implemented for {ft} — running '
+                'single-device (scale out with multihost=true / sharded '
+                'worklists instead)')
             args['data_parallel'] = False
     if args.get('pack_across_videos'):
         from video_features_tpu.registry import PACKED_FEATURES
@@ -521,7 +638,16 @@ def form_list_from_user_input(
         # '.live' paths are VIRTUAL — live-session pseudo-identities
         # (serve/server.submit_live); nothing exists (or should) at them
         if not path.endswith('.live') and not Path(path).exists():
-            print(f'The path does not exist: {path}')
+            # obs.events (→ stderr), not print or warnings.warn: the
+            # feature stream owns stdout, and this also runs inside
+            # serve request handling — where the default warnings
+            # filter would dedupe a repeated bad path to ONE report per
+            # process, hiding every later tenant's mistake
+            import logging
+
+            from video_features_tpu.obs.events import event
+            event(logging.WARNING, 'path does not exist',
+                  video=str(path))
 
     if to_shuffle:
         random.shuffle(path_list)

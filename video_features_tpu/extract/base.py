@@ -21,7 +21,9 @@ present — upstream behavior for everyone else.
 """
 from __future__ import annotations
 
+import logging as _logging
 import os
+import sys
 import time as _time
 import warnings
 from pathlib import Path
@@ -29,6 +31,7 @@ from typing import Dict, List, Union
 
 import numpy as np
 
+from video_features_tpu.obs.events import event
 from video_features_tpu.utils.output import (
     ACTION_TO_EXT, ACTION_TO_LOAD, ACTION_TO_SAVE, make_path,
     read_fingerprint, write_fingerprint,
@@ -409,6 +412,10 @@ class BaseExtractor:
             log_cache_error(f'lookup for {video_path}')
             return False
         if hit:
+            # reference-parity progress line; stdout-safe by construction:
+            # the cache is warn-and-disabled under on_extraction=print
+            # (sanity_check), so this never interleaves with features
+            # vft-lint: ok=stdout-purity — save-mode-only progress line
             print(f'Features for {video_path} served from cache into '
                   f'{Path(out_root).absolute()}/ - skipping extraction..')
         return hit
@@ -478,8 +485,11 @@ class BaseExtractor:
                     if self.manifest is not None:
                         self.manifest.fold_stages(rep)
                     if self.profile:
-                        print(f'--- stage timing: {video_path}')
-                        print(self.tracer.summary())
+                        # stderr: the stage table is a diagnostic, and
+                        # with on_extraction=print stdout carries features
+                        print(f'--- stage timing: {video_path}',
+                              file=sys.stderr)
+                        print(self.tracer.summary(), file=sys.stderr)
                     self.tracer.reset()
             if self.manifest is not None:
                 self.manifest.video_done(video_path, outcome)
@@ -600,9 +610,14 @@ class BaseExtractor:
         out_root = output_path or self.output_path
         if self.on_extraction in ACTION_TO_EXT and \
                 self.is_already_exist(video_path, output_path=out_root):
-            # A concurrent worker finished this video while we extracted it.
-            print('WARNING: extraction didnt find feature files on the 1st try '
-                  'but did on the 2nd try.')
+            # A concurrent worker finished this video while we extracted
+            # it. obs.events, not warnings.warn: the default warnings
+            # filter dedups a constant message per process, and an
+            # operator watching a long-lived daemon needs EVERY
+            # occurrence of this double-work race, not just the first.
+            event(_logging.WARNING,
+                  'extraction didnt find feature files on the 1st try '
+                  'but did on the 2nd try', video=str(video_path))
             return
 
         for key, value in feats_dict.items():
@@ -616,7 +631,8 @@ class BaseExtractor:
                 fpath = make_path(out_root, video_path, key,
                                   ACTION_TO_EXT[self.on_extraction])
                 if key != 'fps' and len(value) == 0:
-                    print(f'Warning: the value is empty for {key} @ {fpath}')
+                    warnings.warn(
+                        f'the value is empty for {key} @ {fpath}')
                 ACTION_TO_SAVE[self.on_extraction](fpath, value)
             else:
                 raise NotImplementedError(
@@ -644,7 +660,13 @@ class BaseExtractor:
             try:
                 ACTION_TO_LOAD[self.on_extraction](fpath)
             except Exception:
-                # Corrupted (e.g. a worker died mid-write) → re-extract.
+                # Corrupted (e.g. a worker died mid-write) → re-extract;
+                # SAY so — a silently re-extracting resume loop hides
+                # recurring corruption (bad disk, torn writers)
+                event(_logging.WARNING,
+                      'existing output failed to load; re-extracting',
+                      exc_info=True, video=str(video_path),
+                      path=str(fpath))
                 return False
         if self.run_fingerprint is not None:
             recorded = read_fingerprint(out_root, video_path)
@@ -663,6 +685,10 @@ class BaseExtractor:
                 return False
             # no sidecar: pre-fingerprint outputs keep the legacy skip
             # (absence can't prove staleness)
+        # reference-parity resume line (pinned by the CLI-equivalence
+        # tests); save-mode only — is_already_exist returns False up top
+        # for on_extraction=print, so this never touches the stream
+        # vft-lint: ok=stdout-purity — save-mode-only progress line
         print(f'Features for {video_path} already exist in '
               f'{Path(out_root).absolute()}/ - skipping..')
         return True

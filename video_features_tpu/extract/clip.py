@@ -122,6 +122,7 @@ class ExtractCLIP(BaseFrameWiseExtractor):
         else:
             labels = load_label_map('kinetics')
             if labels is None:
+                # vft-lint: ok=stdout-purity — show_pred narration surface
                 print('show_pred: no Kinetics label map available — skipping')
                 self._classes = None
                 return None, None
@@ -137,6 +138,7 @@ class ExtractCLIP(BaseFrameWiseExtractor):
         try:
             text_feats, classes = self._get_text_feats()
         except FileNotFoundError as e:
+            # vft-lint: ok=stdout-purity — show_pred narration surface
             print(f'show_pred unavailable: {e}')
             return
         if text_feats is None:

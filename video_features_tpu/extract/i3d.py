@@ -438,6 +438,7 @@ class ExtractI3D(BaseExtractor):
                                   stream=stream, pads=tuple(pads),
                                   crop_size=crop,
                                   platform=self._device.platform)
+            # vft-lint: ok=stdout-purity — show_pred narration surface
             print(f'At stack {stack_counter} ({stream} stream)')
             show_predictions_on_dataset(np.asarray(logits), 'kinetics')
         if 'flow' in self.streams:
@@ -455,5 +456,9 @@ class ExtractI3D(BaseExtractor):
                 out_dir.mkdir(parents=True, exist_ok=True)
                 path = out_dir / f'stack_{stack_counter:06d}.png'
                 cv2.imwrite(str(path), flow_to_image(flow)[..., ::-1])
-            except Exception as e:  # debug surface: never fail extraction
-                print(f'[flow viz] PNG write skipped: {e}')
+            except Exception:  # debug surface: never fail extraction
+                import logging as _logging
+
+                from video_features_tpu.obs.events import event
+                event(_logging.WARNING, 'flow viz PNG write skipped',
+                      exc_info=True, subsystem='i3d')

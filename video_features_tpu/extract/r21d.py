@@ -153,5 +153,6 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
             from video_features_tpu.ops.nn import linear
             from video_features_tpu.utils.preds import show_predictions_on_dataset
             logits = np.asarray(linear(jnp.asarray(visual_feats), self.params['fc']))
+            # vft-lint: ok=stdout-purity — show_pred narration surface
             print(f'At frames ({start_idx}, {end_idx})')
             show_predictions_on_dataset(logits, self.model_def['dataset'])

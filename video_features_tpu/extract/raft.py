@@ -231,6 +231,7 @@ class ExtractRAFT(BaseExtractor):
         from video_features_tpu.utils.flow_viz import flow_to_image
         for flow in flows[:1]:
             img = flow_to_image(flow)
+            # vft-lint: ok=stdout-purity — show_pred narration surface
             print(f'[flow viz] frame rendered: shape={img.shape}, '
                   f'mean_mag={np.linalg.norm(flow, axis=-1).mean():.3f}')
             try:
@@ -240,5 +241,9 @@ class ExtractRAFT(BaseExtractor):
                 path = out_dir / f'{self._viz_stem}_{self._viz_count:06d}.png'
                 cv2.imwrite(str(path), img[..., ::-1])  # RGB → BGR on disk
                 self._viz_count += 1
-            except Exception as e:  # debug surface: never fail extraction
-                print(f'[flow viz] PNG write skipped: {e}')
+            except Exception:  # debug surface: never fail extraction
+                import logging as _logging
+
+                from video_features_tpu.obs.events import event
+                event(_logging.WARNING, 'flow viz PNG write skipped',
+                      exc_info=True, subsystem='raft')

@@ -162,5 +162,6 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
         x = resize_bilinear_scale(x, resize_hw, scale)
         x = center_crop(x, (224, 224))
         logits = np.asarray(s3d_model.forward(self.params, x, features=False))
+        # vft-lint: ok=stdout-purity — show_pred narration surface
         print(f'At frames ({start_idx}, {end_idx})')
         show_predictions_on_dataset(logits, 'kinetics')

@@ -291,6 +291,7 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                 # with SEPARATE heads ((head(cls)+head_dist(dist))/2); the
                 # pooled features here can't reconstruct the two tokens, so
                 # any logits printed from them would misrepresent the model
+                # vft-lint: ok=stdout-purity — show_pred narration surface
                 print('show_pred: distilled DeiT logits need the separate '
                       'cls/dist tokens (timm deit.py); skipping the top-5 '
                       'table for pooled features')

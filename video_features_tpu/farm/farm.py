@@ -59,6 +59,9 @@ _MB = 1 << 20
 # entry zeroes a sibling's live workers out of the scrape)
 _LIVE_FARMS: set = set()
 _LIVE_LOCK = threading.Lock()
+# thread-discipline declaration (vft-lint): module-level mutables and
+# the lock that guards every access to them
+_LOCKED_BY = {'_LIVE_FARMS': '_LIVE_LOCK'}
 
 
 class FarmUnavailable(RuntimeError):
@@ -210,6 +213,8 @@ class DecodeFarm:
                 try:
                     w.task_q.put(('stop',))
                 except Exception:
+                    # vft-lint: ok=swallowed-exception — best-effort stop
+                    # to a possibly-dead child; join below bounds teardown
                     pass
         deadline = time.monotonic() + 5.0
         for w in self._workers:
@@ -232,6 +237,8 @@ class DecodeFarm:
                 w.shm.close()
                 w.shm.unlink()
             except Exception:
+                # vft-lint: ok=swallowed-exception — idempotent teardown:
+                # a ring already unlinked by a respawn raises harmlessly
                 pass
             w.shm = None
 
@@ -292,6 +299,9 @@ class DecodeFarm:
                                if seg is not None
                                else self.cache_key_fn(str(task.path)))
                     except Exception:
+                        # vft-lint: ok=swallowed-exception — fallback by
+                        # design: an unhashable video skips dedupe and
+                        # decodes normally (its own failure reports there)
                         key = None             # unhashable → no dedupe
                 with self._lock:
                     twin = (self._inflight_keys.get(key)
@@ -326,6 +336,8 @@ class DecodeFarm:
                     self._append_flush()
                     last_flush = time.monotonic()
                 time.sleep(0.02)
+        # vft-lint: ok=swallowed-exception — stored, not swallowed: the
+        # drain loop re-raises _dispatch_error to the caller
         except BaseException as e:            # surfaced by the drain loop
             self._dispatch_error = e
         finally:
@@ -633,6 +645,8 @@ class DecodeFarm:
                     # it is transport accounting, not video accounting
                     w.ctrl_q.put(('winq_ack',))
                 except Exception:
+                    # vft-lint: ok=swallowed-exception — ack to a dead
+                    # worker; the supervisor reaps it on the next tick
                     pass
                 with self._lock:
                     self._stats['queue_fallback'] += 1
@@ -649,6 +663,8 @@ class DecodeFarm:
                     try:
                         w.ctrl_q.put(('abort', seq))
                     except Exception:
+                        # vft-lint: ok=swallowed-exception — abort to a
+                        # dead worker; supervision handles the corpse
                         pass
                 return None
             task.emitted += 1
@@ -786,6 +802,8 @@ class DecodeFarm:
                 try:
                     w.proc.join(0.1)
                 except Exception:
+                    # vft-lint: ok=swallowed-exception — reaping a corpse;
+                    # the retirement below is what matters
                     pass
                 w.proc = None
                 # re-dispatch its queue to surviving workers (or fail)

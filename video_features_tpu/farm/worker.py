@@ -156,6 +156,10 @@ def worker_main(widx: int, epoch: int, recipe, ring_name: str,
                 out_q.put(('end', widx, epoch, seq, n))
             except _Abort:
                 out_q.put(('end', widx, epoch, seq, n))
+            # vft-lint: ok=swallowed-exception — the 'err' message IS
+            # the report: it carries the full traceback to the parent,
+            # whose drain loop routes it through obs.events (workers are
+            # jax-free spawn processes and keep no logging config)
             except Exception:
                 # one video's decode failure is that video's error; the
                 # worker stays up for the rest of the worklist
@@ -165,4 +169,6 @@ def worker_main(widx: int, epoch: int, recipe, ring_name: str,
         try:
             shm.close()
         except Exception:
+            # vft-lint: ok=swallowed-exception — exit-path close of a
+            # segment the parent may already have unlinked
             pass

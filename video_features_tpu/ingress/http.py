@@ -33,6 +33,9 @@ MAX_HEADER_BYTES = 32768
 MAX_HEADERS = 100
 
 # HTTP status → reason phrases we actually emit
+# thread-discipline declaration (vft-lint): write-once constants need
+# no lock — nothing mutates them after import
+_LOCKED_BY = {'_REASONS': 'immutable'}
 _REASONS = {200: 'OK', 400: 'Bad Request', 401: 'Unauthorized',
             403: 'Forbidden', 404: 'Not Found', 405: 'Method Not Allowed',
             409: 'Conflict', 413: 'Payload Too Large',
@@ -403,6 +406,9 @@ class HttpServer:
                         pass
                 except (OSError, ValueError, ConnectionError):
                     pass                   # client went away
+                # vft-lint: ok=swallowed-exception — reported to the
+                # CLIENT as a structured 500 carrying the error; the
+                # connection loop must survive one handler's crash
                 except Exception as e:
                     try:
                         resp.send_json(500, {

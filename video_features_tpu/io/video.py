@@ -442,6 +442,8 @@ class VideoLoader:
         try:
             self.close()
         except Exception:
+            # vft-lint: ok=swallowed-exception — context-exit close is
+            # best-effort; decode errors already surfaced on the iterator
             pass
 
 
@@ -521,6 +523,8 @@ def prefetch(iterable, depth: int = 2):
                 if not put_or_abort(item):
                     return
             put_or_abort(_END)
+        # vft-lint: ok=swallowed-exception — shipped, not swallowed:
+        # the consumer re-raises whatever the producer thread posts
         except BaseException as e:  # re-raised by the consumer
             put_or_abort(e)
 

@@ -23,6 +23,7 @@ The reference has no collective backend at all — its multi-node story is
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 
@@ -56,5 +57,5 @@ def initialize(coordinator_address: Optional[str] = None,
         # Not on a pod/cluster (autodetection found no coordinator). A
         # single-process run needs no distributed runtime: process_count()
         # is 1 and the worklist shard is the whole list.
-        print('multihost: no cluster environment detected — '
-              'continuing as a single-process run')
+        warnings.warn('multihost: no cluster environment detected — '
+                      'continuing as a single-process run')

@@ -18,6 +18,7 @@ work-list and the output files.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -68,9 +69,10 @@ def make_mesh(n_devices: Optional[int] = None,
         # the number) — the time-axis resharding this layer was validated
         # against postdates 0.4. Surface it loudly; data-only meshes
         # (time_parallel=1) are verified on 0.4.x.
-        print('WARNING: (data, time) meshes are numerically unvalidated '
-              'on this jax version — flow-stream divergence was measured '
-              'on 0.4.x. Use time_parallel=1 (data-only) or upgrade jax.')
+        warnings.warn(
+            '(data, time) meshes are numerically unvalidated on this '
+            'jax version — flow-stream divergence was measured on '
+            '0.4.x. Use time_parallel=1 (data-only) or upgrade jax.')
     grid = np.asarray(devices, dtype=object).reshape(shape)
     return Mesh(grid, (DATA_AXIS, TIME_AXIS))
 

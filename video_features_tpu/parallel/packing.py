@@ -46,10 +46,13 @@ hostage until the next request happens to share its geometry.
 """
 from __future__ import annotations
 
+import logging as _logging
+import sys
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from video_features_tpu.obs.events import event
 from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
 
 # Stream sentinel: "no more input for now — flush partial pools". Yielded
@@ -543,6 +546,8 @@ def run_packed(ex, video_paths: Iterable,
         recipe_err: Optional[BaseException] = None
         try:
             recipe = ex.farm_recipe()
+        # vft-lint: ok=swallowed-exception — stored, not swallowed: the
+        # structured recipe-failure warning below reports recipe_err
         except Exception as e:
             recipe_err = e                     # a BROKEN recipe, not a
             recipe = None                      # family without one
@@ -694,6 +699,14 @@ def run_packed(ex, video_paths: Iterable,
                               meta)
                 except Exception:
                     task.failed = True
+                    # a one-line event, not log_extraction_error: the
+                    # vanished client is the CAUSE, the task failure is
+                    # the effect — but it must not be silent (a leaked
+                    # quota unit / session would be invisible otherwise)
+                    event(_logging.WARNING,
+                          'per-window delivery failed; failing the '
+                          'live task', exc_info=True,
+                          video=str(task.path), stage='d2h')
                     continue
             if getattr(task, 'stream_only', False):
                 continue          # don't pin a live session's rows in RAM
@@ -802,7 +815,9 @@ def run_packed(ex, video_paths: Iterable,
         if getattr(ex, 'profile', True):
             mesh_note = (f' = {capacity} x {ndev} devices'
                          if ndev > 1 else '')
+            # stderr: the stage table is a diagnostic, and with
+            # on_extraction=print stdout carries features
             print(f'--- stage timing: packed worklist ({n_started[0]} '
-                  f'videos, batch {batch}{mesh_note})')
-            print(ex.tracer.summary())
+                  f'videos, batch {batch}{mesh_note})', file=sys.stderr)
+            print(ex.tracer.summary(), file=sys.stderr)
         ex.tracer.reset()

@@ -30,6 +30,7 @@ re-extract on restart via the unchanged resume contract.
 """
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import signal
@@ -37,11 +38,13 @@ import socket
 import sys
 import threading
 import time
-import traceback
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from video_features_tpu.config import Config, load_config, split_serve_config
+from video_features_tpu.config import (
+    Config, knob_exclude, load_config, split_serve_config,
+)
+from video_features_tpu.obs.events import event
 from video_features_tpu.parallel.packing import FLUSH, VideoTask
 from video_features_tpu.registry import (
     LIVE_FEATURES, PACKED_FEATURES, create_extractor,
@@ -57,40 +60,15 @@ _CLOSE = object()
 # metrics.LATENCY_WINDOW)
 REQUEST_HISTORY = 4096
 
-# config keys that do NOT change the compiled program or the weights —
-# everything else lands in the pool key. Output roots are per-REQUEST
-# (VideoTask.out_root routes them through a shared extractor), video lists
-# are the payload, and profile is forced on for the metrics surface.
-# tmp_path stays IN the key: loaders read the entry's tmp root, so a
-# request with a different tmp_path must get its own entry rather than
-# silently writing re-encode temps under another request's root. The
-# cache_* namespace also stays IN the key: the worker's extractor
-# publishes/consults the cache configured at build time, so requests
-# with different cache settings must not share an entry (they'd inherit
-# the first builder's cache behavior silently). mesh_devices likewise
-# stays IN the key (it is NOT listed below): it changes the compiled
-# program's sharding and how many chips the entry is resident on, so a
-# 1-chip and a 4-chip request each get their own warm entry.
-_KEY_EXCLUDE = frozenset({
-    'video_paths', 'file_with_video_paths', 'output_path',
-    'profile', 'profile_dir', 'timeout_s',
-    # flight-recorder knobs (obs/): telemetry settings must not
-    # fragment the executable key space — two requests differing only in
-    # trace_out would otherwise transplant + compile twice. Like
-    # profile, the FIRST builder's obs settings win for a shared entry;
-    # a server-wide timeline comes from the trace_out base override
-    # (merged across every worker at drain).
-    'trace_out', 'trace_capacity', 'manifest_out',
-    # output-side pipelining depth (async device loop): how deep D2H
-    # defers behind dispatch, never what the step computes (outputs are
-    # byte-identical by contract) — two requests differing only in
-    # inflight must share one warm entry; the FIRST builder's depth wins
-    'inflight',
-    # input-side decode parallelism (decode farm): worker processes and
-    # ring sizing change where decode runs, never the bytes produced —
-    # same policy as inflight, the FIRST builder's farm settings win
-    'decode_workers', 'decode_farm_ring_mb',
-})
+# config keys that do NOT change the compiled program, the weights, or
+# the worker's run behavior — everything else lands in the pool key.
+# The per-knob classification (and its rationale: why tmp_path, the
+# cache_* namespace, and mesh_devices stay IN the key while trace/
+# inflight/farm knobs share the FIRST builder's settings) lives in ONE
+# place, ``config.KNOB_CLASSIFICATION`` — the cache fingerprint derives
+# its own exclusion set from the same registry, and vft-lint rejects
+# hand-maintained copies of either list.
+_KEY_EXCLUDE = knob_exclude('pool_key')
 
 
 def pool_key(args: Config) -> tuple:
@@ -314,8 +292,9 @@ class _Worker:
             # no request hangs, and retire this entry so the next submit
             # rebuilds a healthy one
             self.crashed = True
-            print(f'serve worker {self.label} crashed:', file=sys.stderr)
-            traceback.print_exc()
+            event(logging.ERROR, 'serve worker crashed; failing its '
+                  'outstanding videos and retiring the entry',
+                  subsystem='serve', exc_info=True, label=self.label)
             with self._lock:
                 stranded = list(self.outstanding)
                 self.outstanding.clear()
@@ -456,7 +435,10 @@ class ExtractionServer:
             try:
                 self.ingress.begin_drain()
             except Exception:
-                pass
+                # drain continues regardless, but a front door that
+                # failed to close is worth a line in the log
+                event(logging.WARNING, 'ingress begin_drain failed',
+                      subsystem='serve', exc_info=True)
         with self._lock:
             # snapshot under the lock: _reap_retired_locked mutates
             # _retired concurrently
@@ -496,7 +478,8 @@ class ExtractionServer:
                 try:
                     self.ingress.finish_drain()
                 except Exception:
-                    pass
+                    event(logging.WARNING, 'ingress finish_drain failed',
+                          subsystem='serve', exc_info=True)
             doc = self.metrics()
             metrics_mod.write_metrics_file(self.metrics_path, doc,
                                            prom_text=self._prometheus(doc))
@@ -953,6 +936,9 @@ class ExtractionServer:
             try:
                 ingress_stats = self.ingress.stats()
             except Exception:
+                event(logging.WARNING, 'ingress stats unavailable; '
+                      'metrics document degrades to enabled=False',
+                      subsystem='serve', exc_info=True)
                 ingress_stats = None
         return metrics_mod.build_metrics(
             self._started_at, depth, self.queue_depth, draining,
@@ -987,7 +973,11 @@ class ExtractionServer:
             try:
                 listener(req)
             except Exception:
-                pass
+                # a broken listener must not take down completion, but a
+                # silent one leaks what it guards (per-tenant quota units)
+                event(logging.WARNING, 'completion listener failed',
+                      subsystem='serve', exc_info=True,
+                      request_id=req.id)
         if self.metrics_path:
             # building the metrics document takes the server lock and
             # snapshots every tracer — skip it entirely when no
@@ -1114,6 +1104,9 @@ def serve_main(argv: List[str]) -> int:
     ).start()
     server.install_signal_handlers()
     # machine-greppable endpoint line (tests and tooling scrape it)
+    # vft-lint: ok=stdout-purity — the daemon's documented startup line
+    # (docs/serving.md): clients scrape host:port from it; serve-mode
+    # stdout is not a feature stream (features go to request out_roots)
     print(f'serving on {server.host}:{server.port} '
           f'(pid {os.getpid()}; queue_depth='
           f'{serve_cfg["serve_queue_depth"]}, warm_pool='
@@ -1132,9 +1125,11 @@ def serve_main(argv: List[str]) -> int:
             max_connections=serve_cfg['serve_ingress_max_connections'],
         ).start()
         # second machine-greppable endpoint line (same scraping contract)
+        # vft-lint: ok=stdout-purity — documented startup line (ingress)
         print(f'ingress on {gateway.host}:{gateway.port} '
               f'(tenants={gateway.n_tenants})', flush=True)
     server.serve_forever()
+    # vft-lint: ok=stdout-purity — shutdown line of the same contract
     print('serve: drained, exiting', flush=True)
     sys.stdout.flush()
     sys.stderr.flush()

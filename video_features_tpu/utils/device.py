@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import os
+import warnings
 
 import jax
 
@@ -51,17 +52,20 @@ def enable_compilation_cache(cache_dir, device: str = 'any') -> None:
                 # the host-SHARED accelerator dir — reject/SIGILL fodder
                 # for other hosts. Clear it; correctness beats the
                 # accelerator cache in mixed-device processes.
-                print('compilation cache disabled for this process '
-                      f'(device={device!r} must not persist XLA:CPU entries '
-                      f'into the shared dir {current})')
+                warnings.warn(
+                    'compilation cache disabled for this process '
+                    f'(device={device!r} must not persist XLA:CPU '
+                    f'entries into the shared dir {current})')
             else:
                 # accelerator device with compilation_cache_dir=null: a
                 # plain per-config opt-out, no CPU-entry hazard involved
-                print(f'compilation cache disabled per config '
-                      f'(was {current})')
+                warnings.warn('compilation cache disabled per config '
+                              f'(was {current})')
             try:
                 jax.config.update('jax_compilation_cache_dir', None)
             except Exception:  # pragma: no cover
+                # vft-lint: ok=swallowed-exception — best-effort unset on
+                # ancient jax without the config key; compiles run cold
                 pass
         return
     try:
@@ -72,16 +76,18 @@ def enable_compilation_cache(cache_dir, device: str = 'any') -> None:
         if current and current != path:
             # the cache dir is process-global; a second extractor with a
             # different dir/device would silently redirect the first one's
-            print(f'WARNING: compilation cache already at {current}; '
-                  f'redirecting to {path} (process-global — earlier '
-                  f'extractors in this process now use the new dir)')
+            warnings.warn(
+                f'compilation cache already at {current}; redirecting '
+                f'to {path} (process-global — earlier extractors in '
+                'this process now use the new dir)')
         os.makedirs(path, exist_ok=True)
         jax.config.update('jax_compilation_cache_dir', path)
         # default threshold is 60s; our steady-state steps are seconds, so
         # cache everything that takes meaningful compile time
         jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
     except Exception as e:  # pragma: no cover - depends on fs/backend
-        print(f'WARNING: compilation cache unavailable ({e}); compiling cold')
+        warnings.warn(f'compilation cache unavailable ({e}); '
+                      'compiling cold')
 
 
 def pin_cpu_platform() -> None:
@@ -97,6 +103,8 @@ def pin_cpu_platform() -> None:
     try:
         jax.config.update('jax_platforms', 'cpu')
     except Exception:
+        # vft-lint: ok=swallowed-exception — documented no-op when
+        # backends are already up (the update fails harmlessly)
         pass
 
 

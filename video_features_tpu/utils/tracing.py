@@ -39,6 +39,7 @@ from typing import Dict, Iterable, Iterator, List, Optional
 STAGES = (
     'decode',             # raw decode (stack families without preprocess)
     'decode+preprocess',  # decode + host transform on the prefetch thread
+    'audio_dsp',          # vggish: host-side mel/log-mel DSP on the wav
     'queue_idle',         # serve: blocking waits on an idle request feed
     'pack',               # packed batch assembly (pool flush + np.stack)
     'h2d',                # host→device input transfer (producer thread)
