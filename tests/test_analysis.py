@@ -27,9 +27,10 @@ from video_features_tpu.analysis import (
 )
 from video_features_tpu.analysis.checks import (
     check_contract_keys, check_knob_classification,
-    check_knob_registry_single_source, check_recipe_picklable,
-    check_spawn_purity, check_stage_vocabulary, check_stdout_purity,
-    check_swallowed_exceptions, check_thread_discipline,
+    check_knob_registry_single_source, check_lock_order,
+    check_recipe_picklable, check_spawn_purity, check_stage_vocabulary,
+    check_stdout_purity, check_swallowed_exceptions,
+    check_thread_discipline,
 )
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
@@ -465,6 +466,138 @@ def test_thread_discipline_rejects_missing_lock_name(tmp_path):
 def test_thread_discipline_scope_is_concurrent_dirs_only(tmp_path):
     pkg = make_pkg(tmp_path, {'utils/memo.py': '_MEMO = {}\n'})
     assert check_thread_discipline(pkg) == []
+
+
+# -- lock-order --------------------------------------------------------------
+
+def test_lock_order_flags_blocking_call_under_lock(tmp_path):
+    pkg = make_pkg(tmp_path, {'farm/hub.py': '''
+        import threading
+        _LOCK = threading.Lock()
+
+        def drain(q):
+            with _LOCK:
+                return q.get()
+    '''})
+    findings = check_lock_order(pkg)
+    assert [f.key for f in findings] == ['blocking:drain.get']
+    assert '_LOCK' in findings[0].message
+
+
+def test_lock_order_allows_timeout_and_unlocked_blocking(tmp_path):
+    pkg = make_pkg(tmp_path, {'serve/hub.py': '''
+        import threading
+        _LOCK = threading.Lock()
+
+        def ok(q, t, conn, d):
+            q.get()                   # not under a lock
+            with _LOCK:
+                q.get(timeout=1.0)    # bounded
+                t.join(2.0)           # positional deadline
+                d.get('key')          # dict.get, not Queue.get
+            conn.recv()
+    '''})
+    assert check_lock_order(pkg) == []
+
+
+def test_lock_order_nested_def_resets_held_set(tmp_path):
+    # a function DEFINED under the lock runs later, not under it
+    pkg = make_pkg(tmp_path, {'ingress/hub.py': '''
+        import threading
+        _LOCK = threading.Lock()
+
+        def make(q):
+            with _LOCK:
+                def later():
+                    return q.get()
+                return later
+    '''})
+    assert check_lock_order(pkg) == []
+
+
+def test_lock_order_instance_lock_counts(tmp_path):
+    pkg = make_pkg(tmp_path, {'serve/pool.py': '''
+        class Pool:
+            def drain(self, q):
+                with self._lock:
+                    return q.recv()
+    '''})
+    assert [f.key for f in check_lock_order(pkg)] \
+        == ['blocking:Pool.drain.recv']
+
+
+def test_lock_order_detects_acquisition_cycle(tmp_path):
+    pkg = make_pkg(tmp_path, {'farm/ab.py': '''
+        import threading
+        _A = threading.Lock()
+        _B_LOCK = threading.Lock()
+        _LOCKED_BY = {'_S': '_A', '_T': '_B_LOCK'}
+        _S = {}
+        _T = {}
+
+        def fwd():
+            with _A:
+                with _B_LOCK:
+                    pass
+
+        def rev():
+            with _B_LOCK:
+                with _A:
+                    pass
+    '''})
+    findings = check_lock_order(pkg)
+    assert len(findings) == 1
+    assert findings[0].key.startswith('cycle:')
+    assert '_A' in findings[0].message and '_B_LOCK' in findings[0].message
+
+
+def test_lock_order_nesting_without_cycle_is_clean(tmp_path):
+    pkg = make_pkg(tmp_path, {'farm/ab.py': '''
+        import threading
+        _A = threading.Lock()
+        _B_LOCK = threading.Lock()
+
+        def fwd():
+            with _A:
+                with _B_LOCK:
+                    pass
+    '''})
+    assert check_lock_order(pkg) == []
+
+
+def test_lock_order_name_match_is_token_anchored(tmp_path):
+    # 'block'/'clock'/'_nonblocking_guard' context managers are not
+    # locks; '_lock'/'build_lock'/'_LIVE_LOCK' are
+    pkg = make_pkg(tmp_path, {'serve/hub.py': '''
+        def f(self, q, clock, block):
+            with clock:
+                q.get()
+            with block:
+                q.get()
+            with self._nonblocking_guard:
+                q.get()
+    '''})
+    assert check_lock_order(pkg) == []
+    pkg2 = make_pkg(tmp_path, {'serve/hub2.py': '''
+        def f(self, q, build_lock):
+            with build_lock:
+                q.get()
+    '''}, name='fixpkg2')
+    assert [f.key for f in check_lock_order(pkg2)] == ['blocking:f.get']
+
+
+def test_lock_order_suppression_comment(tmp_path):
+    pkg = make_pkg(tmp_path, {'farm/hub.py': '''
+        import threading
+        _LOCK = threading.Lock()
+
+        def drain(q):
+            with _LOCK:
+                # vft-lint: ok=lock-order — the only producer holds no
+                # locks; bounded by the producer's own deadline
+                return q.get()
+    '''})
+    assert filter_suppressed(pkg, check_lock_order(pkg)) == []
 
 
 # -- baseline ----------------------------------------------------------------

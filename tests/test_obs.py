@@ -511,7 +511,8 @@ METRICS_DOC_KEYS = {'uptime_s', 'queue', 'warm_pool', 'cache', 'farm',
 TRACE_EVENT_KEYS = {'name', 'ph', 'ts', 'dur', 'pid', 'tid', 'args', 's'}
 MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
                  'config', 'fingerprints', 'videos', 'outcomes', 'stages',
-                 'compile', 'executables', 'farm', 'mesh', 'ingress'}
+                 'compile', 'executables', 'farm', 'mesh', 'ingress',
+                 'programs_lock'}
 
 
 CANONICAL_STAGES = {'decode', 'decode+preprocess', 'audio_dsp',

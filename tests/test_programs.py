@@ -1,0 +1,382 @@
+"""vft-programs (video_features_tpu/analysis/programs.py): the program
+contract checker itself.
+
+Three layers, mirroring tests/test_analysis.py:
+
+  * toy jitted functions with PLANTED violations, one per rule — the
+    signature extraction + rule pass must catch each (and must NOT fire
+    on the clean variant);
+  * lock semantics on a real family (r21d — the cheapest build):
+    ``--write-lock`` idempotence, injected dtype drift → exit 2, stale /
+    unknown lock entries reported;
+  * the live-tree gate: the cheap families checked against the SHIPPED
+    ``PROGRAMS.lock.json`` in tier-1, all eight in the slow lane — the
+    same gate CI's ``programs-check`` job enforces.
+
+Plus the float32-boundary parity assertions the no-f64 rule leans on
+(vggish's explicit host-side narrowing must equal jax's old implicit
+device_put downcast; host transforms must preserve uint8).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from video_features_tpu.analysis.core import EXIT_CLEAN, EXIT_FINDINGS
+from video_features_tpu.analysis.programs import (
+    FAMILIES, ProgramSpec, build_family, check_program, collect,
+    default_lock_path, diff_lock, family_lock_hashes, load_lock, main,
+    program_signature, write_lock,
+)
+from video_features_tpu.parallel.mesh import make_mesh
+
+
+def sig_and_findings(spec, family='toy', width=1, mesh=None):
+    sig = program_signature(spec)
+    return sig, check_program(spec, sig, family, width, mesh)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+P = jax.ShapeDtypeStruct((), np.float32)
+B4 = jax.ShapeDtypeStruct((4, 8), np.uint8)
+
+
+# -- per-rule toys -----------------------------------------------------------
+
+def test_clean_toy_has_no_findings_and_full_signature():
+    f = jax.jit(lambda p, b: b.astype(np.float32).sum(axis=1) * p)
+    sig, findings = sig_and_findings(ProgramSpec('step', f, (P, B4)))
+    assert findings == []
+    assert sig['batch'] == {'shape': [4, 8], 'dtype': 'uint8'}
+    assert sig['out'] == [{'shape': [4], 'dtype': 'float32'}]
+    assert sig['batch_donated'] is False
+    assert sig['const_bytes'] == 0
+    assert sig['num_partitions'] == 1
+    assert len(sig['stablehlo_sha256']) == 64
+
+
+def test_no_f64_rule_catches_planted_promotion():
+    from jax.experimental import enable_x64
+    with enable_x64():
+        f = jax.jit(lambda p, b: b.astype(np.float64).sum() * p)
+        _, findings = sig_and_findings(ProgramSpec('step', f, (P, B4)))
+    assert rules_of(findings) == {'no-f64'}
+
+
+def test_no_weak_type_rule_catches_scalar_only_epilogue():
+    f = jax.jit(lambda p, b: jnp.sin(1.0))
+    _, findings = sig_and_findings(ProgramSpec('step', f, (P, B4)))
+    assert rules_of(findings) == {'no-weak-type'}
+
+
+def test_no_host_callback_rule():
+    def cb(x):
+        return np.asarray(x)
+
+    f = jax.jit(lambda p, b: jax.pure_callback(
+        cb, jax.ShapeDtypeStruct(b.shape, np.float32), b))
+    _, findings = sig_and_findings(ProgramSpec('step', f, (P, B4)))
+    assert 'no-host-callback' in rules_of(findings)
+
+
+def test_donation_rule_both_directions():
+    donated = jax.jit(lambda p, b: b.astype(np.float32).sum() * p,
+                      donate_argnums=(1,))
+    plain = jax.jit(lambda p, b: b.astype(np.float32).sum() * p)
+    # program donates, spec says it must not
+    _, findings = sig_and_findings(ProgramSpec('step', donated, (P, B4)))
+    assert rules_of(findings) == {'donation'}
+    # spec expects donation, program dropped it
+    _, findings = sig_and_findings(
+        ProgramSpec('step', plain, (P, B4), donate_batch=True))
+    assert rules_of(findings) == {'donation'}
+    # declared + lowered agree
+    sig, findings = sig_and_findings(
+        ProgramSpec('step', donated, (P, B4), donate_batch=True))
+    assert findings == [] and sig['batch_donated'] is True
+
+
+def test_shardable_rule_names_indivisible_batch():
+    f = jax.jit(lambda p, b: b.astype(np.float32).sum(axis=1) * p)
+    odd = jax.ShapeDtypeStruct((3, 8), np.uint8)
+    mesh = make_mesh(n_devices=2, time_parallel=1)
+    _, findings = sig_and_findings(ProgramSpec('step', f, (P, odd)),
+                                   width=2, mesh=mesh)
+    assert rules_of(findings) == {'shardable'}
+    assert 'cannot shard over 2' in findings[0].message
+
+
+def test_const_budget_rule_catches_closure_captured_weights():
+    weights = np.ones((300_000,), np.float32)          # 1.2 MB closed over
+    f = jax.jit(lambda p, b: b.astype(np.float32).sum()
+                * jnp.asarray(weights).sum() * p)
+    sig, findings = sig_and_findings(ProgramSpec('step', f, (P, B4)))
+    assert rules_of(findings) == {'const-budget'}
+    assert sig['const_bytes'] >= 1_200_000
+    # an explicit budget accepts it (the vft-programs suppression shape)
+    _, findings = sig_and_findings(
+        ProgramSpec('step', f, (P, B4), const_budget=2 << 20))
+    assert findings == []
+
+
+def test_spec_ok_suppression_mirrors_vft_lint():
+    donated = jax.jit(lambda p, b: b.astype(np.float32).sum() * p,
+                      donate_argnums=(1,))
+    _, findings = sig_and_findings(ProgramSpec(
+        'step', donated, (P, B4),
+        ok={'donation': 'toy: donation is the point'}))
+    assert findings == []
+
+
+def test_mesh_width_2_signature_records_partitions():
+    from video_features_tpu.parallel.mesh import batch_sharding, replicated
+    mesh = make_mesh(n_devices=2, time_parallel=1)
+    f = jax.jit(lambda p, b: b.astype(np.float32).sum(axis=1) * p)
+    pp = jax.ShapeDtypeStruct((), np.float32, sharding=replicated(mesh))
+    bb = jax.ShapeDtypeStruct((4, 8), np.uint8,
+                              sharding=batch_sharding(mesh))
+    sig, findings = sig_and_findings(ProgramSpec('step', f, (pp, bb)),
+                                     width=2, mesh=mesh)
+    assert findings == []
+    assert sig['num_partitions'] == 2
+
+
+# -- lock semantics on a real family -----------------------------------------
+
+@pytest.fixture(scope='module')
+def r21d_live():
+    """One r21d build + both mesh-width lowerings, shared by the lock
+    tests (the build is the expensive part)."""
+    live, findings = collect(('r21d',), (1, 2))
+    assert findings == []
+    return live
+
+
+def test_write_lock_is_idempotent(r21d_live, tmp_path):
+    lock = tmp_path / 'lock.json'
+    write_lock(lock, r21d_live)
+    first = lock.read_text()
+    write_lock(lock, r21d_live)
+    assert lock.read_text() == first
+    doc = json.loads(first)
+    assert set(doc['families']) == {'r21d'}
+    assert set(doc['families']['r21d']) == {'mesh1', 'mesh2'}
+
+
+def test_clean_diff_against_own_lock(r21d_live, tmp_path):
+    lock = tmp_path / 'lock.json'
+    write_lock(lock, r21d_live)
+    assert diff_lock(r21d_live, load_lock(lock), ('r21d',)) == []
+
+
+def test_mesh_width_subset_repin_keeps_other_widths(r21d_live, tmp_path):
+    """A --mesh-widths subset re-pin must merge, not drop, the family's
+    other widths' pinned signatures — and a subset CHECK must not
+    report the unchecked widths as stale."""
+    lock = tmp_path / 'lock.json'
+    write_lock(lock, r21d_live)
+    only_m1 = {'r21d': {'mesh1': r21d_live['r21d']['mesh1']}}
+    write_lock(lock, only_m1)
+    doc = json.loads(lock.read_text())
+    assert set(doc['families']['r21d']) == {'mesh1', 'mesh2'}
+    assert diff_lock(r21d_live, load_lock(lock), ('r21d',)) == []
+    # width-subset diff: live has only mesh1, lock has both — clean
+    assert diff_lock(only_m1, load_lock(lock), ('r21d',),
+                     widths=(1,)) == []
+
+
+def test_injected_dtype_drift_is_reported(r21d_live, tmp_path):
+    lock = tmp_path / 'lock.json'
+    write_lock(lock, r21d_live)
+    doc = json.loads(lock.read_text())
+    step = doc['families']['r21d']['mesh1']['programs']['step']
+    step['batch']['dtype'] = 'float64'               # the injected drift
+    lock.write_text(json.dumps(doc))
+    findings = diff_lock(r21d_live, load_lock(lock), ('r21d',))
+    assert len(findings) == 1
+    f = findings[0]
+    assert (f.rule, f.family, f.mesh, f.program) \
+        == ('lock-drift', 'r21d', 1, 'step')
+    assert 'batch' in f.message and 'float64' in f.message
+
+
+def test_unknown_family_in_lock_is_reported(r21d_live, tmp_path):
+    lock = tmp_path / 'lock.json'
+    write_lock(lock, r21d_live)
+    doc = json.loads(lock.read_text())
+    doc['families']['betamax'] = {'mesh1': {'programs': {}}}
+    lock.write_text(json.dumps(doc))
+    findings = diff_lock(r21d_live, load_lock(lock), ('r21d',))
+    assert len(findings) == 1
+    assert findings[0].family == 'betamax'
+    assert 'unknown family' in findings[0].message
+
+
+def test_missing_and_stale_programs_are_both_drift(r21d_live, tmp_path):
+    lock = tmp_path / 'lock.json'
+    write_lock(lock, r21d_live)
+    doc = json.loads(lock.read_text())
+    progs = doc['families']['r21d']['mesh1']['programs']
+    progs['ghost'] = dict(progs['step'])             # pinned, never lowered
+    lock.write_text(json.dumps(doc))
+    findings = diff_lock(r21d_live, load_lock(lock), ('r21d',))
+    assert [f.program for f in findings] == ['ghost']
+    assert 'stale' in findings[0].message
+    # and the reverse: a live program the lock has never seen
+    doc['families']['r21d']['mesh1']['programs'] = {}
+    lock.write_text(json.dumps(doc))
+    findings = diff_lock(r21d_live, load_lock(lock), ('r21d',))
+    assert any('new program not in the lock' in f.message
+               for f in findings)
+
+
+def test_full_scope_repin_prunes_stale_lock_entries(r21d_live, tmp_path):
+    """The bare --write-lock must make the 'unknown family' finding's
+    own remediation advice work: stale families (and stale width keys)
+    are pruned on a full-scope re-pin, kept on subset re-pins."""
+    lock = tmp_path / 'lock.json'
+    write_lock(lock, {'betamax': {'mesh9': {'programs': {}}}})
+    write_lock(lock, r21d_live)                       # subset: kept
+    assert 'betamax' in json.loads(lock.read_text())['families']
+    write_lock(lock, r21d_live, prune_families=True,
+               replace_widths=True)                   # full scope
+    assert set(json.loads(lock.read_text())['families']) == {'r21d'}
+
+
+def test_const_bytes_recorded_at_every_width(r21d_live):
+    """Width-conditional signature fields would make a --mesh-widths
+    subset run drift against a full-width lock (review regression)."""
+    for mesh in ('mesh1', 'mesh2'):
+        assert 'const_bytes' in \
+            r21d_live['r21d'][mesh]['programs']['step']
+
+
+def test_unpinned_family_is_drift(r21d_live):
+    findings = diff_lock(r21d_live, {'families': {}}, ('r21d',))
+    assert len(findings) == 1 and 'not in the lock' in findings[0].message
+
+
+# -- CLI exit codes (the CI contract) ----------------------------------------
+
+def test_cli_exit_0_clean_and_2_on_drift(tmp_path, capsys):
+    lock = tmp_path / 'lock.json'
+    assert main(['--families', 'resnet', '--write-lock',
+                 '--lock', str(lock)]) == EXIT_CLEAN
+    assert main(['--families', 'resnet',
+                 '--lock', str(lock)]) == EXIT_CLEAN
+    doc = json.loads(lock.read_text())
+    step = doc['families']['resnet']['mesh1']['programs']['step']
+    step['params']['float32']['arrays'] += 1         # injected census drift
+    lock.write_text(json.dumps(doc))
+    assert main(['--families', 'resnet',
+                 '--lock', str(lock)]) == EXIT_FINDINGS
+    out = capsys.readouterr().out
+    assert 'params drifted' in out
+
+
+# -- the live-tree gate vs the SHIPPED lock ----------------------------------
+
+def test_shipped_lock_covers_all_families_at_both_widths():
+    doc = load_lock(default_lock_path())
+    assert set(doc['families']) == set(FAMILIES)
+    for family, entry in doc['families'].items():
+        assert set(entry) == {'mesh1', 'mesh2'}, family
+        for mesh in entry.values():
+            assert mesh['programs'], family
+
+
+def test_live_tree_clean_fast_families():
+    """Tier-1 slice of the CI programs-check gate: the two cheapest
+    builds against the shipped lock (the slow lane + CI run all 8)."""
+    assert main(['--families', 'r21d,resnet']) == EXIT_CLEAN
+
+
+@pytest.mark.slow
+def test_live_tree_clean_all_families():
+    assert main([]) == EXIT_CLEAN
+
+
+def test_family_lock_hashes_reads_shipped_lock():
+    hashes = family_lock_hashes('r21d')
+    assert set(hashes) == {'mesh1', 'mesh2'}
+    assert set(hashes['mesh1']) == {'step'}
+    assert len(hashes['mesh1']['step']) == 64
+    assert family_lock_hashes('not-a-family') == {}
+
+
+def test_manifest_records_programs_lock(tmp_path):
+    """configure_obs attaches the family's pinned hashes; the manifest
+    document carries them under the 'programs_lock' key."""
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    args = load_config('r21d', overrides={
+        'device': 'cpu', 'video_paths': ['x.mp4'],
+        'allow_random_weights': True, 'compilation_cache_dir': None,
+        'manifest_out': str(tmp_path / 'manifest.json')})
+    ex = create_extractor(args)
+    doc = ex.manifest.document()
+    assert doc['programs_lock'] == {'r21d': family_lock_hashes('r21d')}
+
+
+# -- float32 boundary parity (the no-f64 satellite) --------------------------
+
+def test_vggish_float32_pin_matches_jax_implicit_downcast():
+    """The explicit host-side ``astype(np.float32)`` at the vggish
+    device boundary must be byte-identical to the implicit float64
+    canonicalization jax used to apply at device_put (x64 disabled) —
+    same double→float rounding, so the pin changes nothing."""
+    rng = np.random.default_rng(0)
+    examples = rng.standard_normal((5, 96, 64)) * 4 - 2   # float64 DSP out
+    explicit = examples.astype(np.float32)
+    implicit = np.asarray(jax.device_put(examples))
+    assert implicit.dtype == np.float32
+    np.testing.assert_array_equal(explicit, implicit)
+
+
+def test_host_transforms_preserve_uint8():
+    from video_features_tpu.ops.host_transforms import (
+        center_crop_host, frames_match_device_contract, resize_pil,
+    )
+    frame = np.random.default_rng(1).integers(
+        0, 255, (120, 160, 3), dtype=np.uint8)
+    for out in (resize_pil(frame, 64), center_crop_host(frame, 96),
+                resize_pil(frame, 64, interpolation='bicubic')):
+        assert frames_match_device_contract(out), out.dtype
+    assert not frames_match_device_contract(frame.astype(np.float64))
+
+
+class FloatLeakRecipe:
+    """Module-level (spawn unpickles by reference): yields one float64
+    window — numpy default-dtype math leaking through a transform."""
+
+    def open(self, path):
+        def windows():
+            yield np.zeros((8, 8, 3), np.float64), 0
+        return {}, windows()
+
+
+def test_farm_worker_rejects_float_windows(tmp_path, caplog):
+    """A recipe leaking float windows fails ITS video with the dtype
+    contract named (worker 'err' path) — shipped bytes must always
+    agree with the in-process decode replay, and jax's silent f64
+    downcast would have masked the disagreement."""
+    import logging
+
+    from video_features_tpu.farm import DecodeFarm
+    from video_features_tpu.parallel.packing import FLUSH, NUDGE, VideoTask
+
+    task = VideoTask(str(tmp_path / 'leak.bin'))
+    farm = DecodeFarm(FloatLeakRecipe(), workers=1, ring_bytes=1 << 20)
+    with caplog.at_level(logging.WARNING, logger='video_features_tpu'):
+        for item in farm.stream(iter([task]), lambda t: True):
+            if item is FLUSH or item is NUDGE:
+                continue
+    assert task.failed
+    assert farm.stats()['videos_failed'] == 1
+    assert 'must be uint8' in caplog.text

@@ -1,0 +1,29 @@
+"""Shared launcher plumbing for the analysis CLIs (vft-lint /
+vft-programs).
+
+Both tools must work from a source checkout without installation, and
+both gate CI on the exit-code contract declared once in
+``video_features_tpu/analysis/core.py`` (EXIT_CLEAN / EXIT_ERROR /
+EXIT_FINDINGS / EXIT_IMPURE). This module holds the one copy of the
+repo-root resolution so the two wrappers cannot drift.
+
+Import-order note: :func:`add_repo_root` only touches ``sys.path`` — it
+deliberately imports nothing from the package, because vft_lint.py must
+snapshot ``sys.modules`` (its jax-purity probe) and vft_programs.py must
+pin the jax platform env BEFORE the first package import.
+"""
+import sys
+from pathlib import Path
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent
+
+
+def add_repo_root() -> Path:
+    """Prepend the repo root to ``sys.path`` (idempotent) so the package
+    resolves from a source checkout; returns the root."""
+    root = repo_root()
+    if str(root) not in sys.path:
+        sys.path.insert(0, str(root))
+    return root

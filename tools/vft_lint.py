@@ -13,12 +13,13 @@ loaded).
 Exit codes: 0 clean, 1 analyzer error, 2 new findings, 3 jax imported.
 """
 import sys
-from pathlib import Path
+
+from _bootstrap import add_repo_root
 
 # honest purity probe: BEFORE the package (or anything else) is imported
 _JAX_PRELOADED = 'jax' in sys.modules
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+add_repo_root()
 
 from video_features_tpu.analysis.__main__ import main  # noqa: E402
 

@@ -24,7 +24,8 @@ from video_features_tpu.analysis.checks import (
     RULES, analyze, closure_forbidden_imports,
 )
 from video_features_tpu.analysis.core import (
-    Package, load_baseline, new_findings, write_baseline,
+    EXIT_CLEAN, EXIT_ERROR, EXIT_FINDINGS, EXIT_IMPURE, Package,
+    load_baseline, new_findings, write_baseline,
 )
 
 DEFAULT_BASELINE = 'tools/vft_lint_baseline.json'
@@ -74,7 +75,7 @@ def main(argv=None, jax_preloaded=None) -> int:
     if args.list_rules:
         for rule in RULES:
             print(rule)
-        return 0
+        return EXIT_CLEAN
 
     pkg_root, tests_dir, repo_root = _default_roots()
     if args.root:
@@ -91,13 +92,13 @@ def main(argv=None, jax_preloaded=None) -> int:
         findings = analyze(package)
     except SyntaxError as e:
         print(f'vft-lint: parse error: {e}', file=sys.stderr)
-        return 1
+        return EXIT_ERROR
 
     if args.write_baseline:
         write_baseline(baseline_path, findings)
         print(f'vft-lint: wrote {len(findings)} accepted finding(s) to '
               f'{baseline_path}')
-        return 0
+        return EXIT_CLEAN
 
     fresh = new_findings(findings, load_baseline(baseline_path))
     for f in fresh:
@@ -129,8 +130,8 @@ def main(argv=None, jax_preloaded=None) -> int:
             print(v.render(own_pkg_root), file=sys.stderr)
         print('vft-lint: FATAL: the analyzer process imported jax',
               file=sys.stderr)
-        return 3
-    return 2 if fresh else 0
+        return EXIT_IMPURE
+    return EXIT_FINDINGS if fresh else EXIT_CLEAN
 
 
 if __name__ == '__main__':

@@ -19,6 +19,8 @@ Rule ids (stable — baselines and suppressions key on them):
   contract-key-sync       export schemas match their pinned contracts
   stage-vocabulary        stage names come from the canonical STAGES
   thread-discipline       module-level mutables declare their lock
+  lock-order              acyclic lock graph; no untimed blocking call
+                          while a lock is held
 """
 from __future__ import annotations
 
@@ -618,6 +620,198 @@ def check_thread_discipline(package: Package) -> List[Finding]:
     return findings
 
 
+# -- lock-order --------------------------------------------------------------
+
+# receiver-less blocking methods: a zero-positional-arg call to one of
+# these blocks until someone else makes progress. The zero-arg shape is
+# the discriminator that keeps dict.get(key) / str.join(seq) /
+# os.path.join(a, b) out of scope — Queue.get(), Connection.recv() and
+# Thread/Process.join() are exactly the forms with no positional args.
+_BLOCKING_METHODS = ('get', 'recv', 'join')
+_LOCK_FACTORY_NAMES = ('Lock', 'RLock', 'Condition', 'Semaphore',
+                       'BoundedSemaphore')
+
+
+# 'lock'/'rlock' as the final identifier TOKEN ('_lock', 'build_lock',
+# '_LIVE_LOCK', 'self._lock') — token-anchored so 'block' / 'clock' /
+# '_nonblocking_guard' context managers are never mistaken for locks
+_LOCK_NAME_RE = re.compile(r'(?:^|_)r?lock$')
+
+
+def _lock_exprs(node: ast.With, module_locks: Set[str]) -> List[str]:
+    """Unparsed context expressions of a ``with`` that are lock
+    acquisitions: any KNOWN module-level lock name (``_LOCKED_BY``
+    values / ``threading.Lock()`` assignments — whatever it is called),
+    plus any name whose final dotted segment is a 'lock'-ending token
+    (the ``self._lock`` instance idiom). ``.acquire()``-style usage is
+    not the codebase idiom."""
+    out = []
+    for item in node.items:
+        src = ast.unparse(item.context_expr)
+        if src in module_locks:
+            out.append(src)
+        elif '(' not in src and \
+                _LOCK_NAME_RE.search(src.rsplit('.', 1)[-1].lower()):
+            out.append(src)
+    return out
+
+
+def _module_level_locks(mod: Module) -> Set[str]:
+    """Module-level lock names: ``_LOCKED_BY`` values (≠ 'immutable')
+    plus any module-level ``threading.Lock()``-family assignment."""
+    locks: Set[str] = set()
+    locked_node = find_assignment(mod.tree, '_LOCKED_BY')
+    if isinstance(locked_node, ast.Dict):
+        for v in locked_node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str) \
+                    and v.value not in _LOCK_VALUES:
+                locks.add(v.value)
+    for stmt in module_level_statements(mod.tree):
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            if _callable_name(stmt.value.func) in _LOCK_FACTORY_NAMES:
+                locks.update(t.id for t in stmt.targets
+                             if isinstance(t, ast.Name))
+    return locks
+
+
+def _is_blocking_call(node: ast.Call) -> Optional[str]:
+    """The blocking method name when ``node`` is a no-timeout blocking
+    call, else None. ``q.get(timeout=t)`` / ``t.join(deadline)`` /
+    ``q.get(False)`` (any positional arg) pass."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    if node.func.attr not in _BLOCKING_METHODS or node.args:
+        return None
+    if any(kw.arg in ('timeout', 'block') for kw in node.keywords):
+        return None
+    return node.func.attr
+
+
+def check_lock_order(package: Package) -> List[Finding]:
+    """Deadlock statics for the threaded subsystems (serve/, farm/,
+    ingress/). Two checks over the lock-acquisition structure:
+
+      * **blocking-under-lock** — a ``Queue.get()`` /
+        ``Connection.recv()`` / ``join()`` with no timeout while ANY
+        lock is held (module-level locks from ``_LOCKED_BY`` /
+        ``threading.Lock()`` assignments, or a ``with self._lock:``
+        style instance lock) waits on another thread's progress while
+        holding what that thread may need — the textbook shape of the
+        stalls PR 6/8 hardening notes fixed by hand;
+      * **cycle** — the static acquisition graph (edges: lock A held
+        when lock B is acquired, per ``with`` nesting; lock identity is
+        (module, expression) — a syntactic approximation, see
+        docs/static_analysis.md) must be acyclic: an A→B edge in one
+        function and B→A in another is lock-order inversion.
+
+    Nested ``def``/``lambda`` bodies reset the held-set (they execute
+    later, not under the ``with``)."""
+    findings: List[Finding] = []
+    edges: Dict[tuple, Set[tuple]] = {}
+    edge_sites: Dict[tuple, tuple] = {}
+
+    for rel, mod in package.modules.items():
+        if not rel.startswith(_CONCURRENT_DIRS):
+            continue
+        module_locks = _module_level_locks(mod)
+
+        def lock_id(expr: str, rel=rel, module_locks=module_locks) -> tuple:
+            # module-level locks get a module-scoped identity; instance
+            # locks (self._lock) one per (module, expression)
+            return (rel, expr if expr in module_locks else f'<{expr}>')
+
+        class _Walker(ast.NodeVisitor):
+            def __init__(self, mod=mod, rel=rel):
+                self.mod, self.rel = mod, rel
+                self.held: List[str] = []
+
+            def visit_With(self, node: ast.With) -> None:
+                locks = _lock_exprs(node, module_locks)
+                for lk in locks:
+                    for held in self.held:
+                        a, b = lock_id(held), lock_id(lk)
+                        if a != b:
+                            edges.setdefault(a, set()).add(b)
+                            edge_sites.setdefault((a, b),
+                                                  (self.rel, node.lineno))
+                self.held.extend(locks)
+                self.generic_visit(node)
+                if locks:
+                    del self.held[-len(locks):]
+
+            visit_AsyncWith = visit_With
+
+            def visit_Call(self, node: ast.Call) -> None:
+                name = _is_blocking_call(node)
+                if name and self.held \
+                        and not self.mod.suppressed('lock-order',
+                                                    node.lineno):
+                    findings.append(Finding(
+                        'lock-order', self.rel, node.lineno,
+                        f'blocking:{self.mod.scope_of(node)}.{name}',
+                        f'{ast.unparse(node.func)}() blocks with no '
+                        f'timeout while holding '
+                        f'{" + ".join(self.held)} — the holder waits on '
+                        f'another thread that may need the lock (add a '
+                        f'timeout, or move the wait outside the lock)'))
+                self.generic_visit(node)
+
+            def _reset_scope(self, node) -> None:
+                held, self.held = self.held, []
+                self.generic_visit(node)
+                self.held = held
+
+            def visit_FunctionDef(self, node) -> None:
+                self._reset_scope(node)
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_Lambda = visit_FunctionDef
+
+        _Walker().visit(mod.tree)
+
+    # cycle sweep over the global acquisition graph
+    def _find_cycle(start: tuple) -> Optional[List[tuple]]:
+        path: List[tuple] = []
+        on_path: Set[tuple] = set()
+        done: Set[tuple] = set()
+
+        def dfs(node: tuple) -> Optional[List[tuple]]:
+            if node in on_path:
+                return path[path.index(node):] + [node]
+            if node in done:
+                return None
+            path.append(node)
+            on_path.add(node)
+            for nxt in sorted(edges.get(node, ())):
+                cyc = dfs(nxt)
+                if cyc is not None:
+                    return cyc
+            path.pop()
+            on_path.discard(node)
+            done.add(node)
+            return None
+
+        return dfs(start)
+
+    reported: Set[frozenset] = set()
+    for start in sorted(edges):
+        cyc = _find_cycle(start)
+        if cyc is None:
+            continue
+        ident = frozenset(cyc)
+        if ident in reported:
+            continue
+        reported.add(ident)
+        rel, line = edge_sites.get((cyc[0], cyc[1]), (cyc[0][0], 1))
+        chain_txt = ' -> '.join(f'{r}:{n}' for r, n in cyc)
+        findings.append(Finding(
+            'lock-order', rel, line,
+            f'cycle:{"|".join(sorted(n for _, n in set(cyc)))}',
+            f'lock-acquisition cycle: {chain_txt} — two call paths '
+            f'taking these locks in opposite orders can deadlock'))
+    return findings
+
+
 # -- registry ----------------------------------------------------------------
 
 ALL_CHECKS = (
@@ -630,11 +824,13 @@ ALL_CHECKS = (
     check_contract_keys,
     check_stage_vocabulary,
     check_thread_discipline,
+    check_lock_order,
 )
 
 RULES = ('spawn-purity', 'recipe-picklable', 'knob-classification',
          'knob-registry', 'swallowed-exception', 'stdout-purity',
-         'contract-key-sync', 'stage-vocabulary', 'thread-discipline')
+         'contract-key-sync', 'stage-vocabulary', 'thread-discipline',
+         'lock-order')
 
 
 def run_checks(package: Package,

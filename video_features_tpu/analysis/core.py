@@ -39,6 +39,15 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 SUPPRESS_RE = re.compile(r'#\s*vft-lint:\s*ok=([a-z0-9_,-]+)')
 
+# Exit-code contract shared by every analysis CLI (vft-lint AND
+# vft-programs — tools/vft_lint.py, tools/vft_programs.py; CI gates on
+# these). EXIT_IMPURE is vft-lint-only: the pure-AST analyzer importing
+# jax is a self-violation; vft-programs NEEDS jax by design.
+EXIT_CLEAN = 0        # no findings beyond baseline/lock + suppressions
+EXIT_ERROR = 1        # analyzer error (unparseable file, bad flags)
+EXIT_FINDINGS = 2     # at least one NEW finding / lock drift
+EXIT_IMPURE = 3       # the vft-lint analyzer process imported jax
+
 # package-relative files the rules anchor on; a fixture package only
 # needs the files its planted rule reads
 CONFIG_PY = 'config.py'

@@ -17,6 +17,10 @@ import yaml
 
 CONFIG_DIR = Path(__file__).parent / 'configs'
 
+# The one registry of feature families. Also the coverage set the
+# vft-programs contract checker pins PROGRAMS.lock.json against
+# (analysis/programs.py) — adding a family here obliges an abstract
+# step spec (BaseExtractor.program_specs) and a lock re-pin.
 KNOWN_FEATURE_TYPES = ('i3d', 'r21d', 's3d', 'vggish', 'resnet', 'raft', 'clip', 'timm')
 
 # -- content-addressed feature cache (cache/; docs/caching.md) ---------------

@@ -342,6 +342,21 @@ class BaseExtractor:
             from video_features_tpu.obs.manifest import RunManifest
             self.manifest_out = str(manifest_out)
             self.manifest = RunManifest(args)
+            try:
+                # which PINNED programs this family maps to
+                # (PROGRAMS.lock.json): a production trace then names
+                # exactly which contract-checked program ran
+                from video_features_tpu.analysis.programs import (
+                    family_lock_hashes,
+                )
+                hashes = family_lock_hashes(self.feature_type)
+                if hashes:
+                    self.manifest.note_programs_lock(
+                        {self.feature_type: hashes})
+            except Exception:
+                # vft-lint: ok=swallowed-exception — telemetry never
+                # fails a run; an unreadable lock reads as "unpinned"
+                pass
 
     def finish_obs(self, export_trace: bool = True) -> None:
         """Publish the run's telemetry artifacts (CLI end-of-run; serve
@@ -369,6 +384,65 @@ class BaseExtractor:
             except Exception:
                 event(_logging.WARNING, 'trace export failed',
                       exc_info=True, path=self.trace_out)
+
+    # -- abstract program specs (analysis/programs.py: vft-programs) --------
+    #
+    # The program contract checker lowers each family's ACTUAL jitted
+    # step at a canonical abstract geometry and pins the signature in
+    # PROGRAMS.lock.json (docs/static_analysis.md "Program contracts").
+    # Families override program_specs; the helpers below build the
+    # abstract (ShapeDtypeStruct) inputs, sharded over a data mesh when
+    # the checker pins a mesh-width variant.
+
+    # canonical raw decode geometry (H, W) the program lock pins — one
+    # representative shape; the contract is about dtypes/donation/
+    # sharding/closure, which are geometry-independent
+    PROGRAM_DECODE_HW = (240, 320)
+
+    def program_specs(self, mesh=None) -> list:
+        """Abstract AOT program specs for the vft-programs checker: the
+        exact jitted callables the hot paths dispatch, paired with
+        abstract inputs at the family's canonical lock geometry — the
+        batch sharded over ``mesh``'s data axis when given. Families
+        override; an empty list reads as "not covered" and is itself a
+        checker finding for the eight known families."""
+        return []
+
+    def _abstract_params(self, mesh=None):
+        """``self.params`` as ShapeDtypeStructs (replicated over ``mesh``
+        when given) — lowering needs shapes/dtypes, never values."""
+        import jax
+        sharding = None
+        if mesh is not None:
+            from video_features_tpu.parallel.mesh import replicated
+            sharding = replicated(mesh)
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=sharding)
+            if hasattr(x, 'shape') else x, self.params)
+
+    def _abstract_batch(self, shape, dtype, mesh=None):
+        """One abstract device batch, leading axis sharded over the data
+        mesh when given (the packed loop's put_input layout)."""
+        import jax
+        sharding = None
+        if mesh is not None:
+            from video_features_tpu.parallel.mesh import batch_sharding
+            sharding = batch_sharding(mesh)
+        return jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sharding)
+
+    def _program_batch_slots(self, mesh=None) -> int:
+        """Global batch rows at the lock geometry: the family's
+        per-device capacity × the mesh's data-axis size — the same
+        ``plan_device_batch`` arithmetic the packed loop runs."""
+        if self.supports_packing:
+            capacity = self.packed_batch_size()
+        else:
+            capacity = int(getattr(self, 'batch_size', 1) or 1)
+        if mesh is None:
+            return capacity
+        from video_features_tpu.parallel.mesh import plan_device_batch
+        return plan_device_batch(capacity, mesh)
 
     def executable_cost(self, batch):
         """Best-effort XLA ``cost_analysis`` (FLOPs / bytes accessed) of

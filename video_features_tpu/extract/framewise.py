@@ -134,6 +134,23 @@ class BaseFrameWiseExtractor(BaseExtractor):
         # fetch_outputs owns the D2H readback
         return {self.feature_type: self.device_step(batch)}
 
+    def program_specs(self, mesh=None):
+        """vft-programs abstract step spec, shared by every frame-wise
+        family (resnet/clip/timm): the REAL ``host_transform`` discovers
+        the compiled input geometry (run once on a zero frame at the
+        canonical decode shape), so the spec can never drift from the
+        preprocessing that actually feeds the step."""
+        import numpy as np
+
+        from video_features_tpu.analysis.programs import ProgramSpec
+        h, w = self.PROGRAM_DECODE_HW
+        ch, cw = self.host_transform(
+            np.zeros((h, w, 3), np.uint8)).shape[:2]
+        batch = self._abstract_batch(
+            (self._program_batch_slots(mesh), ch, cw, 3), np.uint8, mesh)
+        return [ProgramSpec('step', self._step,
+                            (self._abstract_params(mesh), batch))]
+
     def packed_result(self, task) -> Dict[str, np.ndarray]:
         rows = task.rows.get(self.feature_type, [])
         return {

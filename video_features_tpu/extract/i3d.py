@@ -394,6 +394,27 @@ class ExtractI3D(BaseExtractor):
                 (None if self.device_resize
                  else lambda f: resize_pil(f, MIN_SIDE_SIZE)), False)
 
+    def program_specs(self, mesh=None):
+        """vft-programs abstract step spec: the fused two-stream program
+        (RAFT flow + quantization + both I3D towers in ONE executable)
+        at the canonical decode geometry — post-host-resize unless
+        ``device_resize`` lifted the resize in-graph, exactly what the
+        hot path feeds ``_step``."""
+        from video_features_tpu.analysis.programs import ProgramSpec
+        h, w = self.PROGRAM_DECODE_HW
+        if not self.device_resize:
+            geom = _pil_short_side_geometry(h, w, MIN_SIDE_SIZE)
+            if geom is not None:
+                h, w = geom
+        pads, resize_to = self._geometry(h, w)
+        batch = self._abstract_batch(
+            (self._program_batch_slots(mesh), self.stack_size + 1, h, w,
+             3), np.uint8, mesh)
+        return [ProgramSpec(
+            'step', self._step, (self._abstract_params(mesh), batch),
+            kwargs=dict(pads=pads, streams=tuple(self.streams),
+                        resize_to=resize_to))]
+
     def packed_step(self, stacks):
         # device arrays out — dispatch only; the scheduler materializes
         # results k batches later (fetch_outputs), overlapping D2H +

@@ -99,6 +99,18 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
 
     packed_feat_dim = 512
 
+    def program_specs(self, mesh=None):
+        """vft-programs abstract step spec: raw uint8 decode-geometry
+        stacks into the one jitted step (in-graph resize/normalize/crop
+        + the R(2+1)D forward)."""
+        from video_features_tpu.analysis.programs import ProgramSpec
+        h, w = self.PROGRAM_DECODE_HW
+        batch = self._abstract_batch(
+            (self._program_batch_slots(mesh), self.stack_size, h, w, 3),
+            np.uint8, mesh)
+        return [ProgramSpec('step', self._step,
+                            (self._abstract_params(mesh), batch))]
+
     def packed_step(self, stacks):
         # dispatch only (device array out); the scheduler's deferred
         # fetch_outputs owns the D2H readback

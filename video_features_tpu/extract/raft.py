@@ -132,6 +132,33 @@ class ExtractRAFT(BaseExtractor):
         halo = np.stack([padded[d * k: d * k + k + 1] for d in range(n)])
         return halo.reshape((n * (k + 1),) + padded.shape[1:])
 
+    def program_specs(self, mesh=None):
+        """vft-programs abstract step specs. Single-device: the
+        consecutive-pair flow step over (B+1, Hp, Wp, 3) padded frames.
+        Mesh variant: the family's REAL data-parallel program is the
+        shard_map'd halo layout (each device gets its own k+1 frame run,
+        boundary frame duplicated host-side) — n·(k+1) rows, evenly
+        shardable by construction, unlike the B+1 pair form."""
+        from video_features_tpu.analysis.programs import ProgramSpec
+        h, w = self.PROGRAM_DECODE_HW           # already /8-aligned
+        if mesh is None:
+            batch = self._abstract_batch(
+                (self.batch_size + 1, h, w, 3), np.uint8)
+            return [ProgramSpec('flow_step', self._step,
+                                (self._abstract_params(), batch))]
+        prev_mesh = self._mesh
+        self._mesh = mesh
+        try:
+            dp_step = self._build_dp_step()
+        finally:
+            self._mesh = prev_mesh
+        n = mesh.shape['data']
+        k = max(int(self.batch_size), 1)
+        batch = self._abstract_batch((n * (k + 1), h, w, 3), np.uint8,
+                                     mesh)
+        return [ProgramSpec('flow_step_dp', dp_step,
+                            (self._abstract_params(mesh), batch))]
+
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
         # uint8 until on-device (RAFT normalizes in-graph): the values are
         # exact integers either way and the H2D transfer is 4x smaller

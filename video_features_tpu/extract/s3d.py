@@ -101,6 +101,20 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
 
     packed_feat_dim = s3d_model.FEAT_DIM
 
+    def program_specs(self, mesh=None):
+        """vft-programs abstract step spec: the per-geometry jitted step
+        at the canonical lock geometry (one executable per (h, w) — the
+        lock pins the count at ONE geometry; the per-shape cache is the
+        family's own executable-growth bound)."""
+        from video_features_tpu.analysis.programs import ProgramSpec
+        h, w = self.PROGRAM_DECODE_HW
+        step, _, _ = self._geometry_step(h, w)
+        batch = self._abstract_batch(
+            (self._program_batch_slots(mesh), self.stack_size, h, w, 3),
+            np.uint8, mesh)
+        return [ProgramSpec('step', step,
+                            (self._abstract_params(mesh), batch))]
+
     def packed_step(self, stacks):
         # dispatch only (device array out); the scheduler's deferred
         # fetch_outputs owns the D2H readback

@@ -85,6 +85,29 @@ class ExtractVGGish(BaseExtractor):
                             vggish_model.init_state_dict,
                             feature_type='vggish')
 
+    def program_specs(self, mesh=None):
+        """vft-programs abstract step spec: one fixed-size batch of
+        0.96 s log-mel examples into the jitted VGG. The batch dtype is
+        float32 BY CONTRACT — the host DSP runs float64 for reference
+        parity and :meth:`extract` pins the narrowing cast at the device
+        boundary (the no-f64 rule holds the program side of that line)."""
+        from video_features_tpu.analysis.programs import ProgramSpec
+        if mesh is None:
+            b = self.example_batch
+        else:
+            # vggish has no packed path: its real multi-device program
+            # is in-graph data_parallel, whose global batch is
+            # example_batch ROUNDED UP to the data axis (_ensure_mesh →
+            # round_batch_to_data_axis) — not the packed families'
+            # capacity × ndev plan. Pin the program production compiles.
+            from video_features_tpu.parallel.mesh import (
+                round_batch_to_data_axis,
+            )
+            b = round_batch_to_data_axis(self.example_batch, mesh)
+        batch = self._abstract_batch((b, 96, 64, 1), np.float32, mesh)
+        return [ProgramSpec('step', self._step,
+                            (self._abstract_params(mesh), batch))]
+
     def _read_audio(self, video_path: str):
         """(waveform, sr, tmp_files_to_clean) for any supported input."""
         from video_features_tpu.io.audio import extract_wav_from_mp4, read_wav
@@ -134,8 +157,17 @@ class ExtractVGGish(BaseExtractor):
             with self.tracer.stage('audio_dsp'):
                 data, sr, tmp_files = self._read_audio(video_path)
                 examples = waveform_to_examples(data, sr)  # (N, 96, 64)
+            # The DSP above is float64 BY DESIGN (reference-parity host
+            # math); the device program is float32 BY CONTRACT
+            # (PROGRAMS.lock.json pins the batch dtype — the no-f64
+            # rule). Narrow HERE, explicitly: jax used to apply the same
+            # double→float cast silently at device_put (x64 disabled),
+            # which is exactly the invisible promotion seam the rule
+            # exists to keep pinned. Byte-identical to the implicit
+            # path — tests/test_programs.py holds the parity.
             with self.tracer.stage('model'):
-                feats = self._run_batched(examples[..., None])  # NHWC
+                feats = self._run_batched(
+                    examples.astype(np.float32)[..., None])  # NHWC
             if self.post_process:
                 feats = np.asarray(vggish_model.postprocess(
                     self._pca_eig, self._pca_means, feats)).astype(np.uint8)

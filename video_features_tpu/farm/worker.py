@@ -51,6 +51,8 @@ MAX_UNACKED_WINQ = 2
 def worker_main(widx: int, epoch: int, recipe, ring_name: str,
                 ring_bytes: int, task_q, out_q, free_q, ctrl_q) -> None:
     import numpy as np
+
+    from video_features_tpu.ops import host_transforms
     from multiprocessing import shared_memory
 
     from video_features_tpu.farm.ring import RingProducer
@@ -128,6 +130,20 @@ def worker_main(widx: int, epoch: int, recipe, ring_name: str,
                         break
                     dt = time.perf_counter() - t0
                     window = np.ascontiguousarray(window)
+                    if not host_transforms.frames_match_device_contract(
+                            window):
+                        # uint8-in/uint8-out contract
+                        # (ops/host_transforms.py): a float window here
+                        # means a transform leaked numpy default-dtype
+                        # math — ship NOTHING (the parent's in-process
+                        # replay would disagree byte-for-byte); the
+                        # 'err' contract fails just this video, loudly
+                        raise TypeError(
+                            f'recipe produced a {window.dtype} window '
+                            f'for {path} — farm windows must be uint8 '
+                            f'(host transforms never run float math; '
+                            f'see ops/host_transforms.py dtype '
+                            f'contract)')
                     drain_frees()
                     region = ring.alloc(window.nbytes, wait_free)
                     if region is None:

@@ -82,18 +82,18 @@ def _compile_snapshot() -> Dict[str, Dict[str, float]]:
 def xla_cost_analysis(jitted, *args, **kwargs) -> Optional[Dict[str, float]]:
     """Best-effort FLOPs / bytes-accessed for one compiled executable.
 
-    AOT-lowers ``jitted`` at the given abstract shapes and reads the
+    AOT-lowers ``jitted`` at the given abstract shapes — through the ONE
+    ``jitted.lower(...)`` seam shared with the vft-programs contract
+    checker (``analysis.programs.abstract_lowering``) — and reads the
     compiled module's ``cost_analysis()``. With the persistent
     compilation cache on (``enable_compilation_cache``) the second
     compile is a cache read, not a recompile. Returns None when the
     backend/step doesn't support it — cost analysis is an optimization
     report, never a requirement."""
     try:
-        import jax
-        shaped = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
-            if hasattr(x, 'shape') else x, (args, kwargs))
-        cost = jitted.lower(*shaped[0], **shaped[1]).compile().cost_analysis()
+        from video_features_tpu.analysis.programs import abstract_lowering
+        cost = abstract_lowering(jitted, *args,
+                                 **kwargs).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else None
         if not cost:
@@ -125,6 +125,7 @@ class RunManifest:
         self.farm: Dict[str, Any] = {}
         self.mesh: Dict[str, Any] = {}
         self.ingress: Dict[str, Any] = {}
+        self.programs_lock: Dict[str, Any] = {}
         self._compile0 = _compile_snapshot()
         _install_compile_listener()
 
@@ -194,6 +195,18 @@ class RunManifest:
         with self._lock:
             self.ingress.update({k: _jsonable(v) for k, v in info.items()})
 
+    def note_programs_lock(self, info: Dict[str, Any]) -> None:
+        """Record which PINNED programs this run's families map to:
+        ``{family: {mesh<n>: {program: stablehlo_sha256}}}`` from the
+        committed ``PROGRAMS.lock.json`` (``analysis/programs.py``) —
+        so a production trace names exactly which contract-checked
+        program ran, and a trace from BEFORE a re-pin is attributable
+        to the old program. ``{}`` when the lock is absent or the
+        family unpinned. Later notes merge over earlier ones."""
+        with self._lock:
+            self.programs_lock.update(
+                {k: _jsonable(v) for k, v in info.items()})
+
     def note_mesh(self, info: Dict[str, Any]) -> None:
         """Record the device mesh a mesh-sharded packed run executed on
         (``mesh_devices``, the (data, time) shape, per-device labels,
@@ -221,6 +234,7 @@ class RunManifest:
             farm = dict(self.farm)
             mesh = dict(self.mesh)
             ingress = dict(self.ingress)
+            programs_lock = dict(self.programs_lock)
         outcomes: Dict[str, int] = {}
         for v in videos.values():
             outcomes[v['outcome']] = outcomes.get(v['outcome'], 0) + 1
@@ -246,6 +260,10 @@ class RunManifest:
             # network front door (ingress/): per-tenant request/shed
             # view for runs driven through it, {} otherwise
             'ingress': ingress,
+            # program contract lock (analysis/programs.py): the pinned
+            # StableHLO hashes this run's families map to, {} when the
+            # lock is absent or the family unpinned
+            'programs_lock': programs_lock,
         }
 
     def write(self, path: str) -> str:

@@ -9,10 +9,28 @@ import sites are unchanged.
 
 Numerics: exact parity with the reference's PIL-based ``ResizeImproved``
 and torchvision's ``CenterCrop`` — see the per-function notes.
+
+Dtype contract: **uint8 in, uint8 out.** Frames stay integer until they
+are on the device; every float conversion (and its precision) belongs to
+the jitted step, where PROGRAMS.lock.json pins it (the no-f64 rule).
+A host transform drifting to numpy's default float64 — easy to do
+silently with ``/ 255.0``-style math — would make decode-farm workers
+and in-process decode disagree the moment jax's implicit downcast
+stopped hiding it; :func:`frames_match_device_contract` is the
+assertion both paths (and the parity tests) hold against.
 """
 from __future__ import annotations
 
 import numpy as np
+
+
+def frames_match_device_contract(frame: np.ndarray) -> bool:
+    """True iff ``frame`` honors the host-side dtype contract (uint8 —
+    the only dtype the packed H2D path ships for video frames). Farm
+    workers and the in-process windower both feed batches that must
+    agree byte-for-byte; a float-dtype frame here means a transform
+    leaked numpy default-dtype math."""
+    return frame.dtype == np.uint8
 
 
 def pil_edge_resize_geometry(h: int, w: int, size: int,

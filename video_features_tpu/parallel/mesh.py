@@ -102,18 +102,30 @@ def plan_device_batch(capacity: int, mesh: Mesh) -> int:
     return capacity * ndev
 
 
-def require_shardable(batch: int, mesh: Mesh) -> int:
-    """Validate that a GLOBAL batch splits evenly over the data axis,
-    raising a named error instead of letting ``device_put`` fail with an
-    XLA sharding/shape error. Returns the per-shard capacity."""
+def shard_error(batch: int, mesh: Mesh) -> Optional[str]:
+    """Why a GLOBAL batch of ``batch`` rows cannot shard over the mesh's
+    data axis, or None when it can. The non-raising form of
+    :func:`require_shardable` — the vft-programs shardability rule
+    (``analysis/programs.py``) turns the message into a finding instead
+    of an exception."""
     ndev = mesh.shape[DATA_AXIS]
     if batch % ndev != 0 or batch // ndev < 1:
-        raise ValueError(
+        return (
             f'packed batch {batch} cannot shard over {ndev} data-parallel '
             f'devices: the global batch must be a positive multiple of the '
             f'device count (capacity × ndev planning — see '
             f'plan_device_batch)')
-    return batch // ndev
+    return None
+
+
+def require_shardable(batch: int, mesh: Mesh) -> int:
+    """Validate that a GLOBAL batch splits evenly over the data axis,
+    raising a named error instead of letting ``device_put`` fail with an
+    XLA sharding/shape error. Returns the per-shard capacity."""
+    err = shard_error(batch, mesh)
+    if err is not None:
+        raise ValueError(err)
+    return batch // mesh.shape[DATA_AXIS]
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
