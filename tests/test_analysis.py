@@ -728,7 +728,9 @@ def test_analyzer_subprocess_never_imports_jax_and_is_fast():
 def test_knob_registry_is_behavior_preserving():
     """The derived exclusion sets must match the PRE-refactor
     hand-maintained lists exactly (fingerprint/pool-key parity tests
-    depend on membership; this pins the full sets)."""
+    depend on membership; this pins the full sets — new knobs extend it
+    intentionally, here: the vft-flight telemetry knobs, 'neither' like
+    the trace knobs they sit beside)."""
     from video_features_tpu.config import knob_exclude
     assert knob_exclude('fingerprint') == {
         'video_paths', 'file_with_video_paths', 'output_path', 'tmp_path',
@@ -737,14 +739,16 @@ def test_knob_registry_is_behavior_preserving():
         'pack_across_videos', 'pack_decode_ahead', 'decode_workers',
         'mesh_devices', 'decode_farm_ring_mb', 'inflight',
         'compilation_cache_dir', 'profile', 'profile_dir', 'show_pred',
-        'trace_out', 'trace_capacity', 'manifest_out', 'cache_enabled',
-        'cache_dir', 'cache_max_bytes', 'allow_random_weights',
-        'timeout_s', 'config'}
+        'trace_out', 'trace_capacity', 'manifest_out',
+        'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s',
+        'cache_enabled', 'cache_dir', 'cache_max_bytes',
+        'allow_random_weights', 'timeout_s', 'config'}
     assert knob_exclude('pool_key') == {
         'video_paths', 'file_with_video_paths', 'output_path', 'profile',
         'profile_dir', 'timeout_s', 'trace_out', 'trace_capacity',
         'manifest_out', 'inflight', 'decode_workers',
-        'decode_farm_ring_mb'}
+        'decode_farm_ring_mb',
+        'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s'}
 
 
 def test_deleting_a_knob_from_the_registry_breaks_both_consumers():

@@ -273,11 +273,16 @@ def test_farm_oversized_fallback_backpressures(tmp_path):
         seen += 1
         time.sleep(0.05)                      # much slower than decode
         for w in farm._workers:
-            try:                              # queued = unacked ≤ cap,
-                backlog = w.out_q.qsize()     # +1 for start/end markers
+            try:                              # queued = unacked ≤ cap
+                backlog = w.out_q.qsize()
             except NotImplementedError:       # macOS qsize — skip bound
                 backlog = 0
-            assert backlog <= MAX_UNACKED_WINQ + 1
+            # slack beyond the winq credit cap: the start/end markers
+            # plus at most two tiny clock-calibration replies (startup
+            # + the min-RTT refinement, which stops once tight) — all
+            # O(bytes) control messages, not window payloads, so the
+            # memory contract this test pins is untouched
+            assert backlog <= MAX_UNACKED_WINQ + 3
     assert seen == 12
     assert farm.stats()['queue_fallback'] == 12
 
@@ -308,6 +313,145 @@ def test_farm_worker_crash_fails_one_video_and_respawns(tmp_path):
     st = farm.stats()
     assert st['respawns'] >= 1
     assert st['videos_failed'] == 1
+
+
+def test_farm_worker_spans_land_under_worker_pid_calibrated(tmp_path):
+    """vft-flight cross-process span round-trip: decode spans are
+    MEASURED in the worker and shipped on the result channel; the
+    parent records them under the worker's own pid with the
+    clock-calibration offset applied, tagged with the task's trace
+    context — so the merged timeline shows true in-worker decode time,
+    not parent-side drain time."""
+    from tools.trace_view import validate_events
+
+    from video_features_tpu.farm import DecodeFarm
+    from video_features_tpu.obs.context import mint
+    from video_features_tpu.obs.spans import SpanRecorder
+    from video_features_tpu.utils.tracing import Tracer
+
+    paths = [tmp_path / 'sa.bin', tmp_path / 'sb.bin']
+    tasks = _tasks(paths)
+    ctx = mint()
+    for t in tasks:
+        t.trace = ctx.child()
+    rec = SpanRecorder(capacity=4096)
+    farm = DecodeFarm(SyntheticRecipe(n_windows=6), workers=2,
+                      ring_bytes=1 << 20,
+                      tracer=Tracer(enabled=True, recorder=rec))
+    worker_pids = []
+    got = {str(p): 0 for p in paths}
+    from video_features_tpu.parallel.packing import FLUSH, NUDGE
+    for item in farm.stream(iter(tasks), lambda t: True):
+        if not worker_pids:
+            worker_pids = [w.proc.pid for w in farm._workers
+                           if w.proc is not None]
+            # calibration sanity: perf_counter is process-shared on
+            # Linux, so the midpoint offset must be tiny — a huge value
+            # means the handshake mixed up its operands
+            assert all(abs(w.clock_offset) < 60.0
+                       for w in farm._workers)
+        if item is FLUSH or item is NUDGE:
+            continue
+        got[str(item[0].path)] += 1
+    events = rec.snapshot()
+    assert validate_events(events) == []
+    decode = [e for e in events
+              if e['ph'] == 'X' and e['name'] == 'decode']
+    # one in-worker span per shipped window, every one under a WORKER
+    # pid (never the parent's), per-video ordering intact
+    assert len(decode) == sum(got.values()) == 2 * 6
+    assert all(e['pid'] in worker_pids for e in decode)
+    assert all(e['pid'] != os.getpid() for e in decode)
+    for p in paths:
+        vid_spans = [e for e in decode
+                     if e['args']['video'] == str(p)]
+        assert len(vid_spans) == 6
+        # calibrated offsets: in-worker spans sit on the parent
+        # timeline (non-negative, ts-ordered per video)
+        ts = [e['ts'] for e in vid_spans]
+        assert ts == sorted(ts) and ts[0] >= 0
+        # trace context crossed the process boundary
+        assert all(e['args']['trace_id'] == ctx.trace_id
+                   for e in vid_spans)
+        assert all(e['args'].get('span_id') for e in vid_spans)
+        assert all(e['tid'] == e['args']['worker'] for e in vid_spans)
+
+
+def test_farm_clock_calibration_keeps_min_rtt_measurement():
+    """The offset error is bounded by half the exchange's round trip,
+    so only the tightest exchange ever seen may update the offset: the
+    startup handshake (round trip spans process SPAWN — its midpoint
+    would shift spans by ~spawn/2) only seeds it, and a tight in-decode
+    re-sync replaces it; later coarse replies never regress it."""
+    from video_features_tpu.farm import DecodeFarm
+    from video_features_tpu.farm.farm import _Worker
+    farm = DecodeFarm(SyntheticRecipe(), workers=1)   # never started
+    w = _Worker(0, 0)
+    t = time.perf_counter()
+    # startup-grade exchange: 1s round trip (spawn) → coarse seed
+    farm._handle(w, ('clock', 0, 0, t - 1.0, t - 0.2))
+    assert w.clock_rtt >= 1.0
+    assert abs(w.clock_offset + 0.3) < 0.05           # ≈ -(spawn)/2 bias
+    # tight in-decode refinement: ~2ms round trip → replaces the seed
+    t2 = time.perf_counter()
+    farm._handle(w, ('clock', 0, 0, t2 - 0.002, t2 - 0.001))
+    assert w.clock_rtt < 0.05
+    tight = w.clock_offset
+    assert abs(tight) < 0.05          # shared clock ⇒ true offset ≈ 0
+    # a later COARSE reply must never regress the calibration
+    t3 = time.perf_counter()
+    farm._handle(w, ('clock', 0, 0, t3 - 2.0, t3 - 1.0))
+    assert w.clock_offset == tight and w.clock_rtt < 0.05
+
+
+def test_farm_pending_cb_mirrors_backlog_and_zeroes_on_shutdown(tmp_path):
+    """The stall-watchdog feed: the farm mirrors each worker's
+    assignment backlog through pending_cb, and shutdown zeroes the rows
+    so a retired farm can never read as a stall."""
+    from video_features_tpu.farm import DecodeFarm
+    calls = []
+    paths = [tmp_path / f'pb{i}.bin' for i in range(3)]
+    tasks = _tasks(paths)
+    farm = DecodeFarm(SyntheticRecipe(n_windows=6), workers=2,
+                      ring_bytes=1 << 20,
+                      pending_cb=lambda idx, n: calls.append((idx, n)))
+    _drain_farm(farm, tasks)
+    assert calls, 'pending_cb never fired'
+    last = {}
+    for idx, n in calls:
+        last[idx] = n
+    assert set(last) == {0, 1}
+    assert all(n == 0 for n in last.values())   # zeroed at shutdown
+
+
+def test_farm_sigkill_loses_at_most_inflight_spans(tmp_path):
+    """A SIGKILLed worker loses at most its in-flight video's unsent
+    spans: every window that reached the parent has its span, siblings
+    keep a full per-window span ledger, and the victim's spans stop at
+    what it shipped before dying."""
+    from video_features_tpu.farm import DecodeFarm
+    from video_features_tpu.obs.spans import SpanRecorder
+    from video_features_tpu.utils.tracing import Tracer
+
+    paths = [tmp_path / 'ka.bin', tmp_path / 'CRASH.bin',
+             tmp_path / 'kb.bin']
+    tasks = _tasks(paths)
+    rec = SpanRecorder(capacity=4096)
+    farm = DecodeFarm(CrashRecipe(n_windows=8), workers=2,
+                      ring_bytes=1 << 20,
+                      tracer=Tracer(enabled=True, recorder=rec))
+    got = _drain_farm(farm, tasks)
+    decode = [e for e in rec.snapshot()
+              if e['ph'] == 'X' and e['name'] == 'decode']
+    by_video = {}
+    for e in decode:
+        by_video.setdefault(e['args']['video'], []).append(e)
+    for p in (paths[0], paths[2]):
+        assert len(by_video[str(p)]) == 8 == len(got[str(p)])
+    victim_spans = by_video.get(str(paths[1]), [])
+    # exactly the windows that escaped before the SIGKILL (one), no
+    # phantom spans for windows that never reached the parent
+    assert len(victim_spans) == len(got[str(paths[1])]) <= 1
 
 
 def test_farm_unparks_duplicate_while_stream_stays_open(tmp_path):
@@ -402,44 +546,47 @@ def test_packed_farm_byte_identity_framewise(farm_worklist, tmp_path):
     """resnet (FramewiseRecipe: per-frame edge-resize + crop in the
     worker) — packed outputs at decode_workers=2 are byte-identical to
     decode_workers=1, and the farm actually ran."""
-    ex1 = create_extractor(_resnet_args(
+    # ONE extractor, both decode paths via the run-level decode_workers
+    # override with per-task out_roots (the serve warm-reuse pattern) —
+    # halves this tier-1 test's transplant+compile cost
+    from video_features_tpu.parallel.packing import VideoTask
+    ex = create_extractor(_resnet_args(
         farm_worklist, tmp_path / 'w1', tmp_path / 't1',
         pack_across_videos=True, decode_workers=1))
-    ex1.extract_packed(farm_worklist)
-    assert ex1._farm is None                   # 1 ≡ in-process path
+    ex.extract_packed(farm_worklist)
+    assert ex._farm is None                    # 1 ≡ in-process path
 
-    ex2 = create_extractor(_resnet_args(
-        farm_worklist, tmp_path / 'w2', tmp_path / 't2',
-        pack_across_videos=True, decode_workers=2))
-    ex2.extract_packed(farm_worklist)
-    assert ex2._farm is not None
-    st = ex2._farm.stats()
+    farm_root = str(tmp_path / 'w2')
+    ex.extract_packed([VideoTask(p, out_root=farm_root)
+                       for p in farm_worklist], decode_workers=2)
+    assert ex._farm is not None
+    st = ex._farm.stats()
     assert st['videos_assigned'] == len(farm_worklist)
     assert st['windows'] > 0 and st['videos_failed'] == 0
 
-    _assert_outputs_identical(ex1.output_path, ex2.output_path,
-                              farm_worklist)
+    _assert_outputs_identical(ex.output_path, farm_root, farm_worklist)
 
 
 def test_packed_farm_byte_identity_stacks(farm_worklist, tmp_path):
     """r21d (StackRecipe: raw-frame stack windows off the worker's
     decoder) — byte-identical at any worker count."""
-    def run(tag, workers):
-        args = load_config('r21d', overrides=dict(
-            video_paths=farm_worklist, device='cpu',
-            model_name='r2plus1d_18_16_kinetics', stack_size=8,
-            step_size=8, batch_size=2, allow_random_weights=True,
-            on_extraction='save_numpy',
-            output_path=str(tmp_path / tag / 'out'),
-            tmp_path=str(tmp_path / tag / 'tmp'),
-            pack_across_videos=True, decode_workers=workers))
-        ex = create_extractor(args)
-        ex.extract_packed(farm_worklist)
-        return ex
-
-    ex1 = run('s1', 1)
-    ex2 = run('s2', 2)
-    _assert_outputs_identical(ex1.output_path, ex2.output_path,
+    # ONE extractor, in-process then farm decode (run-level override +
+    # per-task out_roots) — same parity contract, half the build cost
+    from video_features_tpu.parallel.packing import VideoTask
+    args = load_config('r21d', overrides=dict(
+        video_paths=farm_worklist, device='cpu',
+        model_name='r2plus1d_18_16_kinetics', stack_size=8,
+        step_size=8, batch_size=2, allow_random_weights=True,
+        on_extraction='save_numpy',
+        output_path=str(tmp_path / 's1' / 'out'),
+        tmp_path=str(tmp_path / 's1' / 'tmp'),
+        pack_across_videos=True, decode_workers=1))
+    ex = create_extractor(args)
+    ex.extract_packed(farm_worklist)
+    farm_root = str(tmp_path / 's2' / 'out')
+    ex.extract_packed([VideoTask(p, out_root=farm_root)
+                       for p in farm_worklist], decode_workers=2)
+    _assert_outputs_identical(ex.output_path, farm_root,
                               farm_worklist, keys=('r21d',))
 
 
@@ -448,29 +595,32 @@ def test_packed_farm_crash_spares_siblings_end_to_end(farm_worklist,
     """The whole stack under a worker kill: a crashing recipe injected
     into a real resnet packed run fails only the marked video — the
     siblings' saved features are byte-identical to a clean farm run."""
-    clean = create_extractor(_resnet_args(
+    # ONE extractor: clean farm pass, then the crash pass through the
+    # same warm build (per-task out_roots keep the trees apart) — half
+    # the transplant+compile cost, same end-to-end contract
+    from video_features_tpu.parallel.packing import VideoTask
+    ex = create_extractor(_resnet_args(
         farm_worklist, tmp_path / 'clean', tmp_path / 'tc',
         pack_across_videos=True, decode_workers=2))
-    clean.extract_packed(farm_worklist)
+    ex.extract_packed(farm_worklist)
+    clean_root = str(ex.output_path)
 
     crash_clip = str(Path(farm_worklist[0]).parent / 'CRASH_e2e.mp4')
     if not os.path.exists(crash_clip):
         _write_clip(crash_clip, 8, seed=99)
     worklist = farm_worklist[:1] + [crash_clip] + farm_worklist[1:]
 
-    ex = create_extractor(_resnet_args(
-        worklist, tmp_path / 'hurt', tmp_path / 'th',
-        pack_across_videos=True, decode_workers=2))
+    hurt_root = str(tmp_path / 'hurt')
     real = ex.farm_recipe()
     ex.farm_recipe = lambda: CrashingRealRecipe(real)
-    ex.extract_packed(worklist)
+    ex.extract_packed([VideoTask(str(p), out_root=hurt_root)
+                       for p in worklist])
 
     assert ex._farm.stats()['respawns'] >= 1
     # the victim has no outputs; every sibling is byte-identical
-    assert not Path(make_path(str(ex.output_path), crash_clip, 'resnet',
+    assert not Path(make_path(hurt_root, crash_clip, 'resnet',
                               '.npy')).exists()
-    _assert_outputs_identical(clean.output_path, ex.output_path,
-                              farm_worklist)
+    _assert_outputs_identical(clean_root, hurt_root, farm_worklist)
 
 
 def test_packed_farm_cache_dedupe_decodes_shared_content_once(

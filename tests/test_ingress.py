@@ -350,11 +350,12 @@ def gatewayed(tmp_path_factory):
     server.drain(wait=True, grace_s=120)
 
 
-def _api(gateway, method, path, body=None, key=API_KEY, timeout=180):
+def _api(gateway, method, path, body=None, key=API_KEY, timeout=180,
+         headers=None):
     import http.client
     c = http.client.HTTPConnection('127.0.0.1', gateway.port,
                                    timeout=timeout)
-    headers = {}
+    headers = dict(headers or {})
     if key:
         headers['Authorization'] = f'Bearer {key}'
     c.request(method, path,
@@ -431,6 +432,52 @@ def test_segment_query_parity_ingress_vs_loopback(gatewayed, ingress_clips):
     # range only, not the whole video
     assert ts.min() >= 200.0 - 1e-6 and ts.max() < 600.0
     assert 0 < len(ts) < 16
+
+
+def test_trace_route_tenant_scoped_and_traceparent_adopted(gatewayed,
+                                                           ingress_clips):
+    """vft-flight over the front door: the caller's W3C traceparent is
+    adopted end-to-end (echoed as trace_id), GET /v1/requests/<id>/trace
+    answers the OWNING tenant, a FOREIGN tenant gets an explicit 403,
+    and an unknown id stays 404."""
+    server, gateway, root = gatewayed
+    clip = ingress_clips[1]
+    caller_trace = 'feedc0de' * 4
+    st, doc = _api(gateway, 'POST', '/v1/extract', {
+        'feature_type': 'resnet', 'video_paths': [clip],
+        'overrides': {'output_path': str(root / 'trace_out_dir')}},
+        headers={'traceparent':
+                 f'00-{caller_trace}-00f067aa0ba902b7-01'})
+    assert st == 200, doc
+    assert doc['trace_id'] == caller_trace, doc
+    rid = doc['request_id']
+    assert _wait_done(gateway, rid)['state'] == 'done'
+
+    # owner reads its trace (this server runs without trace_out, so the
+    # assembled event list is empty — the scoping contract is the point)
+    st, tr = _api(gateway, 'GET', f'/v1/requests/{rid}/trace')
+    assert st == 200, tr
+    assert tr['trace_id'] == caller_trace and tr['tenant'] == 'acme'
+    assert tr['request_id'] == rid and isinstance(tr['events'], list)
+
+    # a FOREIGN tenant gets an explicit 403 (not status's 404 ambiguity)
+    st, err = _api(gateway, 'GET', f'/v1/requests/{rid}/trace',
+                   key=BATCH_KEY)
+    assert st == 403 and err['error'] == 'forbidden', err
+    # ...while the same foreign tenant's STATUS read stays a 404
+    st, err = _api(gateway, 'GET', f'/v1/requests/{rid}', key=BATCH_KEY)
+    assert st == 404
+    # unknown id: 404 for everyone
+    st, err = _api(gateway, 'GET', '/v1/requests/r999999/trace')
+    assert st == 404
+    # a malformed traceparent degrades to a minted trace, never a reject
+    st, doc2 = _api(gateway, 'POST', '/v1/extract', {
+        'feature_type': 'resnet', 'video_paths': [clip],
+        'overrides': {'output_path': str(root / 'trace_out_dir2')}},
+        headers={'traceparent': 'garbage'})
+    assert st == 200 and len(doc2['trace_id']) == 32
+    assert doc2['trace_id'] != caller_trace
+    _wait_done(gateway, doc2['request_id'])
 
 
 def test_segment_decode_is_tracer_bounded_to_range(ingress_clips,

@@ -343,6 +343,14 @@ def test_packed_cli_trace_out_covers_every_video(obs_worklist, tmp_path,
     # no time lost or double-counted: every dispatched batch has exactly
     # one model span and one d2h span
     assert len(by_name.get('d2h', [])) == len(by_name.get('model', []))
+    # vft-flight: a packed CLI run is ONE request — every trace-tagged
+    # span shares the run's single trace_id (per-video child span_ids
+    # under it), so --trace-id filtering works on CLI traces too
+    run_tids = {e['args']['trace_id'] for e in spans
+                if 'args' in e and 'trace_id' in e['args']}
+    assert len(run_tids) == 1, run_tids
+    assert all('span_id' in e['args'] for e in spans
+               if 'args' in e and 'trace_id' in e['args'])
     # the validator tool accepts the real artifact (tier-1 exercise)
     assert trace_view_main([str(trace), '--quiet']) == 0
     capsys.readouterr()
@@ -424,7 +432,11 @@ def test_serve_drain_exports_merged_trace(obs_worklist, tmp_path):
     """A server-wide trace_out base override stitches EVERY worker's
     recorder into one Chrome trace at drain — spans from a real request
     (decode/pack/model/save, request ids) survive the merge and the
-    export validates."""
+    export validates. vft-flight acceptance rides the same request: the
+    caller's traceparent is adopted, the live ``trace`` command
+    assembles admission/pack/model/d2h/save spans sharing that one
+    trace_id (farm decode spans are exercised in tests/test_farm.py),
+    and the ids survive into the merged export."""
     from video_features_tpu.serve.client import ServeClient
     from video_features_tpu.serve.server import ExtractionServer
 
@@ -436,11 +448,38 @@ def test_serve_drain_exports_merged_trace(obs_worklist, tmp_path):
         'output_path': str(tmp_path / 'serve_out'),
         'trace_out': str(trace),
     }, queue_depth=8, pool_size=2).start()
+    caller_trace = 'c0ffee5e1f00d5c0ffee5e1f00d5c0ff'
     try:
         client = ServeClient(port=server.port)
-        rid = client.submit('resnet', [obs_worklist[0]])
+        rid = client.submit(
+            'resnet', [obs_worklist[0]],
+            traceparent=f'00-{caller_trace}-00f067aa0ba902b7-01')
         st = client.wait(rid, timeout_s=300)
         assert st['state'] == 'done', st
+        # the caller's trace id was ADOPTED, not re-minted
+        assert st['trace_id'] == caller_trace, st
+        # the live /trace assembly: one request's spans, one trace_id,
+        # covering admission + pack + model + d2h + save
+        tr = client.trace(rid)
+        assert tr['trace_id'] == caller_trace
+        names = {e['name'] for e in tr['events']}
+        for stage in ('admission', 'pack', 'model', 'd2h', 'save'):
+            assert stage in names, (stage, sorted(names))
+        for e in tr['events']:
+            args = e.get('args') or {}
+            assert (args.get('trace_id') == caller_trace
+                    or caller_trace in (args.get('trace_ids') or ())
+                    or args.get('request_id') == rid), e
+        # ts-sorted (the route contract)
+        ts = [e['ts'] for e in tr['events']]
+        assert ts == sorted(ts)
+        # ANOTHER request must not leak into this one's trace
+        rid2 = client.submit('resnet', [obs_worklist[1]])
+        client.wait(rid2, timeout_s=300)
+        tr2 = client.trace(rid2)
+        assert tr2['trace_id'] != caller_trace
+        assert all((e.get('args') or {}).get('video') != obs_worklist[0]
+                   for e in tr2['events'])
     finally:
         server.drain(wait=True, grace_s=120)
 
@@ -453,6 +492,8 @@ def test_serve_drain_exports_merged_trace(obs_worklist, tmp_path):
     assert any(e['name'] == 'save'
                and e['args'].get('video') == obs_worklist[0]
                and e['args'].get('request_id') == rid for e in spans)
+    # the trace ids survive the merged export too
+    assert any(e['args'].get('trace_id') == caller_trace for e in spans)
 
 
 # -- bench_diff --------------------------------------------------------------
@@ -507,7 +548,13 @@ METRICS_DOC_KEYS = {'uptime_s', 'queue', 'warm_pool', 'cache', 'farm',
                     'inflight_batches',
                     # network front door (ingress/): per-tenant view,
                     # {'enabled': False, ...} on loopback-only servers
-                    'ingress'}
+                    'ingress',
+                    # vft-flight: structured-event counts (the
+                    # vft_events_total mirror's source), span-ring view
+                    # (recorders + events_dropped), and the stall
+                    # watchdog's progress ledger ({'enabled': False}
+                    # without watchdog_stall_s)
+                    'events', 'trace', 'watchdog'}
 TRACE_EVENT_KEYS = {'name', 'ph', 'ts', 'dur', 'pid', 'tid', 'args', 's'}
 MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
                  'config', 'fingerprints', 'videos', 'outcomes', 'stages',
@@ -586,3 +633,447 @@ def test_schema_contract_key_sets(tmp_path):
     from video_features_tpu.obs.manifest import RunManifest
     man = RunManifest({'feature_type': 'resnet'}).document()
     assert set(man) == MANIFEST_KEYS
+
+
+# -- vft-flight: trace context ------------------------------------------------
+
+
+def test_trace_context_mint_parse_roundtrip():
+    from video_features_tpu.obs.context import (
+        TraceContext, accept_traceparent, mint, parse_traceparent,
+    )
+    ctx = mint()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    # wire form round-trips: same trace, NEW span per hop
+    hop = parse_traceparent(ctx.traceparent())
+    assert hop.trace_id == ctx.trace_id
+    assert hop.span_id != ctx.span_id
+    # children stay under the parent's trace
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+    assert set(ctx.attrs()) == {'trace_id', 'span_id'}
+    # malformed / absent / all-zero headers degrade to None (and
+    # accept_traceparent to a fresh mint), never to garbage ids
+    for bad in (None, '', 'not-a-traceparent',
+                '00-' + '0' * 32 + '-00f067aa0ba902b7-01',
+                '00-' + 'a' * 32 + '-' + '0' * 16 + '-01',
+                'ff-' + 'a' * 32 + '-00f067aa0ba902b7-01',
+                '00-a' * 20):
+        assert parse_traceparent(bad) is None, bad
+        assert isinstance(accept_traceparent(bad), TraceContext)
+    # uppercase hex normalizes (the W3C header is case-insensitive)
+    up = parse_traceparent('00-' + 'A' * 32 + '-00F067AA0BA902B7-01')
+    assert up is not None and up.trace_id == 'a' * 32
+
+
+def test_trace_attrs_helper_tolerates_legacy_tasks():
+    from video_features_tpu.obs.context import mint, trace_attrs
+    from video_features_tpu.parallel.packing import VideoTask
+    assert trace_attrs(VideoTask('a.mp4')) == {}
+    assert trace_attrs(object()) == {}
+    ctx = mint()
+    t = VideoTask('a.mp4', trace=ctx)
+    assert trace_attrs(t) == ctx.attrs()
+
+
+# -- vft-flight: spans bugfixes (bytes rendering, bounded snapshot) ----------
+
+
+def test_jsonable_renders_bytes_ascii_safely_with_cap():
+    from video_features_tpu.obs.spans import _jsonable
+    assert _jsonable(b'hello') == 'hello'
+    assert "b'" not in _jsonable(b'hello')        # the old str() bug
+    # non-ASCII bytes escape instead of raising (ASCII-safe contract)
+    out = _jsonable(b'\xff\x00ok')
+    assert isinstance(out, str) and 'ok' in out
+    out.encode('ascii')                            # must be pure ASCII
+    # length cap: a stray frame buffer must not balloon the export
+    big = _jsonable(b'x' * 10_000)
+    assert len(big) < 1_000 and '(+' in big
+    json.dumps({'v': _jsonable(b'\xff' * 300)})    # always JSON-safe
+
+
+def test_snapshot_limit_bounds_events():
+    rec = SpanRecorder(capacity=1000)
+    for i in range(100):
+        rec.span(f's{i}', float(i), float(i) + 0.5)
+    full = [e for e in rec.snapshot() if e['ph'] == 'X']
+    assert len(full) == 100
+    tail = [e for e in rec.snapshot(limit=10) if e['ph'] == 'X']
+    assert len(tail) == 10
+    # MOST RECENT events, still ts-sorted, same origin semantics
+    assert [e['name'] for e in tail] == [f's{i}' for i in range(90, 100)]
+    assert validate_events(rec.snapshot(limit=10)) == []
+    # limit >= len is the full snapshot
+    assert len([e for e in rec.snapshot(limit=500)
+                if e['ph'] == 'X']) == 100
+
+
+def test_span_pid_tid_override_for_cross_process_spans():
+    """Farm decode spans are recorded by the parent but MEASURED in the
+    worker: pid/tid overrides put them in the worker's own lane."""
+    rec = SpanRecorder(capacity=16)
+    rec.span('decode', 1.0, 1.5, pid=4242, tid=7, video='v.mp4')
+    rec.span('local', 2.0, 2.5)
+    import os as _os
+    by_name = {e['name']: e for e in rec.snapshot() if e['ph'] == 'X'}
+    assert by_name['decode']['pid'] == 4242
+    assert by_name['decode']['tid'] == 7
+    assert by_name['local']['pid'] == _os.getpid()
+    assert validate_events(rec.snapshot()) == []
+
+
+# -- vft-flight: event counters + tail ---------------------------------------
+
+
+def test_event_counts_and_tail_feed_metrics_and_blackbox(caplog):
+    from video_features_tpu.obs.events import (
+        event, event_counts, events_tail,
+    )
+    before = event_counts().get(('WARNING', 'testsub'), 0)
+    with caplog.at_level(logging.WARNING, logger='video_features_tpu'):
+        event(logging.WARNING, 'something odd', subsystem='testsub',
+              video='v.mp4', request_id='r1')
+    counts = event_counts()
+    assert counts[('WARNING', 'testsub')] == before + 1
+    tail = events_tail()
+    rec = next(r for r in reversed(tail)
+               if r.get('subsystem') == 'testsub')
+    assert rec['level'] == 'WARNING' and rec['msg'] == 'something odd'
+    assert rec['fields'] == {'video': 'v.mp4', 'request_id': 'r1'}
+    # exc_info captures the traceback text for the black box
+    with caplog.at_level(logging.WARNING, logger='video_features_tpu'):
+        try:
+            raise RuntimeError('boom for tail')
+        except RuntimeError:
+            event(logging.ERROR, 'it died', subsystem='testsub',
+                  exc_info=True)
+    rec = events_tail()[-1]
+    assert 'boom for tail' in rec.get('exc', '')
+
+
+def test_prometheus_mirrors_events_and_trace_dropped():
+    """vft_events_total{level,subsystem} and
+    vft_trace_events_dropped_total are COUNTERS mirrored by delta —
+    repeated renders never double-count, and a recorder aging out of
+    the bounded deque (sum dips) never decrements."""
+    import logging as _logging
+
+    from video_features_tpu.obs.events import event
+    from video_features_tpu.obs.metrics import MetricsRegistry
+    from video_features_tpu.serve import metrics as metrics_mod
+    event(_logging.WARNING, 'mirror me', subsystem='mirrorsub')
+    reg = MetricsRegistry()
+    stats = metrics_mod.RequestStats(registry=reg)
+
+    def render(dropped):
+        doc = metrics_mod.build_metrics(
+            started_at=0.0, queue_depth=0, queue_capacity=1,
+            draining=False, pool_stats={}, request_stats=stats,
+            stage_reports={},
+            trace_stats={'recorders': 2, 'events_dropped': dropped})
+        assert set(doc['trace']) == {'recorders', 'events_dropped'}
+        assert doc['events']['total'] >= 1
+        return metrics_mod.prometheus_text(doc, reg)
+
+    text = render(7)
+    assert_valid_prometheus(text)
+    assert ('vft_events_total{level="WARNING",subsystem="mirrorsub"}'
+            in text)
+    assert 'vft_trace_events_dropped_total 7' in text
+    # stable under re-render; a DIP (recorder eviction) never decrements
+    assert 'vft_trace_events_dropped_total 7' in render(7)
+    assert 'vft_trace_events_dropped_total 7' in render(3)
+    assert 'vft_trace_events_dropped_total 9' in render(9)
+    assert 'vft_watchdog_enabled 0' in text
+
+
+# -- vft-flight: stall watchdog ----------------------------------------------
+
+
+def _fake_clock(start=1000.0):
+    state = {'t': start}
+
+    def clock():
+        return state['t']
+
+    return clock, state
+
+
+def test_watchdog_fires_on_stall_quiet_on_empty_queue():
+    from video_features_tpu.obs.metrics import MetricsRegistry
+    from video_features_tpu.obs.watchdog import StallWatchdog
+    clock, state = _fake_clock()
+    stalls = []
+    reg = MetricsRegistry()
+    wd = StallWatchdog(5.0, on_stall=stalls.append, registry=reg,
+                       clock=clock)
+    # idle-but-EMPTY: no pending work → silence forever
+    wd.advance('w0', 'model')
+    state['t'] += 1000
+    assert wd.check() == []
+    # pending work + advances → quiet
+    wd.set_pending('w0', 3)
+    wd.advance('w0', 'decode')
+    state['t'] += 4.0
+    wd.advance('w0', 'model')
+    state['t'] += 4.0
+    assert wd.check() == []
+    # pending work + NO advance past the deadline → one trip, attributed
+    # to the last stage that advanced
+    state['t'] += 6.0
+    fired = wd.check()
+    assert len(fired) == 1 and fired[0]['worker'] == 'w0'
+    assert fired[0]['stage'] == 'model' and fired[0]['pending'] == 3
+    assert stalls == fired
+    # a tripped worker does NOT re-trip until it advances again
+    state['t'] += 100.0
+    assert wd.check() == []
+    wd.advance('w0', 'd2h')
+    state['t'] += 6.0
+    assert len(wd.check()) == 1
+    assert wd.stalls_total == 2
+    # the counter family carries the stage label
+    text = reg.render()
+    assert 'vft_watchdog_stalls_total{stage="model"} 1' in text
+    assert 'vft_watchdog_stalls_total{stage="d2h"} 1' in text
+    snap = wd.snapshot()
+    assert snap['enabled'] and snap['stalls_total'] == 2
+    assert snap['workers']['w0']['pending'] == 3
+
+
+def test_watchdog_new_work_resets_clock_and_never_started_stage():
+    from video_features_tpu.obs.watchdog import (
+        STAGE_NOT_STARTED, StallWatchdog,
+    )
+    clock, state = _fake_clock()
+    wd = StallWatchdog(5.0, clock=clock)
+    wd.set_pending('w1', 1)
+    state['t'] += 3.0
+    wd.set_pending('w1', 0)          # drained before the deadline
+    state['t'] += 100.0
+    assert wd.check() == []          # long-idle, empty: quiet
+    wd.set_pending('w1', 2)          # NEW work: full stall_s restarts
+    state['t'] += 4.0
+    assert wd.check() == []
+    state['t'] += 2.0
+    fired = wd.check()
+    # queued work that never started attributes to 'admission'
+    assert len(fired) == 1 and fired[0]['stage'] == STAGE_NOT_STARTED
+    wd.forget('w1')
+    assert wd.snapshot()['workers'] == {}
+
+
+def test_watchdog_rides_tracer_progress_hook():
+    """The ledger feeds off the SAME instrumentation sites as the stage
+    table: a Tracer with a progress hook advances the ledger on every
+    add/stage, with farm-worker attribution via the worker attr."""
+    from video_features_tpu.obs.watchdog import StallWatchdog
+    clock, state = _fake_clock()
+    wd = StallWatchdog(5.0, clock=clock)
+    t = Tracer(enabled=True)
+    t.progress = lambda stage, worker=None: (
+        wd.advance('lbl', stage),
+        wd.advance(f'lbl/farm-w{worker}', stage)
+        if worker is not None else None)
+    with t.stage('model'):
+        pass
+    t.add('decode', 0.1, worker=3)
+    snap = wd.snapshot()['workers']
+    assert snap['lbl']['stage'] == 'decode'
+    assert snap['lbl/farm-w3']['stage'] == 'decode'
+
+
+# -- vft-flight: black box ---------------------------------------------------
+
+
+def _make_blackbox(tmp_path, **kw):
+    from video_features_tpu.obs.blackbox import BlackBox
+    rec = SpanRecorder(capacity=64)
+    rec.span('model', 1.0, 2.0, video='v.mp4')
+    kw.setdefault('recorders', lambda: [rec])
+    kw.setdefault('min_interval_s', 0.0)
+    return BlackBox(str(tmp_path / 'postmortem'), **kw), rec
+
+
+def test_blackbox_bundle_layout_and_validation(tmp_path):
+    from video_features_tpu.obs.blackbox import validate_bundle
+    from video_features_tpu.obs.events import event
+    event(logging.WARNING, 'pre-crash breadcrumb', subsystem='obs')
+    bb, _ = _make_blackbox(
+        tmp_path,
+        metrics_fn=lambda: {'queue': {'depth': 1}},
+        prom_fn=lambda: 'vft_x 1\n',
+        manifest_fn=lambda: {'schema': 'frag', 'videos': {}})
+    bundle = bb.dump('worker_crash', label='resnet/resnet18')
+    assert bundle is not None
+    assert validate_bundle(bundle) == []
+    meta = json.loads((Path(bundle) / 'meta.json').read_text())
+    assert meta['reason'] == 'worker_crash'
+    assert meta['extra']['label'] == 'resnet/resnet18'
+    assert meta['sections'] == {'spans': True, 'events': True,
+                                'metrics': True, 'manifest': True}
+    spans_doc = json.loads((Path(bundle) / 'spans.json').read_text())
+    assert validate_events(spans_doc['traceEvents']) == []
+    assert any(e.get('name') == 'model'
+               for e in spans_doc['traceEvents'])
+    lines = (Path(bundle) / 'events.jsonl').read_text().splitlines()
+    assert any('pre-crash breadcrumb' in ln for ln in lines)
+    assert json.loads((Path(bundle) / 'metrics.json').read_text()
+                      )['queue']['depth'] == 1
+    assert (Path(bundle) / 'metrics.prom').read_text() == 'vft_x 1\n'
+    # broken collectors degrade to missing sections, never to a raise
+    bb2, _ = _make_blackbox(
+        tmp_path / 'b2',
+        metrics_fn=lambda: (_ for _ in ()).throw(RuntimeError('wedged')))
+    bundle2 = bb2.dump('watchdog_stall')
+    assert bundle2 is not None and validate_bundle(bundle2) == []
+    meta2 = json.loads((Path(bundle2) / 'meta.json').read_text())
+    assert meta2['sections']['metrics'] is False
+
+
+def test_blackbox_gc_keeps_newest_under_cap_and_rate_limits(tmp_path):
+    bb, rec = _make_blackbox(tmp_path)
+    # every bundle carries the same ~payload; cap to roughly 2 bundles
+    first = bb.dump('r0')
+    size = sum(f.stat().st_size
+               for f in Path(first).rglob('*') if f.is_file())
+    bb.max_bytes = int(size * 2.5)
+    for i in range(1, 6):
+        assert bb.dump(f'r{i}') is not None
+    bundles = sorted(p.name for p in (tmp_path / 'postmortem').iterdir())
+    total = sum(f.stat().st_size
+                for f in (tmp_path / 'postmortem').rglob('*')
+                if f.is_file())
+    assert total <= bb.max_bytes
+    assert any(b.endswith('-r5') for b in bundles)   # newest survives
+    assert not any(b.endswith('-r0') for b in bundles)  # oldest GC'd
+    # rate limit: back-to-back dumps collapse (r5 just fired)
+    bb.min_interval_s = 60.0
+    assert bb.dump('r6') is None
+    assert bb.suppressed == 1
+    bb._last_dump_t = 0.0            # interval elapsed → dumps resume
+    assert bb.dump('r7') is not None
+
+
+def test_serve_worker_crash_dumps_blackbox(tmp_path):
+    """An induced serve-worker crash walks the REAL crash path: the
+    entry retires, and a post-mortem bundle appears (after the recovery,
+    never instead of it)."""
+    from video_features_tpu.obs.blackbox import validate_bundle
+    from video_features_tpu.serve.server import ExtractionServer, _Worker
+    from video_features_tpu.utils.tracing import NULL_TRACER
+
+    pm = tmp_path / 'postmortem'
+    server = ExtractionServer(base_overrides={
+        'postmortem_dir': str(pm),
+        'watchdog_stall_s': 3600.0,      # armed, but must stay quiet
+    })
+    assert server.blackbox is not None and server.watchdog is not None
+    try:
+        class BoomEx:
+            trace_out = None
+            tracer = NULL_TRACER
+
+            def extract_packed(self, feed, **kw):
+                raise RuntimeError('scheduler-level boom')
+
+            def finish_obs(self, export_trace=True):
+                pass
+
+        w = _Worker(server, key=('boom',), label='boom', extractor=BoomEx(),
+                    idle_flush_s=0.01)
+        w.start()
+        w.thread.join(30)
+        assert not w.thread.is_alive() and w.crashed
+        bundles = list(pm.iterdir())
+        assert len(bundles) == 1
+        assert validate_bundle(str(bundles[0])) == []
+        meta = json.loads((bundles[0] / 'meta.json').read_text())
+        assert meta['reason'] == 'serve_worker_crash'
+        assert meta['extra']['label'] == 'boom'
+        # the armed-but-quiet watchdog ledger rides along in the bundle
+        assert meta['extra']['watchdog']['enabled'] is True
+        # the metrics document names the watchdog + events + trace view
+        doc = server.metrics()
+        assert doc['watchdog']['enabled'] is True
+        assert doc['watchdog']['stalls_total'] == 0
+        prom = server._prometheus(doc)
+        assert 'vft_watchdog_enabled 1' in prom
+        assert 'vft_events_total' in prom
+    finally:
+        server.drain(wait=True, grace_s=30)
+
+
+# -- vft-flight: trace_view upgrades -----------------------------------------
+
+
+def _flight_trace(tmp_path):
+    """A two-trace document: trace A's chain (ingress→model overlapped
+    by d2h), trace B a lone span, plus shared-batch trace_ids."""
+    tid_a, tid_b = 'a' * 32, 'b' * 32
+    events = [
+        {'name': 'ingress', 'ph': 'X', 'ts': 0.0, 'dur': 100.0,
+         'pid': 1, 'tid': 1,
+         'args': {'trace_id': tid_a, 'span_id': '1' * 16}},
+        {'name': 'model', 'ph': 'X', 'ts': 120.0, 'dur': 200.0,
+         'pid': 1, 'tid': 1,
+         'args': {'trace_ids': [tid_a, tid_b], 'videos': ['v']}},
+        {'name': 'd2h', 'ph': 'X', 'ts': 200.0, 'dur': 60.0,
+         'pid': 1, 'tid': 2,
+         'args': {'trace_ids': [tid_a]}},      # overlaps model
+        {'name': 'save', 'ph': 'X', 'ts': 340.0, 'dur': 50.0,
+         'pid': 1, 'tid': 1,
+         'args': {'trace_id': tid_a, 'span_id': '2' * 16}},
+        {'name': 'other', 'ph': 'X', 'ts': 400.0, 'dur': 10.0,
+         'pid': 1, 'tid': 1},
+    ]
+    p = tmp_path / 'flight.json'
+    p.write_text(json.dumps({'traceEvents': events}))
+    return p, tid_a, tid_b
+
+
+def test_trace_view_trace_id_filter_and_critical_path(tmp_path, capsys):
+    from tools.trace_view import critical_path, main as trace_view_main
+    p, tid_a, tid_b = _flight_trace(tmp_path)
+    assert trace_view_main([str(p)]) == 0
+    out = capsys.readouterr().out
+    # per-trace critical-path summaries appear for both traces
+    assert f'trace {tid_a}:' in out and f'trace {tid_b}:' in out
+    # filter: only trace A's events counted
+    assert trace_view_main([str(p), '--trace-id', tid_a]) == 0
+    out = capsys.readouterr().out
+    assert '4/5 events' in out
+    assert f'trace {tid_b}:' not in out
+    # unknown id: valid document, empty filter, exit 0
+    assert trace_view_main([str(p), '--trace-id', 'f' * 32]) == 0
+    # critical path: ingress(100) + model(200) + save(50) — d2h overlaps
+    # model and must NOT be double-counted into the chain
+    events = json.loads(p.read_text())['traceEvents']
+    spans_a = [e for e in events if (e.get('args') or {}).get('trace_id')
+               == tid_a or tid_a in ((e.get('args') or {}
+                                      ).get('trace_ids') or ())]
+    total, chain = critical_path(spans_a)
+    assert total == pytest.approx(350.0)
+    assert [e['name'] for e in chain] == ['ingress', 'model', 'save']
+
+
+def test_trace_view_rejects_trace_id_without_span_id(tmp_path, capsys):
+    from tools.trace_view import main as trace_view_main
+    bad = {'traceEvents': [
+        {'name': 'x', 'ph': 'X', 'ts': 0.0, 'dur': 1.0, 'pid': 1,
+         'tid': 1, 'args': {'trace_id': 'a' * 32}},   # no span_id
+    ]}
+    p = tmp_path / 'unpaired.json'
+    p.write_text(json.dumps(bad))
+    assert trace_view_main([str(p), '--quiet']) == 1
+    assert 'trace_id without span_id' in capsys.readouterr().err
+    # batch-level trace_ids (shared work) are exempt by design
+    ok = {'traceEvents': [
+        {'name': 'model', 'ph': 'X', 'ts': 0.0, 'dur': 1.0, 'pid': 1,
+         'tid': 1, 'args': {'trace_ids': ['a' * 32]}},
+    ]}
+    p2 = tmp_path / 'paired.json'
+    p2.write_text(json.dumps(ok))
+    assert trace_view_main([str(p2), '--quiet']) == 0

@@ -15,12 +15,22 @@ Checks:
     LIFO name matching (the recorder emits ``X`` spans, but hand-made
     or merged traces may not);
   * timestamps are monotonically non-decreasing over the event list
-    (the exporter sorts; a torn or hand-concatenated file fails here).
+    (the exporter sorts; a torn or hand-concatenated file fails here);
+  * every event whose args carry a ``trace_id`` also carries a
+    ``span_id`` (the vft-flight pairing contract — an unpaired trace_id
+    breaks parent/child reconstruction; batch-level ``trace_ids`` lists
+    are exempt, they annotate shared work).
+
+Request tracing (vft-flight): ``--trace-id <id>`` filters the summary
+to one request's events, and every trace present gets a critical-path
+summary — the longest chain of non-overlapping spans, i.e. the lower
+bound on that request's wall time no amount of added parallelism
+removes.
 
 Exit codes: 0 valid · 1 invalid (details on stderr) · 2 usage/IO error.
 
 Usage:
-    python tools/trace_view.py TRACE.json [--quiet]
+    python tools/trace_view.py TRACE.json [--quiet] [--trace-id ID]
 """
 from __future__ import annotations
 
@@ -53,6 +63,14 @@ def validate_events(events: List[Dict[str, Any]]) -> List[str]:
         ph = ev['ph']
         if ph in META_PHASES:
             continue
+        args = ev.get('args')
+        if isinstance(args, dict) and 'trace_id' in args \
+                and 'span_id' not in args:
+            # the vft-flight pairing contract: a trace-scoped event
+            # names its own span too (plural trace_ids — shared batch
+            # annotations — are exempt by construction)
+            errors.append(f'event[{i}] ({ev["name"]!r}): args carry '
+                          f'trace_id without span_id')
         ts = ev['ts']
         if not isinstance(ts, (int, float)) or ts < 0:
             errors.append(f'event[{i}] ({ev["name"]!r}): bad ts {ts!r}')
@@ -85,6 +103,86 @@ def validate_events(events: List[Dict[str, Any]]) -> List[str]:
     return errors
 
 
+def event_trace_ids(ev: Dict[str, Any]) -> List[str]:
+    """Every trace id an event is tagged with: its own ``trace_id``
+    plus any shared-batch ``trace_ids`` membership."""
+    args = ev.get('args') or {}
+    ids = []
+    if args.get('trace_id'):
+        ids.append(args['trace_id'])
+    for tid in (args.get('trace_ids') or ()):
+        if tid not in ids:
+            ids.append(tid)
+    return ids
+
+
+def group_by_trace(events: List[Dict[str, Any]]
+                   ) -> Dict[str, List[Dict[str, Any]]]:
+    """trace_id → its events (spans AND instants), in list order."""
+    groups: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+    for ev in events:
+        for tid in event_trace_ids(ev):
+            groups[tid].append(ev)
+    return groups
+
+
+def critical_path(spans: List[Dict[str, Any]]
+                  ) -> Tuple[float, List[Dict[str, Any]]]:
+    """The longest (max total duration) chain of non-overlapping 'X'
+    spans — weighted interval scheduling, O(n log n). This is the lower
+    bound on the request's wall time that no added parallelism removes:
+    everything off the chain already overlapped something on it."""
+    from bisect import bisect_right
+    iv = sorted(((float(e['ts']),
+                  float(e['ts']) + float(e.get('dur', 0.0)), e)
+                 for e in spans if e.get('ph') == 'X'),
+                key=lambda x: x[1])
+    if not iv:
+        return 0.0, []
+    ends = [t for _, t, _ in iv]
+    # best[i] = (total_dur, chain) over the first i intervals
+    best: List[Tuple[float, List[Dict[str, Any]]]] = [(0.0, [])]
+    for i, (s, t, e) in enumerate(iv):
+        j = bisect_right(ends, s, 0, i)     # last interval ending <= s
+        take = best[j][0] + (t - s)
+        if take > best[i][0]:
+            best.append((take, best[j][1] + [e]))
+        else:
+            best.append(best[i])
+    return best[-1]
+
+
+def trace_summaries(events: List[Dict[str, Any]],
+                    only: str = None) -> str:
+    """Per-trace critical-path summary lines (all traces, or one)."""
+    groups = group_by_trace(events)
+    if only is not None:
+        groups = {k: v for k, v in groups.items() if k == only}
+    if not groups:
+        return ''
+    lines = []
+    for tid in sorted(groups):
+        evs = groups[tid]
+        spans = [e for e in evs if e.get('ph') == 'X']
+        if spans:
+            t0 = min(float(e['ts']) for e in spans)
+            t1 = max(float(e['ts']) + float(e.get('dur', 0.0))
+                     for e in spans)
+            wall = t1 - t0
+        else:
+            wall = 0.0
+        cp_total, chain = critical_path(spans)
+        share = (cp_total / wall * 100.0) if wall > 0 else 0.0
+        lines.append(
+            f'trace {tid}: {len(spans)} span(s), wall '
+            f'{wall / 1e3:.3f} ms, critical path {cp_total / 1e3:.3f} '
+            f'ms ({share:.0f}%)')
+        for e in chain:
+            lines.append(f'  {e["name"]:<20} @{float(e["ts"]) / 1e3:10.3f}'
+                         f' ms  {float(e.get("dur", 0.0)) / 1e3:9.3f} ms')
+    return '\n'.join(lines)
+
+
 def summarize(events: List[Dict[str, Any]]) -> str:
     spans: Dict[str, List[float]] = defaultdict(list)
     instants: Dict[str, int] = defaultdict(int)
@@ -112,6 +210,9 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument('trace', help='Chrome trace-event JSON file')
     ap.add_argument('--quiet', action='store_true',
                     help='validate only; no summary table')
+    ap.add_argument('--trace-id', default=None, metavar='ID',
+                    help='summarize only the events of one request '
+                         'trace (vft-flight trace_id)')
     args = ap.parse_args(argv)
 
     try:
@@ -135,8 +236,29 @@ def main(argv: List[str] = None) -> int:
               f'{len(events)} events', file=sys.stderr)
         return 1
     dropped = (doc.get('otherData') or {}).get('events_dropped', 0)
+    if args.trace_id is not None:
+        selected = [e for e in events
+                    if args.trace_id in event_trace_ids(e)]
+        if not selected:
+            # the document is VALID — the filter just matched nothing;
+            # say so on stderr without changing the exit contract
+            print(f'trace_view: no events for trace {args.trace_id!r}',
+                  file=sys.stderr)
+        if not args.quiet:
+            print(summarize(selected))
+            cp = trace_summaries(selected, only=args.trace_id)
+            if cp:
+                print(cp)
+        print(f'trace_view: OK — {len(selected)}/{len(events)} events '
+              f'for trace {args.trace_id}'
+              + (f' ({dropped} dropped at record time)' if dropped
+                 else ''))
+        return 0
     if not args.quiet:
         print(summarize(events))
+        cp = trace_summaries(events)
+        if cp:
+            print(cp)
     print(f'trace_view: OK — {len(events)} events'
           + (f' ({dropped} dropped at record time)' if dropped else ''))
     return 0

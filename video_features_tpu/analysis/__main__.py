@@ -21,7 +21,7 @@ import sys
 from pathlib import Path
 
 from video_features_tpu.analysis.checks import (
-    RULES, analyze, closure_forbidden_imports,
+    ALL_CHECKS, RULE_CHECKS, RULES, analyze, closure_forbidden_imports,
 )
 from video_features_tpu.analysis.core import (
     EXIT_CLEAN, EXIT_ERROR, EXIT_FINDINGS, EXIT_IMPURE, Package,
@@ -69,6 +69,10 @@ def main(argv=None, jax_preloaded=None) -> int:
     parser.add_argument('--fail-on-new', action='store_true',
                         help='exit 2 on findings not in the baseline '
                         '(the default behavior, spelled out for CI)')
+    parser.add_argument('--rules', help='comma-separated subset of rules '
+                        'to run (default: all) — CI uses this to name a '
+                        'specific gate (e.g. contract-key-sync) in its '
+                        'own step instead of burying it')
     parser.add_argument('--list-rules', action='store_true')
     args = parser.parse_args(argv)
 
@@ -87,9 +91,20 @@ def main(argv=None, jax_preloaded=None) -> int:
     baseline_path = Path(args.baseline) if args.baseline \
         else repo_root / DEFAULT_BASELINE
 
+    checks = ALL_CHECKS
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(',') if r.strip()}
+        unknown = wanted - set(RULES)
+        if unknown:
+            print(f'vft-lint: unknown rule(s) {sorted(unknown)}; '
+                  f'known: {", ".join(RULES)}', file=sys.stderr)
+            return EXIT_ERROR
+        checks = tuple(check for name, check in RULE_CHECKS
+                       if name in wanted)
+
     try:
         package = Package(pkg_root, args.package_name, tests_dir=tests_dir)
-        findings = analyze(package)
+        findings = analyze(package, checks)
     except SyntaxError as e:
         print(f'vft-lint: parse error: {e}', file=sys.stderr)
         return EXIT_ERROR

@@ -814,23 +814,26 @@ def check_lock_order(package: Package) -> List[Finding]:
 
 # -- registry ----------------------------------------------------------------
 
-ALL_CHECKS = (
-    check_spawn_purity,
-    check_recipe_picklable,
-    check_knob_classification,
-    check_knob_registry_single_source,
-    check_swallowed_exceptions,
-    check_stdout_purity,
-    check_contract_keys,
-    check_stage_vocabulary,
-    check_thread_discipline,
-    check_lock_order,
+# the ONE rule registry: name ↔ check function pairs. ALL_CHECKS and
+# RULES derive from it, so a rule-name subset (`--rules`, the CI
+# contract-gate step) can never silently run the wrong function — two
+# hand-aligned parallel tuples would drift exactly that way.
+RULE_CHECKS = (
+    ('spawn-purity', check_spawn_purity),
+    ('recipe-picklable', check_recipe_picklable),
+    ('knob-classification', check_knob_classification),
+    ('knob-registry', check_knob_registry_single_source),
+    ('swallowed-exception', check_swallowed_exceptions),
+    ('stdout-purity', check_stdout_purity),
+    ('contract-key-sync', check_contract_keys),
+    ('stage-vocabulary', check_stage_vocabulary),
+    ('thread-discipline', check_thread_discipline),
+    ('lock-order', check_lock_order),
 )
 
-RULES = ('spawn-purity', 'recipe-picklable', 'knob-classification',
-         'knob-registry', 'swallowed-exception', 'stdout-purity',
-         'contract-key-sync', 'stage-vocabulary', 'thread-discipline',
-         'lock-order')
+ALL_CHECKS = tuple(fn for _, fn in RULE_CHECKS)
+
+RULES = tuple(name for name, _ in RULE_CHECKS)
 
 
 def run_checks(package: Package,

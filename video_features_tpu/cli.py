@@ -56,6 +56,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     print('Device:', args['device'])
 
     extractor = create_extractor(args)
+    if extractor.blackbox is not None:
+        # crash-dump black box (obs/blackbox.py): a fatal signal on a
+        # CLI run dumps the recent spans/events/manifest before dying;
+        # farm-worker deaths dump from the supervisor independently
+        from video_features_tpu.obs.blackbox import install_signal_dump
+        install_signal_dump(extractor.blackbox)
 
     # multihost: every host runs this same command; each takes a
     # deterministic interleaved shard of the list (no duplicate work across

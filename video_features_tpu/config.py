@@ -99,6 +99,18 @@ OBS_DEFAULTS: Dict[str, Any] = {
     # per-video outcomes, aggregate stage table, XLA compile time, and
     # per-executable-identity cost analysis. null = off.
     'manifest_out': None,
+    # -- vft-flight (obs/blackbox.py, obs/watchdog.py) -------------------
+    # crash-dump black box: on unhandled worker crash, fatal signal, or
+    # watchdog trip, a bounded post-mortem bundle (recent spans, event
+    # tail, metrics snapshot, manifest fragment) lands here. null = off.
+    'postmortem_dir': None,
+    # size cap for the whole postmortem/ dir: oldest bundles GC first,
+    # the newest always survives
+    'postmortem_max_bytes': 64 * (1 << 20),
+    # stall watchdog: a worker holding queued work longer than this many
+    # seconds without a single stage advance trips a structured event +
+    # vft_watchdog_stalls_total{stage} + a black-box dump. null = off.
+    'watchdog_stall_s': None,
 }
 
 
@@ -185,6 +197,14 @@ KNOB_CLASSIFICATION: Dict[str, str] = {
     'trace_out': 'neither',
     'trace_capacity': 'neither',
     'manifest_out': 'neither',
+    # vft-flight telemetry (black box + watchdog): where crash dumps
+    # land and when liveness trips can't change the extracted bytes,
+    # and fragmenting the executable key space on a postmortem path
+    # would transplant twice for a telemetry difference — same policy
+    # as the trace knobs above
+    'postmortem_dir': 'neither',
+    'postmortem_max_bytes': 'neither',
+    'watchdog_stall_s': 'neither',
     # the cache's own namespace must not fragment its key space; pool-key
     # RELEVANT: a worker's extractor publishes/consults the cache
     # configured at build time, so requests with different cache
@@ -446,6 +466,23 @@ def sanity_check(args: Config) -> None:
         if args['trace_capacity'] < 1:
             raise ValueError('trace_capacity must be >= 1; got '
                              f'{args["trace_capacity"]}')
+
+    # vft-flight knobs (obs/blackbox.py, obs/watchdog.py): the dump dir
+    # coerces to str, the size cap and stall deadline must be positive
+    # (ValueError, not assert — survives `python -O`)
+    if args.get('postmortem_dir') is not None:
+        args['postmortem_dir'] = str(args['postmortem_dir'])
+    if args.get('postmortem_max_bytes') is not None:
+        args['postmortem_max_bytes'] = int(args['postmortem_max_bytes'])
+        if args['postmortem_max_bytes'] < 1:
+            raise ValueError('postmortem_max_bytes must be >= 1; got '
+                             f'{args["postmortem_max_bytes"]}')
+    if args.get('watchdog_stall_s') is not None:
+        args['watchdog_stall_s'] = float(args['watchdog_stall_s'])
+        if args['watchdog_stall_s'] <= 0:
+            raise ValueError('watchdog_stall_s must be > 0 (seconds '
+                             'without a stage advance before a stall '
+                             f'trips); got {args["watchdog_stall_s"]}')
 
     assert args.get('file_with_video_paths') or args.get('video_paths'), \
         '`video_paths` or `file_with_video_paths` must be specified'

@@ -109,6 +109,17 @@ class BaseExtractor:
         self.trace_out = None
         self.manifest = None
         self.manifest_out = None
+        # vft-flight: the run-level trace context (a CLI run is one
+        # "request"; per-video spans derive children) and the crash-dump
+        # black box (postmortem_dir knob) — both attached by
+        # configure_obs, None = legacy behavior
+        self.trace_ctx = None
+        self.blackbox = None
+        # stall-watchdog feed for farm decode workers: the serve layer
+        # installs ``watchdog_pending(worker_idx, n_queued)`` and the
+        # DecodeFarm mirrors each worker's backlog into it (None = no
+        # watchdog, exactly today's behavior)
+        self.watchdog_pending = None
         # decode farm (farm/) — the live DecodeFarm handle while (and
         # after) a farm-backed packed run, for the serve metrics surface;
         # run_packed installs it when decode_workers > 1 takes the
@@ -327,8 +338,27 @@ class BaseExtractor:
         stay legacy."""
         trace_out = args.get('trace_out')
         manifest_out = args.get('manifest_out')
+        if args.get('postmortem_dir'):
+            # crash-dump black box: CLI/packed runs dump on fatal
+            # signals and farm-worker deaths (run_packed hands this to
+            # the DecodeFarm supervisor); the serve daemon builds its
+            # own server-wide BlackBox instead
+            from video_features_tpu.obs.blackbox import BlackBox
+            self.blackbox = BlackBox(
+                str(args['postmortem_dir']),
+                max_bytes=args.get('postmortem_max_bytes'),
+                recorders=lambda: [getattr(self.tracer, 'recorder',
+                                           None)],
+                manifest_fn=lambda: (self.manifest.document()
+                                     if self.manifest is not None
+                                     else None))
         if not (trace_out or manifest_out):
             return
+        # a CLI run is one "request": mint a run-level trace context so
+        # per-video spans share one trace_id end to end, like serve
+        # requests do
+        from video_features_tpu.obs.context import mint
+        self.trace_ctx = mint()
         if not self.tracer.enabled:
             self.tracer = Tracer(enabled=True)
         if trace_out:
@@ -522,6 +552,9 @@ class BaseExtractor:
         """Fault-isolating wrapper around :meth:`extract` for the work loop."""
         recorder = getattr(self.tracer, 'recorder', None)
         t0_video = _time.perf_counter() if recorder is not None else 0.0
+        # per-video child span under the run-level trace (vft-flight)
+        video_ctx = (self.trace_ctx.child()
+                     if self.trace_ctx is not None else None)
         outcome = 'failed'
         try:
             if self.is_already_exist(video_path):
@@ -569,7 +602,9 @@ class BaseExtractor:
                 self.manifest.video_done(video_path, outcome)
             if recorder is not None:
                 recorder.span('video', t0_video, _time.perf_counter(),
-                              video=str(video_path), outcome=outcome)
+                              video=str(video_path), outcome=outcome,
+                              **(video_ctx.attrs()
+                                 if video_ctx is not None else {}))
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         raise NotImplementedError

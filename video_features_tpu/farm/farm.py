@@ -44,6 +44,7 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
 
+from video_features_tpu.obs.context import trace_attrs
 from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
 
 # total across the farm's lifetime; generous vs any real transient (a
@@ -89,7 +90,7 @@ def _request_id(task) -> Optional[str]:
 class _Worker:
     __slots__ = ('idx', 'epoch', 'proc', 'shm', 'task_q', 'out_q',
                  'free_q', 'ctrl_q', 'pending', 'started', 'ring_used',
-                 'aborted')
+                 'aborted', 'clock_offset', 'clock_rtt', 'clock_asked')
 
     def __init__(self, idx: int, epoch: int) -> None:
         self.idx = idx
@@ -104,6 +105,16 @@ class _Worker:
         self.started: set = set()              # seqs whose 'start' arrived
         self.aborted: set = set()              # seqs already sent an abort
         self.ring_used = 0                     # last-reported ring bytes
+        # worker-clock → parent-clock offset ('clock' handshake; 0.0
+        # until calibrated): added to every in-worker span timestamp so
+        # the merged timeline shows true in-worker decode time. The
+        # parent keeps the MINIMUM-round-trip measurement (NTP-style):
+        # the startup exchange's round trip spans process spawn (its
+        # midpoint would shift spans by ~spawn/2), so it only seeds the
+        # offset until a tight in-decode refinement replaces it.
+        self.clock_offset = 0.0
+        self.clock_rtt = float('inf')          # best RTT seen (seconds)
+        self.clock_asked = 0.0                 # last re-sync request t
 
 
 class DecodeFarm:
@@ -114,9 +125,19 @@ class DecodeFarm:
                  tracer: Tracer = NULL_TRACER,
                  cache_key_fn: Optional[Callable] = None,
                  respawn_limit: int = RESPAWN_LIMIT,
-                 live_open: Optional[Callable] = None) -> None:
+                 live_open: Optional[Callable] = None,
+                 blackbox=None,
+                 pending_cb: Optional[Callable] = None) -> None:
         import multiprocessing
         self.recipe = recipe
+        # post-mortem dump target (obs/blackbox.BlackBox or None): a
+        # dead worker process dumps a bundle alongside the respawn
+        self._blackbox = blackbox
+        # stall-watchdog feed (serve): ``pending_cb(worker_idx,
+        # n_queued)`` mirrors each worker's assignment backlog so a
+        # single wedged decode worker trips its own watchdog row even
+        # while its siblings keep the serve-level row advancing
+        self._pending_cb = pending_cb
         self.n_workers = max(int(workers), 1)
         self.ring_bytes = max(int(ring_bytes), _MB // 4)
         self.tracer = tracer
@@ -183,6 +204,10 @@ class DecodeFarm:
                   w.task_q, w.out_q, w.free_q, w.ctrl_q),
             daemon=True, name=f'vft-decode-{idx}')
         w.proc.start()
+        # clock-calibration handshake: the worker reads this first (see
+        # farm/worker.py) and answers with ('clock', ...) carrying its
+        # own perf_counter reading — _handle computes the offset
+        w.ctrl_q.put(('sync', time.perf_counter()))
         for seq in requeue:
             task = self._tasks[seq]
             w.pending.append(seq)
@@ -225,6 +250,22 @@ class DecodeFarm:
                     w.proc.join(1.0)
         for w in self._workers:
             self._close_ring(w)
+        if self._pending_cb is not None:
+            # zero the watchdog rows: a retired farm's stale backlog
+            # must not read as a stall after the run ends. The pending
+            # deques are CLEARED first — _update_gauges below mirrors
+            # len(w.pending) through the same callback, and republishing
+            # a dead worker's backlog would undo this zeroing
+            for w in self._workers:
+                with self._lock:
+                    w.pending.clear()
+                try:
+                    self._pending_cb(w.idx, 0)
+                except Exception:
+                    # vft-lint: ok=swallowed-exception — teardown-path
+                    # liveness hook; the forget on worker retirement
+                    # clears the rows regardless
+                    pass
         with _LIVE_LOCK:
             _LIVE_FARMS.discard(self)
         self._update_gauges()
@@ -268,6 +309,16 @@ class DecodeFarm:
             1 for f in farms for w in f._workers if w.pending))
         self._g_ring.set(sum(
             w.ring_used for f in farms for w in f._workers))
+        if self._pending_cb is not None:
+            with self._lock:
+                backlog = [(w.idx, len(w.pending)) for w in self._workers]
+            for idx, n in backlog:
+                try:
+                    self._pending_cb(idx, n)
+                except Exception:
+                    # vft-lint: ok=swallowed-exception — a broken
+                    # liveness hook must not take down the drain loop
+                    pass
 
     # -- dispatcher ----------------------------------------------------------
 
@@ -619,6 +670,24 @@ class DecodeFarm:
         kind, widx, epoch = msg[0], msg[1], msg[2]
         if epoch != w.epoch:
             return None                       # stale pre-respawn message
+        if kind == 'clock':
+            # calibration reply (midpoint method, minimum-RTT filtered):
+            # the worker echoed our t_parent0 with its own clock; the
+            # midpoint's error is bounded by HALF THE ROUND TRIP, so
+            # only the tightest exchange ever seen updates the offset —
+            # the startup exchange (whose round trip spans process
+            # spawn) seeds it, and the first in-decode re-sync (the
+            # worker polls ctrl every window) replaces it with a
+            # millisecond-grade measurement. Spans recorded before any
+            # reply stay at offset 0 — perf_counter is process-shared
+            # on Linux, so that degradation is benign.
+            t_parent0, t_worker = msg[3], msg[4]
+            rtt = time.perf_counter() - t_parent0
+            if rtt < w.clock_rtt:
+                w.clock_rtt = rtt
+                w.clock_offset = ((t_parent0 + time.perf_counter()) / 2.0
+                                  - t_worker)
+            return None
         if kind == 'start':
             seq, info = msg[3], msg[4]
             task = self._tasks.get(seq)
@@ -651,6 +720,20 @@ class DecodeFarm:
                 with self._lock:
                     self._stats['queue_fallback'] += 1
                     self._stats['bytes'] += window.nbytes
+            if w.clock_rtt > 0.05 \
+                    and time.monotonic() - w.clock_asked > 0.5:
+                # calibration still coarse (the startup exchange spans
+                # spawn): re-sync NOW, while the worker is provably in
+                # its decode loop polling ctrl every window — this
+                # round trip is tight, and min-RTT filtering keeps it
+                w.clock_asked = time.monotonic()
+                try:
+                    w.ctrl_q.put(('sync', time.perf_counter()))
+                except Exception:
+                    # vft-lint: ok=swallowed-exception — re-sync to a
+                    # dying worker; supervision reaps it, spans keep
+                    # the seed offset
+                    pass
             task = self._tasks.get(seq)
             if task is None:
                 return None
@@ -674,12 +757,20 @@ class DecodeFarm:
                 # per-worker provenance + transport occupancy: which
                 # process decoded this window and how full its SHM ring
                 # ran (ring_used ≈ capacity ⇒ the consumer is the wall,
-                # not decode)
-                self.tracer.add('decode', dt, t0=t0,
+                # not decode). The span is placed at the WORKER's
+                # clock-calibrated start and attributed to the worker's
+                # own pid/lane — the merged timeline shows true
+                # in-worker decode time, not parent-side drain time.
+                self.tracer.add('decode', dt,
+                                t0=t0 + w.clock_offset,
+                                span_pid=(w.proc.pid
+                                          if w.proc is not None else None),
+                                span_tid=widx,
                                 video=str(task.path), worker=widx,
                                 ring_used=w.ring_used,
                                 ring_capacity=self.ring_bytes,
-                                request_id=_request_id(task))
+                                request_id=_request_id(task),
+                                **trace_attrs(task))
             return task, window, meta
         if kind in ('end', 'err'):
             seq = msg[3]
@@ -762,6 +853,18 @@ class DecodeFarm:
                   f'{"failing " + str(self._tasks[victim_seq].path) if victim_seq is not None else "no video in flight"}'
                   f'; respawning with {len(requeue)} queued video(s)',
                   subsystem='farm')
+            if self._blackbox is not None:
+                # post-mortem bundle for the dead worker: the spans it
+                # shipped before dying are already in the ring (at most
+                # its in-flight video's tail is lost), the event above
+                # is in the tail — dump both. Never raises, never on
+                # the request hot path (supervise tick only).
+                self._blackbox.dump(
+                    'farm_worker_death', worker=w.idx,
+                    exitcode=w.proc.exitcode,
+                    victim=(str(self._tasks[victim_seq].path)
+                            if victim_seq is not None else None),
+                    requeued=len(requeue))
             if victim_seq is not None:
                 task = self._tasks[victim_seq]
                 task.failed = True

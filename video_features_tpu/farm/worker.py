@@ -10,6 +10,7 @@ Wire protocol (all messages on this worker's own ``out_q``; every
 message leads with ``(kind, widx, epoch, ...)`` and stale epochs are
 dropped by the consumer after a respawn):
 
+  ('clock', widx, epoch, t_parent0, t_worker)          calibration reply
   ('start', widx, epoch, seq, info)                    video opened
   ('win',   widx, epoch, seq, off, adv, shape, dtype, meta, t0, dt,
             ring_used)
@@ -18,7 +19,10 @@ dropped by the consumer after a respawn):
   ('end',   widx, epoch, seq, n_windows)               video drained
   ('err',   widx, epoch, seq, traceback)               video failed
 
-Control (``ctrl_q``, consumer → worker): ('abort', seq) stops decoding
+Control (``ctrl_q``, consumer → worker): ('sync', t_parent0) at spawn
+opens the clock-calibration handshake (answered with 'clock' above so
+the parent can place in-worker decode spans on its own timeline);
+('abort', seq) stops decoding
 that video early (device-side fault made its windows worthless);
 ('winq_ack',) credits back one consumed queue-transport window — the
 worker holds at most ``MAX_UNACKED_WINQ`` unacked 'winq' messages, so
@@ -67,6 +71,26 @@ def worker_main(widx: int, epoch: int, recipe, ring_name: str,
     aborted = set()
     winq_unacked = [0]                   # queue-transport credit counter
 
+    # clock-calibration handshake (vft-flight): the parent put
+    # ('sync', t_parent0) on ctrl_q right after spawn; answering with
+    # our own perf_counter reading lets the parent convert in-worker
+    # span timestamps onto ITS clock (midpoint method — the offset
+    # error is bounded by half the message round trip), so the merged
+    # timeline shows true in-worker decode time under this worker's
+    # pid. Best-effort: no sync within the timeout just means
+    # uncalibrated (zero-offset) spans.
+    try:
+        first = ctrl_q.get(timeout=10)
+        if first and first[0] == 'sync':
+            out_q.put(('clock', widx, epoch, first[1],
+                       time.perf_counter()))
+        elif first and first[0] == 'winq_ack':
+            winq_unacked[0] -= 1
+        elif first and first[0] == 'abort':
+            aborted.add(first[1])
+    except queue_mod.Empty:
+        pass
+
     def poll_ctrl() -> None:
         while True:
             try:
@@ -77,6 +101,14 @@ def worker_main(widx: int, epoch: int, recipe, ring_name: str,
                 aborted.add(msg[1])
             elif msg[0] == 'winq_ack':
                 winq_unacked[0] -= 1
+            elif msg[0] == 'sync':
+                # calibration REFINEMENT round trip: the parent re-syncs
+                # while we are actively decoding (polling every window),
+                # so this exchange is tight — unlike the startup one,
+                # whose round trip spans process spawn. The parent keeps
+                # the minimum-RTT measurement (farm._handle 'clock').
+                out_q.put(('clock', widx, epoch, msg[1],
+                           time.perf_counter()))
 
     def wait_free_for(seq):
         def wait_free():

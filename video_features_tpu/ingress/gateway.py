@@ -8,8 +8,15 @@ Endpoints (all JSON unless noted; auth via ``Authorization: Bearer
   * ``GET  /metrics``            — Prometheus text exposition 0.0.4
   * ``POST /v1/extract``         — submit an extraction request
     (``{feature_type, video_paths, overrides?, timeout_s?,
-    range?: [start_s, end_s], priority?}``) → ``{request_id, tenant}``
+    range?: [start_s, end_s], priority?}``) → ``{request_id, tenant,
+    trace_id}``. A W3C ``traceparent`` request header joins the request
+    to the caller's distributed trace; minted when absent/malformed.
   * ``GET  /v1/requests/<id>``   — request status (tenant-scoped)
+  * ``GET  /v1/requests/<id>/trace`` — the request's assembled span
+    timeline (tenant-scoped: ANOTHER tenant's id answers 403 — the
+    trace surface is explicit about authorization, unlike status's
+    deliberate 404 ambiguity, because traces carry video paths and
+    config detail worth a loud denial)
   * ``POST /v1/live/<session>``  — live session: chunked request body
     (first chunk: JSON header ``{feature_type, fps?, overrides?,
     timeout_s?, priority?}``; then ``.npy`` frame batches; empty chunk
@@ -60,6 +67,9 @@ _EXTRACT_FIELDS = frozenset({'feature_type', 'video_paths', 'overrides',
                              'timeout_s', 'range', 'priority'})
 _LIVE_FIELDS = frozenset({'feature_type', 'fps', 'overrides', 'timeout_s',
                           'priority'})
+
+# W3C Trace Context request header (lowercased by the header parser)
+_TRACEPARENT_HEADER = 'traceparent'
 
 
 class IngressGateway:
@@ -137,9 +147,11 @@ class IngressGateway:
         trace_out = self.server.base_overrides.get('trace_out')
         if trace_out:
             # ingress spans join the server-wide merged Perfetto export
+            # — on the PERSISTENT list: warm-pool churn ages out worker
+            # recorders, never the front door's
             from video_features_tpu.obs.spans import SpanRecorder
             self._recorder = SpanRecorder()
-            self.server._trace_recorders.append(self._recorder)
+            self.server._persistent_recorders.append(self._recorder)
         self.http.start()
         self.server.attach_ingress(self)
         self.server.completion_listeners.append(self._on_request_done)
@@ -298,10 +310,29 @@ class IngressGateway:
             self._h_latency.observe(dt)
             self._count(endpoint, tenant.name if tenant else None, status)
             if self._recorder is not None:
-                self._recorder.span(
-                    'ingress', t0, t0 + dt, endpoint=endpoint,
-                    tenant=(tenant.name if tenant else None),
-                    status=status, request_id=request_id)
+                attrs = dict(endpoint=endpoint,
+                             tenant=(tenant.name if tenant else None),
+                             status=status, request_id=request_id)
+                trace_id = self._trace_id_of(request_id)
+                if trace_id is not None:
+                    # the ingress hop is its own span under the
+                    # request's trace (span_id pairs with trace_id —
+                    # tools/trace_view.py validates the pairing)
+                    from video_features_tpu.obs.context import \
+                        new_span_id
+                    attrs.update(trace_id=trace_id,
+                                 span_id=new_span_id())
+                self._recorder.span('ingress', t0, t0 + dt, **attrs)
+
+    def _trace_id_of(self, request_id: Optional[str]) -> Optional[str]:
+        """The trace id a (possibly just-admitted) request carries, or
+        None — same internal-seam access the drain plumbing uses."""
+        if request_id is None:
+            return None
+        with self.server._lock:
+            req = self.server._requests.get(request_id)
+        trace = getattr(req, 'trace', None)
+        return trace.trace_id if trace is not None else None
 
     @staticmethod
     def _endpoint_label(req: HttpRequest) -> str:
@@ -313,7 +344,8 @@ class IngressGateway:
         if p in ('/healthz', '/metrics', '/v1/metrics', '/v1/extract'):
             return p
         if p.startswith('/v1/requests/'):
-            return '/v1/requests'
+            return ('/v1/requests/trace' if p.endswith('/trace')
+                    else '/v1/requests')
         if p.startswith('/v1/live/'):
             return '/v1/live'
         return 'other'
@@ -333,6 +365,9 @@ class IngressGateway:
             return 200, None
         if path == '/v1/extract' and method == 'POST':
             return self._handle_extract(req, resp, tenant)
+        if path.startswith('/v1/requests/') and path.endswith('/trace') \
+                and method == 'GET':
+            return self._handle_trace(req, resp, tenant)
         if path.startswith('/v1/requests/') and method == 'GET':
             return self._handle_status(req, resp, tenant)
         if path.startswith('/v1/live/') and method == 'POST':
@@ -405,7 +440,8 @@ class IngressGateway:
                 body.get('feature_type'), body.get('video_paths'),
                 overrides=body.get('overrides'),
                 timeout_s=body.get('timeout_s'),
-                range_s=body.get('range'), priority=priority)
+                range_s=body.get('range'), priority=priority,
+                traceparent=req.headers.get(_TRACEPARENT_HEADER))
         except Exception:
             self.quota.release(tenant.name)
             raise
@@ -415,7 +451,40 @@ class IngressGateway:
         rid = result['request_id']
         self._own(rid, tenant)
         resp.send_json(200, {'ok': True, 'request_id': rid,
-                             'tenant': tenant.name, 'priority': priority})
+                             'tenant': tenant.name, 'priority': priority,
+                             'trace_id': result.get('trace_id')})
+        return 200, rid
+
+    def _handle_trace(self, req: HttpRequest, resp: ResponseWriter,
+                      tenant: Tenant) -> Tuple[int, Optional[str]]:
+        """``GET /v1/requests/<id>/trace`` — one request's assembled
+        span timeline. Tenant-scoped with an EXPLICIT 403 on a foreign
+        id (unlike status's 404 ambiguity): traces carry video paths,
+        stage timings, and config detail, so a cross-tenant read is an
+        authorization failure worth naming. Known tradeoff: with the
+        sequential r%06d id space this distinguishes "exists, not
+        yours" from "never existed" — a deliberate choice of audit
+        clarity over id-space opacity on THIS route only (status keeps
+        the uniform 404); revisit if ids ever need to be unguessable."""
+        rid = req.path[len('/v1/requests/'):-len('/trace')]
+        with self._lock:
+            owner = self._owners.get(rid)
+        if owner is None:
+            raise HttpError(404, 'not_found',
+                            f'unknown request_id {rid!r}',
+                            tenant=tenant.name, request_id=rid)
+        if owner != tenant.name:
+            raise HttpError(403, 'forbidden',
+                            f'request {rid!r} belongs to another tenant',
+                            tenant=tenant.name, request_id=rid)
+        tr = self.server.request_trace(rid)
+        if not tr.get('ok'):
+            raise HttpError(404, 'not_found',
+                            tr.get('error', f'unknown request {rid!r}'),
+                            tenant=tenant.name, request_id=rid)
+        tr.pop('ok', None)
+        tr['tenant'] = tenant.name
+        resp.send_json(200, {'ok': True, **tr})
         return 200, rid
 
     def _handle_status(self, req: HttpRequest, resp: ResponseWriter,
@@ -493,7 +562,8 @@ class IngressGateway:
                     header.get('feature_type'), session,
                     overrides=header.get('overrides'),
                     timeout_s=header.get('timeout_s'),
-                    priority=priority)
+                    priority=priority,
+                    traceparent=req.headers.get(_TRACEPARENT_HEADER))
                 if not result.get('ok'):
                     released = True
                     self.quota.release(tenant.name)

@@ -1,0 +1,333 @@
+"""Crash-dump black box: a bounded post-mortem bundle on the way down.
+
+Span export fires on a clean ``trace_out=`` finish; the metrics mirror
+rewrites on request completions. Neither helps when the daemon crashes
+or wedges — exactly the moments an operator needs the flight recorder
+most. This module dumps what the process knows RIGHT NOW into a
+size-capped ``postmortem/`` directory:
+
+  * ``meta.json``    — reason, wall/monotonic time, pid, caller extras
+    (worker label, trace_id, watchdog ledger, ...);
+  * ``spans.json``   — the merged recent span timeline (Chrome
+    trace-event JSON, bounded per recorder via ``snapshot(limit=)`` so a
+    dump never serializes the full 200K-event ring), viewable in
+    Perfetto and validated by ``tools/trace_view.py``;
+  * ``events.jsonl`` — the tail of the structured event log
+    (``obs.events.events_tail``): what the system was saying before it
+    died;
+  * ``metrics.prom`` / ``metrics.json`` — a point-in-time metrics
+    snapshot, when the owner wired one in;
+  * ``manifest.json`` — the run-manifest fragment, when one exists.
+
+Discipline: every write is atomic (a dump torn by the very crash it
+documents must not masquerade as a complete bundle — ``meta.json`` is
+written LAST and is the bundle's validity marker), every section is
+best-effort (one broken collector must not lose the others), the whole
+dump path never raises, dumps are rate-limited (a crash loop must not
+spend its last breath writing the same bundle in a busy loop), and the
+directory is GC'd oldest-bundle-first under ``postmortem_max_bytes``.
+Nothing here runs on the request hot path: callers are crash handlers,
+signal handlers, and the watchdog's monitor thread.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+# per-recorder span bound for one bundle: recent-history window, far
+# beyond any single request, far below the full ring
+SPAN_DUMP_LIMIT = 20_000
+
+# default size cap for the whole postmortem/ dir (config OBS_DEFAULTS
+# carries the knob; this is the fallback for direct construction)
+DEFAULT_MAX_BYTES = 64 * (1 << 20)
+
+# two dumps closer together than this collapse to one (crash loops,
+# watchdog re-trips): the first bundle already holds the history
+MIN_DUMP_INTERVAL_S = 2.0
+
+
+class BlackBox:
+    """One dump target: a directory, a byte budget, and the collectors
+    that know where the telemetry lives."""
+
+    def __init__(self, postmortem_dir: str,
+                 max_bytes: Optional[int] = None,
+                 recorders: Optional[Callable[[], Iterable]] = None,
+                 metrics_fn: Optional[Callable[[], Any]] = None,
+                 prom_fn: Optional[Callable[[], str]] = None,
+                 manifest_fn: Optional[Callable[[], Dict]] = None,
+                 min_interval_s: float = MIN_DUMP_INTERVAL_S) -> None:
+        self.postmortem_dir = str(postmortem_dir)
+        self.max_bytes = int(max_bytes if max_bytes is not None
+                             else DEFAULT_MAX_BYTES)
+        # collectors are CALLABLES, not snapshots: the black box holds
+        # no live references of its own, it asks at dump time
+        self._recorders = recorders
+        self._metrics_fn = metrics_fn
+        self._prom_fn = prom_fn
+        self._manifest_fn = manifest_fn
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._last_dump_t = 0.0
+        self._seq = 0
+        self.dumps = 0                # bundles written (telemetry)
+        self.suppressed = 0           # rate-limited dump requests
+
+    # -- the one entry point -------------------------------------------------
+
+    def dump(self, reason: str, **extra: Any) -> Optional[str]:
+        """Write one bundle; returns its directory path, or None when
+        rate-limited or when even the meta write failed. NEVER raises —
+        this runs on crash paths where a telemetry error must not mask
+        (or re-enter) the original failure."""
+        try:
+            return self._dump(reason, extra)
+        except Exception:
+            # vft-lint: ok=swallowed-exception — the black box is the
+            # last thing standing on a crash path: a dump failure has
+            # nowhere better to go than stderr-best-effort below
+            try:
+                import logging
+
+                from video_features_tpu.obs.events import event
+                event(logging.ERROR, 'black-box dump failed',
+                      subsystem='obs', exc_info=True, reason=reason)
+            except Exception:
+                # vft-lint: ok=swallowed-exception — even the reporter
+                # failed; the process is likely dying, nothing to do
+                pass
+            return None
+
+    def _dump(self, reason: str, extra: Dict[str, Any]) -> Optional[str]:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_dump_t < self.min_interval_s:
+                self.suppressed += 1
+                return None
+            self._last_dump_t = now
+            self._seq += 1
+            seq = self._seq
+        safe_reason = ''.join(c if c.isalnum() or c in '-_' else '_'
+                              for c in str(reason))[:48] or 'unknown'
+        stamp = time.strftime('%Y%m%dT%H%M%S', time.gmtime())
+        bundle = os.path.join(self.postmortem_dir,
+                              f'{stamp}.{seq:03d}-{safe_reason}')
+        os.makedirs(bundle, exist_ok=True)
+
+        sections: Dict[str, Any] = {}
+        sections['spans'] = self._write_spans(bundle)
+        sections['events'] = self._write_events(bundle)
+        sections['metrics'] = self._write_metrics(bundle)
+        sections['manifest'] = self._write_manifest(bundle)
+
+        # meta LAST: its presence marks a complete bundle (validators
+        # and the dryrun key on it)
+        meta = {
+            'schema': 'video_features_tpu.postmortem/1',
+            'reason': str(reason),
+            'time_unix_s': round(time.time(), 3),
+            'pid': os.getpid(),
+            'sections': sections,
+        }
+        if extra:
+            from video_features_tpu.obs.spans import _jsonable
+            meta['extra'] = {k: _jsonable(v) for k, v in extra.items()}
+        self._write_json(os.path.join(bundle, 'meta.json'), meta)
+        with self._lock:
+            self.dumps += 1
+        self._gc()
+        import logging
+
+        from video_features_tpu.obs.events import event
+        event(logging.ERROR, 'black-box bundle written',
+              subsystem='obs', reason=str(reason), path=bundle)
+        return bundle
+
+    # -- sections (each best-effort) -----------------------------------------
+
+    @staticmethod
+    def _write_json(path: str, doc: Any) -> None:
+        from video_features_tpu.utils.output import atomic_write
+        atomic_write(path, lambda f: f.write(
+            json.dumps(doc, sort_keys=True).encode('utf-8')))
+
+    def _write_spans(self, bundle: str) -> bool:
+        if self._recorders is None:
+            return False
+        try:
+            from video_features_tpu.obs.spans import merge_traces
+            recorders = [r for r in self._recorders() if r is not None]
+            if not recorders:
+                return False
+            doc = {
+                'traceEvents': merge_traces(recorders,
+                                            limit=SPAN_DUMP_LIMIT),
+                'displayTimeUnit': 'ms',
+                'otherData': {
+                    'tool': 'video_features_tpu',
+                    'recorders_merged': len(recorders),
+                    'events_dropped': sum(r.dropped for r in recorders),
+                },
+            }
+            self._write_json(os.path.join(bundle, 'spans.json'), doc)
+            return True
+        except Exception:
+            # vft-lint: ok=swallowed-exception — best-effort section:
+            # a broken recorder must not lose the events/metrics dumps
+            return False
+
+    def _write_events(self, bundle: str) -> bool:
+        try:
+            from video_features_tpu.obs.events import events_tail
+            tail = events_tail()
+            from video_features_tpu.utils.output import atomic_write
+            payload = ''.join(json.dumps(rec, sort_keys=True) + '\n'
+                              for rec in tail)
+            atomic_write(os.path.join(bundle, 'events.jsonl'),
+                         lambda f: f.write(payload.encode('utf-8')))
+            return bool(tail)
+        except Exception:
+            # vft-lint: ok=swallowed-exception — best-effort section
+            return False
+
+    def _write_metrics(self, bundle: str) -> bool:
+        wrote = False
+        if self._metrics_fn is not None:
+            try:
+                self._write_json(os.path.join(bundle, 'metrics.json'),
+                                 self._metrics_fn())
+                wrote = True
+            except Exception:
+                # vft-lint: ok=swallowed-exception — best-effort section
+                pass
+        if self._prom_fn is not None:
+            try:
+                from video_features_tpu.utils.output import atomic_write
+                text = self._prom_fn()
+                atomic_write(os.path.join(bundle, 'metrics.prom'),
+                             lambda f: f.write(text.encode('utf-8')))
+                wrote = True
+            except Exception:
+                # vft-lint: ok=swallowed-exception — best-effort section
+                pass
+        return wrote
+
+    def _write_manifest(self, bundle: str) -> bool:
+        if self._manifest_fn is None:
+            return False
+        try:
+            doc = self._manifest_fn()
+            if not doc:
+                return False
+            self._write_json(os.path.join(bundle, 'manifest.json'), doc)
+            return True
+        except Exception:
+            # vft-lint: ok=swallowed-exception — best-effort section
+            return False
+
+    # -- retention -----------------------------------------------------------
+
+    def _gc(self) -> None:
+        """Oldest-bundle-first GC under ``max_bytes``. The NEWEST bundle
+        always survives (a cap smaller than one bundle must not erase
+        the only evidence); bundle dirs sort chronologically by name
+        (UTC stamp + sequence)."""
+        try:
+            root = self.postmortem_dir
+            bundles = sorted(
+                d for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d)))
+        except OSError:
+            return
+        sizes: Dict[str, int] = {}
+        for d in bundles:
+            total = 0
+            for base, _, files in os.walk(os.path.join(root, d)):
+                for f in files:
+                    try:
+                        total += os.path.getsize(os.path.join(base, f))
+                    except OSError:
+                        pass
+            sizes[d] = total
+        overall = sum(sizes.values())
+        for d in bundles[:-1]:                 # newest always survives
+            if overall <= self.max_bytes:
+                break
+            shutil.rmtree(os.path.join(self.postmortem_dir, d),
+                          ignore_errors=True)
+            overall -= sizes[d]
+
+
+def validate_bundle(bundle_dir: str) -> List[str]:
+    """All violations found in one bundle (empty list = valid): meta
+    present and well-formed, the spans section (when meta claims it)
+    a valid trace-event document. Used by tests and the dryrun."""
+    errors: List[str] = []
+    meta_path = os.path.join(bundle_dir, 'meta.json')
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f'meta.json unreadable: {e}']
+    if meta.get('schema') != 'video_features_tpu.postmortem/1':
+        errors.append(f'bad schema {meta.get("schema")!r}')
+    for key in ('reason', 'time_unix_s', 'pid', 'sections'):
+        if key not in meta:
+            errors.append(f'meta.json missing {key!r}')
+    if (meta.get('sections') or {}).get('spans'):
+        try:
+            with open(os.path.join(bundle_dir, 'spans.json')) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return errors + [f'spans.json unreadable: {e}']
+        events = doc.get('traceEvents')
+        if not isinstance(events, list):
+            errors.append('spans.json: traceEvents is not a list')
+        else:
+            try:
+                # the full trace-event grammar check when the repo's
+                # tools/ are importable (tests, dryruns); the structural
+                # check above still ran either way
+                from tools.trace_view import validate_events
+                errors += [f'spans.json: {e}'
+                           for e in validate_events(events)]
+            except ImportError:
+                pass
+    return errors
+
+
+def install_signal_dump(blackbox: BlackBox, signals=None) -> None:
+    """Chain a black-box dump onto fatal signals the process can still
+    observe (SIGQUIT/SIGABRT — SIGKILL/SIGSEGV are not catchable from
+    Python; the farm supervisor covers worker SIGKILLs from the parent
+    side). Previously installed handlers still run afterwards, so this
+    composes with the serve daemon's drain-on-SIGTERM."""
+    import signal as signal_mod
+    if signals is None:
+        signals = tuple(
+            s for s in (getattr(signal_mod, 'SIGQUIT', None),
+                        getattr(signal_mod, 'SIGABRT', None))
+            if s is not None)
+    for sig in signals:
+        prev = signal_mod.getsignal(sig)
+
+        def _handler(signum, frame, _prev=prev):
+            blackbox.dump(f'signal_{signum}')
+            if callable(_prev):
+                _prev(signum, frame)
+            elif _prev == signal_mod.SIG_DFL:
+                signal_mod.signal(signum, signal_mod.SIG_DFL)
+                signal_mod.raise_signal(signum)
+
+        try:
+            signal_mod.signal(sig, _handler)
+        except (OSError, ValueError):
+            # vft-lint: ok=swallowed-exception — e.g. not the main
+            # thread, or the platform refuses: the black box still fires
+            # on crash/watchdog paths, signal coverage is best-effort
+            pass

@@ -1,0 +1,119 @@
+"""Request-scoped trace context (vft-flight): one trace_id end to end.
+
+The span timeline (obs/spans) and the stage table answer "what happened
+WHEN" for one *run*; this module gives every *request* an identity that
+survives the run's seams — accepted from a W3C ``traceparent`` header at
+ingress (minted when absent), carried on the loopback protocol, stamped
+onto every :class:`parallel.packing.VideoTask`, threaded through the
+packed scheduler's span attrs, and shipped across the decode-farm
+process boundary — so "show me everything that happened to request
+r-123" is one filter over the merged timeline
+(``GET /v1/requests/<id>/trace``, ``tools/trace_view.py --trace-id``).
+
+Identifiers follow the W3C Trace Context recommendation: a 16-byte
+``trace_id`` and an 8-byte ``span_id``, lowercase hex, all-zero values
+invalid. Only the ``traceparent`` header is consumed (``tracestate`` is
+vendor baggage this system neither reads nor forwards); an unparseable
+header degrades to a freshly minted context — a malformed client header
+must never fail admission.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Optional
+
+# version "00" traceparent: version-trace_id-parent_id-flags
+_TRACEPARENT_RE = re.compile(
+    r'^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$')
+
+
+class TraceContext:
+    """One (trace_id, span_id) pair. Immutable by convention: derive
+    child spans with :meth:`child` rather than mutating in place — the
+    parent's span_id keeps naming the parent."""
+
+    __slots__ = ('trace_id', 'span_id')
+
+    def __init__(self, trace_id: str, span_id: str) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def child(self) -> 'TraceContext':
+        """A new span under the same trace (per-video task spans under
+        one request's trace)."""
+        return TraceContext(self.trace_id, new_span_id())
+
+    def traceparent(self) -> str:
+        """The W3C wire form (sampled flag always set — this system
+        records everything it traces)."""
+        return f'00-{self.trace_id}-{self.span_id}-01'
+
+    def attrs(self) -> Dict[str, str]:
+        """Span-args projection: the two keys every trace-scoped span
+        carries (``tools/trace_view.py`` validates the pairing)."""
+        return {'trace_id': self.trace_id, 'span_id': self.span_id}
+
+    def __repr__(self) -> str:
+        return f'TraceContext({self.traceparent()!r})'
+
+
+def new_trace_id() -> str:
+    """16 random bytes, lowercase hex; never all-zero (invalid per W3C)."""
+    while True:
+        tid = os.urandom(16).hex()
+        if tid != '0' * 32:
+            return tid
+
+
+def new_span_id() -> str:
+    """8 random bytes, lowercase hex; never all-zero."""
+    while True:
+        sid = os.urandom(8).hex()
+        if sid != '0' * 16:
+            return sid
+
+
+def mint() -> TraceContext:
+    """A fresh root context (no inbound ``traceparent``)."""
+    return TraceContext(new_trace_id(), new_span_id())
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """The context a W3C ``traceparent`` header carries, or None when
+    the header is absent/malformed/all-zero (callers mint instead —
+    accepting garbage ids would poison every downstream filter)."""
+    if not header or not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id = m.group(1), m.group(2), m.group(3)
+    if version == 'ff' or trace_id == '0' * 32 or span_id == '0' * 16:
+        return None
+    # the inbound parent becomes OUR parent: keep its trace, start a new
+    # span under it so this hop is distinguishable from the caller's
+    return TraceContext(trace_id, new_span_id())
+
+
+def accept_traceparent(header: Optional[str]) -> TraceContext:
+    """Parse-or-mint: the ingress/admission entry points always leave
+    with a valid context."""
+    return parse_traceparent(header) or mint()
+
+
+def trace_attrs(task: Any) -> Dict[str, str]:
+    """The span-args for a task-carrying instrumentation site: the
+    task's :class:`TraceContext` attrs, or ``{}`` for legacy/CLI tasks
+    without one — call sites can splat it unconditionally."""
+    ctx = getattr(task, 'trace', None)
+    return ctx.attrs() if ctx is not None else {}
+
+
+def trace_ids_of(tasks: Any) -> list:
+    """The sorted distinct trace ids an iterable of tasks carries —
+    batch-level spans (pack/model/d2h) serve several requests at once
+    and annotate the SET so a per-request trace filter still finds the
+    shared work. One implementation for every batch-span site."""
+    return sorted({t.trace.trace_id for t in tasks
+                   if getattr(t, 'trace', None) is not None})

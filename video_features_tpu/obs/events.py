@@ -26,7 +26,9 @@ from __future__ import annotations
 import logging
 import sys
 import threading
-from typing import Any, Optional
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 LOGGER_NAME = 'video_features_tpu'
 
@@ -34,6 +36,54 @@ _FORMAT = '%(asctime)s %(levelname)s %(name)s: %(message)s'
 
 _configured = False
 _configure_lock = threading.Lock()
+
+# -- event accounting (vft-flight) -------------------------------------------
+# Every structured event is (a) counted per (level, subsystem) — the
+# serve metrics surface mirrors these into the vft_events_total counter
+# family, making error/warn RATES scrapeable instead of only greppable —
+# and (b) appended to a bounded tail ring, the black box's
+# (obs/blackbox.py) record of "what was the system saying right before
+# it died". Both are process-wide like the logger itself; a deque append
+# and a dict bump under one lock cost nothing against the logging call
+# they ride on.
+EVENT_TAIL_CAPACITY = 512
+
+_event_lock = threading.Lock()
+_event_counts: Dict[Tuple[str, str], int] = {}
+_event_tail: 'deque' = deque(maxlen=EVENT_TAIL_CAPACITY)
+
+
+def _record_event(level: int, msg: str, subsystem: Optional[str],
+                  exc_text: Optional[str],
+                  fields: Dict[str, Any]) -> None:
+    levelname = logging.getLevelName(level)
+    rec: Dict[str, Any] = {'t_unix_s': round(time.time(), 3),
+                           'level': levelname,
+                           'subsystem': subsystem or 'core',
+                           'msg': msg}
+    if fields:
+        rec['fields'] = {k: str(v) for k, v in fields.items()}
+    if exc_text:
+        rec['exc'] = exc_text
+    with _event_lock:
+        key = (levelname, subsystem or 'core')
+        _event_counts[key] = _event_counts.get(key, 0) + 1
+        _event_tail.append(rec)
+
+
+def event_counts() -> Dict[Tuple[str, str], int]:
+    """Snapshot of lifetime event counts keyed ``(level, subsystem)`` —
+    the source the serve registry's ``vft_events_total`` family mirrors."""
+    with _event_lock:
+        return dict(_event_counts)
+
+
+def events_tail(limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The most recent structured events (newest last) — the black-box
+    bundle's ``events.jsonl`` section."""
+    with _event_lock:
+        tail = list(_event_tail)
+    return tail[-int(limit):] if limit is not None else tail
 
 
 class _StderrHandler(logging.StreamHandler):
@@ -92,6 +142,11 @@ def event(level: int, msg: str, subsystem: Optional[str] = None,
     (``request_id=getattr(task, 'request', None)``) unconditionally.
     """
     fields = {k: v for k, v in fields.items() if v is not None}
+    exc_text = None
+    if exc_info:
+        import traceback
+        exc_text = traceback.format_exc(limit=30)
+    _record_event(level, msg, subsystem, exc_text, fields)
     if fields:
         ctx = ' '.join(f'{k}={v}' for k, v in fields.items())
         msg = f'{msg} [{ctx}]'

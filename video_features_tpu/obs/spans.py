@@ -50,7 +50,10 @@ class SpanRecorder:
         self.enabled = enabled
         self.capacity = int(capacity)
         self._lock = threading.Lock()
-        # (ph, name, t_start_s, dur_s, tid, attrs|None)
+        # (ph, name, t_start_s, dur_s, tid, attrs|None, pid|None)
+        # pid/tid overrides carry CROSS-PROCESS spans (decode-farm
+        # workers): the worker measures, the parent records, and the
+        # export shows the span under the worker's own pid lane
         self._events: 'deque' = deque(maxlen=self.capacity)
         self._appended = 0
         self._thread_names: Dict[int, str] = {}
@@ -58,22 +61,38 @@ class SpanRecorder:
         # origin, so exports can be correlated with log timestamps
         self._t0 = CLOCK()
         self._wall0 = time.time()
+        # incremental minimum of every start timestamp ever appended:
+        # origin() must be O(1) — the /trace route calls it per recorder
+        # on a request path, and a full O(capacity) ring scan under the
+        # lock would stall the hot span-append path. Never reset on
+        # ring eviction: a conservatively-old origin only shifts ts
+        # later, it can never go negative.
+        self._min_ts = self._t0
 
     # -- recording -----------------------------------------------------------
 
     def span(self, name: str, t_start: float, t_end: float,
+             pid: Optional[int] = None, tid: Optional[int] = None,
              **attrs: Any) -> None:
         """Record one complete ('X') span. ``t_start``/``t_end`` are
         ``CLOCK()`` readings; ``attrs`` become the event's ``args``
-        (video path, request id, batch occupancy, ...)."""
+        (video path, request id, trace/span ids, batch occupancy, ...).
+        ``pid``/``tid`` override the recording process/thread identity —
+        the decode farm records spans its WORKER processes measured
+        (clock-calibrated), and the export must show them under the
+        worker's own lane, not the parent drain thread's."""
         if not self.enabled:
             return
-        tid = threading.get_ident()
+        own_thread = tid is None
+        if own_thread:
+            tid = threading.get_ident()
         with self._lock:
-            if tid not in self._thread_names:
+            if own_thread and tid not in self._thread_names:
                 self._thread_names[tid] = threading.current_thread().name
-            self._events.append(
-                ('X', name, t_start, t_end - t_start, tid, attrs or None))
+            if t_start < self._min_ts:
+                self._min_ts = t_start
+            self._events.append(('X', name, t_start, t_end - t_start,
+                                 int(tid), attrs or None, pid))
             self._appended += 1
 
     def instant(self, name: str, **attrs: Any) -> None:
@@ -84,7 +103,8 @@ class SpanRecorder:
         with self._lock:
             if tid not in self._thread_names:
                 self._thread_names[tid] = threading.current_thread().name
-            self._events.append(('i', name, CLOCK(), 0.0, tid, attrs or None))
+            self._events.append(('i', name, CLOCK(), 0.0, tid,
+                                 attrs or None, None))
             self._appended += 1
 
     # -- export --------------------------------------------------------------
@@ -97,35 +117,48 @@ class SpanRecorder:
 
     def origin(self) -> float:
         """This recorder's ts=0 reference: its epoch or the earliest
-        recorded start, whichever is older — a span timed just before
-        the recorder attached must not export a negative timestamp."""
+        start ever recorded, whichever is older — a span timed just
+        before the recorder attached must not export a negative
+        timestamp. O(1): the minimum is tracked at append time (the
+        /trace route calls this per recorder on a request path)."""
         with self._lock:
-            return min([self._t0]
-                       + [ts for _, _, ts, _, _, _ in self._events])
+            return min(self._t0, self._min_ts)
 
-    def snapshot(self, origin: Optional[float] = None
-                 ) -> List[Dict[str, Any]]:
+    def snapshot(self, origin: Optional[float] = None,
+                 limit: Optional[int] = None) -> List[Dict[str, Any]]:
         """The buffered events as Chrome trace-event dicts, ts-sorted.
 
         ``origin`` overrides the ts=0 reference — multi-recorder merges
         (``merge_traces``) pass one common origin so recorders created
         at different times stay aligned on one timeline (CLOCK is the
-        shared process-wide ``perf_counter``)."""
+        shared process-wide ``perf_counter``).
+
+        ``limit`` bounds the snapshot to the MOST RECENT ``limit``
+        events: on-demand consumers (the serve ``/trace`` route, the
+        black-box dumper) must never serialize the full 200K-event ring
+        under the recorder lock on a request path."""
         with self._lock:
-            events = list(self._events)
+            if limit is not None and limit < len(self._events):
+                from itertools import islice
+                events = list(islice(self._events,
+                                     len(self._events) - int(limit),
+                                     len(self._events)))
+            else:
+                events = list(self._events)
             names = dict(self._thread_names)
-        pid = os.getpid()
-        if origin is None:
-            origin = min([self._t0] + [ts for _, _, ts, _, _, _ in events])
+            if origin is None:
+                origin = min(self._t0, self._min_ts)
+        own_pid = os.getpid()
         out: List[Dict[str, Any]] = []
         for tid, tname in sorted(names.items()):
             out.append({'name': 'thread_name', 'ph': 'M', 'ts': 0,
-                        'pid': pid, 'tid': tid,
+                        'pid': own_pid, 'tid': tid,
                         'args': {'name': tname}})
         body = []
-        for ph, name, ts, dur, tid, attrs in events:
+        for ph, name, ts, dur, tid, attrs, pid in events:
             ev: Dict[str, Any] = {
-                'name': name, 'ph': ph, 'pid': pid, 'tid': tid,
+                'name': name, 'ph': ph,
+                'pid': pid if pid is not None else own_pid, 'tid': tid,
                 'ts': round((ts - origin) * 1e6, 3),
             }
             if ph == 'X':
@@ -158,11 +191,25 @@ class SpanRecorder:
         return path
 
 
+# bytes attrs render at most this many bytes: a span arg is provenance,
+# not payload — an accidental frame buffer must not balloon the export
+_BYTES_RENDER_CAP = 256
+
+
 def _jsonable(v: Any) -> Any:
     """JSON-safe projection shared by span args and the run manifest
     (obs/manifest imports this — one implementation to drift)."""
     if isinstance(v, (str, int, float, bool)) or v is None:
         return v
+    if isinstance(v, (bytes, bytearray)):
+        # ASCII-safe decode, NOT str(): repr would export "b'...'"
+        # wrappers into traces/manifests, and a stray binary blob would
+        # export escape soup of unbounded size — cap and say so
+        head = bytes(v[:_BYTES_RENDER_CAP])
+        text = head.decode('ascii', 'backslashreplace')
+        if len(v) > _BYTES_RENDER_CAP:
+            text += f'...(+{len(v) - _BYTES_RENDER_CAP} bytes)'
+        return text
     if isinstance(v, (list, tuple, set, frozenset)):
         return [_jsonable(x) for x in v]
     if isinstance(v, dict):
@@ -174,19 +221,23 @@ def _jsonable(v: Any) -> Any:
 NULL_RECORDER = SpanRecorder(capacity=1, enabled=False)
 
 
-def merge_traces(recorders: Iterable[SpanRecorder]) -> List[Dict[str, Any]]:
+def merge_traces(recorders: Iterable[SpanRecorder],
+                 limit: Optional[int] = None) -> List[Dict[str, Any]]:
     """One ts-sorted event list over several recorders (the serve daemon
     stitches every warm-pool worker's recorder into one drain export —
     ``export_merged`` below). All recorders share CLOCK, so one common
     origin (the oldest) keeps workers created hours apart correctly
-    offset on the merged timeline instead of each re-basing to 0."""
+    offset on the merged timeline instead of each re-basing to 0.
+    ``limit`` bounds each recorder's contribution to its most recent
+    events (request-path consumers: the ``/trace`` route, black-box
+    dumps)."""
     recorders = list(recorders)
     if not recorders:
         return []
     origin = min(rec.origin() for rec in recorders)
     events: List[Dict[str, Any]] = []
     for rec in recorders:
-        events.extend(rec.snapshot(origin=origin))
+        events.extend(rec.snapshot(origin=origin, limit=limit))
     events.sort(key=lambda e: (e['ph'] != 'M', e['ts']))
     return events
 

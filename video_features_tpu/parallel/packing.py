@@ -52,6 +52,7 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from video_features_tpu.obs.context import trace_attrs, trace_ids_of
 from video_features_tpu.obs.events import event
 from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
 
@@ -112,14 +113,20 @@ class VideoTask:
 
     __slots__ = ('path', 'video_id', 'rows', 'meta_rows', 'info',
                  'emitted', 'done', 'exhausted', 'failed', 'skipped',
-                 'cached', 'out_root', 'finalized', 'segment')
+                 'cached', 'out_root', 'finalized', 'segment', 'trace')
 
     def __init__(self, path: str, video_id: int = -1,
                  out_root: Optional[str] = None,
-                 segment: Optional[tuple] = None) -> None:
+                 segment: Optional[tuple] = None,
+                 trace=None) -> None:
         self.path = path
         self.video_id = video_id
         self.out_root = out_root
+        # request-scoped trace context (obs/context.TraceContext, or
+        # None for legacy CLI tasks): every span/instant this task's
+        # work produces carries its trace_id/span_id, so one request's
+        # timeline is a single filter over the merged export
+        self.trace = trace
         # optional (start_s, end_s) time range (segment queries): the
         # windower decodes/extracts only the covered windows, outputs
         # are named via name_path, and the cache keys on the range.
@@ -211,6 +218,13 @@ def packed_batches(windows: Iterable[tuple], batch: int,
                                     for t, _, _ in pool}),
                   'valid': valid, 'capacity': batch}
                  if tracer.enabled else {})
+        if tracer.enabled:
+            # batch spans serve several requests at once: carry the SET
+            # of trace ids so a per-request trace filter still finds the
+            # shared pack/model/d2h work it rode on
+            tids = trace_ids_of(t for t, _, _ in pool)
+            if tids:
+                attrs['trace_ids'] = tids
         with tracer.stage('pack', **attrs):
             wins = [w for _, w, _ in pool]
             while len(wins) < batch:
@@ -396,18 +410,30 @@ def run_packed(ex, video_paths: Iterable,
     open_q: List[VideoTask] = []
     n_started = [0]
 
+    # the extractor's run-level trace context (CLI runs with trace_out:
+    # configure_obs mints one — "a CLI run is one request"): bare paths
+    # wrap into tasks carrying a child span under it, so the packed
+    # path's spans are trace-filterable exactly like serve requests'.
+    # Pre-built tasks (serve) already carry their request's context.
+    run_ctx = getattr(ex, 'trace_ctx', None)
+
     def task_stream() -> Iterator:
         for item in video_paths:
             if item is FLUSH:
                 yield FLUSH
                 continue
-            task = item if isinstance(item, VideoTask) else VideoTask(item)
+            task = (item if isinstance(item, VideoTask)
+                    else VideoTask(item,
+                                   trace=(run_ctx.child()
+                                          if run_ctx is not None
+                                          else None)))
             task.video_id = n_started[0]
             n_started[0] += 1
             open_q.append(task)
             if recorder is not None:
                 recorder.instant('video_start', video=str(task.path),
-                                 request_id=_request_id(task))
+                                 request_id=_request_id(task),
+                                 **trace_attrs(task))
             yield task
 
     def admit(task: VideoTask) -> bool:
@@ -477,7 +503,8 @@ def run_packed(ex, video_paths: Iterable,
                 # window through on_window — nothing to save or publish
                 feats_dict = ex._maybe_concat_streams(ex.packed_result(t))
                 with ex.tracer.stage('save', video=str(t.path),
-                                     request_id=_request_id(t)):
+                                     request_id=_request_id(t),
+                                     **trace_attrs(t)):
                     if t.out_root is not None:
                         ex.action_on_extraction(feats_dict, t.name_path,
                                                 output_path=t.out_root)
@@ -506,7 +533,8 @@ def run_packed(ex, video_paths: Iterable,
             if recorder is not None:
                 recorder.instant('video_done', video=str(t.path),
                                  outcome=outcome,
-                                 request_id=_request_id(t))
+                                 request_id=_request_id(t),
+                                 **trace_attrs(t))
             if manifest is not None:
                 manifest.video_done(t.path, outcome)
             if on_video_done is not None:
@@ -570,6 +598,12 @@ def run_packed(ex, video_paths: Iterable,
             farm = DecodeFarm(
                 recipe, workers=n_decode,
                 ring_bytes=ring_mb * (1 << 20), tracer=ex.tracer,
+                # post-mortem target (obs/blackbox.py): a dead decode
+                # worker dumps a bundle alongside the respawn
+                blackbox=getattr(ex, 'blackbox', None),
+                # stall-watchdog feed (obs/watchdog.py): per-worker
+                # assignment backlog, mirrored on the supervise tick
+                pending_cb=getattr(ex, 'watchdog_pending', None),
                 cache_key_fn=(ex._video_cache_key
                               if getattr(ex, 'cache', None) is not None
                               else None),
@@ -624,12 +658,13 @@ def run_packed(ex, video_paths: Iterable,
                 ex.tracer.add('decode+preprocess',
                               _time.perf_counter() - t0, t0=t0)
             else:
-                # span provenance: the video (and serve request) this
-                # decode slice worked for
+                # span provenance: the video (and serve request + trace)
+                # this decode slice worked for
                 ex.tracer.add('decode+preprocess',
                               _time.perf_counter() - t0, t0=t0,
                               video=str(item[0].path),
-                              request_id=_request_id(item[0]))
+                              request_id=_request_id(item[0]),
+                              **trace_attrs(item[0]))
             yield item
 
     # the farm traces per-worker 'decode' spans from the workers' own
@@ -648,8 +683,17 @@ def run_packed(ex, video_paths: Iterable,
     from collections import deque
     depth = max(int(inflight if inflight is not None
                     else getattr(ex, 'inflight', 1) or 1), 1)
-    pending: 'deque' = deque()   # (out_dev, prov, valid, batch_videos)
+    # (out_dev, prov, valid, batch_videos, batch_traces)
+    pending: 'deque' = deque()
     ex._inflight_now = 0
+
+    def batch_trace_ids(prov) -> Optional[list]:
+        """Distinct trace ids riding this batch (tracing on only) — the
+        model/d2h spans carry them so a per-request trace filter finds
+        the shared device work too."""
+        if not ex.tracer.enabled:
+            return None
+        return trace_ids_of(t for t, _ in prov) or None
 
     def doom_batch(prov, batch_videos, valid, stage):
         # fault isolation (shared by the dispatch and sync sites): a
@@ -670,12 +714,16 @@ def run_packed(ex, video_paths: Iterable,
         own ``d2h`` stage — readback must not launder into compute time)
         plus row scatter; asynchronously raised execution faults surface
         here and doom only this batch's videos."""
-        out_dev, prov, valid, batch_videos = pending.popleft()
+        out_dev, prov, valid, batch_videos, batch_traces = \
+            pending.popleft()
         ex._inflight_now = len(pending)
         try:
-            with ex.tracer.stage('d2h', videos=batch_videos,
-                                 valid=valid, capacity=batch,
-                                 **mesh_attrs(valid)):
+            with ex.tracer.stage(
+                    'd2h', videos=batch_videos, valid=valid,
+                    capacity=batch,
+                    **({'trace_ids': batch_traces} if batch_traces
+                       else {}),
+                    **mesh_attrs(valid)):
                 out = ex.fetch_outputs(out_dev)
         except KeyboardInterrupt:
             raise
@@ -736,14 +784,18 @@ def run_packed(ex, video_paths: Iterable,
             # the error path below rebuilds the list lazily if needed
             batch_videos = (sorted({str(t.path) for t, _ in prov})
                             if ex.tracer.enabled else None)
+            batch_traces = batch_trace_ids(prov)
             try:
                 # 'model' times dispatch + any compute the backend runs
                 # synchronously; the wait-for-results tail lands on the
                 # 'd2h' stage at the sync point (their shares sum to the
                 # old all-in 'model' share)
-                with ex.tracer.stage('model', videos=batch_videos,
-                                     valid=valid, capacity=batch,
-                                     **mesh_attrs(valid)):
+                with ex.tracer.stage(
+                        'model', videos=batch_videos, valid=valid,
+                        capacity=batch,
+                        **({'trace_ids': batch_traces} if batch_traces
+                           else {}),
+                        **mesh_attrs(valid)):
                     out = ex.packed_step(dev)
             except KeyboardInterrupt:
                 raise
@@ -766,7 +818,8 @@ def run_packed(ex, video_paths: Iterable,
                     if identity not in costed:
                         costed[identity] = (tuple(shape),
                                             getattr(dev, 'dtype', None))
-            pending.append((out, prov, valid, batch_videos))
+            pending.append((out, prov, valid, batch_videos,
+                            batch_traces))
             ex._inflight_now = len(pending)
             while len(pending) >= depth:
                 sync_oldest()

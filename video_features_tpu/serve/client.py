@@ -92,14 +92,17 @@ class ServeClient:
                overrides: Optional[Dict[str, Any]] = None,
                timeout_s: Optional[float] = None,
                range_s: Optional[List[float]] = None,
-               priority: Optional[str] = None) -> str:
+               priority: Optional[str] = None,
+               traceparent: Optional[str] = None) -> str:
         """Enqueue one extraction request; returns its request_id.
         Raises :class:`ServeError` on rejection (queue_full, draining,
         invalid config, …) — backpressure is the caller's to handle.
         ``range_s=[start_s, end_s]`` makes it a segment query (only the
         covered windows decode; outputs named ``_seg<a>-<b>ms``);
         ``priority`` ('interactive' | 'batch') feeds admission — a
-        saturated queue sheds batch before interactive."""
+        saturated queue sheds batch before interactive; ``traceparent``
+        (W3C ``00-<trace>-<span>-<flags>``) joins the request to a
+        caller-owned distributed trace (minted server-side otherwise)."""
         msg: Dict[str, Any] = {'cmd': 'submit', 'feature_type': feature_type,
                                'video_paths': list(video_paths)}
         if overrides:
@@ -110,10 +113,20 @@ class ServeClient:
             msg['range'] = [float(range_s[0]), float(range_s[1])]
         if priority is not None:
             msg['priority'] = str(priority)
+        if traceparent is not None:
+            msg['traceparent'] = str(traceparent)
         return self._call(msg)['request_id']
 
     def status(self, request_id: str) -> Dict[str, Any]:
         return self._call({'cmd': 'status', 'request_id': request_id})
+
+    def trace(self, request_id: str) -> Dict[str, Any]:
+        """The request's assembled span timeline: ``{request_id,
+        trace_id, state, events}`` — every recorded span/instant across
+        the server's live recorders carrying the request's trace id
+        (requires the server to run with a ``trace_out`` base override;
+        empty otherwise)."""
+        return self._call({'cmd': 'trace', 'request_id': request_id})
 
     def wait(self, request_id: str, timeout_s: float = 300.0,
              poll_s: float = 0.05) -> Dict[str, Any]:

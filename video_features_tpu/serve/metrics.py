@@ -108,6 +108,8 @@ def build_metrics(started_at: float,
                   inflight_batches: int = 0,
                   farm_stats: Optional[Dict[str, Any]] = None,
                   ingress_stats: Optional[Dict[str, Any]] = None,
+                  trace_stats: Optional[Dict[str, Any]] = None,
+                  watchdog_stats: Optional[Dict[str, Any]] = None,
                   ) -> Dict[str, Any]:
     """Assemble the one metrics document. ``stage_reports`` maps a
     human-readable pool-entry label → that entry's ``Tracer.report()``;
@@ -145,6 +147,23 @@ def build_metrics(started_at: float,
                       else {'enabled': False, 'requests_total': 0,
                             'shed_total': 0, 'live_sessions': 0,
                             'open_connections': 0, 'tenants': {}})
+    # structured-event accounting (obs/events): lifetime counts per
+    # (level, subsystem) — the vft_events_total mirror's source; always
+    # present so scrapers see a stable schema
+    from video_features_tpu.obs.events import event_counts
+    counts = {f'{level}/{subsystem}': n
+              for (level, subsystem), n in sorted(event_counts().items())}
+    doc['events'] = {'total': sum(counts.values()), 'counts': counts}
+    # span-ring view (vft-flight): live recorders + events lost to ring
+    # wrap — today only visible in the Chrome-trace footer, invisible
+    # to scrapers without this
+    doc['trace'] = (trace_stats if trace_stats is not None
+                    else {'recorders': 0, 'events_dropped': 0})
+    # stall watchdog (obs/watchdog): the progress-ledger view, or the
+    # stable disabled shape on servers without watchdog_stall_s
+    doc['watchdog'] = (watchdog_stats if watchdog_stats is not None
+                       else {'enabled': False, 'stalls_total': 0,
+                             'workers': {}})
     doc.update(request_stats.snapshot())
     doc['stages'] = {label: rep for label, rep in stage_reports.items()}
     doc['stages_merged'] = merge_reports(stage_reports.values())
@@ -192,6 +211,32 @@ def prometheus_text(doc: Dict[str, Any],
             g(f'vft_farm_{key}',
               'decode farm accounting (merged across warm workers)'
               ).set(value)
+    # monotonic mirrors (counter semantics, hence _total names): the
+    # document carries lifetime totals; the registry counter advances by
+    # the delta so repeated renders never double-count and a recorder
+    # aging out of the bounded deque (sum dips) never decrements
+    def _mirror_counter(name: str, help_text: str, total: float,
+                        labels: Optional[Dict[str, str]] = None) -> None:
+        c = registry.counter(name, help_text, labels=labels)
+        delta = float(total) - c.value
+        if delta > 0:
+            c.inc(delta)
+
+    for key, n in ((doc.get('events') or {}).get('counts') or {}).items():
+        level, _, subsystem = key.partition('/')
+        _mirror_counter('vft_events_total',
+                        'structured events by level and subsystem '
+                        '(obs/events)', n,
+                        labels={'level': level,
+                                'subsystem': subsystem or 'core'})
+    _mirror_counter('vft_trace_events_dropped_total',
+                    'span-ring events lost to ring-buffer wrap across '
+                    'the live recorders', (doc.get('trace') or {}
+                                           ).get('events_dropped', 0))
+    wd = doc.get('watchdog') or {}
+    g('vft_watchdog_enabled',
+      '1 when the stall watchdog is armed, else 0').set(
+          1 if wd.get('enabled') else 0)
     for stage, rep in (doc.get('stages_merged') or {}).items():
         # gauge family names deliberately avoid the _total suffix
         # (reserved for counter semantics): these mirror a point-in-time

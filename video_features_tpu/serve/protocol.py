@@ -26,8 +26,15 @@ Commands (the ``cmd`` field):
     and outputs are named ``<stem>_seg<start>-<end>ms``. ``priority``
     (``interactive``, the default, or ``batch``) feeds admission
     control: a saturated queue sheds ``batch`` before ``interactive``.
+    ``traceparent`` (optional, W3C ``00-<trace>-<span>-<flags>``) joins
+    the request to a caller-owned distributed trace; absent or
+    malformed, the server mints one. The submit response echoes the
+    ``trace_id`` either way.
   * ``status``  — ``{cmd, request_id}`` → per-request state + per-video
     states (see ``serve.server.Request.snapshot``).
+  * ``trace``   — ``{cmd, request_id}`` → ``{ok, request_id, trace_id,
+    events}``: the request's assembled span timeline, filtered from the
+    live recorders (``serve.server.ExtractionServer.request_trace``).
   * ``metrics`` — ``{cmd}`` → the live metrics document
     (``docs/serving.md`` schema).
   * ``metrics_prom`` — ``{cmd}`` → ``{ok, text}``: the same state as
@@ -40,7 +47,8 @@ from __future__ import annotations
 import json
 from typing import Any, Dict
 
-COMMANDS = ('submit', 'status', 'metrics', 'metrics_prom', 'drain', 'ping')
+COMMANDS = ('submit', 'status', 'trace', 'metrics', 'metrics_prom',
+            'drain', 'ping')
 
 # wire protocol version this build speaks; MAJOR is the compatibility
 # gate (minor bumps are additive-fields-only and never rejected)
@@ -50,7 +58,7 @@ MAJOR = 1
 # submit() fields copied verbatim into the request (everything else in the
 # message is rejected — catches client/server schema drift loudly)
 SUBMIT_FIELDS = ('cmd', 'v', 'feature_type', 'video_paths', 'overrides',
-                 'timeout_s', 'range', 'priority')
+                 'timeout_s', 'range', 'priority', 'traceparent')
 
 PRIORITIES = ('interactive', 'batch')
 

@@ -30,6 +30,7 @@ re-extract on restart via the unchanged resume contract.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import queue
@@ -42,8 +43,9 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from video_features_tpu.config import (
-    Config, knob_exclude, load_config, split_serve_config,
+    OBS_DEFAULTS, Config, knob_exclude, load_config, split_serve_config,
 )
+from video_features_tpu.obs.context import accept_traceparent
 from video_features_tpu.obs.events import event
 from video_features_tpu.parallel.packing import FLUSH, VideoTask
 from video_features_tpu.registry import (
@@ -59,6 +61,11 @@ _CLOSE = object()
 # week-long daemon's request table stays bounded (same reasoning as
 # metrics.LATENCY_WINDOW)
 REQUEST_HISTORY = 4096
+
+# per-recorder span bound for the /trace assembly: the route reads the
+# RECENT window of each ring, never the full 200K events under the
+# recorder lock on a request path
+TRACE_ROUTE_SPAN_LIMIT = 50_000
 
 # config keys that do NOT change the compiled program, the weights, or
 # the worker's run behavior — everything else lands in the pool key.
@@ -93,13 +100,17 @@ def resolve_mesh_devices(args: Config) -> Config:
 
 
 class _ServeTask(VideoTask):
-    """A packed-scheduler task carrying its originating request."""
+    """A packed-scheduler task carrying its originating request. Each
+    task gets its own child span under the request's trace, so the
+    merged timeline distinguishes per-video work inside one request."""
 
     __slots__ = ('request',)
 
     def __init__(self, path: str, request: 'Request',
                  out_root: str, segment=None) -> None:
-        super().__init__(path, out_root=out_root, segment=segment)
+        super().__init__(path, out_root=out_root, segment=segment,
+                         trace=(request.trace.child()
+                                if request.trace is not None else None))
         self.request = request
 
 
@@ -132,7 +143,8 @@ class Request:
     def __init__(self, request_id: str, feature_type: str, paths: List[str],
                  deadline: Optional[float],
                  segment: Optional[tuple] = None,
-                 priority: str = 'interactive') -> None:
+                 priority: str = 'interactive',
+                 trace=None) -> None:
         self.id = request_id
         self.feature_type = feature_type
         self.videos: Dict[str, str] = {p: 'pending' for p in paths}
@@ -140,6 +152,10 @@ class Request:
         self.deadline = deadline          # monotonic, None = no deadline
         self.segment = segment            # (start_s, end_s) | None
         self.priority = priority
+        # request-scoped trace context (obs/context.TraceContext):
+        # accepted from the caller's traceparent or minted at admission;
+        # every task span derives a child from it
+        self.trace = trace
         self.t0 = time.monotonic()
         self.done_t: Optional[float] = None
 
@@ -160,6 +176,8 @@ class Request:
         out = {'request_id': self.id, 'state': self.state(),
                'feature_type': self.feature_type,
                'videos': dict(self.videos)}
+        if self.trace is not None:
+            out['trace_id'] = self.trace.trace_id
         if self.segment is not None:
             out['range'] = [float(self.segment[0]), float(self.segment[1])]
         if self.priority != 'interactive':
@@ -167,6 +185,9 @@ class Request:
         if self.done_t is not None:
             out['latency_s'] = round(self.done_t - self.t0, 4)
         return out
+
+
+_WD_SEQ = itertools.count(1)
 
 
 class _Worker:
@@ -179,6 +200,13 @@ class _Worker:
         self.server = server
         self.key = key
         self.label = label
+        # watchdog ledger key: labels COLLIDE across pool entries (two
+        # entries for one family with different overrides — metrics()
+        # disambiguates the same collision with '#i'), and a shared row
+        # would let worker B's advances mask worker A's stall and a
+        # retirement delete a live sibling's state — so every worker
+        # gets a process-unique key (itertools.count: atomic, no lock)
+        self.wd_key = f'{label}#{next(_WD_SEQ)}'
         self.ex = extractor
         self.idle_flush_s = idle_flush_s
         self.max_batch_wait_s = max_batch_wait_s
@@ -199,6 +227,7 @@ class _Worker:
     def submit(self, tasks: List[_ServeTask]) -> None:
         with self._lock:
             self.outstanding.update(tasks)
+        self.server._wd_pending(self)
         for t in tasks:
             self.queue.put(t)
         if self.crashed:
@@ -250,6 +279,9 @@ class _Worker:
             if task.request.expired():
                 with self._lock:
                     self.outstanding.discard(task)
+                # republish the watchdog ledger: an all-expired backlog
+                # must read as pending=0, not as a stalled worker
+                self.server._wd_pending(self)
                 self.server._video_expired(task)
                 continue
             if was_idle:
@@ -268,6 +300,7 @@ class _Worker:
     def _on_video_done(self, task) -> None:
         with self._lock:
             self.outstanding.discard(task)
+        self.server._wd_pending(self)
         self.server._video_done(task)
 
     def _run(self) -> None:
@@ -302,6 +335,12 @@ class _Worker:
                 task.failed = True
                 self.server._video_done(task)
             self.server._retire_crashed(self)
+            # post-mortem bundle AFTER the stranded videos failed and
+            # the entry retired — the dump is telemetry, the recovery
+            # above is the contract; never raises, off the hot path
+            self.server._dump_blackbox('serve_worker_crash',
+                                       label=self.label,
+                                       stranded=len(stranded))
 
 
 class ExtractionServer:
@@ -382,6 +421,42 @@ class ExtractionServer:
         # merged drain export; bounded like the ring buffers themselves
         # so lifetime churn can't grow it without limit
         self._trace_recorders: 'deque' = deque(maxlen=32)
+        # LONG-LIVED recorders (the server's own admission-span recorder,
+        # the ingress gateway's) live OUTSIDE the churn deque: >32 warm
+        # builds over a daemon's lifetime must age out old WORKER
+        # recorders, never the admission/ingress spans every /trace
+        # assembly, drain export, and black-box bundle depends on
+        self._persistent_recorders: List = []
+        # the server's own recorder (admission spans + /trace assembly),
+        # present only when the base trace_out is configured — same
+        # gating as the workers' recorders
+        self._server_recorder = None
+        if self.base_overrides.get('trace_out'):
+            from video_features_tpu.obs.spans import SpanRecorder
+            self._server_recorder = SpanRecorder()
+            self._persistent_recorders.append(self._server_recorder)
+        # vft-flight: crash-dump black box (postmortem_dir base
+        # override) + stall watchdog (watchdog_stall_s). Both are
+        # telemetry: absent knobs = exactly today's behavior.
+        self.blackbox = None
+        if self.base_overrides.get('postmortem_dir'):
+            from video_features_tpu.obs.blackbox import BlackBox
+            max_bytes = self.base_overrides.get('postmortem_max_bytes')
+            self.blackbox = BlackBox(
+                str(self.base_overrides['postmortem_dir']),
+                max_bytes=(int(max_bytes) if max_bytes is not None
+                           else OBS_DEFAULTS['postmortem_max_bytes']),
+                recorders=self._all_recorders,
+                metrics_fn=self._metrics_for_blackbox,
+                prom_fn=lambda: self._prometheus(
+                    self._metrics_for_blackbox()))
+        self.watchdog = None
+        if self.base_overrides.get('watchdog_stall_s'):
+            from video_features_tpu.obs.watchdog import StallWatchdog
+            self.watchdog = StallWatchdog(
+                float(self.base_overrides['watchdog_stall_s']),
+                on_stall=self._on_stall,
+                registry=self.registry).start()
         self._draining = False
         self._drained = threading.Event()
         self._sock: Optional[socket.socket] = None
@@ -480,6 +555,11 @@ class ExtractionServer:
                 except Exception:
                     event(logging.WARNING, 'ingress finish_drain failed',
                           subsystem='serve', exc_info=True)
+            if self.watchdog is not None:
+                # stop BEFORE the final exports: a drain-quiesced worker
+                # with close-sentinel queue state must not read as a
+                # stall while the monitor races shutdown
+                self.watchdog.stop()
             doc = self.metrics()
             metrics_mod.write_metrics_file(self.metrics_path, doc,
                                            prom_text=self._prometheus(doc))
@@ -511,8 +591,7 @@ class ExtractionServer:
         path = self.base_overrides.get('trace_out')
         if not path:
             return
-        with self._lock:
-            recorders = list(self._trace_recorders)
+        recorders = self._all_recorders()
         if not recorders:
             return
         try:
@@ -524,6 +603,141 @@ class ExtractionServer:
             from video_features_tpu.obs.events import event
             event(logging.WARNING, 'merged trace export failed',
                   subsystem='serve', exc_info=True, path=str(path))
+
+    # -- vft-flight: watchdog + black box ------------------------------------
+
+    def _all_recorders(self) -> List:
+        """Every live span recorder: the long-lived server/ingress ones
+        plus the (bounded, churn-evicted) worker recorders."""
+        with self._lock:
+            return (list(self._persistent_recorders)
+                    + list(self._trace_recorders))
+
+    def _wd_pending(self, worker: '_Worker') -> None:
+        """Mirror a worker's outstanding-task count into the watchdog
+        ledger (no-op without a watchdog)."""
+        if self.watchdog is None:
+            return
+        # set_pending runs UNDER the worker lock: reading the count and
+        # publishing it must be one atomic step, or a concurrent
+        # submit/done pair can land their publishes out of order and
+        # leave the ledger at a value outstanding never had (stale >0 =
+        # spurious stall; stale 0 = masked wedge). Safe nesting: the
+        # watchdog's own lock is a leaf — nothing inside it ever takes
+        # a worker lock.
+        with worker._lock:
+            self.watchdog.set_pending(worker.wd_key,
+                                      len(worker.outstanding))
+
+    def _wire_watchdog(self, worker: '_Worker') -> None:
+        """Feed the watchdog's progress ledger from the worker's tracer
+        — the SAME instrumentation sites as the stage table/timeline.
+        Farm decode workers get their own sub-rows (``label/farm-wN``)
+        via the ``worker=`` span attr the farm already stamps."""
+        if self.watchdog is None:
+            return
+        from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
+        if worker.ex.tracer is NULL_TRACER or not worker.ex.tracer.enabled:
+            # serve forces profile=True at admission so this never fires
+            # on the normal path — but a disabled tracer would mean an
+            # armed watchdog with pending work and NO advances (every
+            # busy worker reads as stalled), and hooking the shared
+            # NULL_TRACER singleton would leak across extractors
+            worker.ex.tracer = Tracer(enabled=True)
+        wd, wd_key = self.watchdog, worker.wd_key
+
+        def _progress(stage: str, farm_worker=None) -> None:
+            wd.advance(wd_key, stage)
+            if farm_worker is not None:
+                wd.advance(f'{wd_key}/farm-w{farm_worker}', stage)
+
+        worker.ex.tracer.progress = _progress
+        # farm decode workers' QUEUED work: the farm mirrors each
+        # worker's assignment backlog on its supervise tick, so a single
+        # wedged farm worker trips its own row even while siblings keep
+        # the serve-level row advancing
+        worker.ex.watchdog_pending = (
+            lambda widx, n: wd.set_pending(f'{wd_key}/farm-w{widx}',
+                                           int(n)))
+
+    def _wd_forget(self, worker: '_Worker') -> None:
+        if self.watchdog is not None:
+            self.watchdog.forget(worker.wd_key)
+            # farm sub-rows retire with their serve worker
+            self.watchdog.forget_prefix(worker.wd_key + '/')
+
+    def _on_stall(self, info: Dict[str, Any]) -> None:
+        """Watchdog trip: the structured event + counter already fired
+        (obs/watchdog.py); the server's contribution is the post-mortem
+        bundle."""
+        self._dump_blackbox('watchdog_stall', **info)
+
+    def _dump_blackbox(self, reason: str, **extra: Any) -> None:
+        """Write a post-mortem bundle (no-op without postmortem_dir;
+        never raises; never on the request hot path — callers are crash
+        handlers and the watchdog monitor thread)."""
+        if self.blackbox is None:
+            return
+        if self.watchdog is not None:
+            extra.setdefault('watchdog', self.watchdog.snapshot())
+        self.blackbox.dump(reason, **extra)
+
+    def _metrics_for_blackbox(self) -> Dict[str, Any]:
+        """The metrics document for a dump — with a lock PROBE first: a
+        dump often documents a wedge, and if the admission lock is what
+        wedged, the bundle must skip this section rather than hang on
+        it (BlackBox treats the raise as a best-effort section miss)."""
+        if not self._lock.acquire(timeout=2.0):
+            raise RuntimeError(
+                'admission lock unavailable; skipping metrics section')
+        self._lock.release()
+        return self.metrics()
+
+    def _record_admission(self, t0: float, req: Request,
+                          **attrs: Any) -> None:
+        """The 'admission' span: submit-call wall time under the
+        request's trace (server recorder; present only with a base
+        trace_out, like every other recorder)."""
+        rec = self._server_recorder
+        if rec is None:
+            return
+        rec.span('admission', t0, time.perf_counter(),
+                 request_id=req.id, feature_type=req.feature_type,
+                 priority=req.priority,
+                 **(req.trace.attrs() if req.trace is not None else {}),
+                 **attrs)
+
+    def request_trace(self, request_id: str) -> Dict[str, Any]:
+        """One request's assembled span timeline: every event across the
+        live recorders (workers, ingress, the server's own admission
+        spans) carrying the request's trace_id — directly
+        (``trace_id``), as a shared-batch member (``trace_ids``), or by
+        ``request_id``. Bounded per recorder (TRACE_ROUTE_SPAN_LIMIT);
+        events older than the rings have wrapped out (flight-recorder
+        semantics, same as the export)."""
+        with self._lock:
+            req = self._requests.get(request_id)
+        recorders = self._all_recorders()
+        if req is None:
+            return protocol.error(f'unknown request_id {request_id!r}')
+        ctx = req.trace
+        trace_id = ctx.trace_id if ctx is not None else None
+        events: List[Dict[str, Any]] = []
+        if recorders and trace_id is not None:
+            origin = min(r.origin() for r in recorders)
+            for rec in recorders:
+                for e in rec.snapshot(origin=origin,
+                                      limit=TRACE_ROUTE_SPAN_LIMIT):
+                    if e.get('ph') == 'M':
+                        continue
+                    args = e.get('args') or {}
+                    if args.get('trace_id') == trace_id \
+                            or trace_id in (args.get('trace_ids') or ()) \
+                            or args.get('request_id') == request_id:
+                        events.append(e)
+            events.sort(key=lambda e: e['ts'])
+        return protocol.ok(request_id=request_id, trace_id=trace_id,
+                           state=req.state(), events=events)
 
     # -- admission + dispatch ------------------------------------------------
 
@@ -565,7 +779,13 @@ class ExtractionServer:
                timeout_s: Optional[float] = None,
                range_s=None,
                priority: str = 'interactive',
+               traceparent: Optional[str] = None,
                _live_session=None) -> Dict[str, Any]:
+        # request-scoped trace context: adopt the caller's W3C
+        # traceparent or mint one — minted EARLY so even the admission
+        # span of a rejected submit has an identity to hang on
+        t0_admit = time.perf_counter()
+        trace_ctx = accept_traceparent(traceparent)
         if not isinstance(video_paths, (list, tuple)) or not video_paths:
             self.stats.bump('rejected')
             return protocol.error('video_paths must be a non-empty list')
@@ -649,15 +869,18 @@ class ExtractionServer:
             with self._lock:
                 self._next_id += 1
                 req = Request(f'r{self._next_id:06d}', feature_type, paths,
-                              None, segment=segment, priority=priority)
+                              None, segment=segment, priority=priority,
+                              trace=trace_ctx)
                 for p in paths:
                     req.videos[p] = 'cached'
                 req.pending = 0
                 self._requests[req.id] = req
                 self._record_done_locked(req)
             self.stats.bump('submitted')
+            self._record_admission(t0_admit, req, cached=len(paths))
             self._after_completion(req)
-            return protocol.ok(request_id=req.id)
+            return protocol.ok(request_id=req.id,
+                               trace_id=trace_ctx.trace_id)
 
         with self._lock:
             if self._draining:
@@ -709,6 +932,9 @@ class ExtractionServer:
                         # least-loaded chip(s) via the placer (a mesh
                         # entry takes mesh_devices chips)
                         worker.devices = self._place_extractor(extractor)
+                        # liveness ledger rides the tracer's progress
+                        # hook — wired before the first stage records
+                        self._wire_watchdog(worker)
                         worker.start()
                         rec = getattr(extractor.tracer, 'recorder', None)
                         with self._lock:
@@ -747,7 +973,8 @@ class ExtractionServer:
                             if timeout_s is not None else None)
                 self._next_id += 1
                 req = Request(f'r{self._next_id:06d}', feature_type, paths,
-                              deadline, segment=segment, priority=priority)
+                              deadline, segment=segment, priority=priority,
+                              trace=trace_ctx)
                 for p in cache_hits:
                     # already answered from cache above: terminal before
                     # the misses even enqueue
@@ -771,14 +998,17 @@ class ExtractionServer:
                 # and closed between admission and enqueue
                 worker.submit(tasks)
             self.stats.bump('submitted')
-            return protocol.ok(request_id=req.id)
+            self._record_admission(t0_admit, req, videos=len(miss_paths))
+            return protocol.ok(request_id=req.id,
+                               trace_id=trace_ctx.trace_id)
         self.stats.bump('rejected')
         return protocol.error('worker churn outpaced admission; retry')
 
     def submit_live(self, feature_type: str, session,
                     overrides: Optional[Dict[str, Any]] = None,
                     timeout_s: Optional[float] = None,
-                    priority: str = 'interactive') -> Dict[str, Any]:
+                    priority: str = 'interactive',
+                    traceparent: Optional[str] = None) -> Dict[str, Any]:
         """Admit one LIVE session: a long-lived request whose frames
         arrive over time (``session`` is an ``ingress.live.LiveSession``
         — or anything with ``pseudo_path``/``bind``/``windows``/
@@ -789,7 +1019,8 @@ class ExtractionServer:
         and features stream back out through it, per window."""
         return self.submit(feature_type, [session.pseudo_path],
                            overrides=overrides, timeout_s=timeout_s,
-                           priority=priority, _live_session=session)
+                           priority=priority, traceparent=traceparent,
+                           _live_session=session)
 
     def attach_ingress(self, ingress) -> None:
         """Register the network front door (``ingress/``) so drain can
@@ -888,6 +1119,7 @@ class ExtractionServer:
                 self._fold_retired_locked(w.ex.tracer.report())
                 self._retired.remove(w)
                 self._release_placement(w)
+                self._wd_forget(w)
 
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
@@ -940,13 +1172,23 @@ class ExtractionServer:
                       'metrics document degrades to enabled=False',
                       subsystem='serve', exc_info=True)
                 ingress_stats = None
+        # vft-flight telemetry: span-ring loss across the live
+        # recorders, the watchdog's progress-ledger view
+        recorders = self._all_recorders()
+        trace_stats = {'recorders': len(recorders),
+                       'events_dropped': sum(r.dropped
+                                             for r in recorders)}
+        watchdog_stats = (self.watchdog.snapshot()
+                          if self.watchdog is not None else None)
         return metrics_mod.build_metrics(
             self._started_at, depth, self.queue_depth, draining,
             pool_stats, self.stats, reports,
             cache_stats=merge_cache_stats(c.stats() for c in caches),
             inflight_batches=inflight_batches,
             farm_stats=merge_farm_stats(farms),
-            ingress_stats=ingress_stats)
+            ingress_stats=ingress_stats,
+            trace_stats=trace_stats,
+            watchdog_stats=watchdog_stats)
 
     # -- completion callbacks (worker threads) -------------------------------
 
@@ -1021,6 +1263,7 @@ class ExtractionServer:
             self.pool.remove(worker.key, worker)
             self._fold_retired_locked(worker.ex.tracer.report())
             self._release_placement(worker)
+            self._wd_forget(worker)
 
     # -- endpoint ------------------------------------------------------------
 
@@ -1071,9 +1314,12 @@ class ExtractionServer:
                                overrides=msg.get('overrides'),
                                timeout_s=msg.get('timeout_s'),
                                range_s=msg.get('range'),
-                               priority=msg.get('priority', 'interactive'))
+                               priority=msg.get('priority', 'interactive'),
+                               traceparent=msg.get('traceparent'))
         if cmd == 'status':
             return self.status(msg.get('request_id'))
+        if cmd == 'trace':
+            return self.request_trace(msg.get('request_id'))
         if cmd == 'metrics':
             return protocol.ok(metrics=self.metrics())
         if cmd == 'metrics_prom':
@@ -1103,6 +1349,11 @@ def serve_main(argv: List[str]) -> int:
         batch_shed_fraction=serve_cfg['serve_batch_shed_fraction'],
     ).start()
     server.install_signal_handlers()
+    if server.blackbox is not None:
+        # fatal-signal dumps (SIGQUIT/SIGABRT) compose with the graceful
+        # SIGTERM/SIGINT drain above — different signals, both covered
+        from video_features_tpu.obs.blackbox import install_signal_dump
+        install_signal_dump(server.blackbox)
     # machine-greppable endpoint line (tests and tooling scrape it)
     # vft-lint: ok=stdout-purity — the daemon's documented startup line
     # (docs/serving.md): clients scrape host:port from it; serve-mode

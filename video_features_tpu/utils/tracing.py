@@ -115,6 +115,12 @@ class Tracer:
     def __init__(self, enabled: bool = True, recorder=None) -> None:
         self.enabled = enabled
         self.recorder = recorder
+        # liveness hook (obs/watchdog.py): called with (stage, worker)
+        # on every recorded stage — the stall watchdog's progress ledger
+        # rides the SAME instrumentation sites as the table/timeline.
+        # ``worker`` is the farm worker index when the site carries one
+        # (the ``worker=`` span attr), else None.
+        self.progress = None
         self._lock = threading.Lock()
         self._stats: Dict[str, _StageStat] = {}
         self._order: List[str] = []
@@ -122,18 +128,30 @@ class Tracer:
     # -- recording -----------------------------------------------------------
 
     def add(self, name: str, dt: float, t0: Optional[float] = None,
+            span_pid: Optional[int] = None, span_tid: Optional[int] = None,
             **attrs) -> None:
         """Record ``dt`` seconds under ``name``. ``t0`` (the stage's
         ``time.perf_counter`` start, when the caller knows it) places the
         span on the timeline; without it the span is back-dated from
-        now."""
+        now. ``span_pid``/``span_tid`` override the span's recorded
+        process/thread identity (cross-process sites: the decode farm
+        records spans its workers measured)."""
         if not self.enabled:
             return
         rec = self.recorder
         if rec is not None and rec.enabled:
             if t0 is None:
                 t0 = time.perf_counter() - dt
-            rec.span(name, t0, t0 + dt, **attrs)
+            rec.span(name, t0, t0 + dt, pid=span_pid, tid=span_tid,
+                     **attrs)
+        progress = self.progress
+        if progress is not None:
+            try:
+                progress(name, attrs.get('worker'))
+            except Exception:
+                # vft-lint: ok=swallowed-exception — a broken liveness
+                # hook must not fail the hot loop it observes
+                pass
         with self._lock:
             stat = self._stats.get(name)
             if stat is None:
