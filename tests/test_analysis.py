@@ -30,7 +30,7 @@ from video_features_tpu.analysis.checks import (
     check_knob_registry_single_source, check_lock_order,
     check_recipe_picklable, check_spawn_purity, check_stage_vocabulary,
     check_stdout_purity, check_swallowed_exceptions,
-    check_thread_discipline,
+    check_thread_discipline, check_wire_literal,
 )
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
@@ -598,6 +598,93 @@ def test_lock_order_suppression_comment(tmp_path):
                 return q.get()
     '''})
     assert filter_suppressed(pkg, check_lock_order(pkg)) == []
+
+
+# -- wire-literal ------------------------------------------------------------
+
+_WIRE_HTTP = '''
+    OK = 200
+    NOT_FOUND = 404
+'''
+_WIRE_PROTOCOL = '''
+    CMD_PING = 'ping'
+    COMMANDS = (CMD_PING,)
+'''
+
+
+def test_wire_literal_flags_inline_status_int(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'ingress/http.py': _WIRE_HTTP,
+        'ingress/gateway.py': '''
+            from fixpkg.ingress.http import HttpError, NOT_FOUND
+
+            def route(resp):
+                resp.send_json(200, {'ok': True})
+                raise HttpError(NOT_FOUND, 'not_found', 'x')
+        ''',
+    })
+    findings = check_wire_literal(pkg)
+    assert [f.key for f in findings] == ['status:200']
+
+
+def test_wire_literal_flags_inline_command_string(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'serve/protocol.py': _WIRE_PROTOCOL,
+        'serve/server.py': '''
+            def dispatch(msg):
+                cmd = msg.get('cmd')
+                if cmd == 'ping':
+                    return {'ok': True}
+        ''',
+        'serve/client.py': '''
+            def ping(self):
+                return self._call({'cmd': 'ping'})
+        ''',
+    })
+    keys = {f.key for f in check_wire_literal(pkg)}
+    assert keys == {'cmd:ping'}
+    assert {f.file for f in check_wire_literal(pkg)} \
+        == {'serve/server.py', 'serve/client.py'}
+
+
+def test_wire_literal_clean_when_constants_are_used(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        'ingress/http.py': _WIRE_HTTP,
+        'serve/protocol.py': _WIRE_PROTOCOL,
+        'ingress/gateway.py': '''
+            from fixpkg.ingress.http import OK
+
+            def route(resp):
+                resp.send_json(OK, {'ok': True})
+        ''',
+        'serve/server.py': '''
+            from fixpkg.serve import protocol
+
+            def dispatch(msg):
+                if msg.get('cmd') == protocol.CMD_PING:
+                    return {'ok': True}
+        ''',
+    })
+    assert check_wire_literal(pkg) == []
+
+
+def test_wire_literal_defining_modules_are_exempt(tmp_path):
+    # http.py spells its own reason table with ints; protocol.py IS the
+    # command vocabulary — neither is a violation
+    pkg = make_pkg(tmp_path, {
+        'ingress/http.py': '''
+            OK = 200
+            NOT_FOUND = 404
+
+            class HttpError(Exception):
+                pass
+
+            def reject(resp):
+                resp.send_json(503, {'ok': False})
+        ''',
+        'serve/protocol.py': _WIRE_PROTOCOL,
+    })
+    assert check_wire_literal(pkg) == []
 
 
 # -- baseline ----------------------------------------------------------------

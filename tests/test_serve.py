@@ -50,6 +50,34 @@ RESNET_KEYS = ('resnet', 'fps', 'timestamps_ms')
 
 # -- pure units (no server, no jax) ------------------------------------------
 
+
+def test_check_version_minor_skew_accepted_major_rejected():
+    """The MAJOR/MINOR compatibility contract behind WIRE.lock.json's
+    bump semantics: VERSION is now '1.1' (the first real MINOR bump —
+    PR 8's versioning + PR 11's trace surface landed additively), and a
+    client speaking ANY unknown 1.x must keep working, while an unknown
+    major gets the structured rejection echoing its request_id."""
+    from video_features_tpu.serve import protocol
+
+    assert protocol.VERSION == '1.1'
+    assert protocol.MAJOR == 1
+    # minor skew is additive-fields-only by contract: never rejected,
+    # future minors included
+    assert protocol.check_version({'v': '1.0'}) is None
+    assert protocol.check_version({'v': '1.1'}) is None
+    assert protocol.check_version({'v': '1.7'}) is None
+    # pre-versioning clients (no v) keep working
+    assert protocol.check_version({'cmd': 'ping'}) is None
+    # unknown MAJOR: structured error naming both versions and echoing
+    # the message's request_id for client-side correlation
+    rej = protocol.check_version({'v': '2.0', 'request_id': 'r000042'})
+    assert rej is not None and rej['ok'] is False
+    assert '2.0' in rej['error'] and protocol.VERSION in rej['error']
+    assert rej['v'] == protocol.VERSION
+    assert rej['request_id'] == 'r000042'
+    # malformed versions fail loudly too, not as a parse error
+    assert protocol.check_version({'v': 'banana'})['ok'] is False
+
 def test_warm_pool_lru_hit_rate_and_graceful_eviction():
     from video_features_tpu.serve.pool import WarmPool
 

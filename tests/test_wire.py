@@ -1,0 +1,605 @@
+"""vft-wire (video_features_tpu/analysis/wire.py): the wire-contract
+checker itself.
+
+Same two layers as the vft-lint suite (tests/test_analysis.py):
+
+  * fixture packages with a MINIMAL wire surface, mutated per rule —
+    the checker must catch each planted drift/desync (and stay quiet on
+    the clean variant);
+  * the live codebase: the extracted surface must match the shipped
+    ``WIRE.lock.json`` exactly, and every cross-layer rule must be
+    green — the same gate CI's ``wire-check`` job enforces.
+
+Everything here is pure AST — no extractor builds, no jax, no sockets
+(tier-1 wall-clock budget: the one subprocess test is the analyzer
+itself, ~1 s). Runtime wire behavior lives in tests/test_serve.py and
+tests/test_ingress.py.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+from video_features_tpu.analysis.core import Package
+from video_features_tpu.analysis.wire import (
+    check_docs, check_error_echo, check_sync, default_lock_path,
+    diff_lock, extract_surface, load_lock, lock_view, main, write_lock,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PKG_ROOT = REPO_ROOT / 'video_features_tpu'
+
+
+# -- fixture wire package -----------------------------------------------------
+
+_PROTOCOL = '''
+    CMD_PING = 'ping'
+    CMD_SUBMIT = 'submit'
+    COMMANDS = (CMD_PING, CMD_SUBMIT)
+    VERSION = '1.0'
+    MAJOR = 1
+    SUBMIT_FIELDS = ('cmd', 'v', 'feature_type', 'video_paths',
+                     'timeout_s')
+    PRIORITIES = ('interactive', 'batch')
+
+
+    def check_version(msg):
+        v = msg.get('v')
+        if v is None:
+            return None
+        return error('unsupported version',
+                     v=VERSION, request_id=msg.get('request_id'))
+
+
+    def error(message, **extra):
+        out = {'ok': False, 'error': message}
+        out.update(extra)
+        return out
+
+
+    def ok(**fields):
+        out = {'ok': True}
+        out.update(fields)
+        return out
+'''
+
+_SERVER = '''
+    from fixwire.serve import protocol
+
+
+    class ExtractionServer:
+        def submit(self, feature_type, video_paths, timeout_s=None):
+            if not video_paths:
+                return protocol.error('queue_full', depth=1, capacity=1)
+            return protocol.ok(request_id='r1')
+
+        def status(self, request_id):
+            req = self._requests.get(request_id)
+            if req is None:
+                return protocol.error('unknown request_id')
+            return protocol.ok(**req.snapshot())
+
+        def _dispatch(self, msg):
+            cmd = msg.get('cmd')
+            if cmd == protocol.CMD_PING:
+                return protocol.ok(draining=False, v=protocol.VERSION)
+            if cmd == protocol.CMD_SUBMIT:
+                unknown = set(msg) - set(protocol.SUBMIT_FIELDS)
+                if unknown:
+                    return protocol.error('unknown submit fields')
+                return self.submit(msg.get('feature_type'),
+                                   msg.get('video_paths'),
+                                   timeout_s=msg.get('timeout_s'))
+            return protocol.error('unknown cmd')
+
+
+    class Request:
+        def snapshot(self):
+            out = {'request_id': self.id, 'state': self.state()}
+            if self.done_t is not None:
+                out['latency_s'] = 1.0
+            return out
+'''
+
+_CLIENT = '''
+    from fixwire.serve import protocol
+
+
+    class ServeClient:
+        def _call(self, msg):
+            msg.setdefault('v', protocol.VERSION)
+            return msg
+
+        def ping(self):
+            return self._call({'cmd': protocol.CMD_PING})
+
+        def submit(self, feature_type, video_paths, timeout_s=None):
+            msg = {'cmd': protocol.CMD_SUBMIT,
+                   'feature_type': feature_type,
+                   'video_paths': list(video_paths)}
+            if timeout_s is not None:
+                msg['timeout_s'] = float(timeout_s)
+            return self._call(msg)['request_id']
+'''
+
+_HTTP = '''
+    OK = 200
+    BAD_REQUEST = 400
+    FORBIDDEN = 403
+    NOT_FOUND = 404
+    METHOD_NOT_ALLOWED = 405
+    SERVICE_UNAVAILABLE = 503
+
+
+    class HttpError(Exception):
+        def __init__(self, status, code, message, **extra):
+            super().__init__(message)
+            self.status = status
+'''
+
+_GATEWAY = '''
+    from fixwire.ingress.http import (
+        BAD_REQUEST, FORBIDDEN, METHOD_NOT_ALLOWED, NOT_FOUND, OK,
+        HttpError,
+    )
+
+    _EXTRACT_FIELDS = frozenset({'feature_type', 'video_paths',
+                                 'timeout_s'})
+
+
+    class IngressGateway:
+        def __init__(self, server):
+            reg = server.registry
+            self._c = reg.counter('vft_ingress_requests_total', 'h',
+                                  labels={'tenant': '', 'endpoint': '',
+                                          'code': ''})
+            self._g = reg.gauge('vft_ingress_open_connections', 'h')
+
+        def _handle(self, req, resp, conn):
+            if req.path == '/healthz':
+                resp.send_json(OK, {'ok': True, 'draining': False})
+                return
+            tenant = self.auth.authenticate(req.headers)
+            self._route(req, resp, conn, tenant)
+
+        def _route(self, req, resp, conn, tenant):
+            path, method = req.path, req.method
+            if path == '/v1/extract' and method == 'POST':
+                return self._handle_extract(req, resp, tenant)
+            if path.startswith('/v1/requests/') and method == 'GET':
+                return self._handle_status(req, resp, tenant)
+            raise HttpError(NOT_FOUND if method in ('GET', 'POST')
+                            else METHOD_NOT_ALLOWED,
+                            'not_found', 'no route')
+
+        def _handle_extract(self, req, resp, tenant):
+            body = req.json_body(1)
+            unknown = set(body) - _EXTRACT_FIELDS
+            if unknown:
+                raise HttpError(BAD_REQUEST, 'bad_request', 'unknown',
+                                tenant=tenant.name)
+            resp.send_json(OK, {'ok': True, 'request_id': 'r1',
+                                'tenant': tenant.name})
+            return OK, 'r1'
+
+        def _handle_status(self, req, resp, tenant):
+            rid = req.path[len('/v1/requests/'):]
+            owner = self._owners.get(rid)
+            if owner != tenant.name:
+                raise HttpError(NOT_FOUND, 'not_found', 'unknown',
+                                tenant=tenant.name, request_id=rid)
+            st = {'state': 'done'}
+            st['tenant'] = tenant.name
+            resp.send_json(OK, {'ok': True, **st})
+            return OK, rid
+'''
+
+_INGRESS_MD = '''
+    # Ingress
+
+    | Route | What |
+    |---|---|
+    | `GET /healthz` | liveness |
+    | `POST /v1/extract` | submit |
+    | `GET /v1/requests/<id>` | status |
+'''
+
+_SERVING_MD = '''
+    # Serving
+
+    | command | what |
+    |---|---|
+    | `submit` | submit |
+    | `ping` | liveness |
+'''
+
+_FILES = {
+    'serve/protocol.py': _PROTOCOL,
+    'serve/server.py': _SERVER,
+    'serve/client.py': _CLIENT,
+    'ingress/http.py': _HTTP,
+    'ingress/gateway.py': _GATEWAY,
+}
+
+
+def make_wire_pkg(tmp_path, mutate=None, name='fixwire', docs=True):
+    # dedent FIRST: mutations operate on the final module text, so a
+    # non-matching replacement fails loudly instead of silently
+    files = {rel: textwrap.dedent(src) for rel, src in _FILES.items()}
+    if mutate:
+        mutate(files)
+    root = tmp_path / name
+    root.mkdir(exist_ok=True)
+    (root / '__init__.py').write_text('')
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        init = p.parent / '__init__.py'
+        if not init.exists():
+            init.write_text('')
+    docs_dir = None
+    if docs:
+        docs_dir = tmp_path / 'docs'
+        docs_dir.mkdir(exist_ok=True)
+        (docs_dir / 'ingress.md').write_text(textwrap.dedent(_INGRESS_MD))
+        (docs_dir / 'serving.md').write_text(textwrap.dedent(_SERVING_MD))
+    return Package(root, name), docs_dir
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def _sub(files, rel, old, new):
+    assert old in files[rel], (rel, old)
+    files[rel] = files[rel].replace(old, new)
+
+
+# -- extraction ---------------------------------------------------------------
+
+def test_extracts_the_fixture_surface(tmp_path):
+    pkg, _ = make_wire_pkg(tmp_path)
+    s = extract_surface(pkg)
+    assert s['version'] == '1.0'
+    assert set(s['commands']) == {'ping', 'submit'}
+    sub = s['commands']['submit']
+    # request fields come from msg.get + the SUBMIT_FIELDS reference
+    assert sub['request_fields'] == ['feature_type', 'timeout_s', 'v',
+                                     'video_paths']
+    # response/error fields resolve through the self.submit hop
+    assert sub['response_fields'] == ['ok', 'request_id']
+    assert set(sub['error_fields']) >= {'ok', 'error', 'depth',
+                                        'capacity'}
+    assert sub['client_methods'] == ['submit']
+    # **snapshot() spreads resolve against Request.snapshot statically
+    ping = s['commands']['ping']
+    assert ping['response_fields'] == ['draining', 'ok', 'v']
+    assert set(s['routes']) == {'* /healthz', 'POST /v1/extract',
+                                'GET /v1/requests/<id>'}
+    ext = s['routes']['POST /v1/extract']
+    assert ext['auth'] and not ext['tenant_scoped']
+    assert ext['status'] == [200, 400]
+    assert ext['errors'] == [[400, 'bad_request']]
+    assert ext['request_fields'] == ['feature_type', 'timeout_s',
+                                     'video_paths']
+    st = s['routes']['GET /v1/requests/<id>']
+    assert st['tenant_scoped'] and st['status'] == [200, 404]
+    # **st spread resolves the assigned keys of the local dict
+    assert 'tenant' in st['response_fields']
+    hz = s['routes']['* /healthz']
+    assert not hz['auth'] and hz['response_fields'] == ['draining', 'ok']
+    # transport picks up the un-routed 404/405 fallback
+    assert {404, 405} <= set(s['transport']['status'])
+    assert s['metrics'] == {
+        'vft_ingress_requests_total': ['code', 'endpoint', 'tenant'],
+        'vft_ingress_open_connections': []}
+
+
+def test_clean_fixture_has_no_rule_findings(tmp_path):
+    pkg, docs = make_wire_pkg(tmp_path)
+    s = extract_surface(pkg)
+    assert check_sync(pkg, s) == []
+    assert check_error_echo(pkg, s) == []
+    assert check_docs(pkg, s, docs) == []
+
+
+# -- wire-sync ----------------------------------------------------------------
+
+def test_sync_flags_client_only_command(tmp_path):
+    def mutate(files):
+        files['serve/client.py'] += (
+            "\n    def frob(self):\n"
+            "        return self._call({'cmd': 'frobnicate'})\n")
+    pkg, _ = make_wire_pkg(tmp_path, mutate)
+    findings = check_sync(pkg, extract_surface(pkg))
+    assert any(f.key == 'client-only:frobnicate' for f in findings)
+
+
+def test_sync_flags_server_only_and_undeclared_command(tmp_path):
+    def mutate(files):
+        _sub(files, 'serve/server.py',
+             "return protocol.error('unknown cmd')",
+             "if cmd == 'reload':\n"
+             "            return protocol.ok(reloaded=True)\n"
+             "        return protocol.error('unknown cmd')")
+    pkg, _ = make_wire_pkg(tmp_path, mutate)
+    keys = {f.key for f in check_sync(pkg, extract_surface(pkg))}
+    # handled but not declared in COMMANDS, and no client method
+    assert {'undeclared:reload', 'server-only:reload'} <= keys
+
+
+def test_sync_flags_declared_but_undispatched_command(tmp_path):
+    def mutate(files):
+        _sub(files, 'serve/protocol.py',
+             "COMMANDS = (CMD_PING, CMD_SUBMIT)",
+             "CMD_STATUS = 'status'\n"
+             "COMMANDS = (CMD_PING, CMD_SUBMIT, CMD_STATUS)")
+    pkg, _ = make_wire_pkg(tmp_path, mutate)
+    keys = {f.key for f in check_sync(pkg, extract_surface(pkg))}
+    assert 'undispatched:status' in keys
+
+
+def test_sync_flags_client_field_the_server_rejects(tmp_path):
+    def mutate(files):
+        _sub(files, 'serve/client.py',
+             "msg['timeout_s'] = float(timeout_s)",
+             "msg['timeout_s'] = float(timeout_s)\n"
+             "            msg['surprise'] = 1")
+    pkg, _ = make_wire_pkg(tmp_path, mutate)
+    keys = {f.key for f in check_sync(pkg, extract_surface(pkg))}
+    assert 'submit-field:surprise' in keys
+
+
+# -- error-echo ---------------------------------------------------------------
+
+def test_error_echo_flags_check_version_without_request_id(tmp_path):
+    def mutate(files):
+        _sub(files, 'serve/protocol.py',
+             ", request_id=msg.get('request_id')", "")
+    pkg, _ = make_wire_pkg(tmp_path, mutate)
+    findings = check_error_echo(pkg, extract_surface(pkg))
+    assert [f.key for f in findings] == ['check_version:request_id']
+
+
+def test_error_echo_flags_tenant_scoped_error_without_echo(tmp_path):
+    def mutate(files):
+        _sub(files, 'ingress/gateway.py',
+             "tenant=tenant.name, request_id=rid)",
+             "tenant=tenant.name)")
+    pkg, _ = make_wire_pkg(tmp_path, mutate)
+    findings = check_error_echo(pkg, extract_surface(pkg))
+    assert any('request_id' in f.key for f in findings)
+
+
+def test_error_echo_suppression_comment(tmp_path):
+    def mutate(files):
+        _sub(files, 'ingress/gateway.py',
+             "raise HttpError(NOT_FOUND, 'not_found', 'unknown',\n"
+             "                            tenant=tenant.name, "
+             "request_id=rid)",
+             "# vft-wire: ok=error-echo — fixture rationale\n"
+             "            raise HttpError(NOT_FOUND, 'not_found', "
+             "'unknown',\n                            "
+             "tenant=tenant.name)")
+    pkg, _ = make_wire_pkg(tmp_path, mutate)
+    assert check_error_echo(pkg, extract_surface(pkg)) == []
+
+
+# -- doc-sync -----------------------------------------------------------------
+
+def test_doc_sync_flags_undocumented_route_and_command(tmp_path):
+    pkg, docs = make_wire_pkg(tmp_path)
+    (docs / 'ingress.md').write_text('# Ingress\n| `GET /healthz` |\n')
+    (docs / 'serving.md').write_text('# Serving\n| `submit` |\n')
+    keys = {f.key for f in check_docs(pkg, extract_surface(pkg), docs)}
+    assert 'route:POST /v1/extract' in keys
+    assert 'command:ping' in keys
+
+
+def test_doc_sync_flags_stale_documented_route(tmp_path):
+    pkg, docs = make_wire_pkg(tmp_path)
+    text = (docs / 'ingress.md').read_text()
+    (docs / 'ingress.md').write_text(
+        text + '| `POST /v1/retired` | gone |\n')
+    keys = {f.key for f in check_docs(pkg, extract_surface(pkg), docs)}
+    assert keys == {'stale-route:/v1/retired'}
+
+
+def test_doc_sync_skips_without_docs_dir(tmp_path):
+    pkg, _ = make_wire_pkg(tmp_path, docs=False)
+    assert check_docs(pkg, extract_surface(pkg), None) == []
+
+
+# -- lock semantics -----------------------------------------------------------
+
+def _pin(tmp_path, pkg):
+    lock = tmp_path / 'WIRE.lock.json'
+    write_lock(lock, lock_view(extract_surface(pkg)))
+    return lock
+
+
+def test_lock_roundtrip_is_clean(tmp_path):
+    pkg, _ = make_wire_pkg(tmp_path)
+    lock = _pin(tmp_path, pkg)
+    assert diff_lock(extract_surface(pkg), load_lock(lock)) == []
+
+
+def test_removed_command_demands_major_bump(tmp_path):
+    pkg, _ = make_wire_pkg(tmp_path)
+    lock = _pin(tmp_path, pkg)
+
+    def mutate(files):
+        _sub(files, 'serve/server.py',
+             "if cmd == protocol.CMD_PING:\n"
+             "            return protocol.ok(draining=False, "
+             "v=protocol.VERSION)\n        ", "")
+        _sub(files, 'serve/protocol.py',
+             "COMMANDS = (CMD_PING, CMD_SUBMIT)",
+             "COMMANDS = (CMD_SUBMIT,)")
+        _sub(files, 'serve/client.py',
+             "def ping(self):\n"
+             "        return self._call({'cmd': protocol.CMD_PING})\n",
+             "")
+    pkg2, _ = make_wire_pkg(tmp_path, mutate, name='fixwire2')
+    findings = diff_lock(extract_surface(pkg2), load_lock(lock))
+    drops = [f for f in findings if f.key == 'command:-ping']
+    assert len(drops) == 1
+    assert 'MAJOR' in drops[0].message and '2.0' in drops[0].message
+
+
+def test_removed_route_demands_major_bump(tmp_path):
+    pkg, _ = make_wire_pkg(tmp_path)
+    lock = _pin(tmp_path, pkg)
+
+    def mutate(files):
+        _sub(files, 'ingress/gateway.py',
+             "        if path == '/v1/extract' and method == 'POST':\n"
+             "            return self._handle_extract(req, resp, "
+             "tenant)\n", "")
+    pkg2, _ = make_wire_pkg(tmp_path, mutate, name='fixwire3')
+    findings = diff_lock(extract_surface(pkg2), load_lock(lock))
+    assert any(f.key == 'route:-POST /v1/extract'
+               and 'MAJOR' in f.message for f in findings)
+
+
+def test_added_field_demands_minor_bump_then_repin_clears(tmp_path):
+    pkg, _ = make_wire_pkg(tmp_path)
+    lock = _pin(tmp_path, pkg)
+
+    def add_field(files):
+        _sub(files, 'serve/server.py',
+             "return protocol.ok(request_id='r1')",
+             "return protocol.ok(request_id='r1', trace_id='t1')")
+
+    def add_field_and_bump(files):
+        add_field(files)
+        _sub(files, 'serve/protocol.py',
+             "VERSION = '1.0'", "VERSION = '1.1'")
+
+    pkg2, _ = make_wire_pkg(tmp_path, add_field, name='fixwire4')
+    findings = diff_lock(extract_surface(pkg2), load_lock(lock))
+    adds = [f for f in findings if f.key.endswith('+trace_id')]
+    assert adds and 'MINOR' in adds[0].message and '1.1' in adds[0].message
+    # with the MINOR bump taken the advice flips to plain re-pin …
+    pkg3, _ = make_wire_pkg(tmp_path, add_field_and_bump, name='fixwire5')
+    findings = diff_lock(extract_surface(pkg3), load_lock(lock))
+    adds = [f for f in findings if f.key.endswith('+trace_id')]
+    assert adds and 'already taken' in adds[0].message
+    # … and --write-lock settles it
+    write_lock(lock, lock_view(extract_surface(pkg3)))
+    assert diff_lock(extract_surface(pkg3), load_lock(lock)) == []
+
+
+def test_version_drift_alone_is_reported(tmp_path):
+    pkg, _ = make_wire_pkg(tmp_path)
+    lock = _pin(tmp_path, pkg)
+
+    def mutate(files):
+        _sub(files, 'serve/protocol.py',
+             "VERSION = '1.0'", "VERSION = '1.1'")
+    pkg2, _ = make_wire_pkg(tmp_path, mutate, name='fixwire6')
+    findings = diff_lock(extract_surface(pkg2), load_lock(lock))
+    assert [f.key for f in findings] == ['version:1.0->1.1']
+
+
+def test_scope_subset_write_merges_and_full_scope_prunes(tmp_path):
+    pkg, _ = make_wire_pkg(tmp_path)
+    lock = _pin(tmp_path, pkg)
+    doc = load_lock(lock)
+    # poison the routes section, then re-pin ONLY commands: routes must
+    # survive untouched (subset merge), so the poison still diffs
+    doc['routes']['POST /v1/retired'] = {'auth': True, 'status': [200]}
+    lock.write_text(json.dumps(doc))
+    surface = extract_surface(pkg)
+    write_lock(lock, lock_view(surface), scopes=('commands',))
+    kept = load_lock(lock)
+    assert 'POST /v1/retired' in kept['routes']
+    findings = diff_lock(surface, kept)
+    assert [f.key for f in findings] == ['route:-POST /v1/retired']
+    # the full-scope re-pin rebuilds the document and prunes the stale
+    # route entry
+    write_lock(lock, lock_view(surface))
+    kept = load_lock(lock)
+    assert 'POST /v1/retired' not in kept['routes']
+    assert diff_lock(surface, kept) == []
+
+
+# -- CLI contract -------------------------------------------------------------
+
+def _cli(tmp_path, pkg_name, extra=()):
+    return main(['--root', str(tmp_path / pkg_name),
+                 '--package-name', pkg_name,
+                 '--docs-dir', str(tmp_path / 'docs'),
+                 '--lock', str(tmp_path / 'w.json'), *extra])
+
+
+def test_cli_write_lock_then_clean_then_drift(tmp_path, capsys):
+    make_wire_pkg(tmp_path)
+    assert _cli(tmp_path, 'fixwire', ['--write-lock']) == 0
+    assert _cli(tmp_path, 'fixwire') == 0
+    # plant a removed route in place
+    gw = tmp_path / 'fixwire' / 'ingress' / 'gateway.py'
+    src = gw.read_text()
+    cut = ("        if path == '/v1/extract' and method == 'POST':\n"
+           "            return self._handle_extract(req, resp, tenant)\n")
+    assert cut in src
+    gw.write_text(src.replace(cut, ''))
+    rc = _cli(tmp_path, 'fixwire')
+    assert rc == 2
+    out = capsys.readouterr().out
+    assert 'POST /v1/extract' in out and 'MAJOR' in out
+
+
+def test_cli_rejects_unknown_scope(tmp_path, capsys):
+    make_wire_pkg(tmp_path)
+    assert _cli(tmp_path, 'fixwire', ['--scope', 'nonsense']) == 1
+
+
+# -- the live codebase --------------------------------------------------------
+
+def test_live_tree_matches_shipped_lock():
+    """The CI ``wire-check`` gate, pinned in tier-1: the extracted wire
+    surface equals WIRE.lock.json and every sync/doc rule is green."""
+    pkg = Package(PKG_ROOT, 'video_features_tpu')
+    surface = extract_surface(pkg)
+    findings = (check_sync(pkg, surface)
+                + check_error_echo(pkg, surface)
+                + check_docs(pkg, surface, REPO_ROOT / 'docs')
+                + diff_lock(surface, load_lock(default_lock_path())))
+    assert findings == [], '\n'.join(f.render() for f in findings)
+
+
+def test_live_lock_covers_the_whole_surface():
+    """Acceptance criteria: every loopback command and every ingress
+    route is pinned — an empty section would make the drift rules
+    vacuous without failing anything."""
+    lock = load_lock(default_lock_path())
+    from video_features_tpu.serve import protocol
+    assert set(lock['commands']) == set(protocol.COMMANDS)
+    assert protocol.VERSION == lock['version'] == '1.1'
+    paths = {k.split(' ', 1)[1] for k in lock['routes']}
+    assert {'/healthz', '/v1/extract', '/v1/requests/<id>',
+            '/v1/requests/<id>/trace', '/v1/live/<id>', '/v1/metrics',
+            '/metrics'} == paths
+    # the structural facts the fleet story depends on
+    assert lock['routes']['GET /v1/requests/<id>/trace']['tenant_scoped']
+    assert not lock['routes']['* /healthz']['auth']
+    assert lock['metrics']['vft_ingress_shed_total'] == \
+        ['class', 'reason', 'tenant']
+
+
+def test_analyzer_subprocess_never_imports_jax_and_is_fast():
+    """Acceptance criteria: the wire checker runs via the wrapper in
+    well under the 30 s CI target and never imports jax (the wrapper
+    exits 3 on a purity self-violation)."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / 'tools' / 'vft_wire.py')],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=60)
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, (proc.returncode, proc.stdout,
+                                  proc.stderr)
+    assert wall < 10, f'vft-wire took {wall:.1f}s (budget: 10s)'

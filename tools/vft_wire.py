@@ -1,0 +1,28 @@
+#!/usr/bin/env python3
+"""vft-wire launcher: ``python tools/vft_wire.py [flags]``.
+
+A thin wrapper over ``python -m video_features_tpu.analysis.wire`` that
+works from a source checkout without installation (repo-root resolution
+shared with vft-lint/vft-programs via ``_bootstrap``). Like vft-lint,
+the checker is pure-AST: it parses the wire surface — the loopback
+protocol, ``ServeClient``, the ingress routes — and never imports any
+of it; the snapshot below is taken BEFORE the first package import so a
+jax import sneaking into the ``__init__`` chain trips the exit-3 guard
+honestly even on jax-resident hosts.
+
+Exit codes (analysis/core.py contract): 0 clean, 1 analyzer error,
+2 lock drift / new finding, 3 jax imported.
+"""
+import sys
+
+from _bootstrap import add_repo_root
+
+# honest purity probe: BEFORE the package (or anything else) is imported
+_JAX_PRELOADED = 'jax' in sys.modules
+
+add_repo_root()
+
+from video_features_tpu.analysis.wire import main  # noqa: E402
+
+if __name__ == '__main__':
+    sys.exit(main(jax_preloaded=_JAX_PRELOADED))

@@ -21,6 +21,8 @@ Rule ids (stable — baselines and suppressions key on them):
   thread-discipline       module-level mutables declare their lock
   lock-order              acyclic lock graph; no untimed blocking call
                           while a lock is held
+  wire-literal            status codes / command names come from the
+                          shared constants the wire lock anchors on
 """
 from __future__ import annotations
 
@@ -30,10 +32,12 @@ from typing import Dict, Iterable, List, Optional, Set
 
 from video_features_tpu.analysis.core import (
     CACHE_KEY_PY, CONFIG_PY, FARM_RECIPES_PY, FARM_WORKER_PY,
-    HOST_TRANSFORMS_PY, OBS_MANIFEST_PY, SERVE_METRICS_PY, SERVE_SERVER_PY,
+    HOST_TRANSFORMS_PY, INGRESS_HTTP_PY, OBS_MANIFEST_PY, SERVE_CLIENT_PY,
+    SERVE_METRICS_PY, SERVE_PROTOCOL_PY, SERVE_SERVER_PY,
     TRACING_PY, Finding, Module, Package, assigned_dict_keys,
-    dict_literal_str_keys, find_assignment, find_function,
-    module_level_statements, set_literal_values, str_constants_in,
+    callable_name, dict_literal_str_keys, find_assignment, find_function,
+    module_constants, module_level_statements, set_literal_values,
+    str_constants_in,
 )
 from video_features_tpu.analysis.imports import (
     chain, module_imports, spawn_closure,
@@ -84,12 +88,9 @@ def check_spawn_purity(package: Package) -> List[Finding]:
 
 # -- recipe-picklable --------------------------------------------------------
 
-def _callable_name(func: ast.AST) -> str:
-    if isinstance(func, ast.Name):
-        return func.id
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    return ''
+# the shared spelling lives in analysis/core.py (vft-wire resolves call
+# targets the same way)
+_callable_name = callable_name
 
 
 def check_recipe_picklable(package: Package) -> List[Finding]:
@@ -812,6 +813,84 @@ def check_lock_order(package: Package) -> List[Finding]:
     return findings
 
 
+# -- wire-literal ------------------------------------------------------------
+
+# call positions whose first positional argument IS an HTTP status code
+_WIRE_STATUS_CALLS = ('HttpError', 'send_json', 'send', 'start_chunked')
+
+
+def check_wire_literal(package: Package) -> List[Finding]:
+    """The wire surface is pinned statically (``WIRE.lock.json``,
+    analysis/wire.py), which only works if the surface is SPELLED in one
+    place: status codes come from ``ingress/http.py``'s named constants
+    and command names from ``serve/protocol.py``'s ``CMD_*`` constants.
+    An inline ``404`` in a status position or an inline ``'submit'`` in
+    a cmd position is invisible to the extractor — the same collapse
+    the knob-registry rule already did for exclusion lists."""
+    findings: List[Finding] = []
+    # (a) inline ints in status positions anywhere under serve/ingress
+    # (ingress/http.py itself DEFINES the vocabulary and is exempt)
+    for rel, mod in package.modules.items():
+        if not rel.startswith(('serve/', 'ingress/')) \
+                or rel == INGRESS_HTTP_PY:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callable_name(node.func) not in _WIRE_STATUS_CALLS \
+                    or not node.args:
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, int) \
+                    and not mod.suppressed('wire-literal', node.lineno):
+                findings.append(Finding(
+                    'wire-literal', rel, node.lineno,
+                    f'status:{a0.value}',
+                    f'inline status code {a0.value} in a '
+                    f'{_callable_name(node.func)}(...) call — use the '
+                    f'named constant from ingress/http.py so vft-wire '
+                    f'can pin the route status-code set statically'))
+    # (b) inline command strings in cmd positions in the loopback
+    # server/client (serve/protocol.py defines CMD_* and is exempt)
+    commands = set(module_constants(package.get(SERVE_PROTOCOL_PY),
+                                    types=(str,),
+                                    prefix='CMD_').values())
+    if not commands:
+        return findings
+    for rel in (SERVE_SERVER_PY, SERVE_CLIENT_PY):
+        mod = package.get(rel)
+        if mod is None:
+            continue
+        for node in ast.walk(mod.tree):
+            bad: Optional[ast.Constant] = None
+            if isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                names = {s.id for s in sides if isinstance(s, ast.Name)}
+                names |= {s.attr for s in sides
+                          if isinstance(s, ast.Attribute)}
+                if 'cmd' in names:
+                    for s in sides:
+                        if isinstance(s, ast.Constant) \
+                                and s.value in commands:
+                            bad = s
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and k.value == 'cmd' \
+                            and isinstance(v, ast.Constant) \
+                            and v.value in commands:
+                        bad = v
+            if bad is not None \
+                    and not mod.suppressed('wire-literal', bad.lineno):
+                findings.append(Finding(
+                    'wire-literal', rel, bad.lineno,
+                    f'cmd:{bad.value}',
+                    f'inline command string {bad.value!r} — use '
+                    f'serve/protocol.py CMD_* constants so the client, '
+                    f'the dispatch, and the vft-wire lock share one '
+                    f'spelling'))
+    return findings
+
+
 # -- registry ----------------------------------------------------------------
 
 # the ONE rule registry: name ↔ check function pairs. ALL_CHECKS and
@@ -829,6 +908,7 @@ RULE_CHECKS = (
     ('stage-vocabulary', check_stage_vocabulary),
     ('thread-discipline', check_thread_discipline),
     ('lock-order', check_lock_order),
+    ('wire-literal', check_wire_literal),
 )
 
 ALL_CHECKS = tuple(fn for _, fn in RULE_CHECKS)

@@ -59,6 +59,12 @@ TRACING_PY = 'utils/tracing.py'
 FARM_WORKER_PY = 'farm/worker.py'
 FARM_RECIPES_PY = 'farm/recipes.py'
 HOST_TRANSFORMS_PY = 'ops/host_transforms.py'
+# the wire surface (vft-wire, analysis/wire.py, + the wire-literal rule):
+# the loopback protocol/client and the ingress transport/routes
+SERVE_PROTOCOL_PY = 'serve/protocol.py'
+SERVE_CLIENT_PY = 'serve/client.py'
+INGRESS_HTTP_PY = 'ingress/http.py'
+INGRESS_GATEWAY_PY = 'ingress/gateway.py'
 
 
 class Finding:
@@ -242,6 +248,37 @@ def new_findings(findings: Iterable[Finding],
 
 
 # -- shared AST helpers ------------------------------------------------------
+
+def callable_name(func: ast.AST) -> str:
+    """Bare name of a call target: ``Name`` id or ``Attribute`` attr
+    (empty for anything fancier) — the one spelling shared by the lint
+    rules and the vft-wire extractor."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ''
+
+
+def module_constants(mod: Optional['Module'],
+                     types: tuple = (str, int),
+                     prefix: str = '') -> Dict[str, object]:
+    """Module-level ``NAME = <constant>`` assignments (bools excluded),
+    optionally filtered by name prefix — the constant tables the
+    wire-literal rule and vft-wire resolve references against."""
+    out: Dict[str, object] = {}
+    if mod is None:
+        return out
+    for stmt in module_level_statements(mod.tree):
+        if isinstance(stmt, ast.Assign) \
+                and isinstance(stmt.value, ast.Constant) \
+                and isinstance(stmt.value.value, types) \
+                and not isinstance(stmt.value.value, bool):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id.startswith(prefix):
+                    out[t.id] = stmt.value.value
+    return out
+
 
 def module_level_statements(tree: ast.Module) -> Iterable[ast.stmt]:
     """Top-level statements, descending into plain ``if`` blocks (version

@@ -45,7 +45,10 @@ from typing import Any, Dict, Optional, Tuple
 
 from video_features_tpu.ingress.auth import ApiKeyAuth, Tenant
 from video_features_tpu.ingress.http import (
-    HttpError, HttpRequest, HttpServer, ResponseWriter,
+    BAD_REQUEST, CLIENT_CLOSED, CONFLICT, FORBIDDEN, INTERNAL_ERROR,
+    METHOD_NOT_ALLOWED, NOT_FOUND, OK, SERVICE_UNAVAILABLE,
+    TOO_MANY_REQUESTS, UNAUTHORIZED, HttpError, HttpRequest, HttpServer,
+    ResponseWriter,
 )
 from video_features_tpu.ingress.live import (
     LiveSession, LiveSessionError, decode_frame_chunk,
@@ -277,18 +280,18 @@ class IngressGateway:
         t0 = time.perf_counter()
         endpoint = self._endpoint_label(req)
         tenant: Optional[Tenant] = None
-        status = 500
+        status = INTERNAL_ERROR
         request_id = None
         try:
             if req.path == '/healthz':
-                status = 200
-                resp.send_json(200, {
+                status = OK
+                resp.send_json(OK, {
                     'ok': True, 'draining': self.server._draining})
                 return
             tenant = self.auth.authenticate(req.headers)
             if tenant is None:
-                status = 401
-                resp.send_json(401, {
+                status = UNAUTHORIZED
+                resp.send_json(UNAUTHORIZED, {
                     'ok': False, 'error': 'unauthorized',
                     'message': 'missing or unknown API key '
                                '(Authorization: Bearer <key>)'})
@@ -304,7 +307,7 @@ class IngressGateway:
             except (OSError, ValueError):
                 pass
         except (OSError, ConnectionError, socket.timeout):
-            status = 499            # client went away mid-request
+            status = CLIENT_CLOSED            # client went away mid-request
         finally:
             dt = time.perf_counter() - t0
             self._h_latency.observe(dt)
@@ -355,14 +358,14 @@ class IngressGateway:
                tenant: Tenant) -> Tuple[int, Optional[str]]:
         path, method = req.path, req.method
         if path == '/v1/metrics' and method == 'GET':
-            resp.send_json(200, {'ok': True,
-                                 'metrics': self.server.metrics()})
-            return 200, None
+            resp.send_json(OK, {'ok': True,
+                                'metrics': self.server.metrics()})
+            return OK, None
         if path == '/metrics' and method == 'GET':
             text = self.server._prometheus(self.server.metrics())
-            resp.send(200, text.encode('utf-8'),
+            resp.send(OK, text.encode('utf-8'),
                       content_type='text/plain; version=0.0.4')
-            return 200, None
+            return OK, None
         if path == '/v1/extract' and method == 'POST':
             return self._handle_extract(req, resp, tenant)
         if path.startswith('/v1/requests/') and path.endswith('/trace') \
@@ -372,7 +375,8 @@ class IngressGateway:
             return self._handle_status(req, resp, tenant)
         if path.startswith('/v1/live/') and method == 'POST':
             return self._handle_live(req, resp, conn, tenant)
-        raise HttpError(404 if method in ('GET', 'POST') else 405,
+        raise HttpError(NOT_FOUND if method in ('GET', 'POST')
+                        else METHOD_NOT_ALLOWED,
                         'not_found', f'no route {method} {path}')
 
     # -- extraction requests --------------------------------------------------
@@ -382,7 +386,7 @@ class IngressGateway:
         from video_features_tpu.serve.protocol import PRIORITIES
         priority = body.get('priority') or tenant.priority
         if priority not in PRIORITIES:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             f'unknown priority {priority!r}; known: '
                             f'{", ".join(PRIORITIES)}')
         if priority == 'interactive' and tenant.priority == 'batch':
@@ -390,7 +394,7 @@ class IngressGateway:
             # provisions a batch key precisely so saturation sheds it
             # first — a client-side header must not reclaim the
             # interactive headroom that policy protects
-            raise HttpError(403, 'priority_forbidden',
+            raise HttpError(FORBIDDEN, 'priority_forbidden',
                             f'tenant {tenant.name!r} is provisioned as '
                             "'batch' and cannot request 'interactive'",
                             tenant=tenant.name)
@@ -401,7 +405,7 @@ class IngressGateway:
         if not ok:
             self._count_shed(tenant, priority, reason)
             raise HttpError(
-                429, reason,
+                TOO_MANY_REQUESTS, reason,
                 f'tenant {tenant.name!r} is over its '
                 + ('request rate' if reason == 'rate_limited'
                    else 'concurrent-request budget'),
@@ -415,23 +419,23 @@ class IngressGateway:
         if err == 'queue_full':
             self._count_shed(tenant, priority, 'queue_full')
             self.quota.count_shed(tenant)
-            return HttpError(503, 'queue_full',
+            return HttpError(SERVICE_UNAVAILABLE, 'queue_full',
                              'admission queue is full for priority '
                              f'class {priority!r}; retry with backoff',
                              tenant=tenant.name, priority=priority,
                              depth=result.get('depth'),
                              capacity=result.get('capacity'))
         if err == 'draining':
-            return HttpError(503, 'draining', 'server is draining',
+            return HttpError(SERVICE_UNAVAILABLE, 'draining', 'server is draining',
                              tenant=tenant.name)
-        return HttpError(400, 'rejected', str(err), tenant=tenant.name)
+        return HttpError(BAD_REQUEST, 'rejected', str(err), tenant=tenant.name)
 
     def _handle_extract(self, req: HttpRequest, resp: ResponseWriter,
                         tenant: Tenant) -> Tuple[int, Optional[str]]:
         body = req.json_body(self.max_body_bytes)
         unknown = set(body) - _EXTRACT_FIELDS
         if unknown:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             f'unknown fields: {sorted(unknown)}')
         priority = self._resolve_priority(body, tenant)
         self._check_quota(tenant, priority)
@@ -450,10 +454,10 @@ class IngressGateway:
             raise self._submit_error(result, tenant, priority)
         rid = result['request_id']
         self._own(rid, tenant)
-        resp.send_json(200, {'ok': True, 'request_id': rid,
-                             'tenant': tenant.name, 'priority': priority,
-                             'trace_id': result.get('trace_id')})
-        return 200, rid
+        resp.send_json(OK, {'ok': True, 'request_id': rid,
+                            'tenant': tenant.name, 'priority': priority,
+                            'trace_id': result.get('trace_id')})
+        return OK, rid
 
     def _handle_trace(self, req: HttpRequest, resp: ResponseWriter,
                       tenant: Tenant) -> Tuple[int, Optional[str]]:
@@ -470,22 +474,22 @@ class IngressGateway:
         with self._lock:
             owner = self._owners.get(rid)
         if owner is None:
-            raise HttpError(404, 'not_found',
+            raise HttpError(NOT_FOUND, 'not_found',
                             f'unknown request_id {rid!r}',
                             tenant=tenant.name, request_id=rid)
         if owner != tenant.name:
-            raise HttpError(403, 'forbidden',
+            raise HttpError(FORBIDDEN, 'forbidden',
                             f'request {rid!r} belongs to another tenant',
                             tenant=tenant.name, request_id=rid)
         tr = self.server.request_trace(rid)
         if not tr.get('ok'):
-            raise HttpError(404, 'not_found',
+            raise HttpError(NOT_FOUND, 'not_found',
                             tr.get('error', f'unknown request {rid!r}'),
                             tenant=tenant.name, request_id=rid)
         tr.pop('ok', None)
         tr['tenant'] = tenant.name
-        resp.send_json(200, {'ok': True, **tr})
-        return 200, rid
+        resp.send_json(OK, {'ok': True, **tr})
+        return OK, rid
 
     def _handle_status(self, req: HttpRequest, resp: ResponseWriter,
                        tenant: Tenant) -> Tuple[int, Optional[str]]:
@@ -495,18 +499,18 @@ class IngressGateway:
         if owner != tenant.name:
             # someone else's request id is indistinguishable from an
             # unknown one — the id space must not leak across tenants
-            raise HttpError(404, 'not_found',
+            raise HttpError(NOT_FOUND, 'not_found',
                             f'unknown request_id {rid!r}',
                             tenant=tenant.name, request_id=rid)
         st = self.server.status(rid)
         if not st.get('ok'):
-            raise HttpError(404, 'not_found',
+            raise HttpError(NOT_FOUND, 'not_found',
                             st.get('error', f'unknown request {rid!r}'),
                             tenant=tenant.name, request_id=rid)
         st.pop('ok', None)
         st['tenant'] = tenant.name
-        resp.send_json(200, {'ok': True, **st})
-        return 200, rid
+        resp.send_json(OK, {'ok': True, **st})
+        return OK, rid
 
     # -- live sessions ---------------------------------------------------------
 
@@ -515,23 +519,23 @@ class IngressGateway:
                      tenant: Tenant) -> Tuple[int, Optional[str]]:
         sid = req.path[len('/v1/live/'):]
         if not sid or '/' in sid or len(sid) > 128:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             f'malformed session id {sid!r}')
         chunks = req.iter_chunks(self.max_body_bytes)
         try:
             header_raw = next(chunks)
         except StopIteration:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             'live session body must start with a JSON '
                             'header chunk')
         try:
             header = json.loads(header_raw.decode('utf-8'))
         except (ValueError, UnicodeDecodeError) as e:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             f'malformed live-session header: {e}')
         unknown = set(header) - _LIVE_FIELDS
         if unknown:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             f'unknown header fields: {sorted(unknown)}')
         priority = self._resolve_priority(header, tenant)
         try:
@@ -539,14 +543,14 @@ class IngressGateway:
                 sid, tenant.name, fps=float(header.get('fps', 25.0)),
                 idle_flush_s=self.server.idle_flush_s)
         except (LiveSessionError, TypeError, ValueError) as e:
-            raise HttpError(400, 'bad_request', str(e))
+            raise HttpError(BAD_REQUEST, 'bad_request', str(e))
 
         # duplicate in-flight session ids are REJECTED: two writers on
         # one session id would interleave frames into one window stream
         with self._lock:
             if sid in self._live:
                 raise HttpError(
-                    409, 'duplicate_session',
+                    CONFLICT, 'duplicate_session',
                     f'live session {sid!r} is already in flight',
                     tenant=tenant.name, session=sid)
             self._live[sid] = session
@@ -586,7 +590,7 @@ class IngressGateway:
                     self.quota.release(tenant.name)
                 raise
 
-            resp.start_chunked(200)
+            resp.start_chunked(OK)
             resp.write_chunk((json.dumps(
                 {'ok': True, 'request_id': rid, 'session': sid,
                  'tenant': tenant.name}) + '\n').encode('utf-8'))
@@ -621,7 +625,7 @@ class IngressGateway:
                 resp.end_chunked()
             except (OSError, ValueError):
                 pass
-            return 200, rid
+            return OK, rid
         finally:
             session.abort()
             with self._lock:

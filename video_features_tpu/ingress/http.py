@@ -32,16 +32,41 @@ MAX_REQUEST_LINE = 8192
 MAX_HEADER_BYTES = 32768
 MAX_HEADERS = 100
 
+# The named status vocabulary of the ingress wire surface. Every status
+# an ingress module emits comes from THESE names (vft-lint's
+# wire-literal rule rejects inline ints in status positions outside
+# this module), which is what lets the vft-wire extractor
+# (analysis/wire.py) resolve the per-route status-code sets it pins in
+# WIRE.lock.json — an inline 418 would be invisible drift.
+OK = 200
+BAD_REQUEST = 400
+UNAUTHORIZED = 401
+FORBIDDEN = 403
+NOT_FOUND = 404
+METHOD_NOT_ALLOWED = 405
+CONFLICT = 409
+PAYLOAD_TOO_LARGE = 413
+TOO_MANY_REQUESTS = 429
+HEADERS_TOO_LARGE = 431
+# nginx convention: the client went away mid-request — never sent on
+# the wire, only a metrics label (vft_ingress_requests_total{code=})
+CLIENT_CLOSED = 499
+INTERNAL_ERROR = 500
+SERVICE_UNAVAILABLE = 503
+
 # HTTP status → reason phrases we actually emit
 # thread-discipline declaration (vft-lint): write-once constants need
 # no lock — nothing mutates them after import
 _LOCKED_BY = {'_REASONS': 'immutable'}
-_REASONS = {200: 'OK', 400: 'Bad Request', 401: 'Unauthorized',
-            403: 'Forbidden', 404: 'Not Found', 405: 'Method Not Allowed',
-            409: 'Conflict', 413: 'Payload Too Large',
-            429: 'Too Many Requests',
-            431: 'Request Header Fields Too Large',
-            500: 'Internal Server Error', 503: 'Service Unavailable'}
+_REASONS = {OK: 'OK', BAD_REQUEST: 'Bad Request',
+            UNAUTHORIZED: 'Unauthorized',
+            FORBIDDEN: 'Forbidden', NOT_FOUND: 'Not Found',
+            METHOD_NOT_ALLOWED: 'Method Not Allowed',
+            CONFLICT: 'Conflict', PAYLOAD_TOO_LARGE: 'Payload Too Large',
+            TOO_MANY_REQUESTS: 'Too Many Requests',
+            HEADERS_TOO_LARGE: 'Request Header Fields Too Large',
+            INTERNAL_ERROR: 'Internal Server Error',
+            SERVICE_UNAVAILABLE: 'Service Unavailable'}
 
 
 class HttpError(Exception):
@@ -89,10 +114,10 @@ class HttpRequest:
         try:
             n = int(raw)
         except ValueError:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             f'malformed Content-Length {raw!r}')
         if n < 0:
-            raise HttpError(400, 'bad_request', 'negative Content-Length')
+            raise HttpError(BAD_REQUEST, 'bad_request', 'negative Content-Length')
         return n
 
     def read_body(self, max_bytes: int) -> bytes:
@@ -103,13 +128,13 @@ class HttpRequest:
             return read_chunked(self._rfile, max_bytes)
         n = self.content_length() or 0
         if n > max_bytes:
-            raise HttpError(413, 'body_too_large',
+            raise HttpError(PAYLOAD_TOO_LARGE, 'body_too_large',
                             f'request body is {n} bytes; the ingress '
                             f'accepts at most {max_bytes}',
                             max_bytes=max_bytes, got_bytes=n)
         body = self._rfile.read(n) if n else b''
         if len(body) != n:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             'connection closed mid-body')
         return body
 
@@ -120,9 +145,9 @@ class HttpRequest:
         try:
             obj = json.loads(body.decode('utf-8'))
         except (ValueError, UnicodeDecodeError) as e:
-            raise HttpError(400, 'bad_request', f'malformed JSON body: {e}')
+            raise HttpError(BAD_REQUEST, 'bad_request', f'malformed JSON body: {e}')
         if not isinstance(obj, dict):
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             'request body must be a JSON object')
         return obj
 
@@ -131,7 +156,7 @@ class HttpRequest:
         each chunk is one client message). Ends after the zero-length
         terminator chunk."""
         if not self.chunked:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             'this endpoint requires Transfer-Encoding: '
                             'chunked')
         return iter_chunks(self._rfile, max_chunk_bytes)
@@ -144,14 +169,14 @@ def read_request(rfile) -> Optional[HttpRequest]:
     if not line:
         return None
     if len(line) > MAX_REQUEST_LINE:
-        raise HttpError(400, 'bad_request', 'request line too long')
+        raise HttpError(BAD_REQUEST, 'bad_request', 'request line too long')
     try:
         method, target, version = line.decode('latin-1').split()
     except ValueError:
-        raise HttpError(400, 'bad_request',
+        raise HttpError(BAD_REQUEST, 'bad_request',
                         f'malformed request line {line!r}')
     if not version.startswith('HTTP/1.'):
-        raise HttpError(400, 'bad_request',
+        raise HttpError(BAD_REQUEST, 'bad_request',
                         f'unsupported HTTP version {version!r}')
     headers: Dict[str, str] = {}
     total = 0
@@ -159,17 +184,17 @@ def read_request(rfile) -> Optional[HttpRequest]:
         raw = rfile.readline(MAX_HEADER_BYTES + 1)
         total += len(raw)
         if total > MAX_HEADER_BYTES:
-            raise HttpError(431, 'headers_too_large',
+            raise HttpError(HEADERS_TOO_LARGE, 'headers_too_large',
                             'header block too large')
         if raw in (b'\r\n', b'\n', b''):
             break
         try:
             name, _, value = raw.decode('latin-1').partition(':')
         except UnicodeDecodeError:
-            raise HttpError(400, 'bad_request', 'undecodable header')
+            raise HttpError(BAD_REQUEST, 'bad_request', 'undecodable header')
         headers[name.strip().lower()] = value.strip()
     else:
-        raise HttpError(400, 'bad_request', 'too many headers')
+        raise HttpError(BAD_REQUEST, 'bad_request', 'too many headers')
     return HttpRequest(method.upper(), target, rfile, headers)
 
 
@@ -180,7 +205,7 @@ def read_chunked(rfile, max_bytes: int) -> bytes:
     for chunk in iter_chunks(rfile, max_bytes):
         total += len(chunk)
         if total > max_bytes:
-            raise HttpError(413, 'body_too_large',
+            raise HttpError(PAYLOAD_TOO_LARGE, 'body_too_large',
                             f'chunked body exceeded {max_bytes} bytes',
                             max_bytes=max_bytes)
         out.append(chunk)
@@ -194,27 +219,27 @@ def iter_chunks(rfile, max_chunk_bytes: int) -> Iterator[bytes]:
     while True:
         size_line = rfile.readline(64)
         if not size_line:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             'connection closed mid-chunked-body')
         if not size_line.endswith(b'\n'):
             # readline hit its bound mid-line (an over-long chunk
             # extension): parsing the size anyway would leave the line's
             # tail to be consumed as payload — misframed forever after
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             'chunk-size line too long')
         try:
             size = int(size_line.split(b';', 1)[0].strip(), 16)
         except ValueError:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             f'malformed chunk size {size_line!r}')
         if size < 0:
             # int(_, 16) happily parses '-1'; rfile.read(-1) would then
             # buffer to EOF — the exact unbounded read the max-chunk
             # bound exists to prevent
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             f'negative chunk size {size_line!r}')
         if size > max_chunk_bytes:
-            raise HttpError(413, 'body_too_large',
+            raise HttpError(PAYLOAD_TOO_LARGE, 'body_too_large',
                             f'chunk of {size} bytes exceeds the '
                             f'{max_chunk_bytes}-byte bound',
                             max_bytes=max_chunk_bytes, got_bytes=size)
@@ -223,7 +248,7 @@ def iter_chunks(rfile, max_chunk_bytes: int) -> Iterator[bytes]:
             return
         data = rfile.read(size)
         if len(data) != size:
-            raise HttpError(400, 'bad_request',
+            raise HttpError(BAD_REQUEST, 'bad_request',
                             'connection closed mid-chunk')
         rfile.readline(8)               # chunk's trailing CRLF
         yield data
@@ -411,7 +436,7 @@ class HttpServer:
                 # connection loop must survive one handler's crash
                 except Exception as e:
                     try:
-                        resp.send_json(500, {
+                        resp.send_json(INTERNAL_ERROR, {
                             'ok': False, 'error': 'internal',
                             'message': f'{type(e).__name__}: {e}'})
                     except (OSError, ValueError):

@@ -86,7 +86,7 @@ class ServeClient:
     # -- commands ------------------------------------------------------------
 
     def ping(self) -> bool:
-        return bool(self._call({'cmd': 'ping'}).get('ok'))
+        return bool(self._call({'cmd': protocol.CMD_PING}).get('ok'))
 
     def submit(self, feature_type: str, video_paths: List[str],
                overrides: Optional[Dict[str, Any]] = None,
@@ -103,7 +103,8 @@ class ServeClient:
         saturated queue sheds batch before interactive; ``traceparent``
         (W3C ``00-<trace>-<span>-<flags>``) joins the request to a
         caller-owned distributed trace (minted server-side otherwise)."""
-        msg: Dict[str, Any] = {'cmd': 'submit', 'feature_type': feature_type,
+        msg: Dict[str, Any] = {'cmd': protocol.CMD_SUBMIT,
+                               'feature_type': feature_type,
                                'video_paths': list(video_paths)}
         if overrides:
             msg['overrides'] = dict(overrides)
@@ -118,7 +119,8 @@ class ServeClient:
         return self._call(msg)['request_id']
 
     def status(self, request_id: str) -> Dict[str, Any]:
-        return self._call({'cmd': 'status', 'request_id': request_id})
+        return self._call({'cmd': protocol.CMD_STATUS,
+                           'request_id': request_id})
 
     def trace(self, request_id: str) -> Dict[str, Any]:
         """The request's assembled span timeline: ``{request_id,
@@ -126,7 +128,8 @@ class ServeClient:
         the server's live recorders carrying the request's trace id
         (requires the server to run with a ``trace_out`` base override;
         empty otherwise)."""
-        return self._call({'cmd': 'trace', 'request_id': request_id})
+        return self._call({'cmd': protocol.CMD_TRACE,
+                           'request_id': request_id})
 
     def wait(self, request_id: str, timeout_s: float = 300.0,
              poll_s: float = 0.05) -> Dict[str, Any]:
@@ -139,7 +142,8 @@ class ServeClient:
             rfile = conn.makefile('rb')
             while True:
                 conn.sendall(protocol.encode(
-                    {'cmd': 'status', 'request_id': request_id}))
+                    {'cmd': protocol.CMD_STATUS,
+                     'request_id': request_id}))
                 st = self._read_response(rfile)
                 if st['state'] != 'running':
                     return st
@@ -150,12 +154,12 @@ class ServeClient:
                 time.sleep(poll_s)
 
     def metrics(self) -> Dict[str, Any]:
-        return self._call({'cmd': 'metrics'})['metrics']
+        return self._call({'cmd': protocol.CMD_METRICS})['metrics']
 
     def metrics_prom(self) -> str:
         """The same state as Prometheus text exposition format 0.0.4."""
-        return self._call({'cmd': 'metrics_prom'})['text']
+        return self._call({'cmd': protocol.CMD_METRICS_PROM})['text']
 
     def drain(self) -> None:
         """Ask the server to drain (finish queued work, then exit)."""
-        self._call({'cmd': 'drain'})
+        self._call({'cmd': protocol.CMD_DRAIN})

@@ -47,12 +47,29 @@ from __future__ import annotations
 import json
 from typing import Any, Dict
 
-COMMANDS = ('submit', 'status', 'trace', 'metrics', 'metrics_prom',
-            'drain', 'ping')
+# command-name constants: the ONE spelling of each command. The server
+# dispatch and ServeClient build their messages from these (vft-lint's
+# wire-literal rule rejects inline command strings in serve/), and the
+# vft-wire extractor (analysis/wire.py) anchors its static command
+# enumeration here — an inline 'submit' string would be invisible to it.
+CMD_SUBMIT = 'submit'
+CMD_STATUS = 'status'
+CMD_TRACE = 'trace'
+CMD_METRICS = 'metrics'
+CMD_METRICS_PROM = 'metrics_prom'
+CMD_DRAIN = 'drain'
+CMD_PING = 'ping'
+
+COMMANDS = (CMD_SUBMIT, CMD_STATUS, CMD_TRACE, CMD_METRICS,
+            CMD_METRICS_PROM, CMD_DRAIN, CMD_PING)
 
 # wire protocol version this build speaks; MAJOR is the compatibility
-# gate (minor bumps are additive-fields-only and never rejected)
-VERSION = '1.0'
+# gate (minor bumps are additive-fields-only and never rejected).
+# History: 1.0 introduced versioning itself (check_version + client `v`
+# stamping); 1.1 is the first real MINOR bump, retroactively covering
+# the additive `trace` command / `/v1/requests/<id>/trace` route that
+# landed without a bump — exactly the drift WIRE.lock.json now catches.
+VERSION = '1.1'
 MAJOR = 1
 
 # submit() fields copied verbatim into the request (everything else in the

@@ -1302,9 +1302,9 @@ class ExtractionServer:
         if bad_version is not None:
             return bad_version
         cmd = msg.get('cmd')
-        if cmd == 'ping':
+        if cmd == protocol.CMD_PING:
             return protocol.ok(draining=self._draining, v=protocol.VERSION)
-        if cmd == 'submit':
+        if cmd == protocol.CMD_SUBMIT:
             unknown = set(msg) - set(protocol.SUBMIT_FIELDS)
             if unknown:
                 return protocol.error(
@@ -1316,16 +1316,16 @@ class ExtractionServer:
                                range_s=msg.get('range'),
                                priority=msg.get('priority', 'interactive'),
                                traceparent=msg.get('traceparent'))
-        if cmd == 'status':
+        if cmd == protocol.CMD_STATUS:
             return self.status(msg.get('request_id'))
-        if cmd == 'trace':
+        if cmd == protocol.CMD_TRACE:
             return self.request_trace(msg.get('request_id'))
-        if cmd == 'metrics':
+        if cmd == protocol.CMD_METRICS:
             return protocol.ok(metrics=self.metrics())
-        if cmd == 'metrics_prom':
+        if cmd == protocol.CMD_METRICS_PROM:
             # Prometheus text exposition 0.0.4 of the same state
             return protocol.ok(text=self._prometheus(self.metrics()))
-        if cmd == 'drain':
+        if cmd == protocol.CMD_DRAIN:
             self.drain(wait=False)
             return protocol.ok(draining=True)
         return protocol.error(
