@@ -377,6 +377,166 @@ def bench_cache(precision: str, batch: int, stack: int, tmp_dir: str,
     }
 
 
+def _feature_file_errors(root_a: str, root_b: str) -> dict:
+    """max-abs + rel-L2 error between two output roots' FEATURE files
+    (matched by relative path; fps/timestamps sidecars excluded — they
+    are identical across lanes and would dilute the rel-L2 denominator).
+    The honest error a *_bf16_* rung records next to its speedup."""
+    from video_features_tpu.ops.precision import rel_l2
+
+    def feature_files(root):
+        return {p.relative_to(root): p for p in Path(root).rglob('*.npy')
+                if not p.name.endswith(('_fps.npy',
+                                        '_timestamps_ms.npy'))}
+
+    a_files, b_files = feature_files(root_a), feature_files(root_b)
+    # symmetric: an extra/renamed bf16 output is itself a divergence the
+    # rung must surface, not silently ignore
+    assert set(a_files) == set(b_files), (
+        f'lanes produced different output sets: only-fp32='
+        f'{sorted(set(a_files) - set(b_files))} only-bf16='
+        f'{sorted(set(b_files) - set(a_files))}')
+    refs, cands = [], []
+    for rel, pa in sorted(a_files.items()):
+        refs.append(np.load(pa).ravel())
+        cands.append(np.load(b_files[rel]).ravel())
+    assert refs, 'no feature files to compare'
+    ref = np.concatenate(refs)
+    cand = np.concatenate(cands)
+    return {
+        'max_abs_error': round(float(np.max(np.abs(ref - cand))), 6),
+        'rel_l2_error': round(rel_l2(ref, cand), 6),
+    }
+
+
+def bench_bf16_framewise(jax, device, iters: int, on_accel: bool) -> dict:
+    """The framewise in-graph bf16 rung: the SAME resnet step (the
+    production ``ExtractResNet._forward``) timed fp32 vs bf16 on
+    device-resident uint8 batches — bf16 params from the transplant cast
+    (half the HBM), bf16 activations with the ops/nn.py fp32 islands —
+    plus the measured error of one batch. The framewise families are the
+    bandwidth-bound end (2500+ frames/s) where bf16 storage pays most."""
+    from functools import partial
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from video_features_tpu.extract.resnet import ExtractResNet
+    from video_features_tpu.models import resnet as resnet_model
+    from video_features_tpu.ops.precision import param_np_dtype, rel_l2
+    from video_features_tpu.transplant.torch2jax import transplant
+
+    arch = 'resnet50' if on_accel else 'resnet18'
+    size = 224 if on_accel else 64
+    batch = 32 if on_accel else 2
+    sd = resnet_model.init_state_dict(arch=arch)
+    rng = np.random.RandomState(0)
+    frames = jax.device_put(
+        rng.randint(0, 255, (iters, batch, size, size, 3))
+        .astype(np.uint8), device)
+    one = jax.device_put(
+        rng.randint(0, 255, (batch, size, size, 3)).astype(np.uint8),
+        device)
+
+    rates, outs = {}, {}
+    for lane in ('float32', 'bfloat16'):
+        params = jax.device_put(
+            transplant(sd, dtype=param_np_dtype(lane)), device)
+        step = partial(
+            ExtractResNet._forward, arch=arch,
+            dtype=jnp.bfloat16 if lane == 'bfloat16' else jnp.float32)
+
+        def chained(p, xs):
+            def body(acc, x):
+                return acc + step(p, x).sum(), None
+            acc, _ = lax.scan(body, jnp.float32(0), xs)
+            return acc
+
+        jitted = jax.jit(chained)
+        assert np.isfinite(float(jitted(params, frames)))  # compile+guard
+        t0 = time.perf_counter()
+        checksum = float(jitted(params, frames))
+        rates[lane] = batch * iters / (time.perf_counter() - t0)
+        assert np.isfinite(checksum)
+        outs[lane] = np.asarray(jax.jit(step)(params, one))
+
+    err = float(np.max(np.abs(outs['float32'] - outs['bfloat16'])))
+    return {
+        'resnet_ingraph_bf16_frames_per_sec': round(rates['bfloat16'], 3),
+        'resnet_ingraph_bf16_fp32_frames_per_sec': round(
+            rates['float32'], 3),
+        'resnet_ingraph_bf16_speedup': round(
+            rates['bfloat16'] / rates['float32'], 3),
+        'resnet_ingraph_bf16_max_abs_error': round(err, 6),
+        'resnet_ingraph_bf16_rel_l2_error': round(
+            rel_l2(outs['float32'], outs['bfloat16']), 6),
+    }
+
+
+def bench_serve_bf16(precision: str, tmp_dir: str, platform: str,
+                     wl_paths: list) -> dict:
+    """The serve-warm bf16 rung: the same worklist served twice per lane
+    (cold then warm) through ONE daemon — fp32 and bf16 requests build
+    DISTINCT warm pool entries (compute_dtype is pool-key relevant;
+    asserted via the pool size), and the warm-pass rates give the
+    steady-state speedup a resident bf16 entry actually delivers, with
+    the measured error of the warm outputs recorded beside it."""
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+    from video_features_tpu.utils.output import make_path
+
+    base = {
+        'device': platform, 'precision': precision,
+        'model_name': 'resnet18', 'batch_size': 8,
+        'allow_random_weights': True, 'on_extraction': 'save_numpy',
+        'tmp_path': os.path.join(tmp_dir, 'sbf_tmp'),
+    }
+    server = ExtractionServer(
+        base_overrides=base,
+        queue_depth=max(64, 4 * len(wl_paths))).start()
+    try:
+        client = ServeClient(port=server.port)
+
+        def one_pass(tag, lane):
+            out_root = os.path.join(tmp_dir, f'sbf_out_{tag}')
+            t0 = time.perf_counter()
+            rids = [client.submit('resnet', [p], overrides={
+                        'output_path': out_root,
+                        'compute_dtype': lane})
+                    for p in wl_paths]
+            for rid in rids:
+                st = client.wait(rid, timeout_s=900)
+                assert st['state'] == 'done', f'serve bf16 {tag}: {st}'
+            return out_root, time.perf_counter() - t0
+
+        one_pass('f32_cold', 'float32')
+        f32_root, f32_s = one_pass('f32_warm', 'float32')
+        one_pass('bf16_cold', 'bfloat16')
+        bf16_root, bf16_s = one_pass('bf16_warm', 'bfloat16')
+
+        clips = 0
+        for p in wl_paths:
+            arr = np.load(make_path(os.path.join(bf16_root, 'resnet',
+                                                 'resnet18'),
+                                    p, 'resnet', '.npy'))
+            clips += arr.shape[0]
+        assert clips > 0, 'serve bf16 warm pass produced no clips'
+        m = client.metrics()
+        # distinct warm entries per lane — the pool-key split the knob's
+        # 'both' classification promises (never a shared program)
+        assert m['warm_pool']['size'] >= 2, m['warm_pool']
+        errs = _feature_file_errors(f32_root, bf16_root)
+        return {
+            'serve_bf16_clips_per_sec': round(clips / bf16_s, 3),
+            'serve_bf16_fp32_clips_per_sec': round(clips / f32_s, 3),
+            'serve_bf16_speedup': round(f32_s / bf16_s, 3),
+            'serve_bf16_max_abs_error': errs['max_abs_error'],
+            'serve_bf16_rel_l2_error': errs['rel_l2_error'],
+        }
+    finally:
+        server.drain(wait=True, grace_s=120)
+
+
 def _bench_video(tmp_dir: str, seconds: str = None) -> str:
     """A local benchmark clip: the reference sample if present, else a
     synthetic one (tools/make_sample_video.py). ``BENCH_VIDEO=synthetic``
@@ -546,6 +706,20 @@ def run() -> dict:
         except Exception as e:
             rungs[f'{fam}_ingraph_error'] = f'{type(e).__name__}: {e}'
 
+    # the bf16 fast lane (compute_dtype=bfloat16, ops/precision.py):
+    # device-only framewise speedup + measured error vs the fp32
+    # sibling, always recorded together so a committed bf16 number is
+    # checkable against its family's pinned bound. BENCH_BF16=0/1
+    # overrides the accelerator-only default.
+    run_bf16 = os.environ.get('BENCH_BF16',
+                              '1' if on_accel else '0') == '1'
+    if run_bf16:
+        try:
+            rungs.update(bench_bf16_framewise(jax, device, iters,
+                                              on_accel))
+        except Exception as e:
+            rungs['bf16_ingraph_error'] = f'{type(e).__name__}: {e}'
+
     # per-rung Tracer stage reports (decode/h2d/model/save split) ride
     # along in the record so tools/bench_diff.py users can see WHERE a
     # regression landed, not just that one did
@@ -713,6 +887,53 @@ def run() -> dict:
                     except Exception as e:
                         rungs['worklist_mesh_error'] = \
                             f'{type(e).__name__}: {e}'
+                # The bf16 fast-lane rung (compute_dtype=bfloat16): the
+                # same worklist, packed, on an accepting family
+                # (BENCH_BF16_FEATURE, default resnet — the framewise
+                # bandwidth-bound end) — one fp32 sibling pass + one
+                # bf16 pass at OTHERWISE IDENTICAL knobs (inflight=1,
+                # in-process decode), so the delta is the lane alone,
+                # with the measured output error recorded next to the
+                # speedup (never a speedup without its cost).
+                if wl_paths is not None and run_bf16:
+                    try:
+                        bf_feature = os.environ.get('BENCH_BF16_FEATURE',
+                                                    'resnet')
+                        wrec_f32 = run_worklist(
+                            bf_feature, wl_paths,
+                            os.path.join(tmp_dir, 'bf16_f32'),
+                            tmp_dir, platform, batch_size=min(batch, 8),
+                            stack=stack, precision=precision,
+                            packed=True, inflight=1, decode_workers=1,
+                            compute_dtype='float32')
+                        wrec_bf16 = run_worklist(
+                            bf_feature, wl_paths,
+                            os.path.join(tmp_dir, 'bf16'),
+                            tmp_dir, platform, batch_size=min(batch, 8),
+                            stack=stack, precision=precision,
+                            packed=True, inflight=1, decode_workers=1,
+                            compute_dtype='bfloat16')
+                        errs = _feature_file_errors(
+                            os.path.join(tmp_dir, 'bf16_f32', 'out'),
+                            os.path.join(tmp_dir, 'bf16', 'out'))
+                        rungs['worklist_packed_bf16_clips_per_sec'] = \
+                            wrec_bf16['clips_per_sec']
+                        rungs['worklist_packed_bf16_fp32_clips_per_sec'] \
+                            = wrec_f32['clips_per_sec']
+                        rungs['worklist_packed_bf16_speedup'] = round(
+                            wrec_bf16['clips_per_sec']
+                            / max(wrec_f32['clips_per_sec'], 1e-9), 3)
+                        rungs['worklist_packed_bf16_max_abs_error'] = \
+                            errs['max_abs_error']
+                        rungs['worklist_packed_bf16_rel_l2_error'] = \
+                            errs['rel_l2_error']
+                        rungs['worklist_bf16_compute_dtype'] = \
+                            wrec_bf16['compute_dtype']
+                        stage_reports['worklist_packed_bf16'] = \
+                            wrec_bf16['stages']
+                    except Exception as e:
+                        rungs['worklist_bf16_error'] = \
+                            f'{type(e).__name__}: {e}'
             # The serving rung (serve/): the same worklist content
             # submitted as dynamic per-video requests against the
             # warm-pool daemon — sustained warm clips/sec, the cold-start
@@ -781,6 +1002,21 @@ def run() -> dict:
                     rungs['cache_bytes_saved'] = crec['cache_bytes_saved']
                 except Exception as e:
                     rungs['cache_error'] = f'{type(e).__name__}: {e}'
+            # The serve-warm bf16 rung: fp32 and bf16 entries resident
+            # side by side in ONE daemon (distinct pool keys), warm
+            # rates + measured error. BENCH_BF16_SERVE=0/1 overrides.
+            if os.environ.get('BENCH_BF16_SERVE',
+                              '1' if on_accel else '0') == '1':
+                try:
+                    if wl_paths is None:
+                        from tools.worklist_bench import make_worklist
+                        wl_paths = make_worklist(
+                            tmp_dir, 4 if on_accel else 2,
+                            10 if on_accel else 2)
+                    rungs.update(bench_serve_bf16(precision, tmp_dir,
+                                                  platform, wl_paths))
+                except Exception as e:
+                    rungs['serve_bf16_error'] = f'{type(e).__name__}: {e}'
     if mode == 'e2e' and f'e2e_{precision}' in rungs:
         headline_key = f'e2e_{precision}'
 

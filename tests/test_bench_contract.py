@@ -117,6 +117,69 @@ def test_bench_worklist_async_rung_emits_keys():
     assert 'd2h' in packed_rep
 
 
+def test_bench_bf16_rungs_emit_keys():
+    """BENCH_BF16=1 drives the bf16 fast-lane rungs: the in-graph
+    framewise pair (fp32 vs bf16 on the SAME resnet step) and the packed
+    worklist pair — every speedup recorded WITH its measured error, and
+    the error under the family's pinned parity bound. fp32 rung keys are
+    untouched (default path byte-identical)."""
+    from video_features_tpu.ops.precision import BF16_REL_L2_BOUNDS
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_WORKLIST': '1', 'BENCH_SERVE': '0',
+                      'BENCH_CACHE': '0', 'BENCH_BF16': '1',
+                      'BENCH_BF16_SERVE': '0',
+                      'BENCH_WORKLIST_FEATURE': 'resnet'})
+    rungs = rec['rungs']
+    for err in ('bf16_ingraph_error', 'worklist_bf16_error'):
+        assert err not in rungs, rungs.get(err)
+    # in-graph framewise pair: speedup + error always recorded together
+    assert rungs['resnet_ingraph_bf16_frames_per_sec'] > 0
+    assert rungs['resnet_ingraph_bf16_fp32_frames_per_sec'] > 0
+    assert rungs['resnet_ingraph_bf16_speedup'] > 0
+    assert rungs['resnet_ingraph_bf16_max_abs_error'] > 0
+    assert 0 < rungs['resnet_ingraph_bf16_rel_l2_error'] \
+        <= BF16_REL_L2_BOUNDS['resnet']
+    # packed worklist pair: real files, fp32 sibling rung beside it
+    assert rungs['worklist_packed_bf16_clips_per_sec'] > 0
+    assert rungs['worklist_packed_bf16_fp32_clips_per_sec'] > 0
+    assert rungs['worklist_packed_bf16_speedup'] > 0
+    assert rungs['worklist_packed_bf16_max_abs_error'] > 0
+    assert 0 < rungs['worklist_packed_bf16_rel_l2_error'] \
+        <= BF16_REL_L2_BOUNDS['resnet']
+    assert rungs['worklist_bf16_compute_dtype'] == 'bfloat16'
+    # fp32 rungs keep their historical keys (the default path's numbers
+    # never get relabelled by the lane's arrival)
+    assert any(k.startswith('worklist_packed_clips_per_sec')
+               for k in rungs)
+
+
+def test_bench_diff_error_rungs_flagged_never_gated(tmp_path):
+    """tools/bench_diff.py direction-awareness for the *_error* fields:
+    a measured-error rung that RISES shows as WORSE (lower-is-better)
+    but never trips --fail-on-regression; speedups gate like any
+    throughput rung."""
+    import tools.bench_diff as bd
+    old = {'metric': 'm', 'value': 1.0, 'unit': 'u', 'vs_baseline': 1.0,
+           'rungs': {'worklist_packed_bf16_max_abs_error': 0.001,
+                     'worklist_packed_bf16_speedup': 2.0}}
+    new = {'metric': 'm', 'value': 1.0, 'unit': 'u', 'vs_baseline': 1.0,
+           'rungs': {'worklist_packed_bf16_max_abs_error': 0.01,
+                     'worklist_packed_bf16_speedup': 2.0}}
+    a, b = tmp_path / 'a.json', tmp_path / 'b.json'
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new))
+    # 10x worse error, threshold 1%: still exit 0 — flagged, never gated
+    assert bd.main([str(a), str(b), '--fail-on-regression', '1']) == 0
+    # ...but a dropped speedup DOES gate
+    new['rungs']['worklist_packed_bf16_speedup'] = 1.0
+    b.write_text(json.dumps(new))
+    assert bd.main([str(a), str(b), '--fail-on-regression', '10']) == 1
+    assert bd.lower_is_better('x_rel_l2_error')
+    assert bd.is_error_rung('x_max_abs_error')
+    assert not bd.is_error_rung('serve_bf16_speedup')
+
+
 def test_bench_serve_rung_emits_keys():
     """BENCH_SERVE=1 drives the warm-pool service rung (serve/): the
     record must carry the sustained + cold clips/sec, the latency

@@ -531,3 +531,34 @@ def test_device_placer_spreads_families_and_releases():
     # ask for more than exists: clamped, never raises (build-time
     # validation already rejected genuine over-asks)
     assert len(placer.assign(devices, len(devices) + 5)) == len(devices)
+
+
+def test_device_placer_ranks_by_real_bytes():
+    """Byte-aware placement (the bf16 fast lane's accounting): two
+    half-size entries should stack on one chip before a second full-size
+    copy does, the bytes gauges read REAL residency, and release nets
+    the ledger back to zero."""
+    import jax
+
+    from video_features_tpu.serve.pool import DevicePlacer
+
+    devices = jax.devices()[:2]
+    placer = DevicePlacer()
+    big = placer.assign(devices, 1, nbytes=1000)     # fp32-sized entry
+    small1 = placer.assign(devices, 1, nbytes=500)   # bf16-sized
+    small2 = placer.assign(devices, 1, nbytes=400)
+    assert big[0].id != small1[0].id
+    # 500 < 1000: the second small entry stacks on the small chip —
+    # byte ranking, not entry-count ranking (which would tie 1 vs 1 and
+    # fall back to device id, landing on the BIG chip)
+    assert small2[0].id == small1[0].id
+    by_bytes = placer.snapshot_bytes()
+    assert by_bytes[f'd{big[0].id}'] == 1000
+    assert by_bytes[f'd{small1[0].id}'] == 900
+    # zero-byte callers (tests, unknown sizes) keep the historical
+    # entry-count ordering as the secondary key
+    placer.release(small2, nbytes=400)
+    placer.release(small1, nbytes=500)
+    placer.release(big, nbytes=1000)
+    assert set(placer.snapshot_bytes().values()) == {0}
+    assert set(placer.snapshot().values()) == {0}

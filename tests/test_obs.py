@@ -343,6 +343,12 @@ def test_packed_cli_trace_out_covers_every_video(obs_worklist, tmp_path,
     # no time lost or double-counted: every dispatched batch has exactly
     # one model span and one d2h span
     assert len(by_name.get('d2h', [])) == len(by_name.get('model', []))
+    # every model/d2h span names the precision lane that computed it
+    # (compute_dtype — the bf16 fast lane's post-hoc attribution hook);
+    # this run is the default lane, so every span says float32
+    for name in ('model', 'd2h'):
+        assert all(e['args'].get('compute_dtype') == 'float32'
+                   for e in by_name.get(name, []) if 'args' in e), name
     # vft-flight: a packed CLI run is ONE request — every trace-tagged
     # span shares the run's single trace_id (per-video child span_ids
     # under it), so --trace-id filtering works on CLI traces too

@@ -1,8 +1,16 @@
-"""ops/precision pins + the precision='mixed' extraction mode."""
+"""ops/precision pins + the precision='mixed' extraction mode + the
+compute_dtype=bfloat16 fast lane's pinned parity bounds (PARITY.md-style:
+the bounds table lives in ops/precision.BF16_REL_L2_BOUNDS; this module
+asserts the measured drift of every accepting family's REAL jitted step
+stays under it — the cheapest family in tier-1, the full six-family
+ladder in the slow lane)."""
 import numpy as np
+import pytest
 
 from video_features_tpu.ops.precision import (
-    MIXED_PINS, normalize_pins, pin_scope,
+    BF16_REL_L2_BOUNDS, COMPUTE_DTYPES, ComputeDtypeError, MIXED_PINS,
+    check_compute_dtype, normalize_pins, param_np_dtype, pin_scope,
+    rel_l2,
 )
 
 
@@ -42,33 +50,223 @@ def test_pin_scope_sets_matmul_precision():
 def test_mixed_mode_extractor_runs_and_matches_on_cpu(tmp_path):
     """precision='mixed' compiles and runs; on CPU every precision executes
     fp32, so mixed must be bit-identical to highest — this checks the pin
-    plumbing doesn't alter the graph structure."""
+    plumbing doesn't alter the graph structure. ONE i3d build serves
+    both precisions (mixed's pins are empty, so the jitted step is the
+    same callable — only the ambient matmul-precision context differs,
+    which is exactly the knob under test); the second transplant the old
+    two-build version paid bought nothing but tier-1 wall clock."""
     import jax
 
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
 
-    def build(precision):
-        args = load_config('i3d', overrides={
-            'video_paths': 'v.mp4', 'device': 'cpu',
-            'precision': precision, 'stack_size': 10, 'step_size': 10,
-            'allow_random_weights': True,
-            'output_path': str(tmp_path / f'o{precision}'),
-            'tmp_path': str(tmp_path / f't{precision}'),
-        })
-        return create_extractor(args)
+    args = load_config('i3d', overrides={
+        'video_paths': 'v.mp4', 'device': 'cpu',
+        'precision': 'mixed', 'stack_size': 10, 'step_size': 10,
+        'allow_random_weights': True,
+        'output_path': str(tmp_path / 'o'),
+        'tmp_path': str(tmp_path / 't'),
+    })
+    ex = create_extractor(args)
+    assert ex.precision == 'mixed' and ex.precision_pins == ()
 
     stacks = np.random.RandomState(0).randint(
         0, 255, (1, 11, 64, 64, 3)).astype(np.float32)
     outs = {}
-    for precision in ('mixed', 'highest'):
-        ex = build(precision)
-        with ex.precision_scope():
+    scopes = {'mixed': ex.precision_scope(),
+              'highest': jax.default_matmul_precision('highest')}
+    for precision, scope in scopes.items():
+        with scope:
             out = ex._step(ex.params, jax.device_put(stacks),
                            pads=(0, 0, 0, 0), streams=('rgb', 'flow'))
         outs[precision] = {k: np.asarray(v) for k, v in out.items()}
     for k in ('rgb', 'flow'):
         np.testing.assert_array_equal(outs['mixed'][k], outs['highest'][k])
+
+
+# -- the bf16 fast lane (compute_dtype=bfloat16) ------------------------------
+#
+# One extractor per (family, lane) serves ALL of a family's assertions
+# (parity, census, output dtype — the PR 11 reuse pattern: builds are
+# the expensive part); the fp32 reference and the bf16 candidate see
+# IDENTICAL uint8 inputs, so every diff is the lane's. The builds live
+# in the SLOW lane (tier-1's 870 s budget has no room for six extractor
+# pairs); tier-1 keeps the build-free numerics + identity gates below
+# plus the lock-census gate in test_programs.
+
+# family → (config overrides, input batch builder). Geometries are the
+# smallest each family compiles quickly at on CPU; the bound is rel-L2,
+# stable across geometry/weights (max-abs scales with feature magnitude).
+_BF16_CASES = {
+    'vggish': ({}, lambda: np.random.RandomState(0)
+               .rand(4, 96, 64, 1).astype(np.float32)),
+    'r21d': ({'stack_size': 10, 'step_size': 10},
+             lambda: np.random.RandomState(0)
+             .randint(0, 255, (1, 10, 64, 86, 3)).astype(np.uint8)),
+    's3d': ({'stack_size': 16, 'step_size': 16},
+            lambda: np.random.RandomState(0)
+            .randint(0, 255, (1, 16, 64, 86, 3)).astype(np.uint8)),
+    'resnet': ({'model_name': 'resnet18', 'batch_size': 2},
+               lambda: np.random.RandomState(0)
+               .randint(0, 255, (2, 224, 224, 3)).astype(np.uint8)),
+    'clip': ({'model_name': 'ViT-B/32', 'batch_size': 2},
+             lambda: np.random.RandomState(0)
+             .randint(0, 255, (2, 224, 224, 3)).astype(np.uint8)),
+    'timm': ({'model_name': 'vit_base_patch16_224', 'batch_size': 2,
+              'pretrained': False},
+             lambda: np.random.RandomState(0)
+             .randint(0, 255, (2, 224, 224, 3)).astype(np.uint8)),
+}
+
+
+def _build_lane(ft, compute_dtype, tmp_root):
+    from video_features_tpu.config import load_config
+    from video_features_tpu.registry import create_extractor
+    overrides = {
+        'video_paths': 'v.mp4', 'device': 'cpu',
+        'allow_random_weights': True, 'compute_dtype': compute_dtype,
+        'output_path': f'{tmp_root}/out_{ft}_{compute_dtype}',
+        'tmp_path': f'{tmp_root}/tmp_{ft}_{compute_dtype}',
+    }
+    overrides.update(_BF16_CASES[ft][0])
+    return create_extractor(load_config(ft, overrides=overrides))
+
+
+def _lane_outputs(ft, tmp_root):
+    """(fp32 features, bf16-lane features, bf16 extractor) on identical
+    inputs — the step functions the hot paths dispatch, not re-wraps."""
+    import jax
+    batch = _BF16_CASES[ft][1]()
+    outs = {}
+    ex_b = None
+    for lane in ('float32', 'bfloat16'):
+        ex = _build_lane(ft, lane, tmp_root)
+        if lane == 'bfloat16':
+            ex_b = ex
+        x = batch
+        if ft == 'vggish' and lane == 'bfloat16':
+            x = x.astype(ex.param_dtype)       # the _run_batched edge cast
+        if ft == 's3d':
+            step, _, _ = ex._geometry_step(*batch.shape[2:4])
+            out = step(ex.params, jax.device_put(x))
+        else:
+            out = ex._step(ex.params, jax.device_put(x))
+        outs[lane] = np.asarray(out)
+    return outs['float32'], outs['bfloat16'], ex_b
+
+
+def _assert_lane_contract(ft, tmp_root):
+    import jax
+    ref, fast, ex_b = _lane_outputs(ft, tmp_root)
+    # the lane actually computed differently...
+    assert np.abs(ref - fast).max() > 0, f'{ft}: lanes identical?'
+    # ...features still leave the device as float32 (on-disk contract)...
+    assert fast.dtype == np.float32
+    # ...within the family's pinned parity bound...
+    err = rel_l2(ref, fast)
+    assert err <= BF16_REL_L2_BOUNDS[ft], (
+        f'{ft}: bf16 lane rel-L2 {err:.3e} over the pinned bound '
+        f'{BF16_REL_L2_BOUNDS[ft]:.1e}')
+    # ...and the cast reached EVERY param: bf16 in HBM, zero fp32
+    # survivors (the PROGRAMS.lock census holds the same line)
+    dtypes = {str(leaf.dtype)
+              for leaf in jax.tree_util.tree_leaves(ex_b.params)
+              if hasattr(leaf, 'dtype')}
+    assert dtypes == {'bfloat16'}, (ft, dtypes)
+
+
+def test_bf16_bounds_table_is_pinned():
+    """PARITY.md-style pin: the bounds (and who accepts the lane) are an
+    intentional, test-visible contract — moving one is a review event,
+    not a drive-by edit."""
+    from video_features_tpu.registry import BF16_FEATURES
+    assert BF16_REL_L2_BOUNDS == {
+        'r21d': 1.5e-2, 's3d': 2e-2, 'resnet': 2e-2,
+        'clip': 3e-2, 'timm': 5e-2, 'vggish': 2.5e-2,
+    }
+    assert set(BF16_REL_L2_BOUNDS) == BF16_FEATURES
+    assert COMPUTE_DTYPES == ('float32', 'bfloat16')
+
+
+def test_bf16_refusal_is_structured_and_names_the_bound():
+    for ft in ('i3d', 'raft'):
+        with pytest.raises(ComputeDtypeError) as e:
+            check_compute_dtype(ft, 'bfloat16')
+        msg = str(e.value)
+        assert ft in msg and '1e-3' in msg and 'precision=mixed' in msg
+    with pytest.raises(ComputeDtypeError):
+        check_compute_dtype('resnet', 'float16')    # unknown value
+    assert check_compute_dtype('i3d', 'float32') == 'float32'
+    assert check_compute_dtype('resnet', 'bfloat16') == 'bfloat16'
+
+
+def test_param_np_dtype():
+    import ml_dtypes
+    assert param_np_dtype('float32') == np.dtype(np.float32)
+    assert param_np_dtype('bfloat16') == np.dtype(ml_dtypes.bfloat16)
+
+
+def test_compute_dtype_is_identity_on_both_axes():
+    """The KNOB_CLASSIFICATION 'both' contract, pinned via the two REAL
+    consumers: fp32 and bf16 runs of the same video must produce
+    distinct cache fingerprints (never share a cache entry) and
+    distinct serve pool keys (never share a warm program)."""
+    from video_features_tpu.cache.key import config_fingerprint
+    from video_features_tpu.config import KNOB_CLASSIFICATION, Config
+    from video_features_tpu.serve.server import pool_key
+    assert KNOB_CLASSIFICATION['compute_dtype'] == 'both'
+    base = dict(feature_type='resnet', model_name='resnet18',
+                batch_size=8, device='cpu', output_path='/o',
+                tmp_path='/t')
+    f32 = Config(base, compute_dtype='float32')
+    bf16 = Config(base, compute_dtype='bfloat16')
+    assert config_fingerprint(f32) != config_fingerprint(bf16)
+    assert pool_key(f32) != pool_key(bf16)
+
+
+def test_bf16_islands_and_epilogue_cast_tier1():
+    """Build-free tier-1 slice of the lane's numerics: the ops/nn fp32
+    accumulation islands fire exactly on bf16 input (fp32 input lowers
+    the pre-lane graph verbatim — no convert ops appear), and the
+    feature epilogue always hands back float32. The full per-family
+    error ladder — real extractor builds, measured drift vs the pinned
+    bounds — lives in the slow lane below; tier-1's STRUCTURAL bf16
+    gate is the lock census in test_programs (resnet, both lanes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from video_features_tpu.ops.nn import adaptive_avg_pool, softmax
+    from video_features_tpu.ops.precision import features_to_f32
+
+    x32 = np.linspace(-3, 3, 4 * 7 * 7 * 5,
+                      dtype=np.float32).reshape(4, 7, 7, 5)
+    xb = jnp.asarray(x32, jnp.bfloat16)
+    # islands keep the lane's dtype on the outside...
+    assert softmax(xb).dtype == jnp.bfloat16
+    assert adaptive_avg_pool(xb).dtype == jnp.bfloat16
+    # ...and compute fp32 inside: the bf16 result equals the fp32
+    # computation rounded ONCE at the end (not bf16 all the way through)
+    ref = jax.nn.softmax(jnp.asarray(np.asarray(xb, np.float32)), axis=-1)
+    np.testing.assert_array_equal(
+        np.asarray(softmax(xb), np.float32),
+        np.asarray(ref.astype(jnp.bfloat16), np.float32))
+    # fp32 path byte-clean: the island branch emits NOTHING for f32
+    jx_f32 = jax.make_jaxpr(softmax)(x32)
+    assert 'bf16' not in str(jx_f32)
+    # the epilogue cast is a no-op (no convert) on the fp32 lane and a
+    # single convert on the bf16 lane
+    assert features_to_f32(jnp.asarray(x32)) .dtype == jnp.float32
+    assert 'convert' not in str(jax.make_jaxpr(features_to_f32)(x32))
+    assert features_to_f32(xb).dtype == jnp.float32
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('ft', sorted(_BF16_CASES))
+def test_bf16_lane_parity_all_families(ft, tmp_path):
+    """The full lane gate, one family per case: real extractor builds on
+    both lanes, identical inputs, measured rel-L2 under the pinned
+    bound, all-bf16 params census, float32 feature outputs."""
+    _assert_lane_contract(ft, str(tmp_path))
 
 
 def test_iter_early_pin_structurally_sound():

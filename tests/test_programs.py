@@ -28,10 +28,12 @@ import jax.numpy as jnp
 from video_features_tpu.analysis.core import EXIT_CLEAN, EXIT_FINDINGS
 from video_features_tpu.analysis.programs import (
     FAMILIES, ProgramSpec, build_family, check_program, collect,
-    default_lock_path, diff_lock, family_lock_hashes, load_lock, main,
-    program_signature, write_lock,
+    default_lock_path, diff_lock, family_lock_hashes, lane_families,
+    load_lock, main, mesh_key, parse_mesh_key, program_signature,
+    write_lock,
 )
 from video_features_tpu.parallel.mesh import make_mesh
+from video_features_tpu.registry import BF16_FEATURES
 
 
 def sig_and_findings(spec, family='toy', width=1, mesh=None):
@@ -152,8 +154,11 @@ def test_mesh_width_2_signature_records_partitions():
 @pytest.fixture(scope='module')
 def r21d_live():
     """One r21d build + both mesh-width lowerings, shared by the lock
-    tests (the build is the expensive part)."""
-    live, findings = collect(('r21d',), (1, 2))
+    tests (the build is the expensive part). float32 lane only: the
+    width/merge semantics under test are lane-independent, and the bf16
+    lane's own semantics have their targeted tests below — one build
+    here instead of two keeps the module inside the tier-1 budget."""
+    live, findings = collect(('r21d',), (1, 2), lanes=('float32',))
     assert findings == []
     return live
 
@@ -265,17 +270,22 @@ def test_unpinned_family_is_drift(r21d_live):
 # -- CLI exit codes (the CI contract) ----------------------------------------
 
 def test_cli_exit_0_clean_and_2_on_drift(tmp_path, capsys):
+    # float32 lane only: each main() builds once per lane, and this test
+    # runs three mains — the lane-aware CLI/diff semantics have their
+    # own (single-build) coverage above, so doubling every build here
+    # would buy nothing but tier-1 wall clock
+    lane = ['--lanes', 'float32']
     lock = tmp_path / 'lock.json'
     assert main(['--families', 'resnet', '--write-lock',
-                 '--lock', str(lock)]) == EXIT_CLEAN
+                 '--lock', str(lock)] + lane) == EXIT_CLEAN
     assert main(['--families', 'resnet',
-                 '--lock', str(lock)]) == EXIT_CLEAN
+                 '--lock', str(lock)] + lane) == EXIT_CLEAN
     doc = json.loads(lock.read_text())
     step = doc['families']['resnet']['mesh1']['programs']['step']
     step['params']['float32']['arrays'] += 1         # injected census drift
     lock.write_text(json.dumps(doc))
     assert main(['--families', 'resnet',
-                 '--lock', str(lock)]) == EXIT_FINDINGS
+                 '--lock', str(lock)] + lane) == EXIT_FINDINGS
     out = capsys.readouterr().out
     assert 'params drifted' in out
 
@@ -283,18 +293,100 @@ def test_cli_exit_0_clean_and_2_on_drift(tmp_path, capsys):
 # -- the live-tree gate vs the SHIPPED lock ----------------------------------
 
 def test_shipped_lock_covers_all_families_at_both_widths():
+    """Every family pins both mesh widths on the float32 lane, and every
+    bf16-accepting family (registry.BF16_FEATURES) ADDITIONALLY pins
+    both widths of its mesh<n>@bfloat16 fast-lane variants — a refusing
+    family (i3d, raft) must have none."""
     doc = load_lock(default_lock_path())
     assert set(doc['families']) == set(FAMILIES)
     for family, entry in doc['families'].items():
-        assert set(entry) == {'mesh1', 'mesh2'}, family
+        want = {'mesh1', 'mesh2'}
+        if family in BF16_FEATURES:
+            want |= {'mesh1@bfloat16', 'mesh2@bfloat16'}
+        assert set(entry) == want, family
         for mesh in entry.values():
             assert mesh['programs'], family
 
 
+def test_shipped_bf16_variants_census_is_pure_bf16():
+    """The lane's load-bearing acceptance: the committed lock's
+    compute_dtype=bfloat16 variants carry ZERO fp32 (or fp64) params —
+    proof the transplant-time cast reached every tensor (fp32 lives
+    only in activation islands, which a params census never sees)."""
+    doc = load_lock(default_lock_path())
+    checked = 0
+    for family in sorted(BF16_FEATURES):
+        for key, entry in doc['families'][family].items():
+            if '@bfloat16' not in key:
+                continue
+            for name, sig in entry['programs'].items():
+                census = sig['params']
+                assert set(census) == {'bfloat16'}, (family, key, name,
+                                                    census)
+                assert census['bfloat16']['arrays'] > 0
+                checked += 1
+    assert checked >= 2 * len(BF16_FEATURES)   # both widths per family
+
+
+def test_lane_helpers_roundtrip():
+    assert mesh_key(1, 'float32') == 'mesh1'          # pre-lane keys hold
+    assert mesh_key(2, 'bfloat16') == 'mesh2@bfloat16'
+    assert parse_mesh_key('mesh1') == (1, 'float32')
+    assert parse_mesh_key('mesh2@bfloat16') == (2, 'bfloat16')
+    assert lane_families('float32', FAMILIES) == FAMILIES
+    assert set(lane_families('bfloat16', FAMILIES)) == BF16_FEATURES
+
+
+def test_bf16_census_rule_catches_fp32_survivor():
+    """A bf16-lane program whose params census still shows float32
+    arrays must trip 'bf16-census' — and the same signature on the
+    float32 lane must not (fp32 params are that lane's contract)."""
+    f = jax.jit(lambda p, b: (b.astype(jnp.bfloat16).sum(axis=1)
+                              * p).astype(np.float32))
+    spec = ProgramSpec('step', f, (P, B4))      # P is a float32 param
+    sig = program_signature(spec)
+    bf16_findings = check_program(spec, sig, 'toy', 1, None,
+                                  lane='bfloat16')
+    assert rules_of(bf16_findings) == {'bf16-census'}
+    assert 'float32' in bf16_findings[0].message
+    assert '@bfloat16' in bf16_findings[0].render()
+    assert check_program(spec, sig, 'toy', 1, None,
+                         lane='float32') == []
+
+
+def test_bf16_lane_collect_and_lock_roundtrip(tmp_path):
+    """One REAL bf16-lane build (vggish — the cheapest family): collect
+    places it under mesh<n>@bfloat16, write-lock/diff round-trips clean,
+    and a census-drift plant in the bf16 variant is reported with the
+    lane named."""
+    live, findings = collect(('vggish',), (1,), lanes=('bfloat16',))
+    assert findings == []
+    assert set(live['vggish']) == {'mesh1@bfloat16'}
+    sig = live['vggish']['mesh1@bfloat16']['programs']['step']
+    assert set(sig['params']) == {'bfloat16'}
+    assert sig['batch']['dtype'] == 'bfloat16'   # halved H2D at the edge
+    lock = tmp_path / 'lock.json'
+    write_lock(lock, live)
+    assert diff_lock(live, load_lock(lock), ('vggish',),
+                     widths=(1,)) == []
+    doc = json.loads(lock.read_text())
+    doc['families']['vggish']['mesh1@bfloat16']['programs']['step'][
+        'params'] = {'float32': {'arrays': 1, 'bytes': 4}}
+    lock.write_text(json.dumps(doc))
+    findings = diff_lock(live, load_lock(lock), ('vggish',), widths=(1,))
+    assert len(findings) == 1
+    assert findings[0].lane == 'bfloat16'
+    assert 'params drifted' in findings[0].message
+
+
 def test_live_tree_clean_fast_families():
     """Tier-1 slice of the CI programs-check gate: the two cheapest
-    builds against the shipped lock (the slow lane + CI run all 8)."""
-    assert main(['--families', 'r21d,resnet']) == EXIT_CLEAN
+    builds against the shipped lock (the slow lane + CI run all 8,
+    both lanes). resnet runs BOTH lanes (the bf16 variants gate in
+    tier-1 too); r21d pins float32 only here — its bf16 build would be
+    a third full build and the CI job covers it."""
+    assert main(['--families', 'resnet']) == EXIT_CLEAN
+    assert main(['--families', 'r21d', '--lanes', 'float32']) == EXIT_CLEAN
 
 
 @pytest.mark.slow
@@ -304,9 +396,14 @@ def test_live_tree_clean_all_families():
 
 def test_family_lock_hashes_reads_shipped_lock():
     hashes = family_lock_hashes('r21d')
-    assert set(hashes) == {'mesh1', 'mesh2'}
+    # a run manifest names its lane's pinned program: the bf16 variants
+    # ride the same mapping under their mesh<n>@bfloat16 keys
+    assert set(hashes) == {'mesh1', 'mesh2',
+                           'mesh1@bfloat16', 'mesh2@bfloat16'}
     assert set(hashes['mesh1']) == {'step'}
     assert len(hashes['mesh1']['step']) == 64
+    assert len(hashes['mesh1@bfloat16']['step']) == 64
+    assert hashes['mesh1@bfloat16']['step'] != hashes['mesh1']['step']
     assert family_lock_hashes('not-a-family') == {}
 
 

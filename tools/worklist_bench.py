@@ -97,7 +97,8 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
                  tmp_dir: str, platform: str, batch_size: int = 8,
                  stack: int = 16, precision: str = None,
                  packed: bool = False, inflight: int = None,
-                 decode_workers: int = None, mesh_devices: int = None):
+                 decode_workers: int = None, mesh_devices: int = None,
+                 compute_dtype: str = None):
     """One timed pass of the real worklist loop; returns the record.
 
     ``packed=False`` times the per-video loop cli.py runs by default;
@@ -142,6 +143,11 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         overrides['decode_workers'] = int(decode_workers)
     if mesh_devices is not None:
         overrides['mesh_devices'] = int(mesh_devices)
+    if compute_dtype is not None:
+        # the bf16 fast lane (ops/precision.py): outputs are NOT
+        # byte-identical to float32's — the *_bf16_* rungs record the
+        # measured error next to the speedup for exactly that reason
+        overrides['compute_dtype'] = str(compute_dtype)
     args = load_config(feature_type, overrides=overrides)
     ex = create_extractor(args)
 
@@ -213,6 +219,9 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
         # single chip; mesh_devices=0 auto-detect resolves here) —
         # config metadata naming the device set behind the number
         'mesh_devices': int(getattr(ex, '_packed_mesh_ndev', 1) or 1),
+        # the precision lane the step computed in ('float32' default;
+        # 'bfloat16' = the fast lane) — rung metadata like inflight
+        'compute_dtype': str(getattr(ex, 'compute_dtype', 'float32')),
         'n_videos': len(paths),
         'videos_per_min': round(len(paths) / elapsed * 60, 3),
         'clips_total': int(clips),

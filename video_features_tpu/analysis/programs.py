@@ -9,7 +9,11 @@ dropped donation, or a weight tensor accidentally captured by closure
 (baked into the HLO as a constant) ships invisibly. This module
 AOT-lowers every family's *actual* jitted step — the same callable the
 hot paths dispatch — at a canonical abstract geometry, on CPU, at mesh
-widths {1, 2} (forced host devices), and
+widths {1, 2} (forced host devices) and, for families that accept the
+bf16 fast lane (``registry.BF16_FEATURES``), on BOTH compute_dtype
+lanes (``mesh<n>`` = float32 as always; ``mesh<n>@bfloat16`` = the fast
+lane, whose parameter dtype census proves the transplant cast left no
+fp32 param behind — the ``bf16-census`` rule), and
 
   * extracts an **abstract signature** per program: batch/output avals
     (weak types included), the full parameter dtype census, the declared
@@ -62,8 +66,44 @@ DEFAULT_LOCK = 'PROGRAMS.lock.json'           # repo-root, committed
 FAMILIES = tuple(KNOWN_FEATURE_TYPES)
 MESH_WIDTHS = (1, 2)
 
+# compute_dtype lanes the lock pins per family: 'float32' entries keep
+# their historical mesh<n> keys byte-for-byte (the default path must
+# never drift when a lane is added), 'bfloat16' variants land under
+# mesh<n>@bfloat16 for every family in registry.BF16_FEATURES — their
+# parameter dtype census is the proof that the transplant-time cast
+# left NO fp32 param behind (the bf16-census rule below).
+LANES = ('float32', 'bfloat16')
+
 RULES = ('no-f64', 'no-weak-type', 'no-host-callback', 'donation',
-         'shardable', 'const-budget')
+         'shardable', 'const-budget', 'bf16-census')
+
+
+def lane_families(lane: str, families: Iterable[str]) -> tuple:
+    """The subset of ``families`` that builds on ``lane`` — every family
+    for float32; only the opted-in ``registry.BF16_FEATURES`` for the
+    bf16 fast lane (the rest REFUSE the knob at config time, which is
+    itself contract-tested — not a lock gap)."""
+    if lane == 'float32':
+        return tuple(families)
+    from video_features_tpu.registry import BF16_FEATURES
+    return tuple(f for f in families if f in BF16_FEATURES)
+
+
+def mesh_key(width: int, lane: str) -> str:
+    """Lock entry key for one (mesh width, compute_dtype lane):
+    ``mesh<n>`` for float32 (unchanged — pre-lane locks stay valid),
+    ``mesh<n>@bfloat16`` for the fast lane."""
+    return f'mesh{width}' if lane == 'float32' else f'mesh{width}@{lane}'
+
+
+def parse_mesh_key(key: str) -> Tuple[int, str]:
+    """Inverse of :func:`mesh_key`: ``'mesh2@bfloat16'`` → (2, 'bfloat16')."""
+    base, _, lane = key.partition('@')
+    try:
+        width = int(base.replace('mesh', '') or 0)
+    except ValueError:
+        width = 0
+    return width, (lane or 'float32')
 
 # default baked-constant budget per program: small epilogue constants
 # (normalization mean/std, resize index tables, iota caches) are fine;
@@ -95,14 +135,20 @@ _FAMILY_OVERRIDES: Dict[str, Dict[str, Any]] = {
 }
 
 
-def build_family(feature_type: str):
+def build_family(feature_type: str, compute_dtype: str = 'float32'):
     """The real extractor, built exactly like production builds it
     (``registry.create_extractor`` over the merged config) — so the
-    lowered programs ARE the shipped programs, closures included."""
+    lowered programs ARE the shipped programs, closures included.
+    ``compute_dtype`` selects the lane (``'bfloat16'`` builds the fast
+    lane's extractor: bf16 params from the transplant cast, bf16
+    activations — whose lowering the mesh<n>@bfloat16 lock variants
+    pin)."""
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
     overrides = dict(_BASE_OVERRIDES)
     overrides.update(_FAMILY_OVERRIDES.get(feature_type, {}))
+    if compute_dtype != 'float32':
+        overrides['compute_dtype'] = compute_dtype
     return create_extractor(load_config(feature_type, overrides=overrides))
 
 
@@ -140,20 +186,23 @@ class ProgramSpec:
 
 
 class Finding:
-    """One rule violation or lock drift at ``family/mesh<n>/program``."""
+    """One rule violation or lock drift at
+    ``family/mesh<n>[@lane]/program``."""
 
-    __slots__ = ('rule', 'family', 'mesh', 'program', 'message')
+    __slots__ = ('rule', 'family', 'mesh', 'program', 'message', 'lane')
 
     def __init__(self, rule: str, family: str, mesh: int, program: str,
-                 message: str) -> None:
+                 message: str, lane: str = 'float32') -> None:
         self.rule = rule
         self.family = family
         self.mesh = int(mesh)
         self.program = program
         self.message = message
+        self.lane = lane
 
     def render(self) -> str:
-        return (f'{self.family}/mesh{self.mesh}/{self.program}: '
+        lane = '' if self.lane == 'float32' else f'@{self.lane}'
+        return (f'{self.family}/mesh{self.mesh}{lane}/{self.program}: '
                 f'[{self.rule}] {self.message}')
 
 
@@ -284,13 +333,14 @@ def program_signature(spec: ProgramSpec) -> Dict[str, Any]:
 # -- rule checks -------------------------------------------------------------
 
 def check_program(spec: ProgramSpec, sig: Dict[str, Any], family: str,
-                  width: int, mesh) -> List[Finding]:
+                  width: int, mesh, lane: str = 'float32') -> List[Finding]:
     findings: List[Finding] = []
     text = sig['_text']
 
     def report(rule: str, message: str) -> None:
         if rule not in spec.ok:
-            findings.append(Finding(rule, family, width, spec.name, message))
+            findings.append(Finding(rule, family, width, spec.name,
+                                    message, lane=lane))
 
     if re.search(r'\bf64\b|xf64[>x]', text):
         report('no-f64',
@@ -330,6 +380,24 @@ def check_program(spec: ProgramSpec, sig: Dict[str, Any], family: str,
                f'constants (budget {spec.const_budget}) — weights '
                f'captured by closure get baked into the HLO per '
                f'geometry instead of being passed as params')
+    if lane == 'bfloat16':
+        # the lane's load-bearing proof: the transplant-time cast left
+        # no fp32 (or fp64) PARAM behind — a survivor would silently
+        # keep fp32 HBM residency and promote its whole sub-graph back
+        # to fp32, defeating the knob while the bench still reports a
+        # "bf16" number. fp32 is allowed only in ACTIVATION islands
+        # (ops/nn.py), which a params census never sees.
+        leaked = sorted(dt for dt in sig['params']
+                        if dt in ('float32', 'float64'))
+        if leaked:
+            detail = ', '.join(
+                f'{dt}: {sig["params"][dt]["arrays"]} array(s) / '
+                f'{sig["params"][dt]["bytes"]} bytes' for dt in leaked)
+            report('bf16-census',
+                   f'compute_dtype=bfloat16 program still carries '
+                   f'{detail} in its parameter census — the '
+                   f'transplant-time cast (torch2jax dtype seam) missed '
+                   f'them; bf16 params must be bf16 in HBM')
     return findings
 
 
@@ -345,33 +413,41 @@ def _program_mesh(width: int):
 
 
 def collect(families: Iterable[str], widths: Iterable[int],
+            lanes: Iterable[str] = LANES,
             ) -> Tuple[Dict[str, Any], List[Finding]]:
-    """Build each family once, lower its programs at every width, run the
-    rule checks. Returns (live lock document fragment, findings)."""
+    """Build each family once per lane it supports, lower its programs
+    at every width, run the rule checks. Returns (live lock document
+    fragment, findings). float32 entries land under the historical
+    ``mesh<n>`` keys; bf16-lane entries (``registry.BF16_FEATURES``
+    only) under ``mesh<n>@bfloat16``."""
+    families = tuple(families)
     live: Dict[str, Any] = {}
     findings: List[Finding] = []
     for family in families:
-        ex = build_family(family)
-        fam_doc: Dict[str, Any] = {}
-        for width in widths:
-            mesh = _program_mesh(width)
-            specs = ex.program_specs(mesh=mesh)
-            if not specs:
-                findings.append(Finding(
-                    'coverage', family, width, '-',
-                    f'{family} exposes no abstract program specs '
-                    f'(BaseExtractor.program_specs) — every family must '
-                    f'pin its compiled programs'))
-                continue
-            progs: Dict[str, Any] = {}
-            for spec in specs:
-                sig = program_signature(spec)
-                findings.extend(
-                    check_program(spec, sig, family, width, mesh))
-                sig.pop('_text')
-                progs[spec.name] = sig
-            fam_doc[f'mesh{width}'] = {'programs': progs}
-        live[family] = fam_doc
+        live[family] = {}
+    for lane in lanes:
+        for family in lane_families(lane, families):
+            ex = build_family(family, compute_dtype=lane)
+            fam_doc = live[family]
+            for width in widths:
+                mesh = _program_mesh(width)
+                specs = ex.program_specs(mesh=mesh)
+                if not specs:
+                    findings.append(Finding(
+                        'coverage', family, width, '-',
+                        f'{family} exposes no abstract program specs '
+                        f'(BaseExtractor.program_specs) — every family '
+                        f'must pin its compiled programs', lane=lane))
+                    continue
+                progs: Dict[str, Any] = {}
+                for spec in specs:
+                    sig = program_signature(spec)
+                    findings.extend(
+                        check_program(spec, sig, family, width, mesh,
+                                      lane=lane))
+                    sig.pop('_text')
+                    progs[spec.name] = sig
+                fam_doc[mesh_key(width, lane)] = {'programs': progs}
     return live, findings
 
 
@@ -454,15 +530,26 @@ _DIFF_FIELDS = ('batch', 'params', 'out', 'out_tree', 'batch_donated',
 
 def diff_lock(live: Dict[str, Any], lock: Dict[str, Any],
               checked: Iterable[str],
-              widths: Iterable[int] = MESH_WIDTHS) -> List[Finding]:
+              widths: Iterable[int] = MESH_WIDTHS,
+              lanes: Iterable[str] = LANES) -> List[Finding]:
     """Field-by-field drift between the live lowerings and the lock.
-    Families outside ``checked`` — and mesh widths outside ``widths`` —
-    are skipped (a ``--families`` / ``--mesh-widths`` subset run must
-    not report what it didn't lower as missing/stale); but a lock
-    family that is not a known family at all is always reported."""
+    Families outside ``checked`` — and mesh widths outside ``widths`` /
+    lanes outside ``lanes`` — are skipped (a ``--families`` /
+    ``--mesh-widths`` / ``--lanes`` subset run must not report what it
+    didn't lower as missing/stale); but a lock family that is not a
+    known family at all is always reported. A bf16 lane key is only
+    "checked" for families that ACCEPT the lane — a lock carrying
+    mesh<n>@bfloat16 for a refusing family is stale and surfaces as a
+    live-side-missing program drift once the family joins the lane's
+    checked set... until then it is simply never compared (subset
+    semantics), so prune it with a full-scope --write-lock."""
     findings: List[Finding] = []
-    checked_meshes = {f'mesh{w}' for w in widths}
+    lanes = tuple(lanes)
     locked = lock.get('families', {})
+
+    def checked_meshes(family: str) -> set:
+        return {mesh_key(w, lane) for w in widths for lane in lanes
+                if family in lane_families(lane, (family,))}
     for family in sorted(locked):
         if family not in FAMILIES:
             findings.append(Finding(
@@ -478,8 +565,8 @@ def diff_lock(live: Dict[str, Any], lock: Dict[str, Any],
                 f'{family} is not in the lock — pin it with '
                 f'--write-lock'))
             continue
-        for mesh in sorted((set(lv) | set(lk)) & checked_meshes):
-            width = int(mesh.replace('mesh', '') or 0)
+        for mesh in sorted((set(lv) | set(lk)) & checked_meshes(family)):
+            width, lane = parse_mesh_key(mesh)
             lvp = lv.get(mesh, {}).get('programs', {})
             lkp = lk.get(mesh, {}).get('programs', {})
             for name in sorted(set(lvp) | set(lkp)):
@@ -487,13 +574,15 @@ def diff_lock(live: Dict[str, Any], lock: Dict[str, Any],
                     findings.append(Finding(
                         'lock-drift', family, width, name,
                         'new program not in the lock (compiled-program '
-                        'count changed) — re-pin with --write-lock'))
+                        'count changed) — re-pin with --write-lock',
+                        lane=lane))
                     continue
                 if name not in lvp:
                     findings.append(Finding(
                         'lock-drift', family, width, name,
                         'pinned program no longer lowered by the family '
-                        '— stale lock entry (re-pin with --write-lock)'))
+                        '— stale lock entry (re-pin with --write-lock)',
+                        lane=lane))
                     continue
                 for field in _DIFF_FIELDS:
                     a, b = lkp[name].get(field), lvp[name].get(field)
@@ -503,7 +592,7 @@ def diff_lock(live: Dict[str, Any], lock: Dict[str, Any],
                         findings.append(Finding(
                             'lock-drift', family, width, name,
                             f'{field} drifted: lock={_short(a)} '
-                            f'live={_short(b)}'))
+                            f'live={_short(b)}', lane=lane))
     return findings
 
 
@@ -525,6 +614,10 @@ def main(argv=None) -> int:
                         help='comma-separated mesh widths to pin '
                         '(default: 1,2 — width 2 needs '
                         '--xla_force_host_platform_device_count=2)')
+    parser.add_argument('--lanes', default=','.join(LANES),
+                        help='comma-separated compute_dtype lanes to '
+                        'check/pin (default: float32,bfloat16 — the '
+                        'bf16 lane covers registry.BF16_FEATURES only)')
     parser.add_argument('--lock', help='lock file path (default: '
                         f'<repo>/{DEFAULT_LOCK})')
     parser.add_argument('--write-lock', action='store_true',
@@ -546,6 +639,12 @@ def main(argv=None) -> int:
               f'(known: {", ".join(FAMILIES)})', file=sys.stderr)
         return EXIT_ERROR
     widths = tuple(int(w) for w in args.mesh_widths.split(','))
+    lanes = tuple(args.lanes.split(','))
+    bad_lanes = [lane for lane in lanes if lane not in LANES]
+    if bad_lanes:
+        print(f'vft-programs: unknown lanes {bad_lanes} '
+              f'(known: {", ".join(LANES)})', file=sys.stderr)
+        return EXIT_ERROR
     lock_path = Path(args.lock) if args.lock else default_lock_path()
 
     import jax
@@ -559,7 +658,7 @@ def main(argv=None) -> int:
         return EXIT_ERROR
 
     try:
-        live, findings = collect(families, widths)
+        live, findings = collect(families, widths, lanes)
     except Exception as e:                    # noqa: BLE001 — CLI boundary
         import traceback
         traceback.print_exc()
@@ -569,7 +668,8 @@ def main(argv=None) -> int:
     if args.write_lock:
         write_lock(lock_path, live,
                    prune_families=set(families) == set(FAMILIES),
-                   replace_widths=set(widths) == set(MESH_WIDTHS))
+                   replace_widths=(set(widths) == set(MESH_WIDTHS)
+                                   and set(lanes) == set(LANES)))
         n = sum(len(e.get('programs', {}))
                 for fam in live.values() for e in fam.values())
         print(f'vft-programs: pinned {n} program signature(s) across '
@@ -579,14 +679,14 @@ def main(argv=None) -> int:
         return EXIT_CLEAN
 
     findings.extend(diff_lock(live, load_lock(lock_path), families,
-                              widths=widths))
+                              widths=widths, lanes=lanes))
     for f in findings:
         print(f.render())
     n_progs = sum(len(e.get('programs', {}))
                   for fam in live.values() for e in fam.values())
     print(f'vft-programs: {len(findings)} finding(s) across {n_progs} '
           f'programs, {len(live)} families, mesh widths '
-          f'{list(widths)}', file=sys.stderr)
+          f'{list(widths)}, lanes {list(lanes)}', file=sys.stderr)
     return EXIT_FINDINGS if findings else EXIT_CLEAN
 
 

@@ -62,6 +62,18 @@ PIPELINE_DEFAULTS: Dict[str, Any] = {
     # knob only drives the PACKED paths (pack_across_videos / serve) —
     # the per-video loop keeps data_parallel for in-graph DP.
     'mesh_devices': 1,
+    # the bf16 fast lane (ops/precision.py, docs/benchmarks.md "bf16
+    # fast lane"): 'float32' (default) is exactly today's numerics;
+    # 'bfloat16' casts params to bf16 at transplant time (half the HBM
+    # residency + H2D bytes) and runs bf16 activations with fp32
+    # accumulation islands, under a measured per-family max-abs error
+    # bound (tests/test_precision.py). Orthogonal to the matmul
+    # `precision=` knob. Families without a pinned bound REFUSE it with
+    # a structured build-time error (registry.BF16_FEATURES); outputs
+    # are NOT byte-identical across lanes, so the knob is classified
+    # 'both' — fp32 and bf16 artifacts never share a cache entry or a
+    # warm serve program.
+    'compute_dtype': 'float32',
 }
 
 # -- decode farm (farm/; docs/decode_farm.md) --------------------------------
@@ -175,6 +187,12 @@ KNOB_CLASSIFICATION: Dict[str, str] = {
     # entry is resident on, so a 1-chip and a 4-chip request each get
     # their own warm entry.
     'mesh_devices': 'pool_only',
+    # the bf16 fast lane changes BOTH identities: bf16 features are
+    # numerically different bytes (within the pinned bound — a bf16 run
+    # must never serve an fp32 cache entry or vice versa), and a bf16
+    # entry is a different compiled program with half the params HBM —
+    # fp32 and bf16 warm pool entries must coexist, not collide
+    'compute_dtype': 'both',
     'compilation_cache_dir': 'pool_only',
     # input-side decode parallelism (decode farm): where decode runs,
     # never the bytes produced (tests/test_farm.py pins byte-identity);
@@ -397,6 +415,17 @@ def sanity_check(args: Config) -> None:
         raise ValueError(
             f"decode_backend must be 'auto', 'native', or 'cv2'; "
             f'got {backend!r}')
+
+    # bf16 fast lane (ops/precision.py): validate the value AND the
+    # family's acceptance at config time — a family without a pinned
+    # parity bound refuses the knob with a structured error here, so a
+    # serve submit fails its build with the bound named instead of a
+    # worker shipping out-of-bound features. ComputeDtypeError is a
+    # ValueError — same surface as every other knob rejection.
+    from video_features_tpu.ops.precision import check_compute_dtype
+    args['compute_dtype'] = check_compute_dtype(
+        args.get('feature_type'),
+        str(args.get('compute_dtype') or 'float32'))
     if args.get('cache_enabled'):
         if not args.get('cache_dir'):
             raise ValueError('cache_enabled=true requires cache_dir '

@@ -68,6 +68,7 @@ class BaseExtractor:
         profile: bool = False,
         precision: str = 'highest',
         inflight: int = 2,
+        compute_dtype: str = 'float32',
     ) -> None:
         self.feature_type = feature_type
         self.on_extraction = on_extraction
@@ -77,6 +78,18 @@ class BaseExtractor:
         self.device = device
         self.concat_rgb_flow = concat_rgb_flow
         self.precision = precision
+        # bf16 fast lane (ops/precision.py): the STORAGE + activation
+        # dtype of the device step — 'float32' is byte-for-byte today's
+        # graph; 'bfloat16' halves params HBM/H2D and runs bf16
+        # activations with fp32 accumulation islands, under the family's
+        # pinned parity bound. sanity_check already refused unknown
+        # values and non-accepting families at config time; extractors
+        # constructed directly get the same guard here.
+        from video_features_tpu.ops.precision import COMPUTE_DTYPES
+        if compute_dtype not in COMPUTE_DTYPES:
+            raise ValueError(f'compute_dtype must be one of '
+                             f'{COMPUTE_DTYPES}; got {compute_dtype!r}')
+        self.compute_dtype = compute_dtype
         # output-side pipelining depth: the device loop keeps up to this
         # many dispatched batches in flight before materializing the
         # oldest one's results (D2H + scatter + save overlap compute);
@@ -98,6 +111,10 @@ class BaseExtractor:
         # right after build, before any batch flows; None = default
         # (first local device / every local device for a packed mesh)
         self._placement_devices = None
+        # bytes the serve DevicePlacer charged this entry's chips at
+        # placement time (params_nbytes at build) — released verbatim at
+        # retirement so the per-chip residency ledger nets to zero
+        self._placement_nbytes = 0
         # content-addressed feature cache + run identity — attached by
         # configure_cache (registry.create_extractor calls it with the
         # full merged config); None = legacy behavior everywhere
@@ -139,6 +156,25 @@ class BaseExtractor:
         from video_features_tpu.ops.precision import MIXED_AMBIENT
         ambient = MIXED_AMBIENT if self.precision == 'mixed' else self.precision
         return jax.default_matmul_precision(ambient)
+
+    @property
+    def param_dtype(self):
+        """Numpy STORAGE dtype for transplanted params on this lane
+        (``ml_dtypes.bfloat16`` for the bf16 fast lane, else float32) —
+        what ``load_params`` hands the transplant layer's ``dtype=``
+        seam, so a bf16 entry's params are bf16 in HBM from build."""
+        from video_features_tpu.ops.precision import param_np_dtype
+        return param_np_dtype(self.compute_dtype)
+
+    @property
+    def compute_jnp_dtype(self):
+        """The jnp activation dtype the device step casts its uint8
+        input to — threaded into each family's jitted forward as a
+        trace-time constant, so the float32 lane's program is
+        byte-identical to the pre-knob graph."""
+        import jax.numpy as jnp
+        return jnp.bfloat16 if self.compute_dtype == 'bfloat16' \
+            else jnp.float32
 
     @property
     def precision_pins(self):
@@ -213,6 +249,26 @@ class BaseExtractor:
                     f'{len(local)} local {local[0].platform} device(s) — '
                     'lower mesh_devices (or 0 to auto-detect)')
         self.mesh_devices = max(n, 1)
+
+    def params_nbytes(self) -> int:
+        """Per-chip device residency of this extractor's params (plus
+        every declared ``_device_buffer_attrs`` buffer), in REAL bytes —
+        what the serve placement layer (``serve/pool.DevicePlacer``)
+        ranks chips by, so a bf16 fast-lane entry counts its actual
+        ~half-size footprint instead of '1 entry'. Logical (per-copy)
+        bytes: a mesh entry replicates params per chip, and the placer
+        charges each assigned chip one copy."""
+        total = 0
+        trees = [getattr(self, 'params', None)]
+        trees += [getattr(self, attr, None)
+                  for attr in self._device_buffer_attrs]
+        import jax
+        for tree in trees:
+            if tree is None:
+                continue
+            for leaf in jax.tree_util.tree_leaves(tree):
+                total += int(getattr(leaf, 'nbytes', 0) or 0)
+        return total
 
     # names of extra device-committed array attributes (beyond
     # ``params``) that ``place_on`` must migrate with the extractor —

@@ -50,11 +50,14 @@ class ExtractCLIP(BaseFrameWiseExtractor):
         self._device = jax_device(self.device)
         if params is None:
             from video_features_tpu.transplant.torch2jax import transplant
+            # param_dtype: float32 upcast of the fp16 OpenAI checkpoints
+            # by default; the bf16 fast lane stores bf16 in HBM instead
             params = transplant(state_dict,
                                 no_transpose=set(clip_model.NO_TRANSPOSE),
-                                dtype=np.float32)
+                                dtype=self.param_dtype)
         self.params = jax.device_put(params, self._device)
-        self._step = jax.jit(partial(self._forward, arch=self.arch))
+        self._step = jax.jit(partial(self._forward, arch=self.arch,
+                                     dtype=self.compute_jnp_dtype))
         self._text_feats: Optional[np.ndarray] = None
 
     def _load_state_dict(self, args):
@@ -76,11 +79,16 @@ class ExtractCLIP(BaseFrameWiseExtractor):
                                what=f'clip ({self.model_name})')
         if ckpt and str(ckpt).endswith('.npz'):
             # via load_torch_checkpoint for the same float32 upcast the
-            # .pt path (and every other extractor) applies
+            # .pt path (and every other extractor) applies — or the bf16
+            # storage cast when the fast lane is on. args because this
+            # runs before super().__init__ sets self.compute_dtype.
+            from video_features_tpu.ops.precision import param_np_dtype
             from video_features_tpu.transplant.torch2jax import (
                 load_torch_checkpoint,
             )
-            return None, load_torch_checkpoint(ckpt)
+            return None, load_torch_checkpoint(
+                ckpt, dtype=param_np_dtype(
+                    args.get('compute_dtype', 'float32')))
         if ckpt:
             import torch
             sd = torch.load(ckpt, map_location='cpu', weights_only=False)
@@ -92,10 +100,11 @@ class ExtractCLIP(BaseFrameWiseExtractor):
         return clip_model.init_state_dict(model_name=args.model_name), None
 
     @staticmethod
-    def _forward(params, batch, arch):
-        x = to_float_zero_one(batch)
+    def _forward(params, batch, arch, dtype=None):
+        from video_features_tpu.ops.precision import features_to_f32
+        x = to_float_zero_one(batch, dtype)
         x = normalize(x, clip_model.MEAN, clip_model.STD)
-        return clip_model.encode_image(params, x, arch)
+        return features_to_f32(clip_model.encode_image(params, x, arch))
 
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
         n_px = self.input_resolution

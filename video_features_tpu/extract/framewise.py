@@ -36,6 +36,7 @@ class BaseFrameWiseExtractor(BaseExtractor):
             profile=args.get('profile', False),
             precision=args.get('precision', 'highest'),
             inflight=args.get('inflight', 2),
+            compute_dtype=args.get('compute_dtype', 'float32'),
         )
         self.batch_size = args.batch_size
         self.decode_workers = int(args.get('decode_workers', 1))

@@ -51,6 +51,7 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
             profile=args.get('profile', False),
             precision=args.get('precision', 'highest'),
             inflight=args.get('inflight', 2),
+            compute_dtype=args.get('compute_dtype', 'float32'),
         )
         self.model_name = args.model_name
         self.model_def = MODEL_CFGS[self.model_name]
@@ -68,8 +69,11 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
         self.data_parallel = args.get('data_parallel', False)
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
+        # dtype rides the partial as a trace-time constant: the float32
+        # lane's jitted program is byte-identical to the pre-knob graph
         self._step = jax.jit(
-            partial(self._forward_batch, arch=self.model_def['arch']))
+            partial(self._forward_batch, arch=self.model_def['arch'],
+                    dtype=self.compute_jnp_dtype))
 
     # -- model --------------------------------------------------------------
 
@@ -80,20 +84,25 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
         return load_or_init(
             args, 'checkpoint_path',
             partial(r21d_model.init_state_dict, arch=self.model_def['arch']),
-            feature_type='r21d')
+            feature_type='r21d', dtype=self.param_dtype)
 
     @staticmethod
-    def _forward_batch(params, stacks, arch):
+    def _forward_batch(params, stacks, arch, dtype=None):
         """(B, stack, H, W, 3) uint8 → (B, 512) features.
 
         Transform chain parity (reference extract_r21d.py:102-107):
         ToFloatTensorInZeroOne → Resize(128, 171) → Normalize → CenterCrop(112).
+        ``dtype`` is the bf16 fast lane's activation dtype (trace-time
+        constant; None ≡ float32, the byte-identical default graph) —
+        features always leave as float32.
         """
-        x = to_float_zero_one(stacks)
+        from video_features_tpu.ops.precision import features_to_f32
+        x = to_float_zero_one(stacks, dtype)
         x = resize_bilinear(x, (128, 171))
         x = normalize(x, r21d_model.MEAN, r21d_model.STD)
         x = center_crop(x, (112, 112))
-        return r21d_model.forward(params, x, arch=arch, features=True)
+        return features_to_f32(
+            r21d_model.forward(params, x, arch=arch, features=True))
 
     # -- packed corpus mode: hooks from StackPackingMixin -------------------
 

@@ -36,20 +36,26 @@ class ExtractResNet(BaseFrameWiseExtractor):
         super().__init__(args, feat_dim=cfg['feat_dim'])
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
-        self._step = jax.jit(partial(self._forward, arch=self.model_name))
+        # dtype rides the partial as a trace-time constant: the float32
+        # lane's jitted program is byte-identical to the pre-knob graph
+        self._step = jax.jit(partial(self._forward, arch=self.model_name,
+                                     dtype=self.compute_jnp_dtype))
 
     def load_params(self, args):
         from video_features_tpu.extract.weights import load_or_init
         return load_or_init(
             args, 'checkpoint_path',
             partial(resnet_model.init_state_dict, arch=self.model_name),
-            feature_type='resnet', what=f'resnet ({self.model_name})')
+            feature_type='resnet', what=f'resnet ({self.model_name})',
+            dtype=self.param_dtype)
 
     @staticmethod
-    def _forward(params, batch, arch):
-        x = to_float_zero_one(batch)
+    def _forward(params, batch, arch, dtype=None):
+        from video_features_tpu.ops.precision import features_to_f32
+        x = to_float_zero_one(batch, dtype)
         x = normalize(x, resnet_model.MEAN, resnet_model.STD)
-        return resnet_model.forward(params, x, arch=arch, features=True)
+        return features_to_f32(
+            resnet_model.forward(params, x, arch=arch, features=True))
 
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
         frame = short_side_resize_pil(

@@ -36,6 +36,7 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
             profile=args.get('profile', False),
             precision=args.get('precision', 'highest'),
             inflight=args.get('inflight', 2),
+            compute_dtype=args.get('compute_dtype', 'float32'),
         )
         self.stack_size = args.stack_size
         self.step_size = args.step_size
@@ -56,17 +57,18 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
     def load_params(self, args):
         from video_features_tpu.extract.weights import load_or_init
         return load_or_init(args, 'checkpoint_path', s3d_model.init_state_dict,
-                            feature_type='s3d')
+                            feature_type='s3d', dtype=self.param_dtype)
 
     @staticmethod
-    def _forward(params, stacks, resize_hw, resize_scale):
-        x = to_float_zero_one(stacks)
+    def _forward(params, stacks, resize_hw, resize_scale, dtype=None):
+        from video_features_tpu.ops.precision import features_to_f32
+        x = to_float_zero_one(stacks, dtype)
         # the reference's short-side Resize(224) interpolates at the GIVEN
         # scale 224/min(h, w), not out/in (reference models/transforms.py:
         # 76-96, scale_factor + recompute_scale_factor=False)
         x = resize_bilinear_scale(x, resize_hw, resize_scale)
         x = center_crop(x, (224, 224))
-        return s3d_model.forward(params, x, features=True)
+        return features_to_f32(s3d_model.forward(params, x, features=True))
 
     def _geometry_step(self, h: int, w: int):
         """(jitted step, resize_hw, scale) for decode geometry (h, w).
@@ -93,7 +95,8 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
             scale = 224.0 / min(h, w)
             resize_hw = (math.floor(h * scale), math.floor(w * scale))
             step = jax.jit(partial(self._forward, resize_hw=resize_hw,
-                                   resize_scale=scale))
+                                   resize_scale=scale,
+                                   dtype=self.compute_jnp_dtype))
             cached = self._geom_steps[(h, w)] = (step, resize_hw, scale)
         return cached
 

@@ -161,6 +161,17 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                 f'ViT/DeiT model for high-resolution inputs.')
         self._init_kwargs = spec.get('init', {})
         super().__init__(args, feat_dim=spec['feat_dim'])
+        if args.get('sequence_parallel') and self.compute_dtype != 'float32':
+            # refused BEFORE _load_params: every other compute_dtype
+            # refusal fires pre-weights (config time), and this one must
+            # not transplant a potentially-GBs checkpoint first
+            raise NotImplementedError(
+                'sequence_parallel + compute_dtype=bfloat16 is not '
+                'supported: the ring-attention kernel\'s online-'
+                'softmax accumulators are tuned fp32 end to end '
+                '(ops/attention.py) and have no measured bf16 parity '
+                'bound — run the fast lane on the standard path, or '
+                'sequence-parallel at float32')
         self.data_cfg = _data_cfg(self.family, self.arch)
         self._device = jax_device(self.device)
         # _load_params may refine data_cfg from pip-timm's resolved config,
@@ -191,6 +202,8 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         # long-token path for resolutions whose token count exceeds one
         # chip (pairs with image_size; single-chip long-token inputs use
         # blockwise attention automatically).
+        # (sequence_parallel + bfloat16 was already refused above,
+        # before the checkpoint loaded)
         self.sequence_parallel = args.get('sequence_parallel', False)
         if self.sequence_parallel:
             if self.family not in ('vit', 'deit'):
@@ -226,7 +239,8 @@ class ExtractTIMM(BaseFrameWiseExtractor):
             return
         self._step = jax.jit(partial(
             self._forward, family=self.family, arch=self.arch,
-            mean=self.data_cfg['mean'], std=self.data_cfg['std']))
+            mean=self.data_cfg['mean'], std=self.data_cfg['std'],
+            dtype=self.compute_jnp_dtype))
 
     def _load_params(self, args):
         from video_features_tpu.transplant.torch2jax import (
@@ -234,7 +248,7 @@ class ExtractTIMM(BaseFrameWiseExtractor):
         )
         ckpt = args.get('checkpoint_path')
         if ckpt:
-            return load_torch_checkpoint(ckpt)
+            return load_torch_checkpoint(ckpt, dtype=self.param_dtype)
         if args.get('pretrained', True):  # opt-out for offline runs
             try:  # optional pip timm: pull pretrained weights + data config
                 import timm
@@ -254,7 +268,7 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                 crop=data['input_size'][-1],
                 interpolation=data.get('interpolation', 'bilinear'),
                 mean=tuple(data['mean']), std=tuple(data['std']))
-            return transplant(model.state_dict())
+            return transplant(model.state_dict(), dtype=self.param_dtype)
         # no checkpoint and no pip-timm: hard error unless random weights
         # are explicitly allowed (the reference's timm path always loads
         # pretrained weights, extract_timm.py:48)
@@ -263,14 +277,17 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                            what=f'timm ({self.model_name})')
         init = _MODEL_MODULES[self.family]
         return transplant(init.init_state_dict(arch=self.arch,
-                                               **self._init_kwargs))
+                                               **self._init_kwargs),
+                          dtype=self.param_dtype)
 
     @staticmethod
-    def _forward(params, batch, family, arch, mean, std):
-        x = to_float_zero_one(batch)
+    def _forward(params, batch, family, arch, mean, std, dtype=None):
+        from video_features_tpu.ops.precision import features_to_f32
+        x = to_float_zero_one(batch, dtype)
         x = normalize(x, mean, std)
-        return _MODEL_MODULES[family].forward(params, x, arch=arch,
-                                              features=True)
+        return features_to_f32(
+            _MODEL_MODULES[family].forward(params, x, arch=arch,
+                                           features=True))
 
     def host_transform(self, frame: np.ndarray) -> np.ndarray:
         frame = resize_pil(frame, self.data_cfg['resize'],

@@ -44,6 +44,7 @@ class ExtractVGGish(BaseExtractor):
             device=args.device,
             profile=args.get('profile', False),
             precision=args.get('precision', 'highest'),
+            compute_dtype=args.get('compute_dtype', 'float32'),
         )
         if args.show_pred:
             raise NotImplementedError('vggish has no show_pred (reference '
@@ -71,7 +72,18 @@ class ExtractVGGish(BaseExtractor):
                 'post_process=true needs pca_params_path=<vggish_pca_params.npz>')
         self._device = jax_device(self.device)
         self.params = jax.device_put(self.load_params(args), self._device)
-        self._step = jax.jit(vggish_model.forward)
+        if self.compute_dtype == 'bfloat16':
+            # bf16 fast lane: examples ship bf16 (half the H2D bytes —
+            # _run_batched casts at the device edge), the VGG runs bf16,
+            # features leave as float32 like every lane's contract
+            from video_features_tpu.ops.precision import features_to_f32
+
+            def _bf16_forward(params, x):
+                return features_to_f32(vggish_model.forward(params, x))
+
+            self._step = jax.jit(_bf16_forward)
+        else:
+            self._step = jax.jit(vggish_model.forward)
         if self.post_process:
             pca = np.load(pca_path)
             self._pca_eig = jax.device_put(
@@ -83,14 +95,17 @@ class ExtractVGGish(BaseExtractor):
         from video_features_tpu.extract.weights import load_or_init
         return load_or_init(args, 'checkpoint_path',
                             vggish_model.init_state_dict,
-                            feature_type='vggish')
+                            feature_type='vggish', dtype=self.param_dtype)
 
     def program_specs(self, mesh=None):
         """vft-programs abstract step spec: one fixed-size batch of
         0.96 s log-mel examples into the jitted VGG. The batch dtype is
         float32 BY CONTRACT — the host DSP runs float64 for reference
         parity and :meth:`extract` pins the narrowing cast at the device
-        boundary (the no-f64 rule holds the program side of that line)."""
+        boundary (the no-f64 rule holds the program side of that line).
+        Under the bf16 fast lane the batch ships bf16 (``_run_batched``
+        narrows at the device edge — half the H2D bytes), which the lock
+        variant's batch dtype records."""
         from video_features_tpu.analysis.programs import ProgramSpec
         if mesh is None:
             b = self.example_batch
@@ -104,7 +119,7 @@ class ExtractVGGish(BaseExtractor):
                 round_batch_to_data_axis,
             )
             b = round_batch_to_data_axis(self.example_batch, mesh)
-        batch = self._abstract_batch((b, 96, 64, 1), np.float32, mesh)
+        batch = self._abstract_batch((b, 96, 64, 1), self.param_dtype, mesh)
         return [ProgramSpec('step', self._step,
                             (self._abstract_params(mesh), batch))]
 
@@ -184,6 +199,12 @@ class ExtractVGGish(BaseExtractor):
         n = examples.shape[0]
         if n == 0:
             return np.zeros((0, vggish_model.FEAT_DIM), np.float32)
+        if self.compute_dtype == 'bfloat16':
+            # the device edge of the bf16 fast lane: examples narrow to
+            # bf16 HERE (host-side, before device_put) so the H2D
+            # transfer ships half the bytes — the step's graph then runs
+            # bf16 end to end with the ops/nn.py fp32 islands
+            examples = examples.astype(self.param_dtype)
         B = self.example_batch
         out = []
         with self.precision_scope():

@@ -92,16 +92,25 @@ def require_checkpoint(args: Any, key: str, *, feature_type: str,
 def load_or_init(args: Any, key: str, init_fn: Callable[[], Dict[str, Any]],
                  *, feature_type: str, what: Optional[str] = None,
                  load: Optional[Callable[[str], Dict[str, Any]]] = None,
+                 dtype: Any = None,
                  ) -> Dict[str, Any]:
     """Transplanted params from ``args[key]``, or gated random init.
 
     ``load`` overrides the default :func:`load_torch_checkpoint` for
-    families with special checkpoint handling.
+    families with special checkpoint handling. ``dtype`` is the STORAGE
+    dtype floating params are cast to at transplant time (the bf16 fast
+    lane's seam — ``compute_dtype=bfloat16`` extractors pass
+    ``ml_dtypes.bfloat16`` here so params are bf16 in HBM from the first
+    ``device_put``, never cast per-step); None keeps the historical
+    float32 default.
     """
     from video_features_tpu.transplant.torch2jax import (
         load_torch_checkpoint, transplant,
     )
     ckpt = require_checkpoint(args, key, feature_type=feature_type, what=what)
     if ckpt:
-        return load(ckpt) if load is not None else load_torch_checkpoint(ckpt)
-    return transplant(init_fn())
+        if load is not None:
+            return load(ckpt)
+        return (load_torch_checkpoint(ckpt) if dtype is None
+                else load_torch_checkpoint(ckpt, dtype=dtype))
+    return transplant(init_fn(), dtype=dtype)

@@ -88,7 +88,8 @@ def _attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
     scores = jnp.einsum('bnhd,bmhd->bhnm', q, k)
     scores = scores + _rel_pos_bias(p, p['relative_position_index'],
                                     num_heads)[None]
-    probs = jax.nn.softmax(scores, axis=-1)
+    from video_features_tpu.ops.nn import softmax
+    probs = softmax(scores, axis=-1)    # fp32 island under the bf16 lane
     out = jnp.einsum('bhnm,bmhd->bnhd', probs, v).reshape(B, N, D)
     return out @ p['proj']['weight'] + p['proj']['bias']
 

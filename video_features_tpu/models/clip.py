@@ -63,6 +63,9 @@ def quick_gelu(x: jax.Array) -> jax.Array:
 
 
 def layer_norm(x: jax.Array, p: Params, eps: float = 1e-5) -> jax.Array:
+    if x.dtype == jnp.bfloat16:
+        # fp32 accumulation island (bf16 fast lane, ops/nn.py contract)
+        return layer_norm(x.astype(jnp.float32), p, eps).astype(x.dtype)
     mean = x.mean(-1, keepdims=True)
     var = x.var(-1, keepdims=True)
     out = (x - mean) * jax.lax.rsqrt(var + jnp.asarray(eps, x.dtype))
@@ -87,7 +90,8 @@ def multi_head_attention(p: Params, x: jax.Array, num_heads: int,
     attn = (q @ k.transpose(0, 1, 3, 2)) * (head_dim ** -0.5)
     if mask is not None:
         attn = attn + mask.astype(attn.dtype)
-    attn = jax.nn.softmax(attn, axis=-1)
+    from video_features_tpu.ops.nn import softmax
+    attn = softmax(attn, axis=-1)       # fp32 island under the bf16 lane
     out = (attn @ v).transpose(0, 2, 1, 3).reshape(B, L, D)
     return out @ p['out_proj']['weight'].astype(x.dtype) + p['out_proj']['bias'].astype(x.dtype)
 
@@ -162,7 +166,9 @@ def _attention_pool(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
     q = q.reshape(B, 1, num_heads, head_dim).transpose(0, 2, 1, 3)
     k = k.reshape(B, L, num_heads, head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(B, L, num_heads, head_dim).transpose(0, 2, 1, 3)
-    attn = jax.nn.softmax((q @ k.transpose(0, 1, 3, 2)) * (head_dim ** -0.5), axis=-1)
+    from video_features_tpu.ops.nn import softmax
+    attn = softmax((q @ k.transpose(0, 1, 3, 2)) * (head_dim ** -0.5),
+                   axis=-1)             # fp32 island under the bf16 lane
     out = (attn @ v).transpose(0, 2, 1, 3).reshape(B, C)
     return out @ p['c_proj']['weight'].astype(x.dtype) + p['c_proj']['bias'].astype(x.dtype)
 

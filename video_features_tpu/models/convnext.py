@@ -37,6 +37,10 @@ ARCHS = {
 
 
 def layer_norm(x: jax.Array, p: Params, eps: float = 1e-6) -> jax.Array:
+    if x.dtype == jax.numpy.bfloat16:
+        # fp32 accumulation island (bf16 fast lane, ops/nn.py contract)
+        return layer_norm(x.astype(jax.numpy.float32), p,
+                          eps).astype(x.dtype)
     mean = x.mean(axis=-1, keepdims=True)
     var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
     return (x - mean) / jax.numpy.sqrt(var + eps) * p['weight'] + p['bias']

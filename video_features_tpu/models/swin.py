@@ -53,6 +53,9 @@ LN_EPS = 1e-5  # timm swin uses the nn.LayerNorm default, not ViT's 1e-6
 
 
 def _layer_norm(x: jax.Array, p: Params) -> jax.Array:
+    if x.dtype == jnp.bfloat16:
+        # fp32 accumulation island (bf16 fast lane, ops/nn.py contract)
+        return _layer_norm(x.astype(jnp.float32), p).astype(x.dtype)
     mean = x.mean(axis=-1, keepdims=True)
     var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
     return (x - mean) / jnp.sqrt(var + LN_EPS) * p['weight'] + p['bias']
@@ -143,9 +146,13 @@ def _window_attention(p: Params, x: jax.Array, num_heads: int,
     if mask is not None:
         nw = mask.shape[0]
         scores = scores.reshape(Bn // nw, nw, num_heads, N, N)
-        scores = scores + jnp.asarray(mask)[None, :, None]
+        # mask follows scores' dtype: the np.float32 shift mask would
+        # otherwise promote bf16 scores to f32 mid-graph, silently
+        # defeating the bf16 fast lane from the first shifted block
+        scores = scores + jnp.asarray(mask, scores.dtype)[None, :, None]
         scores = scores.reshape(Bn, num_heads, N, N)
-    attn = jax.nn.softmax(scores, axis=-1)
+    from video_features_tpu.ops.nn import softmax
+    attn = softmax(scores, axis=-1)     # fp32 island under the bf16 lane
     out = jnp.einsum('bhnm,bmhd->bnhd', attn, v).reshape(Bn, N, C)
     return _linear(out, p['proj'])
 

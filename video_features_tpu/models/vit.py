@@ -40,6 +40,10 @@ INPUT_RESOLUTION = 224
 
 
 def layer_norm(x: jax.Array, p: Params, eps: float = 1e-6) -> jax.Array:
+    if x.dtype == jnp.bfloat16:
+        # fp32 accumulation island (bf16 fast lane, ops/nn.py contract):
+        # LayerNorm statistics in fp32, result cast back
+        return layer_norm(x.astype(jnp.float32), p, eps).astype(x.dtype)
     mean = x.mean(axis=-1, keepdims=True)
     var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
     return (x - mean) / jnp.sqrt(var + eps) * p['weight'] + p['bias']

@@ -26,6 +26,19 @@ from jax import lax
 Array = jax.Array
 IntOrTuple = Union[int, Sequence[int]]
 
+# -- fp32 accumulation islands (the bf16 fast lane) --------------------------
+#
+# Under ``compute_dtype=bfloat16`` activations flow bf16 end to end, but
+# a few ops accumulate MANY terms whose bf16 rounding compounds past the
+# per-family parity bounds: normalization statistics (mean/var over
+# thousands of elements), softmax (exp + sum), and pooling sums. Each such
+# op below detects a bf16 input, computes in float32, and casts the result
+# back — an explicit, local "island" rather than a global policy, so the
+# float32 lane's graph is BYTE-IDENTICAL to the pre-lane programs (the
+# branch is trace-time static on the abstract dtype; PROGRAMS.lock.json
+# pins that). Matmuls/convs need no island: the MXU accumulates fp32
+# internally for bf16 operands.
+
 
 def _tuple(v: IntOrTuple, n: int) -> Tuple[int, ...]:
     if isinstance(v, int):
@@ -75,6 +88,11 @@ def batch_norm(x: Array, p: Dict[str, Array], eps: float = 1e-5) -> Array:
     ``p`` holds torch-named entries: weight (γ), bias (β), running_mean,
     running_var. Affine params may be absent (γ=1, β=0).
     """
+    if x.dtype == jnp.bfloat16:
+        # fp32 island: the rsqrt(var+eps) fold and the (x-mean)*inv
+        # arithmetic run fp32, result cast back (BatchNorm statistics
+        # island of the bf16 fast lane)
+        return batch_norm(x.astype(jnp.float32), p, eps).astype(x.dtype)
     mean = p['running_mean'].astype(x.dtype)
     var = p['running_var'].astype(x.dtype)
     inv = lax.rsqrt(var + jnp.asarray(eps, x.dtype))
@@ -89,6 +107,9 @@ def batch_norm(x: Array, p: Dict[str, Array], eps: float = 1e-5) -> Array:
 def instance_norm(x: Array, p: Dict[str, Array], eps: float = 1e-5) -> Array:
     """InstanceNorm over spatial dims (channels-last), matching torch
     InstanceNorm2d (affine optional, no running stats — RAFT's fnet)."""
+    if x.dtype == jnp.bfloat16:
+        # fp32 island: per-sample statistics over whole spatial planes
+        return instance_norm(x.astype(jnp.float32), p, eps).astype(x.dtype)
     axes = tuple(range(1, x.ndim - 1))
     mean = x.mean(axis=axes, keepdims=True)
     var = x.var(axis=axes, keepdims=True)
@@ -103,6 +124,10 @@ def instance_norm(x: Array, p: Dict[str, Array], eps: float = 1e-5) -> Array:
 def group_norm(x: Array, p: Dict[str, Array], num_groups: int,
                eps: float = 1e-5) -> Array:
     """GroupNorm (channels-last), matching torch nn.GroupNorm."""
+    if x.dtype == jnp.bfloat16:
+        # fp32 island: per-group statistics
+        return group_norm(x.astype(jnp.float32), p, num_groups,
+                          eps).astype(x.dtype)
     *lead, c = x.shape
     g = num_groups
     xg = x.reshape(*lead, g, c // g)
@@ -129,6 +154,18 @@ def relu(x: Array) -> Array:
     return jax.nn.relu(x)
 
 
+def softmax(x: Array, axis: int = -1) -> Array:
+    """softmax with the bf16 fast lane's fp32 island: exp + normalizing
+    sum run fp32 for bf16 input (compounded rounding across wide
+    attention rows is exactly what the per-family parity bounds can't
+    absorb), result cast back; float32 input takes ``jax.nn.softmax``
+    verbatim — the identical graph every call site lowered before."""
+    if x.dtype == jnp.bfloat16:
+        return jax.nn.softmax(x.astype(jnp.float32),
+                              axis=axis).astype(x.dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
 def max_pool(x: Array, window: IntOrTuple, stride: Optional[IntOrTuple] = None,
              padding: Union[IntOrTuple, Sequence[Tuple[int, int]], str] = 0) -> Array:
     """Max pooling over the spatial dims of channels-last input."""
@@ -151,6 +188,11 @@ def avg_pool(x: Array, window: IntOrTuple, stride: Optional[IntOrTuple] = None,
              padding: Union[IntOrTuple, Sequence[Tuple[int, int]]] = 0,
              count_include_pad: bool = True) -> Array:
     """Average pooling matching torch AvgPool semantics."""
+    if x.dtype == jnp.bfloat16:
+        # fp32 island: window sums accumulate fp32 (also sidesteps the
+        # float init_value / bf16 operand dtype mismatch in reduce_window)
+        return avg_pool(x.astype(jnp.float32), window, stride, padding,
+                        count_include_pad).astype(x.dtype)
     n = x.ndim - 2
     window = _tuple(window, n)
     stride = window if stride is None else _tuple(stride, n)
@@ -176,6 +218,12 @@ def avg_pool(x: Array, window: IntOrTuple, stride: Optional[IntOrTuple] = None,
 def adaptive_avg_pool(x: Array, output_size: int = 1) -> Array:
     """AdaptiveAvgPool to (1,1,...) == global mean over spatial dims."""
     assert output_size == 1, 'only global pooling is used by these models'
+    if x.dtype == jnp.bfloat16:
+        # fp32 island: the global-pooling mean over thousands of
+        # spatial positions is the single widest accumulation in the
+        # conv families — and it feeds the feature output directly
+        return x.astype(jnp.float32).mean(
+            axis=tuple(range(1, x.ndim - 1))).astype(x.dtype)
     return x.mean(axis=tuple(range(1, x.ndim - 1)))
 
 

@@ -27,7 +27,7 @@ from __future__ import annotations
 from contextlib import nullcontext
 from typing import Dict, Optional, Tuple, Union
 
-import jax
+import numpy as np
 
 Pins = Tuple[Tuple[str, str], ...]
 
@@ -62,5 +62,132 @@ def pin_scope(pins: Optional[Pins], component: str):
     if pins:
         for name, prec in pins:
             if name == component:
+                import jax
                 return jax.default_matmul_precision(prec)
     return nullcontext()
+
+
+# -- the compute_dtype fast lane (bf16 storage + activations) ----------------
+#
+# ``compute_dtype=`` is ORTHOGONAL to the matmul ``precision=`` knob above:
+# ``precision`` selects how many bf16 passes each fp32 matmul executes on
+# the MXU (the *arithmetic* of an fp32-resident graph), while
+# ``compute_dtype=bfloat16`` changes what is *stored* — params are cast to
+# bf16 once at transplant time (half the HBM residency and H2D bytes) and
+# activations flow bf16 through the whole step, with fp32 accumulation
+# islands where parity demands it (softmax / LayerNorm / BatchNorm
+# statistics, global pooling — ops/nn.py, the model layer_norm homes).
+# Feature outputs are cast back to float32 at the step epilogue, so the
+# on-disk contract is unchanged; the *values* differ from the fp32 lane
+# within the per-family bounds below.
+
+COMPUTE_DTYPES = ('float32', 'bfloat16')
+
+# Per-family parity bounds for the bf16 lane: feature rel-L2 error vs the
+# float32 lane on identical inputs/weights — the same metric the repo's
+# reference-parity bar uses (BASELINE.json), PARITY.md-style pinned.
+# Measured by tests/test_precision.py (CPU XLA bf16, random weights, the
+# REAL jitted steps) and re-asserted there on every run; the bench's
+# *_bf16_* rungs record the measured error next to the speedup so a
+# committed number is checkable against its bound. Bounds carry ~3x
+# headroom over the measured drift (max-abs error is recorded alongside
+# for absolute context, but scales with feature magnitude — rel-L2 is
+# the stable pin across weights/geometry).
+#
+# NOTE the lane's honest trade: ~0.5-2e-2 rel-L2 is an order past the
+# <=1e-3 reference-parity bar — the bf16 lane is for throughput-bound
+# embedding consumers (retrieval, dedup, clustering), not for
+# reference-parity reproduction; precision=mixed remains the
+# parity-grade fast mode.
+BF16_REL_L2_BOUNDS: Dict[str, float] = {
+    'r21d': 1.5e-2,    # measured 4.9e-3 (stack 10, 64x86, CPU XLA bf16)
+    's3d': 2e-2,       # measured 5.9e-3 (in-graph scale-resize rides bf16)
+    'resnet': 2e-2,    # measured 5.8e-3 (resnet18; BN-fold islands fp32)
+    'clip': 3e-2,      # measured 1.0e-2 (ViT-B/32; LN/softmax islands)
+    'timm': 5e-2,      # measured 1.8e-2 (vit_base_patch16_224)
+    'vggish': 2.5e-2,  # measured 7.2e-3 (plain conv/relu VGG)
+}
+
+# Families that REFUSE the knob, with the measured drift that disqualifies
+# them (docs/benchmarks.md precision ladders): the fused i3d flow path
+# amplifies flow error through the uint8 quantization cliff, and raft's
+# raw flow output compounds bf16 error over 20 GRU refinement iterations —
+# neither meets its parity bound under bf16 storage, so the knob fails the
+# BUILD with a structured error instead of shipping out-of-bound features.
+BF16_REFUSALS: Dict[str, str] = {
+    'i3d': ('the fused RAFT->quantize->I3D flow path measures 1.24e-2 '
+            'feature drift under 1-pass bf16 (docs/benchmarks.md '
+            'precision ladder) vs the <=1e-3 parity bound — the flow '
+            'uint8-quantization cliff amplifies bf16 error; use '
+            "precision=mixed (3-pass bf16 matmuls, 8.5e-4) for i3d's "
+            'fast lane instead'),
+    'raft': ('raw flow output compounds bf16 error across 20 GRU '
+             'refinement iterations (corr/iter sub-graphs measure '
+             '>=4.4e-3 under fast passes, docs/benchmarks.md) vs the '
+             '<=1e-3 parity bound; use precision=mixed for raft '
+             'instead'),
+}
+
+
+class ComputeDtypeError(ValueError):
+    """A family refused (or doesn't know) the requested compute_dtype."""
+
+
+def check_compute_dtype(feature_type: Optional[str],
+                        compute_dtype: str) -> str:
+    """Validate the knob at BUILD time (config.sanity_check): the value
+    must be known, and a bf16 ask against a family outside
+    ``registry.BF16_FEATURES`` raises a structured error naming the
+    parity bound it would break — a serve submit then fails its build
+    with this message instead of a worker shipping drifted features."""
+    if compute_dtype not in COMPUTE_DTYPES:
+        raise ComputeDtypeError(
+            f'compute_dtype must be one of {COMPUTE_DTYPES}; '
+            f'got {compute_dtype!r}')
+    if compute_dtype != 'float32' and feature_type is not None:
+        from video_features_tpu.registry import BF16_FEATURES
+        if feature_type not in BF16_FEATURES:
+            why = BF16_REFUSALS.get(
+                feature_type,
+                f'{feature_type} has no measured bf16 parity bound '
+                f'(tests/test_precision.py) — a family must opt in via '
+                f'registry.BF16_FEATURES with a pinned bound before the '
+                f'fast lane is allowed to serve its features')
+            raise ComputeDtypeError(
+                f'compute_dtype=bfloat16 is refused for '
+                f'feature_type={feature_type}: {why}')
+    return compute_dtype
+
+
+def param_np_dtype(compute_dtype: str) -> np.dtype:
+    """The numpy dtype params are STORED in for this lane — what the
+    transplant layer casts checkpoints to, so bf16 params are bf16 in
+    HBM from the first ``device_put``, not cast per-step."""
+    if compute_dtype == 'bfloat16':
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(np.float32)
+
+
+def rel_l2(reference: np.ndarray, candidate: np.ndarray) -> float:
+    """||candidate - reference||2 / ||reference||2 — the ONE definition
+    of the parity metric the bounds above pin, shared by the tests, the
+    bench *_bf16_* error rungs, and the dryrun gate so no two consumers
+    can disagree about what "under the bound" means."""
+    a = np.asarray(reference, np.float64).ravel()
+    b = np.asarray(candidate, np.float64).ravel()
+    denom = float(np.linalg.norm(a))
+    return float(np.linalg.norm(b - a)) / max(denom, 1e-30)
+
+
+def features_to_f32(x):
+    """Step-epilogue cast: feature outputs always leave the device as
+    float32, whatever lane computed them (the on-disk .npy contract and
+    every consumer's dtype expectation stay lane-independent). A no-op —
+    emitting NO convert into the lowered program, so the float32 lane's
+    StableHLO stays byte-identical to the pre-knob programs — when the
+    input is already float32."""
+    import jax.numpy as jnp
+    if x.dtype == jnp.float32:
+        return x
+    return x.astype(jnp.float32)

@@ -23,9 +23,15 @@ import numpy as np
 Array = jax.Array
 
 
-def to_float_zero_one(x: Array) -> Array:
-    """uint8 [0,255] → float32 [0,1] (reference transforms.py:34-35 numerics)."""
-    return jnp.asarray(x, jnp.float32) / 255.0
+def to_float_zero_one(x: Array, dtype=None) -> Array:
+    """uint8 [0,255] → float [0,1] (reference transforms.py:34-35 numerics).
+
+    ``dtype`` selects the activation dtype the device edge casts to —
+    the bf16 fast lane (``compute_dtype=bfloat16``) passes ``bfloat16``
+    here so the whole step runs bf16 from the first op; None keeps the
+    historical float32 (byte-identical graph for every existing caller).
+    """
+    return jnp.asarray(x, jnp.float32 if dtype is None else dtype) / 255.0
 
 
 def scale_to_pm1(x: Array) -> Array:
@@ -81,8 +87,11 @@ def resize_bilinear_scale(x: Array, size: Tuple[int, int],
     no gathers); the matrices are trace-time constants per geometry.
     """
     *lead, h, w, c = x.shape
-    mh = jnp.asarray(_interp_matrix(h, size[0], scale))
-    mw = jnp.asarray(_interp_matrix(w, size[1], scale))
+    # matrices follow x's dtype so the bf16 lane's einsums stay bf16
+    # instead of silently promoting the activations back to fp32 (for
+    # float32 input this is exactly the constant jnp.asarray always built)
+    mh = jnp.asarray(_interp_matrix(h, size[0], scale), x.dtype)
+    mw = jnp.asarray(_interp_matrix(w, size[1], scale), x.dtype)
     # (..., H, W, C): contract H with mh, then W with mw
     out = jnp.einsum('oh,...hwc->...owc', mh, x)
     return jnp.einsum('pw,...owc->...opc', mw, out)
@@ -151,6 +160,14 @@ def _pil_resample_axis(x: Array, limbs: np.ndarray, axis_h: bool) -> Array:
     lm = jnp.asarray(limbs)                      # (3, out, in) f32
     xf = jnp.asarray(x, jnp.float32)
     eq = 'loh,...hwc->l...owc' if axis_h else 'low,...hwc->l...hoc'
+    # precision stays PINNED at HIGHEST regardless of the ambient matmul
+    # policy or the compute_dtype lane: this einsum is exact INTEGER
+    # arithmetic riding the MXU — byte limbs x uint8 pixels, every
+    # product < 2^17 and every window sum < 2^24, representable exactly
+    # ONLY in full fp32 (see _limb_split). A bf16 pass would corrupt the
+    # fixed-point limbs and break the bit-exact-Pillow contract
+    # (tests/test_device_resize.py), so the bf16 fast lane deliberately
+    # does NOT reach inside this resample — it is exact at any lane.
     parts = jnp.einsum(eq, lm, xf,
                        precision=jax.lax.Precision.HIGHEST)
     p = parts.astype(jnp.int32)

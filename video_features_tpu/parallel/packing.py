@@ -381,12 +381,23 @@ def run_packed(ex, video_paths: Iterable,
     dev_labels = ([f'd{d.id}' for d in ex._mesh.devices.flat]
                   if ndev > 1 else [])
 
+    # which precision lane computed every model/d2h span of this run
+    # (ops/precision.py): a trace or crash bundle must say which lane
+    # produced it — an fp32-vs-bf16 perf or drift question is otherwise
+    # unanswerable post-hoc
+    compute_dtype = str(getattr(ex, 'compute_dtype', 'float32'))
+
     def mesh_attrs(valid: int) -> Dict:
         """Extra span attrs for mesh-sharded model/d2h stages: the mesh
-        width and each shard's valid-slot count (empty single-device)."""
-        if ndev <= 1 or not ex.tracer.enabled:
+        width and each shard's valid-slot count (empty single-device),
+        plus the compute_dtype lane on every packed run."""
+        if not ex.tracer.enabled:
             return {}
-        return {'mesh_devices': ndev, 'shard_valid': shard_valids(valid)}
+        attrs: Dict = {'compute_dtype': compute_dtype}
+        if ndev > 1:
+            attrs.update(mesh_devices=ndev,
+                         shard_valid=shard_valids(valid))
+        return attrs
 
     def record_occupancy(name: str, valid: int) -> None:
         """Aggregate occupancy at the GLOBAL capacity plus — on a mesh —
@@ -813,8 +824,15 @@ def run_packed(ex, video_paths: Iterable,
                 # the device loop's critical path
                 shape = getattr(dev, 'shape', None)
                 if shape is not None:
+                    # the identity names the LANE too when it isn't the
+                    # default: fp32 and bf16 entries lower different
+                    # programs at the same input geometry (the packed
+                    # batch itself is usually uint8 on both lanes)
+                    lane = ('' if compute_dtype == 'float32'
+                            else f':{compute_dtype}')
                     identity = (f'{getattr(ex, "feature_type", "?")}:'
-                                f'{tuple(shape)}:{getattr(dev, "dtype", "")}')
+                                f'{tuple(shape)}:'
+                                f'{getattr(dev, "dtype", "")}{lane}')
                     if identity not in costed:
                         costed[identity] = (tuple(shape),
                                             getattr(dev, 'dtype', None))
@@ -835,7 +853,10 @@ def run_packed(ex, video_paths: Iterable,
         # this is a cache read, and either way it is off the hot path
         import jax
         for identity, (shape, dtype) in costed.items():
-            info: Dict = {'batch': batch}
+            # every executable record names its lane, so the manifest's
+            # xla_cost_analysis section says which precision produced
+            # the FLOPs/bytes it reports
+            info: Dict = {'batch': batch, 'compute_dtype': compute_dtype}
             cost = ex.executable_cost(jax.ShapeDtypeStruct(shape, dtype)) \
                 if dtype is not None else None
             if cost:
@@ -851,7 +872,11 @@ def run_packed(ex, video_paths: Iterable,
             'shape': {str(k): int(v) for k, v in ex._mesh.shape.items()},
             'devices': dev_labels,
             'capacity_per_device': capacity,
-            'global_batch': batch})
+            'global_batch': batch,
+            # which precision lane this mesh's programs computed in —
+            # a bf16 entry is a different compiled program at the same
+            # width, and the manifest must say which one ran
+            'compute_dtype': compute_dtype})
 
     if farm is not None and manifest is not None:
         # farm config + lifetime stats land in the run manifest (the

@@ -37,6 +37,21 @@ DATA_PARALLEL_FEATURES = frozenset(
 PACKED_FEATURES = frozenset(
     {'i3d', 'r21d', 's3d', 'resnet', 'clip', 'timm'})
 
+# feature types whose extractor accepts the bf16 fast lane
+# (compute_dtype=bfloat16 — params cast bf16 at transplant, bf16
+# activations with fp32 accumulation islands, ops/precision.py). Same
+# deliberate-literal policy: a family joins ONLY once its rel-L2 error
+# vs the float32 lane is measured and pinned (ops/precision.py
+# BF16_REL_L2_BOUNDS, asserted by tests/test_precision.py) — an
+# unmeasured family refuses the knob with a
+# structured build-time error (ops/precision.check_compute_dtype)
+# instead of shipping drift nobody bounded. i3d and raft stay OUT by
+# measurement, not omission: the flow uint8-quantization cliff / 20-step
+# GRU error compounding put them over the parity bar under bf16
+# (ops/precision.BF16_REFUSALS names the numbers).
+BF16_FEATURES = frozenset(
+    {'r21d', 's3d', 'resnet', 'clip', 'timm', 'vggish'})
+
 # feature types whose extractor can consume a LIVE session (ingress/):
 # raw network frames windowed to the family's packed geometry
 # (BaseExtractor.live_window_spec). Same deliberate-literal policy: a

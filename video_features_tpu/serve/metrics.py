@@ -202,6 +202,13 @@ def prometheus_text(doc: Dict[str, Any],
         g('vft_device_resident_entries',
           'warm-pool entries resident per device',
           labels={'device': dev}).set(count)
+    for dev, nbytes in (doc.get('warm_pool') or {}
+                        ).get('device_resident_bytes', {}).items():
+        # REAL per-chip residency: a bf16 fast-lane entry counts its
+        # actual ~half-size params footprint, not '1 entry'
+        g('vft_device_resident_bytes',
+          'warm-pool params bytes resident per device',
+          labels={'device': dev}).set(nbytes)
     for key, value in (doc.get('cache') or {}).items():
         if isinstance(value, (int, float)) and not isinstance(value, bool):
             g(f'vft_cache_{key}',

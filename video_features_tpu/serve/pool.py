@@ -141,36 +141,51 @@ class WarmPool:
 class DevicePlacer:
     """Least-loaded device placement for warm-pool entries.
 
-    Tracks how many resident entries each local chip carries and assigns
-    every newly built extractor the least-loaded chip(s) — one for a
-    single-device entry, N for a ``mesh_devices=N`` packed mesh — so
-    different model families end up resident on DIFFERENT chips and a
-    multi-family server uses the whole host instead of stacking every
-    params copy on device 0. Release on entry retirement (eviction reap,
-    crash) returns the chips to the free side of the ranking. Ties break
-    by device id for deterministic placement; on a single-device host
-    every assignment degenerates to that device (today's behavior).
+    Tracks how many resident entries — and how many resident BYTES —
+    each local chip carries and assigns every newly built extractor the
+    least-loaded chip(s) — one for a single-device entry, N for a
+    ``mesh_devices=N`` packed mesh — so different model families end up
+    resident on DIFFERENT chips and a multi-family server uses the whole
+    host instead of stacking every params copy on device 0. Ranking is
+    byte-first (entries, then device id, break ties): entries are not
+    interchangeable HBM units — a bf16 fast-lane entry
+    (``compute_dtype=bfloat16``) is ~half the params bytes of its fp32
+    sibling, so two bf16 entries should stack on one chip before a
+    second fp32 copy does. Callers that don't know their size pass 0 and
+    the ranking degrades to the historical entry-count ordering. Release
+    on entry retirement (eviction reap, crash) returns the chips AND the
+    bytes to the free side of the ranking. Ties break by device id for
+    deterministic placement; on a single-device host every assignment
+    degenerates to that device (today's behavior).
     """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._load: Dict[int, int] = {}      # jax device id → entries
+        self._bytes: Dict[int, int] = {}     # jax device id → params bytes
 
-    def assign(self, devices: Sequence, n: int) -> list:
+    def assign(self, devices: Sequence, n: int, nbytes: int = 0) -> list:
         """Pick the ``n`` least-loaded of ``devices`` (all local chips of
-        the extractor's platform) and count them as occupied. ``n`` is
-        clamped to what exists — build-time validation
+        the extractor's platform) and count them as occupied by
+        ``nbytes`` of residency EACH (params are replicated per chip on
+        a mesh entry, so every chosen chip carries a full copy). ``n``
+        is clamped to what exists — build-time validation
         (``configure_mesh``) already rejected genuine over-asks."""
         n = max(1, min(int(n or 1), len(devices)))
+        nbytes = max(int(nbytes or 0), 0)
         with self._lock:
             ranked = sorted(devices,
-                            key=lambda d: (self._load.get(d.id, 0), d.id))
+                            key=lambda d: (self._bytes.get(d.id, 0),
+                                           self._load.get(d.id, 0), d.id))
             chosen = ranked[:n]
             for d in chosen:
                 self._load[d.id] = self._load.get(d.id, 0) + 1
+                self._bytes[d.id] = self._bytes.get(d.id, 0) + nbytes
         return chosen
 
-    def release(self, devices: Optional[Sequence]) -> None:
+    def release(self, devices: Optional[Sequence],
+                nbytes: int = 0) -> None:
+        nbytes = max(int(nbytes or 0), 0)
         with self._lock:
             for d in devices or ():
                 # keep zero counts instead of popping: the metrics mirror
@@ -178,6 +193,8 @@ class DevicePlacer:
                 # device would leave its last nonzero
                 # vft_device_resident_entries reading sticky forever
                 self._load[d.id] = max(self._load.get(d.id, 0) - 1, 0)
+                self._bytes[d.id] = max(self._bytes.get(d.id, 0)
+                                        - nbytes, 0)
 
     def snapshot(self) -> Dict[str, int]:
         """device id label → resident entry count (metrics surface;
@@ -185,3 +202,11 @@ class DevicePlacer:
         last nonzero scrape)."""
         with self._lock:
             return {f'd{i}': c for i, c in sorted(self._load.items())}
+
+    def snapshot_bytes(self) -> Dict[str, int]:
+        """device id label → resident params bytes (the
+        ``vft_device_resident_bytes`` gauges): REAL bytes, so a chip
+        holding two half-size bf16 entries reads the same as one fp32
+        entry — what HBM actually sees, not an entry count."""
+        with self._lock:
+            return {f'd{i}': b for i, b in sorted(self._bytes.items())}

@@ -1038,15 +1038,23 @@ class ExtractionServer:
             from video_features_tpu.utils.device import jax_devices_all
             local = jax_devices_all(extractor.device)
             n = int(getattr(extractor, 'mesh_devices', 1) or 1)
-            devices = self._placer.assign(local, n)
+            # REAL bytes, not '1 entry': a bf16 fast-lane entry is ~half
+            # the params HBM of its fp32 sibling, and the placer ranks
+            # chips by resident bytes so the accounting sees that
+            nbytes = extractor.params_nbytes()
+            devices = self._placer.assign(local, n, nbytes=nbytes)
             try:
                 extractor.place_on(devices)
             except Exception:
                 # assign() already counted these chips — give them back,
                 # or the failed placement skews every future least-loaded
                 # decision for the server's lifetime
-                self._placer.release(devices)
+                self._placer.release(devices, nbytes=nbytes)
                 raise
+            # remember the EXACT charged bytes for the symmetric release
+            # (recomputing at retirement could drift if the extractor's
+            # buffers changed — the ledger must always net to zero)
+            extractor._placement_nbytes = nbytes
             return devices
         except Exception:
             import logging
@@ -1058,11 +1066,14 @@ class ExtractionServer:
             return None
 
     def _release_placement(self, worker: '_Worker') -> None:
-        """Return a retired entry's chips to the placer (idempotent —
-        retirement paths can race: crash vs reap)."""
+        """Return a retired entry's chips — and its resident bytes — to
+        the placer (idempotent — retirement paths can race: crash vs
+        reap)."""
         devices, worker.devices = worker.devices, None
         if devices:
-            self._placer.release(devices)
+            self._placer.release(
+                devices,
+                nbytes=getattr(worker.ex, '_placement_nbytes', 0))
 
     def _answer_cache_hits(self, args: Config, paths: List[str],
                            segment=None) -> List[str]:
@@ -1161,6 +1172,9 @@ class ExtractionServer:
         # resident-entry counts (the vft_device_resident_entries gauges)
         pool_stats['placements'] = placements
         pool_stats['device_residents'] = self._placer.snapshot()
+        # REAL per-chip residency bytes (bf16 entries count ~half their
+        # fp32 siblings) — the vft_device_resident_bytes gauges
+        pool_stats['device_resident_bytes'] = self._placer.snapshot_bytes()
         from video_features_tpu.cache.store import merge_cache_stats
         from video_features_tpu.farm.farm import merge_farm_stats
         ingress_stats = None
