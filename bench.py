@@ -60,6 +60,14 @@ inference), plus per-video hit latency and the store hit rate (asserted
 to cover the worklist). ``BENCH_CACHE=0/1`` overrides the
 accelerator-only default.
 
+The zero-cold-start rung (``serve_boot_first_feature_s`` /
+``serve_boot_first_feature_cold_s`` / ``aot_hit_rate``): boot-to-first-
+feature wall time for a pre-warmed daemon (``serve_prewarm`` +
+``aot_enabled``, aot/) against a cold vs warm persistent executable
+store — the warm boot loads serialized executables instead of compiling
+(``builds_compiled == 0`` asserted). ``BENCH_AOT=0/1`` overrides the
+accelerator-only default.
+
 Default precision is 'mixed' (ops/precision.py): ambient 3-pass bf16 with
 the drift-tolerant sub-graphs on 1-pass — measured ≤1e-3 feature drift vs
 float32 on the fused path (tools/precision_study.py), i.e. the fastest
@@ -324,6 +332,61 @@ def bench_serve_ingress(tmp_dir: str, platform: str,
         }
     finally:
         server.drain(wait=True, grace_s=120)
+
+
+def bench_aot_boot(tmp_dir: str, platform: str, wl_paths: list) -> dict:
+    """The zero-cold-start rung (aot/): boot-to-first-feature wall time
+    for a pre-warmed daemon (``serve_prewarm`` + ``aot_enabled``)
+    against a COLD executable store — the boot pays XLA compiles and
+    publishes them — vs a WARM store, where every pre-warmed program
+    LOADS (PJRT deserialization) and the boot must be compile-free
+    (``builds_compiled == 0`` asserted, or the rung is mislabeled).
+    Both numbers cover ExtractionServer construction, pre-warm, and one
+    request completing end to end — the latency a deploy/restart
+    actually adds before the first feature lands."""
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+
+    base = {
+        'device': platform, 'model_name': 'resnet18', 'batch_size': 8,
+        'allow_random_weights': True, 'on_extraction': 'save_numpy',
+        'tmp_path': os.path.join(tmp_dir, 'aot_tmp'),
+        'aot_enabled': True, 'aot_dir': os.path.join(tmp_dir, 'aot_store'),
+    }
+
+    def boot(tag):
+        t0 = time.perf_counter()
+        server = ExtractionServer(base_overrides=base,
+                                  queue_depth=64).start()
+        try:
+            server.prewarm(['resnet'])
+            client = ServeClient(port=server.port)
+            rid = client.submit('resnet', [wl_paths[0]], overrides={
+                'output_path': os.path.join(tmp_dir, f'aot_out_{tag}')})
+            st = client.wait(rid, timeout_s=900)
+            assert st['state'] == 'done', f'aot boot {tag}: {st}'
+            first_s = time.perf_counter() - t0
+            m = client.metrics()
+        finally:
+            server.drain(wait=True, grace_s=120)
+        return first_s, m
+
+    cold_s, _ = boot('cold')
+    warm_s, m_warm = boot('warm')
+    pool = m_warm['warm_pool']
+    assert pool['builds_compiled'] == 0 and pool['builds_loaded'] >= 1, \
+        f'warm-store boot was not compile-free — rung mislabeled: {pool}'
+    # per-boot program hit rate (the store counters are process-global
+    # and would fold the cold boot's misses in): loaded / all programs
+    # this boot resolved
+    aot = m_warm['aot']
+    programs = aot['programs_loaded'] + aot['programs_compiled']
+    return {
+        'serve_boot_first_feature_s': round(warm_s, 3),
+        'serve_boot_first_feature_cold_s': round(cold_s, 3),
+        'aot_hit_rate': round(aot['programs_loaded'] / max(programs, 1),
+                              4),
+    }
 
 
 def bench_cache(precision: str, batch: int, stack: int, tmp_dir: str,
@@ -962,6 +1025,22 @@ def run() -> dict:
                         srec['serve_warm_hit_rate']
                 except Exception as e:
                     rungs['serve_error'] = f'{type(e).__name__}: {e}'
+            # The zero-cold-start rung (aot/): boot-to-first-feature
+            # for a pre-warmed daemon against a cold vs warm persistent
+            # executable store — the warm boot must be compile-free.
+            # BENCH_AOT=0/1 overrides the accelerator-only default.
+            if os.environ.get('BENCH_AOT',
+                              '1' if on_accel else '0') == '1':
+                try:
+                    if wl_paths is None:
+                        from tools.worklist_bench import make_worklist
+                        wl_paths = make_worklist(
+                            tmp_dir, 4 if on_accel else 2,
+                            10 if on_accel else 2)
+                    rungs.update(bench_aot_boot(tmp_dir, platform,
+                                                wl_paths))
+                except Exception as e:
+                    rungs['serve_aot_error'] = f'{type(e).__name__}: {e}'
             # The ingress rung (ingress/): the HTTP front door's RTT
             # percentiles vs the loopback socket, through one real
             # segment query. BENCH_INGRESS=0/1 overrides.

@@ -817,7 +817,11 @@ def test_knob_registry_is_behavior_preserving():
     hand-maintained lists exactly (fingerprint/pool-key parity tests
     depend on membership; this pins the full sets — new knobs extend it
     intentionally, here: the vft-flight telemetry knobs, 'neither' like
-    the trace knobs they sit beside)."""
+    the trace knobs they sit beside, and the vft-aot store knobs,
+    'pool_only' like the cache_* knobs they mirror (loaded executables
+    are byte-identical to compiled ones, so the fingerprint excludes
+    them; a worker consults the store it was built with, so the pool
+    key keeps them)."""
     from video_features_tpu.config import knob_exclude
     assert knob_exclude('fingerprint') == {
         'video_paths', 'file_with_video_paths', 'output_path', 'tmp_path',
@@ -829,6 +833,7 @@ def test_knob_registry_is_behavior_preserving():
         'trace_out', 'trace_capacity', 'manifest_out',
         'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s',
         'cache_enabled', 'cache_dir', 'cache_max_bytes',
+        'aot_enabled', 'aot_dir', 'aot_max_bytes',
         'allow_random_weights', 'timeout_s', 'config'}
     assert knob_exclude('pool_key') == {
         'video_paths', 'file_with_video_paths', 'output_path', 'profile',

@@ -178,6 +178,11 @@ def test_bench_diff_error_rungs_flagged_never_gated(tmp_path):
     assert bd.lower_is_better('x_rel_l2_error')
     assert bd.is_error_rung('x_max_abs_error')
     assert not bd.is_error_rung('serve_bf16_speedup')
+    # zero-cold-start rungs: boot-to-first-feature is a latency (rises =
+    # WORSE), the program hit rate gates like a throughput (drops = WORSE)
+    assert bd.lower_is_better('serve_boot_first_feature_s')
+    assert bd.lower_is_better('serve_boot_first_feature_cold_s')
+    assert not bd.lower_is_better('aot_hit_rate')
 
 
 def test_bench_serve_rung_emits_keys():
@@ -220,6 +225,26 @@ def test_bench_serve_ingress_rung_emits_keys():
         assert rungs[key] > 0, (key, rungs)
     assert rungs['serve_ingress_p99_latency_s'] >= \
         rungs['serve_ingress_p50_latency_s']
+
+
+def test_bench_aot_rung_emits_keys():
+    """BENCH_AOT=1 drives the zero-cold-start rung (aot/): two daemon
+    boots against one persistent executable store — the record must
+    carry boot-to-first-feature for the cold-store boot (pays XLA
+    compiles) and the warm-store boot (loads serialized executables;
+    asserted compile-free inside the rung), plus the warm boot's
+    program hit rate — all while keeping the one-JSON-line stdout
+    contract."""
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_SERVE': '0', 'BENCH_WORKLIST': '0',
+                      'BENCH_CACHE': '0', 'BENCH_AOT': '1'})
+    rungs = rec['rungs']
+    assert 'serve_aot_error' not in rungs, rungs.get('serve_aot_error')
+    assert rungs['serve_boot_first_feature_s'] > 0
+    assert rungs['serve_boot_first_feature_cold_s'] > 0
+    # every pre-warmed program loaded on the warm-store boot
+    assert rungs['aot_hit_rate'] == 1.0, rungs
 
 
 def test_bench_cache_rung_emits_keys():

@@ -212,7 +212,8 @@ def test_prometheus_from_serve_doc():
     doc = metrics_mod.build_metrics(
         started_at=0.0, queue_depth=3, queue_capacity=64, draining=False,
         pool_stats={'size': 1, 'capacity': 4, 'hits': 5, 'misses': 1,
-                    'hit_rate': 5 / 6, 'evictions': 0, 'builds': 1},
+                    'hit_rate': 5 / 6, 'evictions': 0,
+                    'builds_compiled': 1, 'builds_loaded': 0},
         request_stats=stats,
         stage_reports={'i3d': {'model': {
             'count': 4, 'total_s': 2.0, 'mean_s': 0.5, 'max_s': 0.9,
@@ -552,6 +553,11 @@ TRACER_RECORD_KEYS = {'count', 'total_s', 'mean_s', 'max_s', 'first_s',
 METRICS_DOC_KEYS = {'uptime_s', 'queue', 'warm_pool', 'cache', 'farm',
                     'requests', 'latency', 'stages', 'stages_merged',
                     'inflight_batches',
+                    # persistent executable store (aot/): merged store
+                    # counters + programs_loaded/programs_compiled —
+                    # the zero-cold-start audit pair (all-zero without
+                    # aot_enabled)
+                    'aot',
                     # network front door (ingress/): per-tenant view,
                     # {'enabled': False, ...} on loopback-only servers
                     'ingress',
@@ -565,7 +571,7 @@ TRACE_EVENT_KEYS = {'name', 'ph', 'ts', 'dur', 'pid', 'tid', 'args', 's'}
 MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
                  'config', 'fingerprints', 'videos', 'outcomes', 'stages',
                  'compile', 'executables', 'farm', 'mesh', 'ingress',
-                 'programs_lock'}
+                 'programs_lock', 'aot'}
 
 
 CANONICAL_STAGES = {'decode', 'decode+preprocess', 'audio_dsp',

@@ -471,7 +471,9 @@ def test_serve_soak_concurrent_requests_and_metrics_file(
         doc = json.loads(Path(metrics_path).read_text())
         assert doc['requests']['completed'] == 4
         # concurrent cold submits may each count a miss, but the per-key
-        # build lock guarantees ONE transplant total
-        assert doc['warm_pool']['builds'] == 1
+        # build lock guarantees ONE transplant total (no aot store in
+        # this config, so the build lands on the compiled counter)
+        assert doc['warm_pool']['builds_compiled'] == 1
+        assert doc['warm_pool']['builds_loaded'] == 0
     finally:
         server.drain(wait=True, grace_s=60)

@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Offline maintenance for the persistent executable store (aot/).
+
+The online path (``aot/store.py``) only evicts inline when a publish
+pushes the store over ``aot_max_bytes`` and only size-checks payloads it
+is about to serve; this tool is the periodic/cron surface that does the
+rest:
+
+  * compacts the append-only ``manifest.jsonl`` (put/touch/del op log)
+    down to one line per live entry — a frequently booted host's
+    manifest otherwise grows with every load;
+  * evicts LRU entries down to ``--target-bytes`` (oldest-loaded first
+    — executables for retired configs/jax versions age out naturally);
+  * ``--verify`` re-hashes every stored payload against its recorded
+    SHA-256 (not just the size check) and evicts mismatches — bit-rot
+    the online size check cannot see;
+  * removes orphaned object directories (crashed writers).
+
+Safe to run against a live store dir: all mutations go through the same
+process-atomic store operations, and concurrent readers degrade evicted
+entries to compile-on-miss.
+
+Usage:
+    python tools/aot_gc.py --aot-dir ~/.cache/video_features_tpu/executables \\
+        [--target-bytes 10000000000] [--verify] [--no-compact]
+
+Prints one JSON report line on stdout. Exit codes:
+    0  clean — no corrupt entries found
+    1  corrupt/truncated entries were found (and evicted)
+    2  usage error (missing/invalid --aot-dir, bad --target-bytes)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--aot-dir', required=True,
+                    help='the executable store directory (aot_dir '
+                         'config key)')
+    ap.add_argument('--target-bytes', type=int, default=None,
+                    help='evict LRU entries until total stored bytes <= N '
+                         '(default: no size pressure)')
+    ap.add_argument('--verify', action='store_true',
+                    help='re-hash every stored payload against its '
+                         'recorded SHA-256 (slower; catches silent bit '
+                         'rot the size check cannot)')
+    ap.add_argument('--no-compact', action='store_true',
+                    help='skip the manifest rewrite (report/evict only)')
+    ns = ap.parse_args(argv)
+
+    aot_dir = os.path.abspath(os.path.expanduser(ns.aot_dir))
+    if not os.path.isdir(aot_dir):
+        print(f'error: --aot-dir {ns.aot_dir!r} is not a directory',
+              file=sys.stderr)
+        return 2
+    if ns.target_bytes is not None and ns.target_bytes < 0:
+        print('error: --target-bytes must be >= 0', file=sys.stderr)
+        return 2
+
+    # a fresh instance, NOT ExecStore.get: the offline tool must read
+    # the manifest as it is on disk, not this process's live view
+    from video_features_tpu.aot.store import ExecStore
+    store = ExecStore(aot_dir)
+    report = store.gc(target_bytes=ns.target_bytes, verify=ns.verify,
+                      compact=not ns.no_compact)
+    report['aot_dir'] = aot_dir
+    report['verified'] = bool(ns.verify)
+    print(json.dumps(report, sort_keys=True))
+    return 1 if report['corrupt_evicted'] else 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -35,7 +35,12 @@ import json
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
-LOWER_IS_BETTER_MARKERS = ('latency', 'resume_pass')
+# 'boot_first_feature' names the zero-cold-start rungs
+# (serve_boot_first_feature[_cold]_s): boot-to-first-feature is a
+# latency even though the name doesn't say so; the '_s' suffix rule
+# would catch it too, but direction must not hinge on a suffix
+# convention alone for a rung CI gates on
+LOWER_IS_BETTER_MARKERS = ('latency', 'resume_pass', 'boot_first_feature')
 
 # rungs that NAME the loop configuration a number was measured under
 # (async depth, decode-farm worker count, mesh width) rather than

@@ -208,6 +208,19 @@ class Finding:
 
 # -- shared abstract-lowering seam (obs/manifest.py reuses this) -------------
 
+def stablehlo_sha256(text: str) -> str:
+    """The byte-deterministic program identity: sha256 over the lowered
+    StableHLO text. The ONE home of the hashing convention — the lock
+    entries pin it per (family, mesh-width, lane), and the executable
+    store (``aot/runtime.py``) keys its persisted compiled executables
+    by the same identity, which is what makes an unchanged lock imply a
+    compile-free boot. (The store hashes the PRODUCTION lowering, which
+    additionally bakes the ambient matmul-precision context and the
+    live args' shardings that the checker's abstract lowering carries
+    no opinion on — same identity space, same determinism guarantee.)"""
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
 def abstract_lowering(jitted, *args, **kwargs):
     """AOT-lower ``jitted`` at the abstract shapes of ``args``/``kwargs``
     — concrete arrays are mapped to ``ShapeDtypeStruct`` in place, avals
@@ -313,7 +326,7 @@ def program_signature(spec: ProgramSpec) -> Dict[str, Any]:
                               if spec.batch_argnum < len(donated) else False),
         'donated_args': [i for i, d in enumerate(donated) if d],
         'num_partitions': int(m.group(1)) if m else 1,
-        'stablehlo_sha256': hashlib.sha256(text.encode()).hexdigest(),
+        'stablehlo_sha256': stablehlo_sha256(text),
     }
     cost = lowering_cost(lowered)
     if cost:

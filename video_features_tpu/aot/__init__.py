@@ -1,0 +1,33 @@
+"""vft-aot: zero cold start — a persistent compiled-executable store.
+
+Every serve boot and every fresh CLI batch job used to re-trace and
+re-compile its programs before the first feature was fast; serve's
+per-key build locks merely serialized the pain. This package keeps the
+COMPILED XLA executables on disk between processes, keyed by the same
+byte-deterministic StableHLO identity ``PROGRAMS.lock.json`` pins
+(``analysis/programs.py``), so a boot against an unchanged program set
+LOADS executables instead of compiling them.
+
+Two layers:
+
+  * :mod:`aot.store` — the jax-free persistent byte store (atomic
+    writes, integrity verification that EVICTS corrupt entries instead
+    of serving them, size-bounded LRU GC; mirrors ``cache/store.py``);
+  * :mod:`aot.runtime` — the jax seam: serialize/deserialize compiled
+    executables (``jax.experimental.serialize_executable``, PJRT-level)
+    and ``ensure_program`` (trace → StableHLO sha → load-or-compile →
+    republish), the one function both the lazy dispatch path
+    (``BaseExtractor.aot_call``) and the serve pre-warm
+    (``BaseExtractor.aot_warm``) go through.
+
+A jax-version / backend / device-kind mismatch is by construction a
+SILENT MISS (the key includes all three): the program recompiles and
+republishes under its own key — never an error. Outputs of a loaded
+executable are byte-identical to a freshly compiled one's
+(tests/test_aot.py pins it), which is why the ``aot_*`` knobs are
+excluded from the cache fingerprint (docs/serving.md "Zero cold
+start").
+"""
+from video_features_tpu.aot.store import (  # noqa: F401
+    ExecStore, log_aot_error, merge_exec_stats,
+)

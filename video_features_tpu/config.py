@@ -95,6 +95,35 @@ FARM_DEFAULTS: Dict[str, Any] = {
     'decode_farm_ring_mb': 64,
 }
 
+# -- persistent executable store (aot/; docs/serving.md "Zero cold start") ---
+# Same injection policy as CACHE_DEFAULTS: one source of truth, older
+# user YAMLs pick the knobs up automatically, CLI dotlist wins.
+AOT_DEFAULTS: Dict[str, Any] = {
+    # consult/publish the persistent compiled-executable store: the
+    # second process running an unchanged program set LOADS executables
+    # (PJRT deserialization, milliseconds) instead of paying XLA
+    # compilation. Keyed by the StableHLO identity PROGRAMS.lock.json
+    # pins + jax version + backend/device kind + device ids — any
+    # mismatch is a silent compile-on-miss, never an error. Outputs of
+    # loaded executables are byte-identical to freshly compiled ones
+    # (tests/test_aot.py), so these knobs stay out of the cache
+    # fingerprint. Off by default — today's behavior exactly.
+    'aot_enabled': False,
+    # where serialized executables live (manifest.jsonl + objects/);
+    # shared across processes on one host. NOTE: on the CPU backend the
+    # payloads record the compiling host's ISA, so a network-shared dir
+    # only pays off for accelerator backends (same caveat as jax's own
+    # compilation cache — utils/device.enable_compilation_cache). TRUST:
+    # payloads restore via pickle-based PJRT machinery — whoever can
+    # write this dir can run code in every loading process, so keep it
+    # writable only by the principals that run the extractors
+    # (docs/serving.md "Zero cold start" § trust model).
+    'aot_dir': '~/.cache/video_features_tpu/executables',
+    # LRU size bound in bytes (null = unbounded); enforced inline on
+    # publish and offline via tools/aot_gc.py
+    'aot_max_bytes': None,
+}
+
 # -- flight recorder (obs/; docs/observability.md) ---------------------------
 # Same injection policy as CACHE_DEFAULTS: one source of truth, older
 # user YAMLs pick the knobs up automatically, CLI dotlist wins.
@@ -230,6 +259,16 @@ KNOB_CLASSIFICATION: Dict[str, str] = {
     'cache_enabled': 'pool_only',
     'cache_dir': 'pool_only',
     'cache_max_bytes': 'pool_only',
+    # executable store (aot/): where compiled programs are LOADED from
+    # can never change the bytes they compute (loaded executables are
+    # byte-identical to fresh compiles — tests/test_aot.py pins it), so
+    # the fingerprint excludes all three; pool-key RELEVANT for the
+    # same reason as cache_*: a worker consults/publishes the store it
+    # was built with, so requests naming different stores must not
+    # share an entry
+    'aot_enabled': 'pool_only',
+    'aot_dir': 'pool_only',
+    'aot_max_bytes': 'pool_only',
     # covered by the weights fingerprint (checkpoint CONTENT is hashed)
     'allow_random_weights': 'pool_only',
     # serve-side per-request plumbing
@@ -334,6 +373,8 @@ def load_config(
             f'Known: {", ".join(KNOWN_FEATURE_TYPES)}')
     args = load_yaml(cfg_path)
     for key, value in CACHE_DEFAULTS.items():
+        args.setdefault(key, value)
+    for key, value in AOT_DEFAULTS.items():
         args.setdefault(key, value)
     for key, value in OBS_DEFAULTS.items():
         args.setdefault(key, value)
@@ -441,6 +482,21 @@ def sanity_check(args: Config) -> None:
             warnings.warn('cache_enabled has no effect with '
                           'on_extraction=print — disabling the cache')
             args['cache_enabled'] = False
+
+    # executable-store knobs (aot/): the dir coerces to str, the size
+    # bound must be a non-negative int. ValueError, not assert —
+    # survives `python -O` like every other knob rejection.
+    if args.get('aot_enabled'):
+        if not args.get('aot_dir'):
+            raise ValueError('aot_enabled=true requires aot_dir '
+                             '(see docs/serving.md "Zero cold start")')
+    if args.get('aot_dir') is not None:
+        args['aot_dir'] = str(args['aot_dir'])
+    if args.get('aot_max_bytes') is not None:
+        args['aot_max_bytes'] = int(args['aot_max_bytes'])
+        if args['aot_max_bytes'] < 0:
+            raise ValueError('aot_max_bytes must be >= 0 or null; '
+                             f'got {args["aot_max_bytes"]}')
 
     # device-loop pipelining: the in-flight depth must be a positive int
     # (1 = synchronous; each extra unit pins one more output batch on
@@ -616,6 +672,16 @@ SERVE_DEFAULTS: Dict[str, Any] = {
     # serve_queue_depth, so a saturated queue sheds batch before
     # interactive. 1.0 = no distinction.
     'serve_batch_shed_fraction': 0.5,
+    # zero cold start (aot/; docs/serving.md "Zero cold start"): build
+    # these warm-pool entries at BOOT, before the first request —
+    # a list of 'family' or 'family@lane' specs (e.g.
+    # '[resnet,resnet@bfloat16]'), each resolved against the base
+    # overrides exactly like a cold submit. With aot_enabled=true in
+    # the base overrides, an unchanged program set makes the boot
+    # compile-free: every pre-warmed program LOADS from the executable
+    # store (builds_loaded in pool stats) instead of compiling. null =
+    # no pre-warm (today's behavior: the first request pays the build).
+    'serve_prewarm': None,
     # -- ingress (ingress/; docs/ingress.md): the network front door ----
     # HTTP/1.1 + chunked endpoint port: null = DISABLED (loopback-only
     # server, today's behavior), 0 = ephemeral (printed at startup)
@@ -662,6 +728,32 @@ def split_serve_config(cli_args: Dict[str, Any]) -> Tuple[Config, Config]:
     if serve['serve_default_timeout_s'] is not None:
         serve['serve_default_timeout_s'] = \
             float(serve['serve_default_timeout_s'])
+    if serve['serve_prewarm'] is not None:
+        # one spec or a list of 'family[@lane]' specs; validated here so
+        # a typo'd family fails the BOOT, not the first request
+        specs = serve['serve_prewarm']
+        if isinstance(specs, str):
+            specs = [specs]
+        if not isinstance(specs, (list, tuple)) or not all(
+                isinstance(s, str) and s.strip() for s in specs):
+            raise ValueError(
+                "serve_prewarm must be a 'family[@lane]' spec or a list "
+                f'of them (e.g. [resnet,resnet@bfloat16]); got '
+                f'{serve["serve_prewarm"]!r}')
+        specs = [s.strip() for s in specs]
+        # validated against the SERVEABLE set, not KNOWN_FEATURE_TYPES:
+        # a family without packed/serving support (vggish, raft) would
+        # pass the build but occupy a pool slot no request can reach —
+        # the same gate the submit path applies, moved to the boot
+        from video_features_tpu.registry import PACKED_FEATURES
+        for spec in specs:
+            family = spec.split('@', 1)[0]
+            if family not in PACKED_FEATURES:
+                raise ValueError(
+                    f'serve_prewarm names unknown or unserveable family '
+                    f'{family!r} (serveable: '
+                    f'{", ".join(sorted(PACKED_FEATURES))})')
+        serve['serve_prewarm'] = specs
     serve['serve_batch_shed_fraction'] = \
         float(serve['serve_batch_shed_fraction'])
     if not (0 < serve['serve_batch_shed_fraction'] <= 1):

@@ -27,7 +27,7 @@ import sys
 import time as _time
 import warnings
 from pathlib import Path
-from typing import Dict, List, Union
+from typing import Any, Dict, List, Union
 
 import numpy as np
 
@@ -37,6 +37,12 @@ from video_features_tpu.utils.output import (
     read_fingerprint, write_fingerprint,
 )
 from video_features_tpu.utils.tracing import NULL_TRACER, Tracer
+
+
+# dispatch-table sentinel: "this geometry permanently falls back to the
+# jit" (store-side failure already reported) — distinct from None ("not
+# looked up yet") so a failed ensure isn't retried on every batch
+_AOT_FALLBACK = object()
 
 
 def log_extraction_error(video_path, request_id=None, stage=None) -> None:
@@ -120,6 +126,17 @@ class BaseExtractor:
         # full merged config); None = legacy behavior everywhere
         self.cache = None
         self.run_fingerprint = None
+        # persistent executable store (aot/) — attached by configure_aot
+        # when aot_enabled; None = every program compiles via the jit,
+        # exactly today's behavior. _aot_programs is the per-geometry
+        # dispatch table aot_call maintains (resident AotPrograms keyed
+        # by batch shape/dtype + static kwargs); aot_stats counts which
+        # path each resident program took (the serve pool's
+        # builds_loaded / builds_compiled split reads it).
+        self._aot_store = None
+        self._aot_programs: Dict[tuple, object] = {}
+        self._aot_lock = None          # created lazily with the store
+        self.aot_stats = {'loaded': 0, 'compiled': 0}
         # flight recorder (obs/) — attached by configure_obs when the
         # trace_out / manifest_out knobs are set; None = no telemetry
         # artifacts, exactly today's behavior
@@ -226,6 +243,9 @@ class BaseExtractor:
             self.device, getattr(self, batch_attr), self.params)
         self._mesh, self.params, self._put_batch = mesh, params, put
         setattr(self, batch_attr, global_batch)
+        # params just moved (replicated over the mesh): resident AOT
+        # executables are bound to the old placement — re-resolve
+        self._aot_invalidate()
 
     # -- mesh-sharded packed execution (mesh_devices=) ----------------------
 
@@ -298,6 +318,9 @@ class BaseExtractor:
                 buf = getattr(self, attr, None)
                 if buf is not None:
                     setattr(self, attr, jax.device_put(buf, devices[0]))
+            # re-placed params invalidate device-bound AOT executables;
+            # the next warm/dispatch re-keys under the new chip's ids
+            self._aot_invalidate()
 
     def _ensure_packed_mesh(self) -> int:
         """Build the packed loop's data-parallel mesh when
@@ -330,6 +353,9 @@ class BaseExtractor:
             self.params = put_replicated(mesh, self.params)
         self._put_batch = partial(put_batch, mesh)
         self._packed_mesh_ndev = n
+        # params just replicated over the fresh mesh: drop any
+        # single-device AOT residents (re-keyed under the mesh lane)
+        self._aot_invalidate()
         return n
 
     # -- content-addressed feature cache (cache/) ---------------------------
@@ -358,6 +384,177 @@ class BaseExtractor:
             except Exception:
                 log_cache_error(f'open ({args.get("cache_dir")})')
                 self.cache = None
+
+    # -- persistent executable store (aot/) ---------------------------------
+
+    def configure_aot(self, args) -> None:
+        """Attach the persistent executable store when ``aot_enabled``
+        — programs then load from disk instead of compiling whenever a
+        previous process published the same program (same StableHLO
+        identity, jax version, backend, device kind/ids). Called by
+        ``registry.create_extractor``; extractors constructed directly
+        (tests, stubs) stay legacy. Store failures degrade to
+        compile-everything, never to a failed build."""
+        if not args.get('aot_enabled'):
+            return
+        import threading
+
+        from video_features_tpu.aot import ExecStore, log_aot_error
+        try:
+            self._aot_store = ExecStore.get(args.get('aot_dir'),
+                                            args.get('aot_max_bytes'))
+            self._aot_lock = threading.Lock()
+        except Exception:
+            log_aot_error(f'open ({args.get("aot_dir")})')
+            self._aot_store = None
+
+    def _aot_lane(self) -> str:
+        """The program's ``mesh<n>[@dtype]`` lane key — the same naming
+        PROGRAMS.lock.json uses for its per-width/per-dtype variants."""
+        from video_features_tpu.analysis.programs import mesh_key
+        width = 1
+        if self._mesh is not None:
+            try:
+                width = int(self._mesh.shape['data'])
+            except (KeyError, TypeError):
+                width = max(int(self._packed_mesh_ndev or 1), 1)
+        return mesh_key(width, self.compute_dtype)
+
+    def _aot_invalidate(self) -> None:
+        """Drop every resident AotProgram. Called whenever params move
+        (placement, mesh build): a resident executable is bound to the
+        chips it was compiled for, and dispatching it with re-placed
+        args would raise — the next ``aot_call`` re-traces and consults
+        the store under the NEW device ids instead."""
+        self._aot_programs.clear()
+
+    def _aot_dispatch_key(self, name: str, batch, statics: dict) -> tuple:
+        # params are attribute-stable between invalidations, so only the
+        # batch geometry + the static kwargs + the ambient matmul
+        # precision (a trace-context input: the jit re-traces per
+        # context, and so must we) discriminate programs
+        import jax
+        return (name, tuple(batch.shape), str(batch.dtype),
+                str(jax.config.jax_default_matmul_precision),
+                tuple(sorted(statics.items())))
+
+    def aot_call(self, name: str, jitted, params, batch, **statics):
+        """The hot-path dispatch seam: run ``jitted(params, batch,
+        **statics)`` through a resident AOT executable when one exists,
+        installing one on first sight of a geometry — loaded from the
+        persistent store when a previous process published this exact
+        program, compiled (and republished) otherwise. Without a store
+        this is EXACTLY the legacy call. Byte-identical either way
+        (tests/test_aot.py pins loaded ≡ compiled ≡ jit)."""
+        if self._aot_store is None or not hasattr(jitted, 'trace'):
+            return jitted(params, batch, **statics)
+        key = self._aot_dispatch_key(name, batch, statics)
+        prog = self._aot_programs.get(key)
+        if prog is None:
+            with self._aot_lock:
+                prog = self._aot_programs.get(key)
+                if prog is None:
+                    prog = self._aot_ensure(name, jitted, (params, batch),
+                                            statics)
+                    self._aot_programs[key] = prog or _AOT_FALLBACK
+        if prog is None or prog is _AOT_FALLBACK:
+            return jitted(params, batch, **statics)
+        return prog(params, batch)
+
+    def _aot_ensure(self, name: str, jitted, args: tuple, statics: dict):
+        """Load-or-compile one program; None = fall back to the jit for
+        this geometry forever (store-side failure, already reported)."""
+        from video_features_tpu.aot import log_aot_error
+        from video_features_tpu.aot.runtime import ensure_program
+        try:
+            prog, path = ensure_program(
+                self._aot_store, name, jitted, args, statics,
+                lane=self._aot_lane(), feature_type=self.feature_type)
+        except Exception:
+            log_aot_error(f'{self.feature_type}/{name}')
+            return None
+        self.aot_stats[path] += 1
+        return prog
+
+    def aot_warm(self) -> Dict[str, int]:
+        """Eagerly warm every program this extractor's ``program_specs``
+        declare, at its CURRENT device placement — the serve boot path
+        (``serve_prewarm`` / cold submits call it right after
+        ``place_on``), so the first request finds its executables
+        resident instead of compiling under the request. Returns the
+        {'loaded': n, 'compiled': n} delta. Never raises: a spec that
+        won't warm falls back to the lazy dispatch path. No-op without
+        a store."""
+        before = dict(self.aot_stats)
+        if self._aot_store is None:
+            return {'loaded': 0, 'compiled': 0}
+        try:
+            import jax
+            from jax.sharding import SingleDeviceSharding
+            self._ensure_packed_mesh()
+            specs = self.program_specs(mesh=self._mesh)
+        except Exception:
+            from video_features_tpu.aot import log_aot_error
+            log_aot_error(f'warm specs for {self.feature_type}')
+            return {'loaded': 0, 'compiled': 0}
+        for spec in specs:
+            if not hasattr(spec.jitted, 'trace'):
+                # not an AOT-stageable jit (e.g. a data_parallel wrapper
+                # closure): the dispatch seam falls back to it directly
+                continue
+            try:
+                args = list(spec.args)
+                params = getattr(self, 'params', None)
+                if params is not None:
+                    # the LIVE params (concrete, placed): the lowering
+                    # then carries the real device binding, so the
+                    # dispatch-time trace of an actual batch hashes to
+                    # the SAME store key (verified equal in test_aot)
+                    args[0] = params
+                batch = args[spec.batch_argnum]
+                if self._mesh is None and hasattr(batch, 'shape'):
+                    device = getattr(self, '_device', None)
+                    if device is not None:
+                        batch = jax.ShapeDtypeStruct(
+                            batch.shape, batch.dtype,
+                            sharding=SingleDeviceSharding(device))
+                        args[spec.batch_argnum] = batch
+                with self._aot_lock, self.precision_scope():
+                    key = self._aot_dispatch_key(
+                        spec.name, batch, dict(spec.kwargs))
+                    if key in self._aot_programs:
+                        continue
+                    prog = self._aot_ensure(spec.name, spec.jitted,
+                                            tuple(args),
+                                            dict(spec.kwargs))
+                    self._aot_programs[key] = prog or _AOT_FALLBACK
+            except Exception:
+                from video_features_tpu.aot import log_aot_error
+                log_aot_error(f'warm {self.feature_type}/{spec.name}')
+        return {k: self.aot_stats[k] - before.get(k, 0)
+                for k in ('loaded', 'compiled')}
+
+    def aot_snapshot(self) -> Dict[str, Any]:
+        """The run-manifest / metrics view of this extractor's AOT
+        state: which path each resident program took, plus the pinned
+        lock hashes the programs derive from."""
+        doc: Dict[str, Any] = {'enabled': self._aot_store is not None,
+                               'loaded': self.aot_stats['loaded'],
+                               'compiled': self.aot_stats['compiled']}
+        if self._aot_store is not None:
+            doc['dir'] = self._aot_store.aot_dir
+            # keyed by name + program identity, NOT name alone: one
+            # name covers several geometry specializations (s3d/i3d),
+            # and an audit surface must list every distinct program —
+            # a 'compiled' entry must never be masked by a 'loaded'
+            # same-name sibling
+            doc['programs'] = {
+                f'{prog.name}@{prog.program_sha[:12]}':
+                    {'path': prog.source,
+                     'stablehlo_sha256': prog.program_sha}
+                for prog in self._aot_programs.values()
+                if prog is not _AOT_FALLBACK and prog is not None}
+        return doc
 
     # -- decode farm (farm/) ------------------------------------------------
 
@@ -459,6 +656,11 @@ class BaseExtractor:
                 # residual stages (the loops fold+reset as they go; this
                 # catches anything recorded since the last reset)
                 self.manifest.fold_stages(self.tracer.report())
+                if self._aot_store is not None:
+                    # which path every program took (loaded vs compiled)
+                    # — the manifest record the zero-cold-start contract
+                    # is audited against
+                    self.manifest.note_aot(self.aot_snapshot())
                 self.manifest.write(self.manifest_out)
             except Exception:
                 event(_logging.WARNING, 'run-manifest write failed',

@@ -116,7 +116,9 @@ class ExtractCLIP(BaseFrameWiseExtractor):
         return ('edge_resize_crop', n_px, n_px, 'bicubic')
 
     def device_step(self, batch: np.ndarray) -> jax.Array:
-        return self._step(self.params, batch)
+        # aot_call: resident/store-loaded executable when the aot store
+        # is on (byte-identical), else exactly the jit call
+        return self.aot_call('step', self._step, self.params, batch)
 
     # -- zero-shot show_pred -------------------------------------------------
 

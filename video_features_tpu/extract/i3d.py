@@ -311,6 +311,9 @@ class ExtractI3D(BaseExtractor):
             if len(self._geom_cache) >= 16:
                 getattr(self._step, 'clear_cache', lambda: None)()
                 self._geom_cache.clear()
+                # resident AOT executables are per-geometry too: the
+                # bound exists to cap live executables, so drop both
+                self._aot_invalidate()
             resize_to = None
             gh, gw = h, w
             if self.device_resize:
@@ -344,9 +347,10 @@ class ExtractI3D(BaseExtractor):
                     self.put_input, tracer=self.tracer):
                 pads, resize_to = self._geometry(*stacks.shape[2:4])
                 with self.tracer.stage('model'):
-                    out = self._step(self.params, stacks, pads=pads,
-                                     streams=tuple(self.streams),
-                                     resize_to=resize_to)
+                    out = self.aot_call('step', self._step,
+                                        self.params, stacks, pads=pads,
+                                        streams=tuple(self.streams),
+                                        resize_to=resize_to)
                 # carry the input batch only for show_pred — holding it
                 # across the in-flight window would pin input HBM
                 yield (out, stacks if self.show_pred else None,
@@ -420,8 +424,11 @@ class ExtractI3D(BaseExtractor):
         # results k batches later (fetch_outputs), overlapping D2H +
         # scatter + save with device compute
         pads, resize_to = self._geometry(*stacks.shape[2:4])
-        out = self._step(self.params, stacks, pads=pads,
-                         streams=tuple(self.streams), resize_to=resize_to)
+        # aot_call keys on the static kwargs too: each (pads, resize_to)
+        # specialization resolves to its own resident executable
+        out = self.aot_call('step', self._step, self.params, stacks,
+                            pads=pads, streams=tuple(self.streams),
+                            resize_to=resize_to)
         return {s: out[s] for s in self.streams}
 
     def packed_result(self, task):

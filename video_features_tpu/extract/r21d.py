@@ -122,8 +122,11 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
 
     def packed_step(self, stacks):
         # dispatch only (device array out); the scheduler's deferred
-        # fetch_outputs owns the D2H readback
-        return {self.feature_type: self._step(self.params, stacks)}
+        # fetch_outputs owns the D2H readback. aot_call routes through a
+        # resident/store-loaded executable when the aot store is on
+        # (byte-identical either way), else it IS the jit call.
+        return {self.feature_type:
+                self.aot_call('step', self._step, self.params, stacks)}
 
     # -- extraction ---------------------------------------------------------
 
@@ -151,7 +154,8 @@ class ExtractR21D(StackPackingMixin, BaseExtractor):
                     iter_batched_windows(windows, self.stack_batch),
                     self.put_input, tracer=self.tracer):
                 with self.tracer.stage('model'):
-                    dev = self._step(self.params, stacks)
+                    dev = self.aot_call('step', self._step,
+                                        self.params, stacks)
                 yield dev, valid, window_idx
 
         with self.precision_scope():

@@ -225,9 +225,12 @@ class ExtractRAFT(BaseExtractor):
                 if dev is None:
                     continue
                 with self.tracer.stage('model'):
-                    step = (self._dp_step if self._mesh is not None
-                            else self._step)
-                    flow = step(self.params, dev)
+                    # aot_call on the single-device path only: the dp
+                    # shard_map program keeps its direct jit dispatch
+                    flow = (self._dp_step(self.params, dev)
+                            if self._mesh is not None
+                            else self.aot_call('flow_step', self._step,
+                                               self.params, dev))
                     flow = np.asarray(raft_model.unpad(flow, pads))[:valid]
                 flows.append(flow)
                 if self.show_pred:

@@ -68,7 +68,9 @@ class ExtractResNet(BaseFrameWiseExtractor):
                 CROP_SIZE, 'bilinear')
 
     def device_step(self, batch: np.ndarray) -> jax.Array:
-        return self._step(self.params, batch)
+        # aot_call: resident/store-loaded executable when the aot store
+        # is on (byte-identical), else exactly the jit call
+        return self.aot_call('step', self._step, self.params, batch)
 
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         from video_features_tpu.ops.nn import linear

@@ -92,6 +92,10 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
             # handful of aspect ratios, so evictions are rare)
             if len(self._geom_steps) >= 16:
                 self._geom_steps.pop(next(iter(self._geom_steps)))
+                # the evicted geometry's resident AOT executable must
+                # retire with its jitted step (the cap bounds live
+                # executables, and the aot table is per-geometry too)
+                self._aot_invalidate()
             scale = 224.0 / min(h, w)
             resize_hw = (math.floor(h * scale), math.floor(w * scale))
             step = jax.jit(partial(self._forward, resize_hw=resize_hw,
@@ -120,9 +124,12 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
 
     def packed_step(self, stacks):
         # dispatch only (device array out); the scheduler's deferred
-        # fetch_outputs owns the D2H readback
+        # fetch_outputs owns the D2H readback. aot_call's dispatch key
+        # includes the batch geometry, so each per-(h, w) jitted step
+        # resolves to its own resident/store-loaded executable.
         step, _, _ = self._geometry_step(*stacks.shape[2:4])
-        return {self.feature_type: step(self.params, stacks)}
+        return {self.feature_type:
+                self.aot_call('step', step, self.params, stacks)}
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         from video_features_tpu.extract.streaming import stream_windows
@@ -152,7 +159,7 @@ class ExtractS3D(StackPackingMixin, BaseExtractor):
                 step, resize_hw, scale = \
                     self._geometry_step(*stacks.shape[2:4])
                 with self.tracer.stage('model'):
-                    dev = step(self.params, stacks)
+                    dev = self.aot_call('step', step, self.params, stacks)
                 yield dev, host_stacks, valid, window_idx, resize_hw, scale
 
         with self.precision_scope():

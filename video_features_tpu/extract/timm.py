@@ -299,7 +299,9 @@ class ExtractTIMM(BaseFrameWiseExtractor):
                 self.data_cfg['crop'], self.data_cfg['interpolation'])
 
     def device_step(self, batch: np.ndarray) -> jax.Array:
-        return self._step(self.params, batch)
+        # aot_call: resident/store-loaded executable when the aot store
+        # is on (byte-identical), else exactly the jit call
+        return self.aot_call('step', self._step, self.params, batch)
 
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         if self.family in ('vit', 'deit', 'beit', 'mixer'):

@@ -216,5 +216,8 @@ class ExtractVGGish(BaseExtractor):
                     chunk = np.concatenate([chunk, pad], axis=0)
                 if self._mesh is not None:
                     chunk = self._put_batch(chunk)
-                out.append(np.asarray(self._step(self.params, chunk))[:valid])
+                # aot_call: resident/store-loaded executable when the
+                # aot store is on (byte-identical), else the jit call
+                out.append(np.asarray(self.aot_call(
+                    'step', self._step, self.params, chunk))[:valid])
         return np.concatenate(out, axis=0)

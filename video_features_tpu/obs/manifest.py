@@ -126,6 +126,7 @@ class RunManifest:
         self.mesh: Dict[str, Any] = {}
         self.ingress: Dict[str, Any] = {}
         self.programs_lock: Dict[str, Any] = {}
+        self.aot: Dict[str, Any] = {}
         self._compile0 = _compile_snapshot()
         _install_compile_listener()
 
@@ -207,6 +208,17 @@ class RunManifest:
             self.programs_lock.update(
                 {k: _jsonable(v) for k, v in info.items()})
 
+    def note_aot(self, info: Dict[str, Any]) -> None:
+        """Record the persistent-executable-store view of a run
+        (``BaseExtractor.aot_snapshot``): which path each resident
+        program took — ``'loaded'`` from the store vs ``'compiled'``
+        fresh — with its StableHLO identity, so a run's manifest PROVES
+        whether its boot was compile-free instead of implying it. The
+        section stays ``{}`` without ``aot_enabled``. Later notes merge
+        over earlier ones."""
+        with self._lock:
+            self.aot.update({k: _jsonable(v) for k, v in info.items()})
+
     def note_mesh(self, info: Dict[str, Any]) -> None:
         """Record the device mesh a mesh-sharded packed run executed on
         (``mesh_devices``, the (data, time) shape, per-device labels,
@@ -235,6 +247,7 @@ class RunManifest:
             mesh = dict(self.mesh)
             ingress = dict(self.ingress)
             programs_lock = dict(self.programs_lock)
+            aot = dict(self.aot)
         outcomes: Dict[str, int] = {}
         for v in videos.values():
             outcomes[v['outcome']] = outcomes.get(v['outcome'], 0) + 1
@@ -264,6 +277,10 @@ class RunManifest:
             # StableHLO hashes this run's families map to, {} when the
             # lock is absent or the family unpinned
             'programs_lock': programs_lock,
+            # persistent executable store (aot/): which path each
+            # program took (loaded vs compiled) + its StableHLO
+            # identity, {} without aot_enabled
+            'aot': aot,
         }
 
     def write(self, path: str) -> str:

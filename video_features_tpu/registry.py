@@ -78,6 +78,12 @@ def create_extractor(args: 'Config') -> 'BaseExtractor':
         # run fingerprint (config-aware resume) + content-addressed
         # feature cache; duck-typed arg objects without .get stay legacy
         extractor.configure_cache(args)
+        # persistent executable store (aot/): programs load from disk
+        # instead of compiling when a previous process published them.
+        # Attach-only — warming is lazy (aot_call, at the ACTUAL batch
+        # geometry) except on the serve boot path, which calls
+        # aot_warm() after device placement.
+        extractor.configure_aot(args)
         # flight recorder (obs/): trace_out / manifest_out knobs
         extractor.configure_obs(args)
         # decode farm (farm/): decode_workers / decode_farm_ring_mb

@@ -110,6 +110,7 @@ def build_metrics(started_at: float,
                   ingress_stats: Optional[Dict[str, Any]] = None,
                   trace_stats: Optional[Dict[str, Any]] = None,
                   watchdog_stats: Optional[Dict[str, Any]] = None,
+                  aot_stats: Optional[Dict[str, Any]] = None,
                   ) -> Dict[str, Any]:
     """Assemble the one metrics document. ``stage_reports`` maps a
     human-readable pool-entry label → that entry's ``Tracer.report()``;
@@ -139,6 +140,18 @@ def build_metrics(started_at: float,
         from video_features_tpu.farm.farm import merge_farm_stats
         farm_stats = merge_farm_stats(())
     doc['farm'] = farm_stats
+    # persistent executable store (aot/): merged store counters across
+    # every store live workers were built against, plus how many
+    # programs took each path (loaded from disk vs compiled) — always
+    # present (all-zero without aot_enabled) so scrapers see one stable
+    # schema; builds_compiled == 0 with programs_loaded > 0 is the
+    # "zero cold start" reading
+    if aot_stats is None:
+        from video_features_tpu.aot.store import merge_exec_stats
+        aot_stats = merge_exec_stats(())
+        aot_stats['programs_loaded'] = 0
+        aot_stats['programs_compiled'] = 0
+    doc['aot'] = aot_stats
     # the network front door's view: per-tenant request/shed counters,
     # live-session + connection gauges (ingress/gateway.stats()) —
     # always present, {'enabled': False} on a loopback-only server, so
@@ -218,6 +231,13 @@ def prometheus_text(doc: Dict[str, Any],
             g(f'vft_farm_{key}',
               'decode farm accounting (merged across warm workers)'
               ).set(value)
+    for key, value in (doc.get('aot') or {}).items():
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            # vft_aot_programs_loaded vs vft_aot_programs_compiled is
+            # the zero-cold-start dashboard pair (docs/serving.md)
+            g(f'vft_aot_{key}',
+              'persistent executable store accounting (merged across '
+              'warm workers)').set(value)
     # monotonic mirrors (counter semantics, hence _total names): the
     # document carries lifetime totals; the registry counter advances by
     # the delta so repeated renders never double-count and a recorder

@@ -4,7 +4,14 @@ The cost structure serving must hide: building an extractor transplants
 weights (seconds) and the first batch through a geometry compiles an XLA
 executable (more seconds). Both attach to the extractor instance — its
 params live on device, its jitted step functions cache per input shape —
-so keeping the INSTANCE resident keeps everything warm. The pool keys
+so keeping the INSTANCE resident keeps everything warm. The compile half
+of that cost is further amortized ACROSS processes by the persistent
+executable store (``aot/``): an entry built with ``aot_enabled`` loads
+previously published executables at build time instead of compiling
+(``builds_loaded`` vs ``builds_compiled`` in the server's pool stats),
+so even a freshly booted daemon — pre-warmed via ``serve_prewarm`` —
+serves its first request from resident, never-compiled-this-process
+programs (docs/serving.md "Zero cold start"). The pool keys
 entries by executable identity (``serve.server.pool_key``: feature_type,
 model/geometry knobs, precision, device — everything that changes the
 compiled program or the weights) and bounds residency with LRU eviction,

@@ -407,7 +407,14 @@ class ExtractionServer:
         # config must transplant ONCE, not N times (the latecomers wait,
         # then adopt the winner's warm worker)
         self._build_locks: Dict[tuple, threading.Lock] = {}
-        self._builds = 0
+        # entry builds split by which path their programs took (vft-aot):
+        # an entry whose AOT warm LOADED every program from the
+        # persistent executable store counts as builds_loaded; anything
+        # that compiled (or has no store) counts as builds_compiled —
+        # 'second boot is compile-free' is literally
+        # builds_compiled == 0 on these counters
+        self._builds_compiled = 0
+        self._builds_loaded = 0
         # content-addressed feature caches touched by requests, keyed by
         # cache dir — metrics merges their hit/miss/bytes-saved counters
         # alongside the warm-pool hit rate
@@ -822,32 +829,12 @@ class ExtractionServer:
         # config resolution is LOCK-FREE: the YAML read + sanity_check
         # must not stall completion callbacks or status/metrics — the
         # admission lock guards only server state (the block below)
-        merged = dict(self.base_overrides)
-        merged.update(overrides or {})
-        merged['video_paths'] = paths
-        merged.pop('file_with_video_paths', None)
-        merged['feature_type'] = feature_type
-        merged['profile'] = True              # tracer feeds /metrics
         try:
-            args = load_config(feature_type, overrides=merged)
+            args, key = self._resolve_entry_config(feature_type, paths,
+                                                   overrides)
         except Exception as e:
             self.stats.bump('rejected')
             return protocol.error(f'invalid request: {e}')
-        if args.get('manifest_out'):
-            # the run manifest is a PER-RUN artifact (outcomes of one
-            # bounded worklist); a resident worker has no run end, its
-            # video table would grow unboundedly, and concurrent workers
-            # would clobber one shared path — the serve surfaces for the
-            # same data are the metrics document and the merged trace
-            import logging
-
-            from video_features_tpu.obs.events import event
-            event(logging.WARNING,
-                  'manifest_out is a per-run CLI knob; ignored by the '
-                  'serve daemon (use metrics / metrics_prom / trace_out)',
-                  subsystem='serve', path=str(args['manifest_out']))
-            args['manifest_out'] = None
-        key = pool_key(resolve_mesh_devices(args))
 
         # -- content-addressed cache: answer hits BEFORE admission -------
         # A hit is an O(read) file copy — it must not occupy a queue slot
@@ -916,32 +903,12 @@ class ExtractionServer:
                                                      or existing.crashed):
                         worker = existing
                     else:
-                        label = args['feature_type'] + (
-                            f"/{args['model_name']}"
-                            if args.get('model_name') else '')
                         try:
-                            extractor = create_extractor(args)
+                            worker = self._spawn_worker(args, key)
                         except Exception as e:
                             self.stats.bump('rejected')
                             return protocol.error(
                                 f'extractor build failed: {e}')
-                        worker = _Worker(self, key, label, extractor,
-                                         self.idle_flush_s,
-                                         self.max_batch_wait_s)
-                        # pin residency BEFORE the first batch flows:
-                        # least-loaded chip(s) via the placer (a mesh
-                        # entry takes mesh_devices chips)
-                        worker.devices = self._place_extractor(extractor)
-                        # liveness ledger rides the tracer's progress
-                        # hook — wired before the first stage records
-                        self._wire_watchdog(worker)
-                        worker.start()
-                        rec = getattr(extractor.tracer, 'recorder', None)
-                        with self._lock:
-                            self._builds += 1
-                            if rec is not None:
-                                self._trace_recorders.append(rec)
-                            self._retired.extend(self.pool.put(key, worker))
 
             with self._lock:
                 if self._draining:
@@ -1021,6 +988,137 @@ class ExtractionServer:
                            overrides=overrides, timeout_s=timeout_s,
                            priority=priority, traceparent=traceparent,
                            _live_session=session)
+
+    def _resolve_entry_config(self, feature_type: str, paths: List[str],
+                              overrides: Optional[Dict[str, Any]] = None,
+                              ) -> tuple:
+        """Resolve one entry's full config + pool key — THE one merge
+        sequence (base overrides → per-call overrides → worklist +
+        profile pinning → ``load_config`` → per-run knob rejection),
+        shared by the submit path and the boot-time pre-warm so the two
+        can never derive DIFFERENT pool keys for the same entry (a
+        drifted pre-warm key would make the first real request silently
+        rebuild while the pre-warmed entry sat unused until evicted).
+        Raises on an invalid config; callers translate (submit → a
+        protocol error, prewarm → a structured boot event)."""
+        merged = dict(self.base_overrides)
+        merged.update(overrides or {})
+        merged['video_paths'] = paths
+        merged.pop('file_with_video_paths', None)
+        merged['feature_type'] = feature_type
+        merged['profile'] = True              # tracer feeds /metrics
+        args = load_config(feature_type, overrides=merged)
+        if args.get('manifest_out'):
+            # the run manifest is a PER-RUN artifact (outcomes of one
+            # bounded worklist); a resident worker has no run end, its
+            # video table would grow unboundedly, and concurrent workers
+            # would clobber one shared path — the serve surfaces for the
+            # same data are the metrics document and the merged trace
+            event(logging.WARNING,
+                  'manifest_out is a per-run CLI knob; ignored by the '
+                  'serve daemon (use metrics / metrics_prom / trace_out)',
+                  subsystem='serve', path=str(args['manifest_out']))
+            args['manifest_out'] = None
+        return args, pool_key(resolve_mesh_devices(args))
+
+    def _spawn_worker(self, args: Config, key: tuple) -> _Worker:
+        """Build one warm-pool entry end to end: transplant, pin chip
+        residency, eagerly resolve its programs against the persistent
+        executable store (AFTER placement — executables bind to the
+        assigned chips), wire liveness, start the worker, and insert it.
+        The cold-start cost serving exists to amortize lives here —
+        shared verbatim by a cold submit and the boot-time pre-warm, so
+        a pre-warmed entry IS the entry a later request would have
+        built. Raises on build failure (callers translate: submit → a
+        protocol error, prewarm → a structured boot event). Callers
+        hold the per-key build lock."""
+        label = args['feature_type'] + (
+            f"/{args['model_name']}" if args.get('model_name') else '')
+        extractor = create_extractor(args)
+        worker = _Worker(self, key, label, extractor,
+                         self.idle_flush_s, self.max_batch_wait_s)
+        # pin residency BEFORE the first batch flows: least-loaded
+        # chip(s) via the placer (a mesh entry takes mesh_devices chips)
+        worker.devices = self._place_extractor(extractor)
+        # zero cold start (aot/): load-or-compile every declared program
+        # at the placed residency; {'loaded': n, 'compiled': n} decides
+        # which builds_* counter this entry lands on. No-op (all zeros)
+        # without aot_enabled in the entry's config.
+        warm = extractor.aot_warm()
+        # liveness ledger rides the tracer's progress hook — wired
+        # before the first stage records
+        self._wire_watchdog(worker)
+        worker.start()
+        rec = getattr(extractor.tracer, 'recorder', None)
+        with self._lock:
+            if warm['loaded'] > 0 and warm['compiled'] == 0:
+                self._builds_loaded += 1
+            else:
+                self._builds_compiled += 1
+            if rec is not None:
+                self._trace_recorders.append(rec)
+            self._retired.extend(self.pool.put(key, worker))
+        return worker
+
+    def prewarm(self, specs) -> Dict[str, Any]:
+        """Build warm-pool entries at BOOT, before any request arrives
+        (the ``serve_prewarm`` knob): each ``'family[@lane]'`` spec is
+        resolved against the base overrides exactly like a cold submit
+        and spawned through the same :meth:`_spawn_worker` path — so
+        with ``aot_enabled`` and an unchanged program set, the whole
+        boot is compile-free (``builds_loaded`` entries, zero
+        ``builds_compiled``) and the first request packs into an
+        already-resident executable. A spec that fails to build is a
+        structured boot event, never a crashed daemon — the family
+        simply cold-builds on its first request as before."""
+        report: Dict[str, Any] = {'entries': 0, 'programs_loaded': 0,
+                                  'programs_compiled': 0, 'errors': []}
+        specs = list(specs or ())
+        if len(specs) > self.pool.capacity:
+            # every put over capacity LRU-retires an earlier entry, so
+            # the boot would pay full builds for entries the first
+            # request can't find — name the misconfiguration instead of
+            # silently wasting the warm-up
+            event(logging.WARNING,
+                  'serve_prewarm names more entries than the warm pool '
+                  'holds; the earliest pre-warmed entries will be '
+                  'evicted before the first request arrives',
+                  subsystem='serve', specs=len(specs),
+                  pool_size=self.pool.capacity)
+        for spec in specs:
+            family, _, lane = str(spec).partition('@')
+            try:
+                # a virtual '.live'-style pseudo path: config validation
+                # needs a non-empty worklist, and nothing should warn
+                # about (or expect) a real file at boot
+                args, key = self._resolve_entry_config(
+                    family, ['__prewarm__.live'],
+                    {'compute_dtype': lane} if lane else None)
+                with self._lock:
+                    build_lock = self._build_locks.setdefault(
+                        key, threading.Lock())
+                with build_lock:
+                    existing = self.pool.peek(key)
+                    if existing is not None and not (existing.closed
+                                                     or existing.crashed):
+                        continue              # duplicate spec: one entry
+                    worker = self._spawn_worker(args, key)
+                report['entries'] += 1
+                report['programs_loaded'] += worker.ex.aot_stats['loaded']
+                report['programs_compiled'] += \
+                    worker.ex.aot_stats['compiled']
+            except Exception as e:
+                event(logging.WARNING,
+                      'serve pre-warm spec failed to build; the family '
+                      'will cold-build on its first request',
+                      subsystem='serve', exc_info=True, spec=str(spec))
+                report['errors'].append(f'{spec}: {e}')
+        if report['entries'] or report['errors']:
+            event(logging.INFO, 'serve pre-warm complete',
+                  subsystem='serve', **{k: v for k, v in report.items()
+                                        if k != 'errors'},
+                  failed=len(report['errors']))
+        return report
 
     def attach_ingress(self, ingress) -> None:
         """Register the network front door (``ingress/``) so drain can
@@ -1137,7 +1235,8 @@ class ExtractionServer:
             self._reap_retired_locked()
             depth = self._inflight_videos
             draining = self._draining
-            builds = self._builds
+            builds_compiled = self._builds_compiled
+            builds_loaded = self._builds_loaded
             reports = {}
             placements = {}
             for i, w in enumerate(self.pool.entries() + self._retired):
@@ -1164,10 +1263,26 @@ class ExtractionServer:
             farms = [w.ex._farm.stats()
                      for w in self.pool.entries() + self._retired
                      if getattr(w.ex, '_farm', None) is not None]
+            # executable-store view (aot/): the stores the live workers
+            # were built against (deduped by dir — entries usually share
+            # one), plus the per-worker program path counters
+            aot_stores: Dict[str, Any] = {}
+            aot_loaded = aot_compiled = 0
+            for w in self.pool.entries() + self._retired:
+                store = getattr(w.ex, '_aot_store', None)
+                if store is not None:
+                    aot_stores[store.aot_dir] = store
+                st = getattr(w.ex, 'aot_stats', None) or {}
+                aot_loaded += int(st.get('loaded', 0))
+                aot_compiled += int(st.get('compiled', 0))
         pool_stats = self.pool.stats()
-        # builds ≤ misses: concurrent cold submits for one key all count
-        # misses but transplant exactly once (the per-key build lock)
-        pool_stats['builds'] = builds
+        # builds_* ≤ misses: concurrent cold submits for one key all
+        # count misses but transplant exactly once (the per-key build
+        # lock). The split is the zero-cold-start audit surface: an
+        # entry whose programs all LOADED from the executable store is
+        # builds_loaded; anything that compiled is builds_compiled.
+        pool_stats['builds_compiled'] = builds_compiled
+        pool_stats['builds_loaded'] = builds_loaded
         # placement view: entry label → resident chips, plus per-device
         # resident-entry counts (the vft_device_resident_entries gauges)
         pool_stats['placements'] = placements
@@ -1194,6 +1309,11 @@ class ExtractionServer:
                                              for r in recorders)}
         watchdog_stats = (self.watchdog.snapshot()
                           if self.watchdog is not None else None)
+        from video_features_tpu.aot.store import merge_exec_stats
+        aot_stats = merge_exec_stats(s.stats()
+                                     for s in aot_stores.values())
+        aot_stats['programs_loaded'] = aot_loaded
+        aot_stats['programs_compiled'] = aot_compiled
         return metrics_mod.build_metrics(
             self._started_at, depth, self.queue_depth, draining,
             pool_stats, self.stats, reports,
@@ -1202,7 +1322,8 @@ class ExtractionServer:
             farm_stats=merge_farm_stats(farms),
             ingress_stats=ingress_stats,
             trace_stats=trace_stats,
-            watchdog_stats=watchdog_stats)
+            watchdog_stats=watchdog_stats,
+            aot_stats=aot_stats)
 
     # -- completion callbacks (worker threads) -------------------------------
 
@@ -1363,6 +1484,12 @@ def serve_main(argv: List[str]) -> int:
         batch_shed_fraction=serve_cfg['serve_batch_shed_fraction'],
     ).start()
     server.install_signal_handlers()
+    # zero cold start: build the configured warm-pool entries BEFORE the
+    # endpoint line prints (scrapers treat that line as readiness) — on
+    # an unchanged program set with aot_enabled this loads executables
+    # instead of compiling, and the first request is compile-free
+    if serve_cfg.get('serve_prewarm'):
+        server.prewarm(serve_cfg['serve_prewarm'])
     if server.blackbox is not None:
         # fatal-signal dumps (SIGQUIT/SIGABRT) compose with the graceful
         # SIGTERM/SIGINT drain above — different signals, both covered
