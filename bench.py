@@ -997,6 +997,43 @@ def run() -> dict:
                     except Exception as e:
                         rungs['worklist_bf16_error'] = \
                             f'{type(e).__name__}: {e}'
+                # The fused multi-family rung (features=[...]): ONE
+                # decode + ONE sha256 pass per video feeding N families
+                # (run_packed_fused) vs N sequential per-family passes —
+                # the wall-clock speedup plus the decode / hash
+                # amortization ratios behind it (both → N when decode
+                # dominates). Outputs are byte-parity-checked against
+                # the sequential passes before any rate is recorded.
+                # BENCH_FUSED=0/1 overrides; BENCH_FUSED_FEATURES picks
+                # the family set (default resnet,clip,timm).
+                if wl_paths is not None and os.environ.get(
+                        'BENCH_FUSED', '1' if on_accel else '0') == '1':
+                    try:
+                        from tools.worklist_bench import (
+                            bench_fused_features, run_worklist_fused,
+                        )
+                        frec = run_worklist_fused(
+                            bench_fused_features(), wl_paths,
+                            os.path.join(tmp_dir, 'fused'), tmp_dir,
+                            platform, batch_size=min(batch, 8),
+                            precision=precision)
+                        rungs[f'worklist_fused_clips_per_sec_'
+                              f'{precision}'] = frec['clips_per_sec']
+                        rungs['worklist_fused_speedup'] = \
+                            frec['fused_speedup']
+                        rungs['worklist_fused_decode_amortization'] = \
+                            frec['decode_amortization']
+                        rungs['worklist_fused_hash_amortization'] = \
+                            frec['hash_amortization']
+                        # which family set produced the number — config
+                        # metadata, never gated
+                        rungs['worklist_fused_families'] = \
+                            ','.join(frec['families'])
+                        stage_reports[f'worklist_fused_{precision}'] = \
+                            frec['stages']
+                    except Exception as e:
+                        rungs['worklist_fused_error'] = \
+                            f'{type(e).__name__}: {e}'
             # The serving rung (serve/): the same worklist content
             # submitted as dynamic per-video requests against the
             # warm-pool daemon — sustained warm clips/sec, the cold-start

@@ -821,7 +821,10 @@ def test_knob_registry_is_behavior_preserving():
     'pool_only' like the cache_* knobs they mirror (loaded executables
     are byte-identical to compiled ones, so the fingerprint excludes
     them; a worker consults the store it was built with, so the pool
-    key keeps them)."""
+    key keeps them; and the fused 'features' routing key, 'neither' —
+    split_fused_overrides drops it before any per-family config exists,
+    and a stray copy fragmenting the fused key space against sequential
+    runs would break the keys-identical contract, tests/test_fused.py)."""
     from video_features_tpu.config import knob_exclude
     assert knob_exclude('fingerprint') == {
         'video_paths', 'file_with_video_paths', 'output_path', 'tmp_path',
@@ -834,13 +837,14 @@ def test_knob_registry_is_behavior_preserving():
         'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s',
         'cache_enabled', 'cache_dir', 'cache_max_bytes',
         'aot_enabled', 'aot_dir', 'aot_max_bytes',
-        'allow_random_weights', 'timeout_s', 'config'}
+        'allow_random_weights', 'timeout_s', 'config', 'features'}
     assert knob_exclude('pool_key') == {
         'video_paths', 'file_with_video_paths', 'output_path', 'profile',
         'profile_dir', 'timeout_s', 'trace_out', 'trace_capacity',
         'manifest_out', 'inflight', 'decode_workers',
         'decode_farm_ring_mb',
-        'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s'}
+        'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s',
+        'features'}
 
 
 def test_deleting_a_knob_from_the_registry_breaks_both_consumers():

@@ -154,6 +154,43 @@ def test_bench_bf16_rungs_emit_keys():
                for k in rungs)
 
 
+def test_bench_fused_rung_emits_keys():
+    """BENCH_FUSED=1 drives the fused multi-family rung: one
+    ``features=[...]`` pass (decode + sha256 once per video, N families
+    out) vs N sequential per-family passes, byte-parity-checked before
+    any rate is recorded. The hash amortization is a deterministic
+    counter ratio — exactly N for N families — while the wall-clock
+    speedup and decode amortization are timing-based and only asserted
+    present; the family set rides as config metadata."""
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_WORKLIST': '1', 'BENCH_SERVE': '0',
+                      'BENCH_CACHE': '0', 'BENCH_FUSED': '1',
+                      'BENCH_MESH_DEVICES': '2',
+                      'BENCH_WORKLIST_FEATURE': 'resnet',
+                      # two cheap framewise families keep the CPU smoke
+                      # off a third model transplant; the rung KEYS are
+                      # family-set-independent
+                      'BENCH_FUSED_FEATURES': 'resnet,clip'})
+    rungs = rec['rungs']
+    assert 'worklist_fused_error' not in rungs, \
+        rungs.get('worklist_fused_error')
+    assert any(k.startswith('worklist_fused_clips_per_sec')
+               for k in rungs)
+    assert rungs['worklist_fused_speedup'] > 0
+    # sha256 passes: counter-based and exact — N sequential family
+    # passes hash every video, the fused pass hashes each ONCE
+    assert rungs['worklist_fused_hash_amortization'] == 2.0
+    # decode seconds: timing-based, so only sign-asserted
+    assert rungs['worklist_fused_decode_amortization'] > 0
+    # the family set behind the number — bench_diff config metadata
+    assert rungs['worklist_fused_families'] == 'resnet,clip'
+    fused_rep = next(v for k, v in rec['stage_reports'].items()
+                     if k.startswith('worklist_fused'))
+    # the lead tracer carries the SHARED decode stream's stage
+    assert 'decode+preprocess' in fused_rep and 'model' in fused_rep
+
+
 def test_bench_diff_error_rungs_flagged_never_gated(tmp_path):
     """tools/bench_diff.py direction-awareness for the *_error* fields:
     a measured-error rung that RISES shows as WORSE (lower-is-better)

@@ -53,13 +53,14 @@ RESNET_KEYS = ('resnet', 'fps', 'timestamps_ms')
 
 def test_check_version_minor_skew_accepted_major_rejected():
     """The MAJOR/MINOR compatibility contract behind WIRE.lock.json's
-    bump semantics: VERSION is now '1.1' (the first real MINOR bump —
-    PR 8's versioning + PR 11's trace surface landed additively), and a
-    client speaking ANY unknown 1.x must keep working, while an unknown
-    major gets the structured rejection echoing its request_id."""
+    bump semantics: VERSION is now '1.2' (1.1 covered PR 8's versioning
+    + PR 11's trace surface; 1.2 adds the additive `features` fused
+    submit field), and a client speaking ANY unknown 1.x must keep
+    working, while an unknown major gets the structured rejection
+    echoing its request_id."""
     from video_features_tpu.serve import protocol
 
-    assert protocol.VERSION == '1.1'
+    assert protocol.VERSION == '1.2'
     assert protocol.MAJOR == 1
     # minor skew is additive-fields-only by contract: never rejected,
     # future minors included

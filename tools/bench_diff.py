@@ -9,7 +9,9 @@ of them into an honest regression report instead of eyeballing JSON:
 
 Direction-aware: throughput-like rungs (``*clips_per_sec*``,
 ``*videos_per_min*``, ``*hit_rate*``, ``*occupancy*``, ``value``,
-``vs_baseline``, ``*_speedup``) regress when they DROP;
+``vs_baseline``, ``*_speedup``, and the fused worklist's
+``*_amortization`` ratios — decode/hash passes amortized across
+families, → N when fusion works) regress when they DROP;
 latency/duration-like rungs (``*latency*``, ``*_s`` suffixed) regress
 when they RISE. Numeric MEASURED-ERROR rungs (``*_error*`` fields the
 bf16 lane records: ``*_max_abs_error`` / ``*_rel_l2_error``) are
@@ -20,9 +22,9 @@ magnitudes make percent-of-error noise). Non-numeric rungs (exception
 strings) and rungs present on only one side are listed but never
 counted as regressions — an absent rung usually means a different
 BENCH_* env, not a slowdown. Config-metadata rungs (``*_inflight``,
-``*_decode_workers``, ``*_mesh_devices`` — they name the loop
-configuration a number ran under) are flagged ``config-changed`` when
-they differ, never counted as regressions.
+``*_decode_workers``, ``*_mesh_devices``, ``*_families`` — they name
+the loop configuration or family set a number ran under) are flagged
+``config-changed`` when they differ, never counted as regressions.
 
 ``--fail-on-regression PCT`` exits 1 if any shared numeric rung
 regressed by more than PCT percent (CI gate); exit 0 otherwise; exit 2
@@ -43,11 +45,12 @@ from typing import Any, Dict, List, Optional, Tuple
 LOWER_IS_BETTER_MARKERS = ('latency', 'resume_pass', 'boot_first_feature')
 
 # rungs that NAME the loop configuration a number was measured under
-# (async depth, decode-farm worker count, mesh width) rather than
-# measuring anything — a change there is a config change to flag, never
-# a perf regression
+# (async depth, decode-farm worker count, mesh width, fused family set)
+# rather than measuring anything — a change there is a config change to
+# flag, never a perf regression
 CONFIG_METADATA_SUFFIXES = ('_inflight', '_decode_workers',
-                            '_mesh_devices', '_compute_dtype')
+                            '_mesh_devices', '_compute_dtype',
+                            '_families')
 
 
 def is_config_metadata(name: str) -> bool:

@@ -35,6 +35,12 @@ figure; bench.py embeds them as the ``worklist_clips_per_sec``,
 ``worklist_farm_clips_per_sec``, and ``worklist_mesh_clips_per_sec``
 rungs. Every record carries the ``inflight`` depth, ``decode_workers``
 count, and resolved ``mesh_devices`` width it ran at.
+
+``BENCH_FUSED=1`` adds the fused multi-family record
+(``run_worklist_fused``): one ``features=[...]`` pass decoding and
+sha256-hashing each video ONCE vs N sequential per-family passes, with
+the wall-clock speedup and the decode / hash amortization ratios —
+bench.py embeds it as the ``worklist_fused_*`` rungs.
 """
 from __future__ import annotations
 
@@ -72,6 +78,22 @@ def bench_mesh_devices() -> int:
         import jax
         n = len(jax.local_devices())
     return max(n, 1)
+
+
+# the fused rung's per-family models: offline-safe picks (random-weight
+# capable, no hub download) whose decode signatures all fuse — resnet /
+# clip / timm share ('framewise', None, None, 'auto')
+_FUSED_MODELS = {'resnet': 'resnet18', 'clip': 'ViT-B/32',
+                 'timm': 'vit_tiny_patch16_224'}
+
+
+def bench_fused_features() -> list:
+    """The ONE place the ``worklist_fused_*`` rung's family set comes
+    from (``BENCH_FUSED_FEATURES`` override, comma-separated, default
+    ``resnet,clip,timm``) — bench.py imports this so both tools' fused
+    rungs always run the same family set under the same rung name."""
+    raw = os.environ.get('BENCH_FUSED_FEATURES', 'resnet,clip,timm')
+    return [f.strip() for f in raw.split(',') if f.strip()]
 
 
 def make_worklist(tmp_dir: str, n_videos: int, seconds: float) -> list:
@@ -236,6 +258,193 @@ def run_worklist(feature_type: str, paths: list, out_dir: str,
     }
 
 
+def run_worklist_fused(families: list, paths: list, out_dir: str,
+                       tmp_dir: str, platform: str, batch_size: int = 8,
+                       precision: str = None):
+    """One fused multi-family pass vs N sequential passes; returns the
+    record behind the ``worklist_fused_*`` rungs.
+
+    The fused pass drives every family through ONE decode stream per
+    video (``run_packed_fused``, parallel/packing.py) while the
+    sequential baseline runs each family's own ``extract_packed`` over
+    the same worklist — the exact N-runs-of-the-CLI comparison the
+    ``features=[...]`` config replaces. Three ratios ride in the record:
+
+      * ``fused_speedup`` — sequential wall over fused wall (the
+        headline: what a corpus owner saves by fusing);
+      * ``decode_amortization`` — sequential decode+preprocess seconds
+        over fused (→ N for N fully-amortized families);
+      * ``hash_amortization`` — sequential sha256 passes over fused
+        (the content-cache keying cost; fused hashes each video once).
+
+    Every pass runs over FRESH byte-copies of the worklist: distinct
+    paths keep the stat-keyed ``hash_file`` memo provably cold per pass
+    (each sequential family pass models its own CLI process) and keep
+    resume sidecars from turning later passes into all-skip no-ops.
+    A byte-parity sweep over the outputs guards the speedup claim —
+    a fused run that diverged from sequential must not record a rate.
+    """
+    from video_features_tpu.cache.key import (
+        hash_file_stats, reset_hash_file_stats,
+    )
+    from video_features_tpu.cache.store import FeatureCache
+    from video_features_tpu.config import load_fused_configs
+    from video_features_tpu.parallel.packing import (
+        FusedTask, VideoTask, run_packed_fused,
+    )
+    from video_features_tpu.registry import create_extractor
+    from video_features_tpu.utils.output import make_path
+    from video_features_tpu.utils.tracing import round_report
+
+    if precision is None:
+        precision = os.environ.get('BENCH_PRECISION', 'mixed')
+    overrides = {
+        'video_paths': paths,
+        'device': platform,
+        'precision': precision,
+        'batch_size': batch_size,
+        'allow_random_weights': True,
+        'profile': True,                       # per-stage Tracer on
+        'pack_across_videos': True,
+        'on_extraction': 'save_numpy',
+        'output_path': os.path.join(out_dir, 'out'),
+        'tmp_path': os.path.join(tmp_dir, 'fused_tmp'),
+    }
+    for fam in families:
+        if fam in _FUSED_MODELS:
+            overrides[f'{fam}.model_name'] = _FUSED_MODELS[fam]
+    configs = load_fused_configs(families, overrides=overrides)
+    exs = {fam: create_extractor(cfg) for fam, cfg in configs.items()}
+    sigs = {fam: ex.fused_decode_signature() for fam, ex in exs.items()}
+    assert len(set(sigs.values())) == 1 and None not in sigs.values(), (
+        f'fused rung families must share one decode signature: {sigs}')
+
+    def copies(tag):
+        d = Path(tmp_dir) / f'copies_{tag}'
+        d.mkdir(parents=True, exist_ok=True)
+        return [str(shutil.copyfile(p, str(d / Path(p).name)) or
+                    d / Path(p).name) for p in paths]
+
+    def fused_tasks(worklist, tag):
+        tasks = []
+        for p in worklist:
+            c = FusedTask(p, list(exs))
+            for fam, sub in c.subtasks.items():
+                sub.out_root = os.path.join(out_dir, tag, fam)
+            tasks.append(c)
+        return tasks
+
+    def decode_total(rep):
+        return sum(rep.get(k, {}).get('total_s', 0.0)
+                   for k in ('decode', 'decode+preprocess'))
+
+    # warm pass (fused) compiles every family's programs — the fused
+    # packer pools per family at each family's own batch size, so these
+    # are the SAME program identities the sequential passes reuse
+    run_packed_fused(exs, fused_tasks(copies('warm'), 'warm'))
+    warm = [f for f in Path(out_dir, 'warm').rglob('*.npy')]
+    assert warm, (
+        'fused warm pass produced no outputs — extraction failed before '
+        'the timed loop (see stderr); aborting rather than timing compiles')
+
+    # suppress per-video tracer resets so stages accumulate per phase;
+    # the saved bound methods reset between phases and restore at the end
+    real_resets = {fam: ex.tracer.reset for fam, ex in exs.items()}
+    for ex in exs.values():
+        ex.tracer.reset = lambda: None
+    try:
+        for reset in real_resets.values():
+            reset()
+
+        # -- sequential baseline: one extract_packed pass per family,
+        # each over its own worklist copies + its own content cache
+        # (modeling N separate CLI processes: cold sha256 memo each)
+        seq_wall = seq_decode = 0.0
+        seq_hash_passes = 0
+        for fam, ex in exs.items():
+            assert ex.run_fingerprint is not None, fam
+            wl = copies(f'seq_{fam}')
+            tasks = [VideoTask(p, out_root=os.path.join(out_dir, 'seq', fam))
+                     for p in wl]
+            ex.cache = FeatureCache(os.path.join(tmp_dir, 'cache_seq', fam))
+            reset_hash_file_stats()
+            t0 = time.perf_counter()
+            ex.extract_packed(tasks)
+            seq_wall += time.perf_counter() - t0
+            seq_hash_passes += hash_file_stats()['passes']
+            seq_decode += decode_total(ex.tracer.report())
+            ex.cache = None
+            real_resets[fam]()
+
+        # -- the fused pass: one decode + one sha256 pass per video
+        wl = copies('fused')
+        tasks = fused_tasks(wl, 'fused')
+        for fam, ex in exs.items():
+            ex.cache = FeatureCache(os.path.join(tmp_dir, 'cache_fused',
+                                                 fam))
+        reset_hash_file_stats()
+        t0 = time.perf_counter()
+        run_packed_fused(exs, tasks)
+        fused_wall = time.perf_counter() - t0
+        fused_hash = hash_file_stats()
+        lead = exs[next(iter(exs))]
+        fused_stages = lead.tracer.report()
+        fused_decode = decode_total(fused_stages)
+        for ex in exs.values():
+            ex.cache = None
+    finally:
+        for fam, ex in exs.items():
+            ex.tracer.reset = real_resets[fam]
+            ex.tracer.reset()
+
+    # byte-parity sweep + clip count from the saved outputs (the real
+    # contract): a fused run that diverged must not record a speedup
+    clips = 0
+    for fam, ex in exs.items():
+        keys = ex._saved_feat_keys()
+        for p in wl:
+            fused_f = make_path(os.path.join(out_dir, 'fused', fam),
+                                p, keys[0], '.npy')
+            seq_f = make_path(os.path.join(out_dir, 'seq', fam),
+                              p, keys[0], '.npy')
+            a = np.load(fused_f, allow_pickle=True)
+            b = np.load(seq_f, allow_pickle=True)
+            assert np.array_equal(a, b), (
+                f'fused outputs diverged from sequential: {fam} {p}')
+            if getattr(a, 'ndim', 0) >= 1:
+                clips += a.shape[0]
+    assert clips > 0, (
+        f'fused worklist produced 0 clips over {len(paths)} videos — '
+        'extraction failed (see stderr) or the source clip is too short')
+
+    return {
+        'families': list(exs),
+        'precision': precision,
+        'n_videos': len(paths),
+        'n_families': len(exs),
+        'clips_total': int(clips),
+        'clips_per_sec': round(clips / fused_wall, 3),
+        'fused_wall_s': round(fused_wall, 4),
+        'sequential_wall_s': round(seq_wall, 4),
+        # the headline ratio: N sequential family passes over one fused
+        # pass — higher is better, → N as decode dominates
+        'fused_speedup': round(seq_wall / fused_wall, 4),
+        'decode_s_sequential': round(seq_decode, 4),
+        'decode_s_fused': round(fused_decode, 4),
+        'decode_amortization': (round(seq_decode / fused_decode, 4)
+                                if fused_decode > 0 else None),
+        # sha256 content-keying passes: fused streams each video once
+        'hash_passes_sequential': int(seq_hash_passes),
+        'hash_passes_fused': int(fused_hash['passes']),
+        'hash_amortization': (round(seq_hash_passes
+                                    / fused_hash['passes'], 4)
+                              if fused_hash['passes'] else None),
+        # the lead tracer's fused-pass split (shared decode + the lead
+        # family's device stages) — embedded under stage_reports
+        'stages': round_report(fused_stages),
+    }
+
+
 def main() -> int:
     import contextlib
     import tempfile
@@ -303,8 +512,16 @@ def main() -> int:
                                     stack=stack, packed=True, inflight=2,
                                     decode_workers=1,
                                     mesh_devices=bench_mesh_devices())
+        # the fused multi-family record is opt-in for the standalone
+        # tool (it transplants one model per family); bench.py gates it
+        # the same way under the worklist_fused_* rungs
+        rec_fused = None
+        if os.environ.get('BENCH_FUSED', '0') == '1':
+            rec_fused = run_worklist_fused(bench_fused_features(), paths,
+                                           os.path.join(td, 'fused'), td,
+                                           platform, batch_size=batch)
     print(json.dumps(rec), file=stdout)
-    for extra in (rec_packed, rec_async, rec_farm, rec_mesh):
+    for extra in (rec_packed, rec_async, rec_farm, rec_mesh, rec_fused):
         if extra is not None:
             print(json.dumps(extra), file=stdout)
     return 0

@@ -56,6 +56,29 @@ _HASH_MEMO: Dict[tuple, str] = {}
 _HASH_MEMO_MAX = 65536
 _MEMO_LOCK = threading.Lock()
 
+# process-wide streaming-pass accounting: 'passes' counts ACTUAL
+# streaming sha256 reads, 'memo_hits' counts stat-memo answers. The
+# fused-worklist amortization contract ("one sha256 pass per video, no
+# matter how many families") is asserted against these counters in
+# tests — a regression that re-hashes per family shows up as passes >
+# videos, not as a silent corpus-scale slowdown.
+_HASH_STATS = {'passes': 0, 'memo_hits': 0}
+
+
+def hash_file_stats() -> Dict[str, int]:
+    """Snapshot of the process-wide streaming-hash counters."""
+    with _MEMO_LOCK:
+        return dict(_HASH_STATS)
+
+
+def reset_hash_file_stats() -> None:
+    """Zero the counters (test isolation; the memo itself is kept —
+    clearing it would force real re-reads and skew what the counters
+    measure next)."""
+    with _MEMO_LOCK:
+        _HASH_STATS['passes'] = 0
+        _HASH_STATS['memo_hits'] = 0
+
 
 def hash_file(path: str) -> str:
     """Streaming SHA-256 of a file's content, memoized by stat identity.
@@ -71,6 +94,8 @@ def hash_file(path: str) -> str:
     memo_key = (real, st.st_size, st.st_mtime_ns)
     with _MEMO_LOCK:
         hit = _HASH_MEMO.get(memo_key)
+        if hit is not None:
+            _HASH_STATS['memo_hits'] += 1
     if hit is not None:
         return hit
     h = hashlib.sha256()
@@ -82,6 +107,7 @@ def hash_file(path: str) -> str:
             h.update(chunk)
     digest = h.hexdigest()
     with _MEMO_LOCK:
+        _HASH_STATS['passes'] += 1
         if len(_HASH_MEMO) >= _HASH_MEMO_MAX:
             _HASH_MEMO.clear()
         _HASH_MEMO[memo_key] = digest

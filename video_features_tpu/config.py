@@ -191,6 +191,12 @@ KNOB_CLASSIFICATION: Dict[str, str] = {
     # per-request concerns, never identity
     'video_paths': 'neither',
     'file_with_video_paths': 'neither',
+    # the fused-worklist family list (`features=[resnet,clip,...]`) is
+    # pure routing: each family still resolves its OWN merged config
+    # (resolve_fused_features strips the key before load_config), so it
+    # must never fragment a family's fingerprint or pool key — a fused
+    # run's cache keys are identical to N sequential runs' by contract
+    'features': 'neither',
     'output_path': 'neither',
     # tmp_path is pool-key relevant: loaders read the ENTRY's tmp root,
     # so a request with a different tmp_path must get its own entry
@@ -386,6 +392,92 @@ def load_config(
     if run_sanity_check:
         sanity_check(args)
     return args
+
+
+def resolve_fused_features(value: Union[str, Iterable[str]]) -> List[str]:
+    """Normalize + validate a fused-worklist ``features`` value.
+
+    Accepts a list (the YAML-parsed CLI form ``features=[resnet,clip]``)
+    or a comma-separated string; returns the de-duplicated family list in
+    user order. Every family must be in :data:`KNOWN_FEATURE_TYPES` —
+    ValueError (not assert: user-facing, must survive ``python -O``)
+    names the offender. A single-family list is legal and simply routes
+    to the ordinary single-family path.
+    """
+    if isinstance(value, str):
+        items = [s.strip() for s in value.split(',') if s.strip()]
+    elif isinstance(value, (list, tuple)):
+        items = [str(s).strip() for s in value if str(s).strip()]
+    else:
+        raise ValueError(
+            f'features must be a list of family names or a comma-separated '
+            f'string (e.g. features=[resnet,clip,timm]); got {value!r}')
+    if not items:
+        raise ValueError('features must name at least one feature family')
+    families: List[str] = []
+    for fam in items:
+        if fam not in KNOWN_FEATURE_TYPES:
+            raise ValueError(
+                f'features names unknown family {fam!r} '
+                f'(known: {", ".join(KNOWN_FEATURE_TYPES)})')
+        if fam not in families:
+            families.append(fam)
+    return families
+
+
+def split_fused_overrides(
+    overrides: Dict[str, Any], families: Iterable[str],
+) -> Tuple[Config, Dict[str, Config]]:
+    """Split a fused-run dotlist into (shared, per-family) overrides.
+
+    ``<family>.<knob>=value`` keys (``parse_dotlist`` keeps the dot) are
+    family-SCOPED: they reach only that family's merged config — the
+    escape hatch for knobs that must differ per family (``timm.
+    model_name=vit_base_patch16_224`` while resnet keeps its YAML
+    default). The routing keys ``features``/``feature_type`` are dropped
+    from the shared set: each family's config is resolved with its own
+    ``feature_type``, and ``features`` leaking into a merged config would
+    fragment its cache fingerprint vs a sequential run (fail-closed
+    unknown keys stay IN the fingerprint).
+    """
+    fams = list(families)
+    shared, scoped = Config(), {f: Config() for f in fams}
+    for key, value in dict(overrides or {}).items():
+        if key in ('features', 'feature_type'):
+            continue
+        head, dot, rest = key.partition('.')
+        if dot and head in scoped and rest:
+            scoped[head][rest] = value
+        else:
+            shared[key] = value
+    return shared, scoped
+
+
+def load_fused_configs(
+    features: Union[str, Iterable[str]],
+    overrides: Optional[Dict[str, Any]] = None,
+    run_sanity_check: bool = True,
+) -> 'Dict[str, Config]':
+    """One merged per-family config per requested family, in user order.
+
+    Each family resolves exactly as a sequential ``load_config(family,
+    shared + family-scoped overrides)`` run would — same YAML defaults,
+    same injected knob defaults, same sanity_check path rewriting
+    (``output_path/<family>[/<model_name>]``) — so per-``(family,
+    video)`` cache keys, resume sidecars, and output naming are
+    byte-for-byte those of N sequential runs. Validation is all-or-
+    nothing: any invalid family or per-family config rejects the whole
+    fused request before any work starts.
+    """
+    families = resolve_fused_features(features)
+    shared, scoped = split_fused_overrides(dict(overrides or {}), families)
+    configs: Dict[str, Config] = {}
+    for fam in families:
+        fam_overrides = Config(shared)
+        fam_overrides.update(scoped[fam])
+        configs[fam] = load_config(fam, overrides=fam_overrides,
+                                   run_sanity_check=run_sanity_check)
+    return configs
 
 
 def resolve_device(device: str) -> str:

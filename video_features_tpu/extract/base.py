@@ -580,6 +580,18 @@ class BaseExtractor:
         via :class:`StackPackingMixin`/``BaseFrameWiseExtractor``."""
         return None
 
+    def fused_decode_signature(self):
+        """Fused-worklist eligibility (``features=[a,b,...]``): families
+        whose signatures are EQUAL can share one raw decode pass per
+        video (``parallel.packing.run_packed_fused``) because their
+        loaders would decode byte-identical frame streams — the
+        signature covers everything upstream of the per-frame host
+        transform. None (the default) keeps the family out of any fused
+        group; it then runs its own sequential pass, outputs unchanged.
+        ``BaseFrameWiseExtractor`` overrides for the frame-wise
+        families."""
+        return None
+
     # -- flight recorder (obs/) ---------------------------------------------
 
     def configure_obs(self, args) -> None:

@@ -130,6 +130,20 @@ class BaseFrameWiseExtractor(BaseExtractor):
             keep_tmp=self.keep_tmp_files, backend=self.decode_backend,
             transform=spec)
 
+    def fused_decode_signature(self):
+        """Frame-wise families fuse when everything upstream of the
+        per-frame transform matches: same retiming (fps/total) and same
+        decode backend produce the same raw frame stream, and the
+        per-family transform is a pure per-frame call over it
+        (``io.video.VideoLoader``) — so one shared decode branched into
+        N spec transforms is byte-identical to N separate decodes. A
+        family whose transform can't be specced can't branch off a
+        shared raw stream, so it stays unfused (None)."""
+        if self.host_transform_spec() is None:
+            return None
+        return ('framewise', self.extraction_fps, self.extraction_total,
+                self.decode_backend)
+
     def packed_step(self, batch) -> Dict:
         # dispatch only (device array out); the scheduler's deferred
         # fetch_outputs owns the D2H readback

@@ -186,6 +186,20 @@ class DecodeFarm:
 
     # -- lifecycle -----------------------------------------------------------
 
+    @staticmethod
+    def _task_msg(seq: int, task) -> tuple:
+        """The ONE builder of worker task messages — ('video', seq, path
+        [, segment[, select]]). ``task.farm_select`` (fused worklists:
+        the family subset still wanting this video's shared decode) is
+        appended only when set, so plain recipes keep receiving the
+        message shape they've always parsed."""
+        select = getattr(task, 'farm_select', None)
+        if select is not None:
+            return ('video', seq, str(task.path),
+                    getattr(task, 'segment', None), tuple(select))
+        return ('video', seq, str(task.path),
+                getattr(task, 'segment', None))
+
     def _spawn(self, idx: int, epoch: int,
                requeue: Iterable[int] = ()) -> _Worker:
         from multiprocessing import shared_memory
@@ -211,8 +225,7 @@ class DecodeFarm:
         for seq in requeue:
             task = self._tasks[seq]
             w.pending.append(seq)
-            w.task_q.put(('video', seq, str(task.path),
-                          getattr(task, 'segment', None)))
+            w.task_q.put(self._task_msg(seq, task))
         return w
 
     def start(self) -> 'DecodeFarm':
@@ -519,8 +532,7 @@ class DecodeFarm:
             self._unfinished.add(seq)
             target.pending.append(seq)
             self._stats['videos_assigned'] += 1
-        target.task_q.put(('video', seq, str(task.path),
-                           getattr(task, 'segment', None)))
+        target.task_q.put(self._task_msg(seq, task))
         return True
 
     def _resolve_parked(self, admit: Callable,
@@ -761,7 +773,17 @@ class DecodeFarm:
                 # clock-calibrated start and attributed to the worker's
                 # own pid/lane — the merged timeline shows true
                 # in-worker decode time, not parent-side drain time.
+                # Fused recipes tag each window with its family
+                # (recipe.family_of) so the SHARED decode span set still
+                # answers "which family was this window for".
+                fam_attr = {}
+                fam_of = getattr(self.recipe, 'family_of', None)
+                if fam_of is not None:
+                    fam = fam_of(meta)
+                    if fam is not None:
+                        fam_attr['family'] = fam
                 self.tracer.add('decode', dt,
+                                **fam_attr,
                                 t0=t0 + w.clock_offset,
                                 span_pid=(w.proc.pid
                                           if w.proc is not None else None),
@@ -917,8 +939,7 @@ class DecodeFarm:
                         if target is not None:
                             target.pending.append(seq)
                     if target is not None:
-                        target.task_q.put(('video', seq, str(task.path),
-                                           getattr(task, 'segment', None)))
+                        target.task_q.put(self._task_msg(seq, task))
                     else:
                         task.failed = True
                         task.exhausted = True

@@ -94,6 +94,66 @@ class FramewiseRecipe(_LoaderRecipe):
         return {'fps': loader.fps}, windows()
 
 
+class FusedRecipe(_LoaderRecipe):
+    """Multi-recipe mode: ONE raw decode pass per video, branched into
+    every requested family's transform pipeline.
+
+    The loader runs with ``transform=None`` (raw frames), and each
+    decoded frame is pushed through every family's named-spec transform
+    in declaration order — byte-identical to N per-family decodes
+    because the in-process path applies its transform as a pure
+    per-frame call over the very same decoded bytes
+    (``io.video.VideoLoader``). Each yielded window is tagged with its
+    family via ``meta = (family, t_ms)`` so the scheduler can route it
+    to that family's pools/program; the farm transport ships meta
+    opaquely, so no wire change is needed.
+
+    ``select`` (an optional family subset, shipped as the task
+    message's 5th element) lets the scheduler drop families that were
+    answered from cache or already failed for this video — the shared
+    decode still runs once for whoever remains.
+    """
+
+    def __init__(self, batch_size: int, fps, total, tmp_path: str,
+                 keep_tmp: bool, backend: str,
+                 transforms: 'Dict[str, Optional[TransformSpec]]') -> None:
+        super().__init__(batch_size, fps, total, tmp_path, keep_tmp,
+                         backend, transform=None)
+        self.transforms = dict(transforms)     # family → spec, user order
+
+    def family_of(self, meta) -> Optional[str]:
+        """The family a ``(window, meta)`` pair belongs to — the farm
+        consumer uses this to stamp per-family attrs on the shared
+        decode spans."""
+        if isinstance(meta, tuple) and len(meta) == 2:
+            return meta[0]
+        return None
+
+    def open(self, path: str, segment=None,
+             select=None) -> Tuple[Dict, Iterator]:
+        from video_features_tpu.extract.streaming import (
+            framewise_segment_windows, segment_frame_range,
+        )
+        loader = self._make_loader(path)
+        frame_range = segment_frame_range(segment, loader.fps)
+        fams = [f for f in self.transforms
+                if select is None or f in select]
+        branch = {f: resolve_transform(self.transforms[f]) for f in fams}
+
+        def windows():
+            try:
+                for frame, t_ms in framewise_segment_windows(loader,
+                                                             frame_range):
+                    for fam in fams:
+                        t = branch[fam]
+                        yield ((t(frame) if t is not None else frame),
+                               (fam, t_ms))
+            finally:
+                loader.close()
+
+        return {'fps': loader.fps}, windows()
+
+
 class StackRecipe(_LoaderRecipe):
     """One window = a ``(win, H, W, 3)`` frame stack stepped by ``step``
     — mirrors the stack families' ``packed_windows`` (r21d/s3d: raw

@@ -133,19 +133,25 @@ def worker_main(widx: int, epoch: int, recipe, ring_name: str,
             msg = task_q.get()
             if msg[0] == 'stop':
                 break
-            # ('video', seq, path[, segment]) — segment is the optional
-            # (start_s, end_s) range of a segment query, replayed by the
-            # recipe with the exact frame filter the in-process path uses
+            # ('video', seq, path[, segment[, select]]) — segment is the
+            # optional (start_s, end_s) range of a segment query,
+            # replayed by the recipe with the exact frame filter the
+            # in-process path uses; select is the fused-worklist family
+            # subset (FusedRecipe only): families answered from cache
+            # drop out of the shared decode's fan-out
             _, seq, path = msg[:3]
             segment = msg[3] if len(msg) > 3 else None
+            select = msg[4] if len(msg) > 4 else None
             n = 0
             try:
-                # keyword only when a range is actually set: recipes
-                # predating the segment contract keep working for
-                # whole-video tasks
-                info, windows = (recipe.open(path, segment=segment)
-                                 if segment is not None
-                                 else recipe.open(path))
+                # keywords only when actually set: recipes predating the
+                # segment/select contracts keep working for plain tasks
+                kw = {}
+                if segment is not None:
+                    kw['segment'] = segment
+                if select is not None:
+                    kw['select'] = select
+                info, windows = recipe.open(path, **kw)
                 out_q.put(('start', widx, epoch, seq, info))
                 it = iter(windows)
                 wait_free = wait_free_for(seq)

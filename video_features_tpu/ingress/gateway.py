@@ -8,9 +8,14 @@ Endpoints (all JSON unless noted; auth via ``Authorization: Bearer
   * ``GET  /metrics``            — Prometheus text exposition 0.0.4
   * ``POST /v1/extract``         — submit an extraction request
     (``{feature_type, video_paths, overrides?, timeout_s?,
-    range?: [start_s, end_s], priority?}``) → ``{request_id, tenant,
-    trace_id}``. A W3C ``traceparent`` request header joins the request
-    to the caller's distributed trace; minted when absent/malformed.
+    range?: [start_s, end_s], priority?, features?: [..]}``) →
+    ``{request_id, tenant, trace_id}``. A W3C ``traceparent`` request
+    header joins the request to the caller's distributed trace; minted
+    when absent/malformed. ``features`` (wire v1.2) submits a FUSED
+    multi-family request: the response carries the umbrella request_id
+    plus a per-family ``requests`` id map (and ``errors`` for families
+    rejected mid-fan-out); status on the umbrella id nests per-family
+    ``videos``. One quota unit per fused request, not per family.
   * ``GET  /v1/requests/<id>``   — request status (tenant-scoped)
   * ``GET  /v1/requests/<id>/trace`` — the request's assembled span
     timeline (tenant-scoped: ANOTHER tenant's id answers 403 — the
@@ -67,7 +72,7 @@ LIVE_IDLE_TIMEOUT_S = 300.0
 LIVE_FINALIZE_TIMEOUT_S = 300.0
 
 _EXTRACT_FIELDS = frozenset({'feature_type', 'video_paths', 'overrides',
-                             'timeout_s', 'range', 'priority'})
+                             'timeout_s', 'range', 'priority', 'features'})
 _LIVE_FIELDS = frozenset({'feature_type', 'fps', 'overrides', 'timeout_s',
                           'priority'})
 
@@ -445,7 +450,8 @@ class IngressGateway:
                 overrides=body.get('overrides'),
                 timeout_s=body.get('timeout_s'),
                 range_s=body.get('range'), priority=priority,
-                traceparent=req.headers.get(_TRACEPARENT_HEADER))
+                traceparent=req.headers.get(_TRACEPARENT_HEADER),
+                features=body.get('features'))
         except Exception:
             self.quota.release(tenant.name)
             raise
@@ -454,9 +460,15 @@ class IngressGateway:
             raise self._submit_error(result, tenant, priority)
         rid = result['request_id']
         self._own(rid, tenant)
-        resp.send_json(OK, {'ok': True, 'request_id': rid,
-                            'tenant': tenant.name, 'priority': priority,
-                            'trace_id': result.get('trace_id')})
+        out = {'ok': True, 'request_id': rid,
+               'tenant': tenant.name, 'priority': priority,
+               'trace_id': result.get('trace_id')}
+        # fused submits answer with the per-family child-id map (and any
+        # families rejected mid-fan-out) alongside the umbrella id
+        for k in ('requests', 'errors'):
+            if k in result:
+                out[k] = result[k]
+        resp.send_json(OK, out)
         return OK, rid
 
     def _handle_trace(self, req: HttpRequest, resp: ResponseWriter,

@@ -165,9 +165,46 @@ class VideoTask:
         return segment_name(self.path, self.segment)
 
 
+class FusedTask(VideoTask):
+    """One video inside a fused multi-family run: the CARRIER the shared
+    decode stream flows through, plus one per-family subtask.
+
+    The carrier owns everything the decode side touches (``emitted`` /
+    ``exhausted`` / ``failed`` / ``info`` — the farm and the in-process
+    windower keep their bookkeeping unchanged on it); each family's
+    scatter-back, fault isolation, and finalization state lives on its
+    SUBTASK, a plain :class:`VideoTask` that the family's unchanged
+    save/cache/finalize path consumes. A family's device-step fault
+    fails only its subtask — the shared decode keeps feeding the
+    healthy siblings; a DECODE fault fails the carrier, which fails
+    every still-active subtask at finalize.
+
+    ``active`` is the family subset still wanting this video after
+    per-family admission (resume skips / cache hits drop out);
+    ``farm_select`` mirrors it onto the farm task message so skipped
+    families also drop out of the worker's transform fan-out.
+    """
+
+    __slots__ = ('subtasks', 'active', 'farm_select')
+
+    def __init__(self, path: str, families: Iterable[str],
+                 video_id: int = -1,
+                 segment: Optional[tuple] = None, trace=None) -> None:
+        super().__init__(path, video_id=video_id, segment=segment,
+                         trace=trace)
+        self.subtasks: Dict[str, VideoTask] = {
+            fam: VideoTask(path, video_id=video_id, segment=segment,
+                           trace=trace)
+            for fam in families}
+        self.active: List[str] = list(self.subtasks)
+        self.farm_select = None
+
+
 def packed_batches(windows: Iterable[tuple], batch: int,
                    max_pool_age_s: Optional[float] = None,
                    tracer: Tracer = NULL_TRACER,
+                   family_of: Optional[Callable] = None,
+                   family_batch: Optional[Dict] = None,
                    ) -> Iterator[Tuple[np.ndarray, list, int]]:
     """Group a cross-video ``(task, window, meta)`` stream into full
     fixed-size batches: ``(stacks, provenance, valid)`` where provenance is
@@ -177,7 +214,16 @@ def packed_batches(windows: Iterable[tuple], batch: int,
     still feeds fixed-shape compiled steps — a batch only ever mixes
     windows of identical geometry, and each geometry's pool holds at most
     ``batch - 1`` windows (memory stays bounded by the number of DISTINCT
-    geometries in flight, not by corpus size). Tail pools flush padded
+    geometries in flight, not by corpus size).
+
+    ``family_of`` (fused worklists) extends the pool key with the window
+    meta's FAMILY, so a fused stream where two families share a geometry
+    (resnet and clip both emit 224×224×3 uint8) still never mixes
+    families in one batch — each family's batches must feed that
+    family's own compiled program. ``family_batch`` (family → capacity)
+    then lets each family's pools fill/pad at ITS packed batch size, so
+    a fused run dispatches the exact per-family programs a sequential
+    run compiles (no new program identities, no AOT-store misses). Tail pools flush padded
     (repeating the last window, masked via ``valid``) only once the whole
     worklist is drained — that final partial batch per geometry is the only
     padding the corpus pays, vs one per video in the per-video loop.
@@ -204,11 +250,19 @@ def packed_batches(windows: Iterable[tuple], batch: int,
     pools: Dict[tuple, list] = {}
     ages: Dict[tuple, float] = {}      # key → oldest pooled window's time
 
+    def cap_of(key) -> int:
+        # fused pools are keyed (family, shape, dtype) and fill at that
+        # family's own packed batch size
+        if family_batch is not None:
+            return int(family_batch[key[0]])
+        return batch
+
     def flush(key):
         pool = pools[key]
         pools[key] = []
         ages.pop(key, None)
         valid = len(pool)
+        cap = cap_of(key)
         # the batch-assembly copy is the packer's own cost — timed as its
         # own 'pack' stage; the span attrs (videos in the batch) are
         # built ONLY when tracing is on, so the default hot loop stays
@@ -216,7 +270,7 @@ def packed_batches(windows: Iterable[tuple], batch: int,
         # packer with plain task tokens.
         attrs = ({'videos': sorted({str(getattr(t, 'path', t))
                                     for t, _, _ in pool}),
-                  'valid': valid, 'capacity': batch}
+                  'valid': valid, 'capacity': cap}
                  if tracer.enabled else {})
         if tracer.enabled:
             # batch spans serve several requests at once: carry the SET
@@ -227,7 +281,7 @@ def packed_batches(windows: Iterable[tuple], batch: int,
                 attrs['trace_ids'] = tids
         with tracer.stage('pack', **attrs):
             wins = [w for _, w, _ in pool]
-            while len(wins) < batch:
+            while len(wins) < cap:
                 wins.append(wins[-1])
             stacked = np.stack(wins)
         return stacked, [(t, m) for t, _, m in pool], valid
@@ -252,11 +306,13 @@ def packed_batches(windows: Iterable[tuple], batch: int,
         task, window, meta = item
         window = np.asarray(window)
         key = (window.shape, window.dtype.str)
+        if family_of is not None:
+            key = (family_of(meta),) + key
         pool = pools.setdefault(key, [])
         if not pool:
             ages[key] = _time.monotonic()
         pool.append((task, window, meta))
-        if len(pool) == batch:
+        if len(pool) == cap_of(key):
             yield flush(key)
         if max_pool_age_s is not None:
             now = _time.monotonic()
@@ -266,6 +322,103 @@ def packed_batches(windows: Iterable[tuple], batch: int,
     for key in list(pools):
         if pools[key]:
             yield flush(key)
+
+
+def _admit_task(ex, task: VideoTask) -> bool:
+    """The per-video admission gate, shared by the single-family and
+    fused packed drivers (fused runs it once per (family, video) against
+    that family's extractor — resume skips and cache hits stay
+    per-family). False means the video is terminal for ``ex`` without
+    decoding; ``task.skipped``/``task.cached`` say why."""
+    # ephemeral tasks (ingress live sessions) have no file behind
+    # them: nothing to resume, nothing to content-hash — always run
+    if getattr(task, 'ephemeral', False):
+        return True
+    # The resume check runs here — lazily, as the decode side reaches
+    # each video — NOT as an up-front scan: is_already_exist loads
+    # every output file, and an eager pass over a mostly-done 20K
+    # worklist would block for minutes before the first batch packs.
+    # Amortized across the run it costs what the per-video loop paid.
+    # (The farm's dispatcher keeps the same property via its bounded
+    # assignment runahead.)
+    # the output_path kwarg is passed only when a task carries a
+    # per-request root: hooks monkeypatched/overridden with the
+    # classic (self, video_path) signature keep working for CLI runs.
+    # name_path (== path unless the task carries a segment range)
+    # keys both resume and the cache materialization target, so a
+    # range extraction never reuses — or clobbers — full outputs.
+    name = task.name_path
+    exists = (ex.is_already_exist(name, output_path=task.out_root)
+              if task.out_root is not None
+              else ex.is_already_exist(name))
+    if exists:
+        task.skipped = True
+        return False
+    # content-addressed cache: a hit materializes this video's outputs
+    # right here and drops it from batch planning entirely — it never
+    # decodes, never occupies batch slots, and finalizes through the
+    # same sweep/on_video_done path as a resume skip
+    if getattr(ex, 'cache', None) is not None and \
+            ex.cache_fetch(task.path, output_path=task.out_root,
+                           segment=task.segment, name_path=name):
+        task.skipped = True
+        task.cached = True
+        return False
+    return True
+
+
+def _finalize_task(ex, t: VideoTask, recorder=None, manifest=None,
+                   on_video_done: Optional[Callable] = None) -> None:
+    """Finalize one (family, video): save/publish (unless skipped or
+    failed), free its rows, stamp the outcome on the recorder/manifest,
+    fire ``on_video_done``. Shared by the single-family driver's sweep
+    and the fused driver's per-family fan-out — the fused path MUST go
+    through the identical save/cache code for its byte-identity
+    contract."""
+    from video_features_tpu.extract.base import log_extraction_error
+    try:
+        if not (t.failed or t.skipped
+                or getattr(t, 'stream_only', False)):
+            # stream_only (live sessions) already delivered every
+            # window through on_window — nothing to save or publish
+            feats_dict = ex._maybe_concat_streams(ex.packed_result(t))
+            with ex.tracer.stage('save', video=str(t.path),
+                                 request_id=_request_id(t),
+                                 **trace_attrs(t)):
+                if t.out_root is not None:
+                    ex.action_on_extraction(feats_dict, t.name_path,
+                                            output_path=t.out_root)
+                else:
+                    ex.action_on_extraction(feats_dict, t.name_path)
+            if getattr(ex, 'cache', None) is not None:
+                with ex.tracer.stage('cache_publish',
+                                     video=str(t.path)):
+                    ex.cache_publish(t.path, output_path=t.out_root,
+                                     segment=t.segment,
+                                     name_path=t.name_path)
+    except KeyboardInterrupt:
+        raise
+    except Exception:
+        t.failed = True           # a failed save IS a failed video
+        log_extraction_error(t.path, request_id=_request_id(t),
+                             stage='save')
+    finally:
+        t.rows = {}               # free feature memory as we go
+        t.finalized = True        # the farm's dedupe unparks twins now
+        from video_features_tpu.utils.output import ACTION_TO_EXT
+        outcome = ('failed' if t.failed else 'cached' if t.cached
+                   else 'skipped' if t.skipped
+                   else 'saved' if ex.on_extraction in ACTION_TO_EXT
+                   else 'printed')
+        if recorder is not None:
+            recorder.instant('video_done', video=str(t.path),
+                             outcome=outcome,
+                             request_id=_request_id(t),
+                             **trace_attrs(t))
+        if manifest is not None:
+            manifest.video_done(t.path, outcome)
+        if on_video_done is not None:
+            on_video_done(t)
 
 
 def run_packed(ex, video_paths: Iterable,
@@ -448,41 +601,7 @@ def run_packed(ex, video_paths: Iterable,
             yield task
 
     def admit(task: VideoTask) -> bool:
-        # ephemeral tasks (ingress live sessions) have no file behind
-        # them: nothing to resume, nothing to content-hash — always run
-        if getattr(task, 'ephemeral', False):
-            return True
-        # The resume check runs here — lazily, as the decode side reaches
-        # each video — NOT as an up-front scan: is_already_exist loads
-        # every output file, and an eager pass over a mostly-done 20K
-        # worklist would block for minutes before the first batch packs.
-        # Amortized across the run it costs what the per-video loop paid.
-        # (The farm's dispatcher keeps the same property via its bounded
-        # assignment runahead.)
-        # the output_path kwarg is passed only when a task carries a
-        # per-request root: hooks monkeypatched/overridden with the
-        # classic (self, video_path) signature keep working for CLI runs.
-        # name_path (== path unless the task carries a segment range)
-        # keys both resume and the cache materialization target, so a
-        # range extraction never reuses — or clobbers — full outputs.
-        name = task.name_path
-        exists = (ex.is_already_exist(name, output_path=task.out_root)
-                  if task.out_root is not None
-                  else ex.is_already_exist(name))
-        if exists:
-            task.skipped = True
-            return False
-        # content-addressed cache: a hit materializes this video's outputs
-        # right here and drops it from batch planning entirely — it never
-        # decodes, never occupies batch slots, and finalizes through the
-        # same sweep/on_video_done path as a resume skip
-        if getattr(ex, 'cache', None) is not None and \
-                ex.cache_fetch(task.path, output_path=task.out_root,
-                               segment=task.segment, name_path=name):
-            task.skipped = True
-            task.cached = True
-            return False
-        return True
+        return _admit_task(ex, task)
 
     def open_windows(task: VideoTask):
         if not admit(task):
@@ -506,50 +625,8 @@ def run_packed(ex, video_paths: Iterable,
     # small in-flight window, not the whole worklist.
 
     def finalize(t: VideoTask) -> None:
-        from video_features_tpu.extract.base import log_extraction_error
-        try:
-            if not (t.failed or t.skipped
-                    or getattr(t, 'stream_only', False)):
-                # stream_only (live sessions) already delivered every
-                # window through on_window — nothing to save or publish
-                feats_dict = ex._maybe_concat_streams(ex.packed_result(t))
-                with ex.tracer.stage('save', video=str(t.path),
-                                     request_id=_request_id(t),
-                                     **trace_attrs(t)):
-                    if t.out_root is not None:
-                        ex.action_on_extraction(feats_dict, t.name_path,
-                                                output_path=t.out_root)
-                    else:
-                        ex.action_on_extraction(feats_dict, t.name_path)
-                if getattr(ex, 'cache', None) is not None:
-                    with ex.tracer.stage('cache_publish',
-                                         video=str(t.path)):
-                        ex.cache_publish(t.path, output_path=t.out_root,
-                                         segment=t.segment,
-                                         name_path=t.name_path)
-        except KeyboardInterrupt:
-            raise
-        except Exception:
-            t.failed = True           # a failed save IS a failed video
-            log_extraction_error(t.path, request_id=_request_id(t),
-                                 stage='save')
-        finally:
-            t.rows = {}               # free feature memory as we go
-            t.finalized = True        # the farm's dedupe unparks twins now
-            from video_features_tpu.utils.output import ACTION_TO_EXT
-            outcome = ('failed' if t.failed else 'cached' if t.cached
-                       else 'skipped' if t.skipped
-                       else 'saved' if ex.on_extraction in ACTION_TO_EXT
-                       else 'printed')
-            if recorder is not None:
-                recorder.instant('video_done', video=str(t.path),
-                                 outcome=outcome,
-                                 request_id=_request_id(t),
-                                 **trace_attrs(t))
-            if manifest is not None:
-                manifest.video_done(t.path, outcome)
-            if on_video_done is not None:
-                on_video_done(t)
+        _finalize_task(ex, t, recorder=recorder, manifest=manifest,
+                       on_video_done=on_video_done)
 
     def sweep(final: bool = False) -> None:
         i = 0
@@ -899,3 +976,428 @@ def run_packed(ex, video_paths: Iterable,
                   f'videos, batch {batch}{mesh_note})', file=sys.stderr)
             print(ex.tracer.summary(), file=sys.stderr)
         ex.tracer.reset()
+
+
+# -- fused multi-family worklists: decode once, extract many ----------------
+
+
+def build_fused_recipe(exs: Dict):
+    """One :class:`farm.recipes.FusedRecipe` for a family→extractor map
+    whose ``fused_decode_signature()`` values all match: the shared
+    decode geometry comes from the lead (first) family — the signature
+    equality the caller established means every family would have built
+    the identical loader — and the per-family branch transforms are each
+    family's own published ``host_transform_spec()``."""
+    from video_features_tpu.farm.recipes import FusedRecipe
+    lead = next(iter(exs.values()))
+    return FusedRecipe(
+        batch_size=lead.batch_size, fps=lead.extraction_fps,
+        total=lead.extraction_total, tmp_path=lead.tmp_path,
+        keep_tmp=lead.keep_tmp_files, backend=lead.decode_backend,
+        transforms={fam: ex.host_transform_spec()
+                    for fam, ex in exs.items()})
+
+
+def run_packed_fused(exs: Dict, video_paths: Iterable,
+                     batch_size: Optional[int] = None,
+                     decode_ahead: int = 2,
+                     on_video_done: Optional[Callable] = None,
+                     max_pool_age_s: Optional[float] = None,
+                     inflight: Optional[int] = None,
+                     decode_workers: Optional[int] = None) -> None:
+    """Drive N same-decode-signature extractors over ONE worklist with
+    ONE decode pass per video.
+
+    ``exs`` maps family name → warm extractor; every extractor must
+    publish the same ``fused_decode_signature()`` (the caller groups by
+    it — ``cli.py``). Per video, the shared raw frame stream is decoded
+    once and branched through each family's named host transform
+    (``FusedRecipe``), each window arrives tagged ``meta=(family,
+    t_ms)``, and the packer pools per ``(family, geometry)`` at that
+    family's own packed batch size — so the device sees the exact
+    per-family programs a sequential run compiles (no new program
+    identities, no AOT-store misses) and every family's outputs are
+    byte-identical to its solo run.
+
+    Scheduling state is a :class:`FusedTask` CARRIER per video (the
+    decode side's bookkeeping object) plus per-family subtasks that own
+    scatter-back, fault isolation, and finalization:
+
+      * admission runs per (family, video) through the shared
+        ``_admit_task`` gate — resume skips and cache hits stay
+        per-family, and a video every family skips never decodes;
+        families that drop out at admission are excluded from the
+        decode fan-out (``farm_select`` on the farm task message, the
+        ``select`` arg in-process), so a mostly-cached family costs no
+        transform work either;
+      * the video's content hash is computed ONCE (``cache.key``'s
+        stat-memoized ``hash_file``) and reused by every family's cache
+        key — the fused run's cache keys are identical to sequential
+        runs';
+      * a family's device-step fault fails only that family's subtask —
+        the shared decode keeps feeding the healthy siblings; a DECODE
+        fault fails the carrier, and with it every still-active
+        subtask;
+      * finalization fans each subtask through the shared
+        ``_finalize_task`` (identical save/publish code), then fires
+        ``on_video_done(carrier)`` once per video.
+
+    ``decode_workers > 1`` ships the fused recipe to the decode farm
+    unchanged — one worker decode per video, N tagged window streams
+    back over the ring. The D2H side keeps a per-family in-flight queue
+    at each family's ``inflight`` depth. Simplification vs
+    ``run_packed``: H2D runs inline per batch (its own ``h2d`` stage)
+    rather than through ``transfer_batches`` — with N families
+    interleaving on one device loop there is no single "next batch" to
+    overlap against.
+    """
+    from video_features_tpu.extract.streaming import (
+        stream_windows_across_videos,
+    )
+    from video_features_tpu.io.video import prefetch_across_videos
+
+    if not exs:
+        raise ValueError('run_packed_fused needs at least one family')
+    sigs = {fam: ex.fused_decode_signature() for fam, ex in exs.items()}
+    if None in sigs.values() or len(set(sigs.values())) != 1:
+        raise ValueError(
+            f'families cannot share one decode pass — fused decode '
+            f'signatures differ or are unfusable: {sigs}')
+
+    fams = list(exs)
+    lead = exs[fams[0]]
+
+    # per-family device setup + batch plan: each family keeps ITS packed
+    # batch size (and mesh plan), so fused batches feed the family's own
+    # compiled programs
+    fam_batch: Dict[str, int] = {}
+    for fam, ex in exs.items():
+        ex._packed_setup()
+        ndev = ex._ensure_packed_mesh()
+        capacity = int(batch_size or ex.packed_batch_size())
+        if ndev > 1:
+            from video_features_tpu.parallel.mesh import plan_device_batch
+            fam_batch[fam] = plan_device_batch(capacity, ex._mesh)
+        else:
+            fam_batch[fam] = capacity
+        ex._inflight_now = 0
+    max_batch = max(fam_batch.values())
+
+    recorders = {fam: getattr(ex.tracer, 'recorder', None)
+                 for fam, ex in exs.items()}
+    manifests = {fam: getattr(ex, 'manifest', None)
+                 for fam, ex in exs.items()}
+    lead_recorder = recorders[fams[0]]
+    run_ctx = getattr(lead, 'trace_ctx', None)
+
+    open_q: List[FusedTask] = []
+    n_started = [0]
+
+    def task_stream() -> Iterator:
+        for item in video_paths:
+            if item is FLUSH:
+                yield FLUSH
+                continue
+            c = (item if isinstance(item, FusedTask)
+                 else FusedTask(item, fams,
+                                trace=(run_ctx.child()
+                                       if run_ctx is not None
+                                       else None)))
+            c.video_id = n_started[0]
+            n_started[0] += 1
+            open_q.append(c)
+            if lead_recorder is not None:
+                lead_recorder.instant('video_start', video=str(c.path),
+                                      **trace_attrs(c))
+            yield c
+
+    def admit_fused(c: FusedTask) -> bool:
+        """Per-family admission over the shared carrier: families whose
+        subtask resolves at admit (resume skip / cache hit) drop out of
+        the decode fan-out; the video decodes only if someone still
+        wants it. Emits the ``decode_pass`` instant exactly once per
+        video that will decode — the observable the fused amortization
+        guard (tests) asserts on."""
+        active = []
+        for fam in c.subtasks:
+            sub = c.subtasks[fam]
+            if _admit_task(exs[fam], sub):
+                active.append(fam)
+            else:
+                sub.exhausted = True   # terminal now; finalized with the
+                #                        carrier so outcomes record once
+        c.active = active
+        c.farm_select = (tuple(active)
+                         if active and len(active) < len(c.subtasks)
+                         else None)
+        if active and lead_recorder is not None:
+            lead_recorder.instant('decode_pass', video=str(c.path),
+                                  families=list(active),
+                                  **trace_attrs(c))
+        return bool(active)
+
+    # -- input side: one shared decode, farm or in-process ------------------
+    n_decode = max(int(decode_workers if decode_workers is not None
+                       else getattr(lead, 'decode_workers', 1) or 1), 1)
+    farm = None
+    if n_decode > 1:
+        from video_features_tpu.farm import farm_available
+        if farm_available():
+            from video_features_tpu.farm import DecodeFarm, FarmUnavailable
+            ring_mb = int(getattr(lead, 'decode_farm_ring_mb', 64) or 64)
+            farm = DecodeFarm(
+                build_fused_recipe(exs), workers=n_decode,
+                ring_bytes=ring_mb * (1 << 20), tracer=lead.tracer,
+                blackbox=getattr(lead, 'blackbox', None),
+                pending_cb=getattr(lead, 'watchdog_pending', None),
+                # content-keyed dedupe stays off: per-family cache keys
+                # diverge, so a carrier-level key could merge videos one
+                # family still needs separately
+                cache_key_fn=None)
+            try:
+                farm.start()
+            except FarmUnavailable as e:
+                event(_logging.WARNING,
+                      f'decode_workers={n_decode} requested but {e} '
+                      '— running in-process decode', subsystem='farm')
+                farm = None
+            else:
+                lead._farm = farm
+        else:
+            event(_logging.WARNING,
+                  f'decode_workers={n_decode} requested but the host '
+                  'cannot spawn shared-memory workers — running '
+                  'in-process decode', subsystem='farm')
+
+    if farm is not None:
+        source = farm.stream(task_stream(), admit_fused)
+    else:
+        recipe = build_fused_recipe(exs)
+
+        def fused_open_windows(c: FusedTask):
+            if not admit_fused(c):
+                return iter(())
+            kw = {}
+            if c.segment is not None:
+                kw['segment'] = c.segment
+            if c.farm_select is not None:
+                kw['select'] = c.farm_select
+            info, windows = recipe.open(c.path, **kw)
+            c.info.update(info)
+            return windows
+
+        source = stream_windows_across_videos(task_stream(),
+                                              fused_open_windows)
+
+    def timed_source():
+        # in-process decode+branch cost, attributed per family window on
+        # the lead tracer (the farm path traces in-worker spans itself)
+        import time as _time
+        it = iter(source)
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            dt = _time.perf_counter() - t0
+            if item is FLUSH:
+                lead.tracer.add('queue_idle', dt, t0=t0)
+            elif item is NUDGE:
+                lead.tracer.add('decode+preprocess', dt, t0=t0)
+            else:
+                lead.tracer.add('decode+preprocess', dt, t0=t0,
+                                video=str(item[0].path),
+                                family=item[2][0],
+                                **trace_attrs(item[0]))
+            yield item
+
+    timed = (timed_source() if lead.tracer.enabled and farm is None
+             else source)
+
+    def counted(src):
+        # PRODUCER-side per-family emit accounting: runs between the
+        # windower (which counts the carrier) and the prefetch buffer,
+        # so by the time the consumer can observe ``carrier.exhausted``
+        # every subtask's ``emitted`` is final — the sweep's readiness
+        # check (done >= emitted per active family) cannot fire early
+        for item in src:
+            if item is not FLUSH and item is not NUDGE:
+                sub = item[0].subtasks.get(item[2][0])
+                if sub is not None:
+                    sub.emitted += 1
+            yield item
+
+    ahead = prefetch_across_videos(counted(timed), decode_ahead * max_batch)
+
+    from collections import deque
+    depth = {fam: max(int(inflight if inflight is not None
+                          else getattr(ex, 'inflight', 1) or 1), 1)
+             for fam, ex in exs.items()}
+    pending: Dict[str, deque] = {fam: deque() for fam in fams}
+    costed: Dict[str, Dict[str, tuple]] = {fam: {} for fam in fams}
+
+    def finalize_carrier(c: FusedTask) -> None:
+        for fam, sub in c.subtasks.items():
+            for k, v in c.info.items():
+                sub.info.setdefault(k, v)
+            if c.failed and not sub.skipped:
+                sub.failed = True    # decode fault fails every family
+            sub.exhausted = True
+            _finalize_task(exs[fam], sub, recorder=recorders[fam],
+                           manifest=manifests[fam])
+        c.rows = {}
+        c.finalized = True
+        if on_video_done is not None:
+            on_video_done(c)
+
+    def sweep(final: bool = False) -> None:
+        i = 0
+        while i < len(open_q):
+            c = open_q[i]
+            if not c.exhausted and c.emitted == 0:
+                break             # decode hasn't reached this video yet
+            if c.exhausted and all(c.subtasks[f].done
+                                   >= c.subtasks[f].emitted
+                                   for f in c.active):
+                del open_q[i]
+                finalize_carrier(c)
+            else:
+                i += 1
+        if final and open_q:
+            c = open_q[0]
+            counts = {f: (c.subtasks[f].done, c.subtasks[f].emitted)
+                      for f in c.active}
+            raise AssertionError(
+                f'fused scheduler lost windows for {c.path}: '
+                f'{counts} (done, emitted) per family, '
+                f'exhausted={c.exhausted}')
+
+    def doom(fam: str, prov, valid: int, stage: str) -> None:
+        # a family's device fault fails ITS subtasks only — the shared
+        # decode keeps feeding the other families
+        from video_features_tpu.obs.events import log_batch_error
+        log_batch_error(sorted({str(c.path) for c, _ in prov}), valid,
+                        fam_batch[fam], stage=f'{stage}:{fam}')
+        for c, _ in prov:
+            sub = c.subtasks[fam]
+            sub.failed = True
+            sub.done += 1
+
+    def sync_oldest(fam: str) -> None:
+        ex = exs[fam]
+        out_dev, prov, valid, batch_videos = pending[fam].popleft()
+        ex._inflight_now = len(pending[fam])
+        try:
+            with ex.tracer.stage('d2h', videos=batch_videos,
+                                 valid=valid, capacity=fam_batch[fam],
+                                 family=fam):
+                out = ex.fetch_outputs(out_dev)
+        except KeyboardInterrupt:
+            raise
+        except Exception:
+            doom(fam, prov, valid, 'd2h')
+            sweep()
+            return
+        ex.tracer.add_occupancy('d2h', valid, fam_batch[fam])
+        for i, (c, meta) in enumerate(prov):
+            f2, t_ms = meta
+            sub = c.subtasks[f2]
+            sub.done += 1
+            if sub.failed or c.failed:
+                continue
+            for key, arr in out.items():
+                sub.rows.setdefault(key, []).append(arr[i])
+            sub.meta_rows.append(t_ms)
+        sweep()
+
+    def drain_all() -> None:
+        for fam in fams:
+            while pending[fam]:
+                sync_oldest(fam)
+
+    for stacked, prov, valid in packed_batches(
+            ahead, max_batch, max_pool_age_s=max_pool_age_s,
+            tracer=lead.tracer, family_of=lambda m: m[0],
+            family_batch=fam_batch):
+        if stacked is None:
+            # batchless drain marker (NUDGE / post-FLUSH): materialize
+            # every family's in-flight queue, then finalize
+            drain_all()
+            sweep()
+            continue
+        fam = prov[0][1][0]
+        ex = exs[fam]
+        batch_videos = (sorted({str(c.path) for c, _ in prov})
+                        if ex.tracer.enabled else None)
+        try:
+            # per-batch precision scope: adjacent batches may belong to
+            # families on different precision lanes
+            with ex.precision_scope():
+                with ex.tracer.stage('h2d', videos=batch_videos,
+                                     valid=valid,
+                                     capacity=fam_batch[fam],
+                                     family=fam):
+                    dev = ex.put_input(stacked)
+                with ex.tracer.stage('model', videos=batch_videos,
+                                     valid=valid,
+                                     capacity=fam_batch[fam],
+                                     family=fam):
+                    out = ex.packed_step(dev)
+        except KeyboardInterrupt:
+            raise
+        except Exception:
+            doom(fam, prov, valid, 'model')
+            sweep()
+            continue
+        ex.tracer.add_occupancy('model', valid, fam_batch[fam])
+        if manifests[fam] is not None:
+            shape = getattr(dev, 'shape', None)
+            if shape is not None:
+                cd = str(getattr(ex, 'compute_dtype', 'float32'))
+                lane = '' if cd == 'float32' else f':{cd}'
+                identity = (f'{fam}:{tuple(shape)}:'
+                            f'{getattr(dev, "dtype", "")}{lane}')
+                costed[fam].setdefault(
+                    identity, (tuple(shape), getattr(dev, 'dtype', None)))
+        pending[fam].append((out, prov, valid, batch_videos))
+        ex._inflight_now = len(pending[fam])
+        while len(pending[fam]) >= depth[fam]:
+            sync_oldest(fam)
+    drain_all()
+    for ex in exs.values():
+        ex._inflight_now = 0
+    sweep(final=True)
+
+    for fam, ex in exs.items():
+        manifest = manifests[fam]
+        if manifest is not None and costed[fam]:
+            import jax
+            for identity, (shape, dtype) in costed[fam].items():
+                info: Dict = {'batch': fam_batch[fam],
+                              'compute_dtype':
+                                  str(getattr(ex, 'compute_dtype',
+                                              'float32'))}
+                cost = (ex.executable_cost(
+                            jax.ShapeDtypeStruct(shape, dtype))
+                        if dtype is not None else None)
+                if cost:
+                    info.update(cost)
+                manifest.note_executable(identity, info)
+        if farm is not None and manifest is not None:
+            manifest.note_farm({'decode_workers': farm.n_workers,
+                                'ring_bytes_per_worker': farm.ring_bytes,
+                                'stats': farm.stats(),
+                                'fused_families': fams})
+        if ex.tracer.enabled and ex.tracer.report():
+            if manifest is not None:
+                manifest.fold_stages(ex.tracer.report())
+            if getattr(ex, 'profile', True):
+                print(f'--- stage timing: fused worklist '
+                      f'[{fam}] ({n_started[0]} videos, batch '
+                      f'{fam_batch[fam]})', file=sys.stderr)
+                print(ex.tracer.summary(), file=sys.stderr)
+            if ex is not lead:
+                ex.tracer.reset()
+    if lead.tracer.enabled:
+        lead.tracer.reset()

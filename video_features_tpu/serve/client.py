@@ -88,12 +88,13 @@ class ServeClient:
     def ping(self) -> bool:
         return bool(self._call({'cmd': protocol.CMD_PING}).get('ok'))
 
-    def submit(self, feature_type: str, video_paths: List[str],
+    def submit(self, feature_type: Optional[str], video_paths: List[str],
                overrides: Optional[Dict[str, Any]] = None,
                timeout_s: Optional[float] = None,
                range_s: Optional[List[float]] = None,
                priority: Optional[str] = None,
-               traceparent: Optional[str] = None) -> str:
+               traceparent: Optional[str] = None,
+               features: Optional[List[str]] = None) -> str:
         """Enqueue one extraction request; returns its request_id.
         Raises :class:`ServeError` on rejection (queue_full, draining,
         invalid config, …) — backpressure is the caller's to handle.
@@ -102,10 +103,16 @@ class ServeClient:
         ``priority`` ('interactive' | 'batch') feeds admission — a
         saturated queue sheds batch before interactive; ``traceparent``
         (W3C ``00-<trace>-<span>-<flags>``) joins the request to a
-        caller-owned distributed trace (minted server-side otherwise)."""
+        caller-owned distributed trace (minted server-side otherwise);
+        ``features=['i3d', 'clip', ...]`` (v1.2) submits a FUSED
+        multi-family request — one umbrella request_id (returned) with
+        per-family children, ``feature_type`` ignored; family-scoped
+        override keys spell ``<family>.<knob>``."""
         msg: Dict[str, Any] = {'cmd': protocol.CMD_SUBMIT,
                                'feature_type': feature_type,
                                'video_paths': list(video_paths)}
+        if features is not None:
+            msg['features'] = list(features)
         if overrides:
             msg['overrides'] = dict(overrides)
         if timeout_s is not None:

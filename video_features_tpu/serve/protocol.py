@@ -29,7 +29,11 @@ Commands (the ``cmd`` field):
     ``traceparent`` (optional, W3C ``00-<trace>-<span>-<flags>``) joins
     the request to a caller-owned distributed trace; absent or
     malformed, the server mints one. The submit response echoes the
-    ``trace_id`` either way.
+    ``trace_id`` either way. ``features`` (optional, v1.2) submits a
+    FUSED multi-family request: one umbrella request id plus a
+    ``requests`` map of per-family child ids in the response
+    (``feature_type`` is ignored when present); family-scoped override
+    keys spell ``<family>.<knob>``.
   * ``status``  — ``{cmd, request_id}`` → per-request state + per-video
     states (see ``serve.server.Request.snapshot``).
   * ``trace``   — ``{cmd, request_id}`` → ``{ok, request_id, trace_id,
@@ -68,14 +72,18 @@ COMMANDS = (CMD_SUBMIT, CMD_STATUS, CMD_TRACE, CMD_METRICS,
 # History: 1.0 introduced versioning itself (check_version + client `v`
 # stamping); 1.1 is the first real MINOR bump, retroactively covering
 # the additive `trace` command / `/v1/requests/<id>/trace` route that
-# landed without a bump — exactly the drift WIRE.lock.json now catches.
-VERSION = '1.1'
+# landed without a bump — exactly the drift WIRE.lock.json now catches;
+# 1.2 adds the optional `features` submit field (fused multi-family
+# requests: one request id, per-family children, `requests`/`errors`
+# in the response and nested per-family `videos` in status).
+VERSION = '1.2'
 MAJOR = 1
 
 # submit() fields copied verbatim into the request (everything else in the
 # message is rejected — catches client/server schema drift loudly)
 SUBMIT_FIELDS = ('cmd', 'v', 'feature_type', 'video_paths', 'overrides',
-                 'timeout_s', 'range', 'priority', 'traceparent')
+                 'timeout_s', 'range', 'priority', 'traceparent',
+                 'features')
 
 PRIORITIES = ('interactive', 'batch')
 

@@ -187,6 +187,57 @@ class Request:
         return out
 
 
+class FusedRequest(Request):
+    """Umbrella for one ``features=[...]`` submit: the caller holds ONE
+    request id while per-family children run through the normal
+    admission/worker machinery (each family its own warm-pool entry,
+    cache, deadline, and fault isolation). The umbrella is terminal
+    when every child is; its state aggregates the children's. It never
+    occupies an admission slot itself and never bumps the completed/
+    failed counters (the children already did) — its one completion
+    side effect is firing the completion listeners, which is where the
+    ingress gateway releases the request's tenant quota unit."""
+
+    def __init__(self, request_id: str, features: List[str],
+                 paths: List[str], priority: str = 'interactive',
+                 trace=None) -> None:
+        super().__init__(request_id, '+'.join(features), paths, None,
+                         priority=priority, trace=trace)
+        self.features = list(features)
+        self.children: Dict[str, Request] = {}
+        self.pending = 0        # completion is tracked via the children
+
+    def state(self) -> str:
+        if not self.children:
+            return 'running'    # fan-out still in flight
+        states = {c.state() for c in self.children.values()}
+        if 'running' in states or any(c.done_t is None
+                                      for c in self.children.values()):
+            return 'running'
+        if states == {'done'}:
+            return 'done'
+        if states & {'done', 'partial'}:
+            return 'partial'
+        return 'failed'
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = {'request_id': self.id, 'state': self.state(),
+               'feature_type': self.feature_type,
+               'features': list(self.features),
+               # per-family child request ids + video states: a fused
+               # status answer is the N family answers, keyed
+               'requests': {f: c.id for f, c in self.children.items()},
+               'videos': {f: dict(c.videos)
+                          for f, c in self.children.items()}}
+        if self.trace is not None:
+            out['trace_id'] = self.trace.trace_id
+        if self.priority != 'interactive':
+            out['priority'] = self.priority
+        if self.done_t is not None:
+            out['latency_s'] = round(self.done_t - self.t0, 4)
+        return out
+
+
 _WD_SEQ = itertools.count(1)
 
 
@@ -787,7 +838,16 @@ class ExtractionServer:
                range_s=None,
                priority: str = 'interactive',
                traceparent: Optional[str] = None,
+               features: Optional[List[str]] = None,
                _live_session=None) -> Dict[str, Any]:
+        if features is not None and _live_session is None:
+            # fused multi-family submit: one request id, per-family
+            # children through the normal machinery (feature_type is
+            # ignored when features is given — the list IS the spec)
+            return self._submit_fused(
+                features, video_paths, overrides=overrides,
+                timeout_s=timeout_s, range_s=range_s, priority=priority,
+                traceparent=traceparent)
         # request-scoped trace context: adopt the caller's W3C
         # traceparent or mint one — minted EARLY so even the admission
         # span of a rejected submit has an identity to hang on
@@ -970,6 +1030,117 @@ class ExtractionServer:
                                trace_id=trace_ctx.trace_id)
         self.stats.bump('rejected')
         return protocol.error('worker churn outpaced admission; retry')
+
+    def _submit_fused(self, features, video_paths,
+                      overrides: Optional[Dict[str, Any]] = None,
+                      timeout_s: Optional[float] = None,
+                      range_s=None,
+                      priority: str = 'interactive',
+                      traceparent: Optional[str] = None) -> Dict[str, Any]:
+        """One ``features=[...]`` submit: validate and pre-flight EVERY
+        family's config first (a fused request admits whole or not at
+        all on config grounds — family 3 failing validation after
+        families 1–2 queued would strand work and quota), then fan out
+        one child submit per family under one shared trace context.
+        Families answered entirely from cache terminate at birth inside
+        their child submit, exactly as today; the warm decode farm's
+        content-hash memoization (``cache/key.py``) makes the N
+        children's hash passes one streaming read per video."""
+        from video_features_tpu.config import (
+            resolve_fused_features, split_fused_overrides,
+        )
+        try:
+            fams = resolve_fused_features(features)
+        except (TypeError, ValueError) as e:
+            self.stats.bump('rejected')
+            return protocol.error(f'invalid features: {e}')
+        bad = [f for f in fams if f not in PACKED_FEATURES]
+        if bad:
+            self.stats.bump('rejected')
+            return protocol.error(
+                f'features {bad} have no packed/serving support; '
+                f'serveable: {", ".join(sorted(PACKED_FEATURES))}')
+        if not isinstance(video_paths, (list, tuple)) or not video_paths:
+            self.stats.bump('rejected')
+            return protocol.error('video_paths must be a non-empty list')
+        paths = [str(p) for p in video_paths]
+        trace_ctx = accept_traceparent(traceparent)
+        # family-scoped overrides ('<family>.<knob>') peel off to their
+        # family; everything else is shared — same split as the fused CLI
+        shared, scoped = split_fused_overrides(overrides or {}, fams)
+        fam_overrides: Dict[str, Dict[str, Any]] = {}
+        for fam in fams:
+            o = dict(shared)
+            o.update(scoped.get(fam, {}))
+            fam_overrides[fam] = o
+            try:
+                self._resolve_entry_config(fam, paths, o)
+            except Exception as e:
+                self.stats.bump('rejected')
+                return protocol.error(f'invalid request for {fam!r}: {e}')
+
+        with self._lock:
+            if self._draining:
+                self.stats.bump('rejected')
+                return protocol.error('draining')
+            self._next_id += 1
+            parent = FusedRequest(f'r{self._next_id:06d}', fams, paths,
+                                  priority=priority, trace=trace_ctx)
+            self._requests[parent.id] = parent
+
+        children: Dict[str, Request] = {}
+        errors: Dict[str, str] = {}
+        for fam in fams:
+            resp = self.submit(fam, paths,
+                               overrides=fam_overrides[fam],
+                               timeout_s=timeout_s, range_s=range_s,
+                               priority=priority,
+                               traceparent=trace_ctx.traceparent())
+            if resp.get('ok'):
+                with self._lock:
+                    children[fam] = self._requests[resp['request_id']]
+            else:
+                # admission rejection mid-fan-out (queue_full under a
+                # race; config errors were pre-flighted): the family
+                # records as a terminal failed child so the umbrella
+                # still completes from the admitted siblings
+                errors[fam] = str(resp.get('error'))
+                child = Request(f'{parent.id}.{fam}', fam, paths, None,
+                                priority=priority, trace=trace_ctx)
+                for p in paths:
+                    child.videos[p] = 'failed'
+                child.pending = 0
+                child.done_t = time.monotonic()
+                children[fam] = child
+        if not any(fam not in errors for fam in fams):
+            # nothing admitted: the umbrella is dead on arrival
+            with self._lock:
+                self._requests.pop(parent.id, None)
+            return protocol.error(
+                'fused submit admitted no family: '
+                + '; '.join(f'{f}: {e}' for f, e in errors.items()))
+
+        with self._lock:
+            parent.children = children
+            for child in children.values():
+                child.fused_parent = parent
+            # terminal-at-birth children (all-cache-hit families, or
+            # every family rejected-but-one-cached) completed BEFORE the
+            # parent hook attached — close the umbrella here if so
+            done = (parent.done_t is None
+                    and all(c.done_t is not None
+                            for c in children.values()))
+            if done:
+                self._record_done_locked(parent)
+        if done:
+            self._fire_completion_listeners(parent)
+        out: Dict[str, Any] = {'request_id': parent.id,
+                               'trace_id': trace_ctx.trace_id,
+                               'requests': {f: c.id
+                                            for f, c in children.items()}}
+        if errors:
+            out['errors'] = errors
+        return protocol.ok(**out)
 
     def submit_live(self, feature_type: str, session,
                     overrides: Optional[Dict[str, Any]] = None,
@@ -1337,13 +1508,7 @@ class ExtractionServer:
         while len(self._done_ids) > REQUEST_HISTORY:
             self._requests.pop(self._done_ids.popleft(), None)
 
-    def _after_completion(self, req: Request) -> None:
-        """Lock-free completion accounting, shared by the worker path
-        and the all-cache-hit terminal-at-birth path."""
-        self.stats.bump('completed')
-        if req.state() in ('partial', 'failed'):
-            self.stats.bump('failed')
-        self.stats.observe_latency(req.done_t - req.t0)
+    def _fire_completion_listeners(self, req: Request) -> None:
         for listener in list(self.completion_listeners):
             # e.g. the ingress gateway releasing this request's tenant
             # concurrency slot; a listener bug must not lose completions
@@ -1355,6 +1520,32 @@ class ExtractionServer:
                 event(logging.WARNING, 'completion listener failed',
                       subsystem='serve', exc_info=True,
                       request_id=req.id)
+
+    def _fused_child_done(self, parent: 'FusedRequest') -> None:
+        """A fused child reached terminal state: close the umbrella when
+        it was the last one. No completed/failed/latency accounting —
+        the children already counted; the umbrella's one side effect is
+        the completion listeners (quota release)."""
+        with self._lock:
+            done = (parent.done_t is None and parent.children
+                    and all(c.done_t is not None
+                            for c in parent.children.values()))
+            if done:
+                self._record_done_locked(parent)
+        if done:
+            self._fire_completion_listeners(parent)
+
+    def _after_completion(self, req: Request) -> None:
+        """Lock-free completion accounting, shared by the worker path
+        and the all-cache-hit terminal-at-birth path."""
+        self.stats.bump('completed')
+        if req.state() in ('partial', 'failed'):
+            self.stats.bump('failed')
+        self.stats.observe_latency(req.done_t - req.t0)
+        self._fire_completion_listeners(req)
+        parent = getattr(req, 'fused_parent', None)
+        if parent is not None:
+            self._fused_child_done(parent)
         if self.metrics_path:
             # building the metrics document takes the server lock and
             # snapshots every tracer — skip it entirely when no
@@ -1450,7 +1641,8 @@ class ExtractionServer:
                                timeout_s=msg.get('timeout_s'),
                                range_s=msg.get('range'),
                                priority=msg.get('priority', 'interactive'),
-                               traceparent=msg.get('traceparent'))
+                               traceparent=msg.get('traceparent'),
+                               features=msg.get('features'))
         if cmd == protocol.CMD_STATUS:
             return self.status(msg.get('request_id'))
         if cmd == protocol.CMD_TRACE:
