@@ -389,6 +389,71 @@ def bench_aot_boot(tmp_dir: str, platform: str, wl_paths: list) -> dict:
     }
 
 
+def bench_index(tmp_dir: str, platform: str, wl_paths: list) -> dict:
+    """The feature-index rung (index/): a daemon with ``index_enabled``
+    extracts a small worklist, the ingest worker folds the published
+    cache objects in (lag polled to zero), then every indexed row is
+    queried back through the loopback ``search`` command. Reports
+    sustained queries/sec and recall@10 — the search is EXACT (batched
+    matmul + top-k over every shard), so each row's own identity must
+    sit in its top-10 at cosine 1.0 and recall pins to 1.0; anything
+    less is an indexing bug, not a quality tradeoff."""
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+
+    cache_dir = os.path.join(tmp_dir, 'index_cache')
+    base = {
+        'device': platform, 'model_name': 'resnet18', 'batch_size': 8,
+        'allow_random_weights': True, 'on_extraction': 'save_numpy',
+        'tmp_path': os.path.join(tmp_dir, 'index_tmp'),
+        'output_path': os.path.join(tmp_dir, 'index_out'),
+        'cache_enabled': True, 'cache_dir': cache_dir,
+        'index_enabled': True,
+    }
+    server = ExtractionServer(base_overrides=base, queue_depth=64).start()
+    try:
+        client = ServeClient(port=server.port)
+        rid = client.submit('resnet', wl_paths[:2])
+        st = client.wait(rid, timeout_s=900)
+        assert st['state'] == 'done', f'index rung extract: {st}'
+        deadline = time.time() + 120
+        while True:
+            idx = client.index_status()
+            if idx['rows_live'] > 0 and idx['ingest_lag_bytes'] == 0:
+                break
+            assert time.time() < deadline, f'ingest never converged: {idx}'
+            time.sleep(0.05)
+        # query every indexed row back (bounded) through the loopback
+        # command — the full wire + merge path, not just the matmul
+        from video_features_tpu.index.service import resolve_index_dir
+        from video_features_tpu.index.shards import IndexStore
+        store = IndexStore.get(resolve_index_dir(base))
+        rows = []
+        for arr, _mask, metas in store.shard_views(
+                store.group_for('resnet')):
+            rows.extend((arr[i], m) for i, m in enumerate(metas)
+                        if m is not None)
+        n = min(len(rows), int(os.environ.get('BENCH_INDEX_QUERIES',
+                                              '32')))
+        assert n > 0, 'index rung: no rows indexed'
+        self_hits = 0
+        t0 = time.perf_counter()
+        for vec, m in rows[:n]:
+            out = client.search(family='resnet',
+                                vector=[float(x) for x in vec], k=10)
+            self_hits += any(h['key'] == m['key']
+                             and h['t_ms'] == m['t_ms']
+                             for h in out['hits'])
+        wall = time.perf_counter() - t0
+        return {
+            'index_queries_per_sec': round(n / wall, 3),
+            'index_recall_at_10': round(self_hits / n, 4),
+            'index_rows_live': idx['rows_live'],
+        }
+    finally:
+        server.drain(wait=True, grace_s=120)
+
+
 def bench_cache(precision: str, batch: int, stack: int, tmp_dir: str,
                 platform: str, wl_paths: list) -> dict:
     """The content-addressed cache rung (cache/): the SAME worklist run
@@ -1118,6 +1183,21 @@ def run() -> dict:
                     rungs['cache_bytes_saved'] = crec['cache_bytes_saved']
                 except Exception as e:
                     rungs['cache_error'] = f'{type(e).__name__}: {e}'
+            # The feature-index rung (index/): serve-side ingest to lag
+            # zero, then every row queried back over the loopback search
+            # command — queries/sec plus recall@10, which exact search
+            # pins to 1.0. BENCH_INDEX=0/1 overrides.
+            if os.environ.get('BENCH_INDEX',
+                              '1' if on_accel else '0') == '1':
+                try:
+                    if wl_paths is None:
+                        from tools.worklist_bench import make_worklist
+                        wl_paths = make_worklist(
+                            tmp_dir, 4 if on_accel else 2,
+                            10 if on_accel else 2)
+                    rungs.update(bench_index(tmp_dir, platform, wl_paths))
+                except Exception as e:
+                    rungs['index_error'] = f'{type(e).__name__}: {e}'
             # The serve-warm bf16 rung: fp32 and bf16 entries resident
             # side by side in ONE daemon (distinct pool keys), warm
             # rates + measured error. BENCH_BF16_SERVE=0/1 overrides.

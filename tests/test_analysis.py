@@ -824,7 +824,11 @@ def test_knob_registry_is_behavior_preserving():
     key keeps them; and the fused 'features' routing key, 'neither' —
     split_fused_overrides drops it before any per-family config exists,
     and a stray copy fragmenting the fused key space against sequential
-    runs would break the keys-identical contract, tests/test_fused.py)."""
+    runs would break the keys-identical contract, tests/test_fused.py;
+    and the vft-index knobs, 'neither' like the cache knobs the index
+    derives from — the index stores nothing the cache does not, so its
+    presence can never change what bytes a run produces or which warm
+    entry serves it)."""
     from video_features_tpu.config import knob_exclude
     assert knob_exclude('fingerprint') == {
         'video_paths', 'file_with_video_paths', 'output_path', 'tmp_path',
@@ -837,6 +841,8 @@ def test_knob_registry_is_behavior_preserving():
         'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s',
         'cache_enabled', 'cache_dir', 'cache_max_bytes',
         'aot_enabled', 'aot_dir', 'aot_max_bytes',
+        'index_enabled', 'index_dir', 'index_shard_rows',
+        'index_poll_s', 'index_query_block', 'index_k_max',
         'allow_random_weights', 'timeout_s', 'config', 'features'}
     assert knob_exclude('pool_key') == {
         'video_paths', 'file_with_video_paths', 'output_path', 'profile',
@@ -844,6 +850,8 @@ def test_knob_registry_is_behavior_preserving():
         'manifest_out', 'inflight', 'decode_workers',
         'decode_farm_ring_mb',
         'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s',
+        'index_enabled', 'index_dir', 'index_shard_rows',
+        'index_poll_s', 'index_query_block', 'index_k_max',
         'features'}
 
 

@@ -191,6 +191,27 @@ def test_bench_fused_rung_emits_keys():
     assert 'decode+preprocess' in fused_rep and 'model' in fused_rep
 
 
+def test_bench_index_rung_emits_keys():
+    """BENCH_INDEX=1 drives the feature-index rung: a served extract
+    publishes into the cache, the ingest worker folds it to lag 0, and
+    query-by-vector rates through the loopback ``search`` command.
+    Recall@10 is a SELF-CHECK, not a measurement — the index is exact,
+    so every indexed row must retrieve itself at rank 1 (score 1.0)
+    and the rung pins 1.0 by construction."""
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_WORKLIST': '1', 'BENCH_SERVE': '0',
+                      'BENCH_CACHE': '0', 'BENCH_FUSED': '0',
+                      'BENCH_BF16': '0', 'BENCH_INGRESS': '0',
+                      'BENCH_WORKLIST_FEATURE': 'resnet',
+                      'BENCH_INDEX': '1'})
+    rungs = rec['rungs']
+    assert 'index_error' not in rungs, rungs.get('index_error')
+    assert rungs['index_queries_per_sec'] > 0
+    assert rungs['index_recall_at_10'] == 1.0
+    assert rungs['index_rows_live'] > 0
+
+
 def test_bench_diff_error_rungs_flagged_never_gated(tmp_path):
     """tools/bench_diff.py direction-awareness for the *_error* fields:
     a measured-error rung that RISES shows as WORSE (lower-is-better)

@@ -1,0 +1,405 @@
+"""The sharded feature index (index/): ingest coherence + exact search.
+
+The contracts under test:
+
+  * **store** — shards bound at ``shard_rows``, vectors L2-normalized,
+    tombstones never served, compaction rewrites shards + manifest
+    atomically, replay survives torn tails, the ingest cursor persists;
+  * **ingest** — ``fold_manifest`` folds each cache put exactly once
+    (cursor + key-dedupe), resets idempotently when the cache manifest
+    compacts under it, and skips non-framewise entries;
+  * **coherence** — an evicted cache object is NEVER a search hit
+    (the ``on_evict`` seam), and ``tools/index_gc.py`` repairs
+    evictions nobody heard about with the 0/1/2 exit-code contract;
+  * **locks** — the wire surface (v1.3: ``search``/``index_status``,
+    ``POST /v1/search``) and the ``index`` program family (mesh widths
+    1 and 2) are pinned.
+
+Budget discipline (tier-1): store/fold units are numpy-only; search
+tests share one tiny jit geometry; the served two-boot AOT e2e is
+``slow`` (the index-smoke CI job and the full lane run it).
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from video_features_tpu.cache.store import FeatureCache
+from video_features_tpu.index.service import (
+    CURSOR_SOURCE, fold_manifest, fold_put,
+)
+from video_features_tpu.index.shards import IndexStore
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+DIM = 8
+
+
+def _store(tmp_path, name='idx', shard_rows=4):
+    # direct ctor, not .get(): each test wants its own on-disk view
+    return IndexStore(str(tmp_path / name), shard_rows=shard_rows)
+
+
+def _rows(n, dim=DIM, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, dim)).astype(np.float32)
+
+
+def _metas(n, key, t0=0):
+    return [{'video': f'{key}.mp4', 'video_sha256': f'sha-{key}',
+             't_ms': t0 + 1000 * i, 'key': key} for i in range(n)]
+
+
+def _put_framewise(cache, tmp_path, key, n=3, seed=0, family='resnet'):
+    """Publish one synthetic framewise entry the ingest path can fold."""
+    src = tmp_path / f'src_{key}'
+    src.mkdir(exist_ok=True)
+    feat, ts = src / 'feat.npy', src / 'ts.npy'
+    np.save(feat, _rows(n, seed=seed))
+    np.save(ts, np.arange(n, dtype=np.int64) * 1000)
+    cache.put(key, {family: (str(feat), '.npy'),
+                    'timestamps_ms': (str(ts), '.npy')},
+              meta={'video': f'{key}.mp4', 'feature_type': family,
+                    'video_sha256': f'sha-{key}'})
+
+
+# -- store: shards, tombstones, compaction, replay ---------------------------
+
+
+def test_add_rows_bounds_shards_and_normalizes(tmp_path):
+    store = _store(tmp_path, shard_rows=4)
+    assert store.add_rows('resnet', _rows(10), _metas(10, 'k1')) == 10
+    gkey = store.group_for('resnet')
+    views = store.shard_views(gkey)
+    # 10 rows at shard_rows=4 -> shards of 4, 4, 2 — bounded, unpadded
+    assert [v[0].shape[0] for v in views] == [4, 4, 2]
+    for arr, mask, metas in views:
+        # every stored row is unit-norm: scores ARE cosine similarities
+        np.testing.assert_allclose(np.linalg.norm(arr, axis=1), 1.0,
+                                   atol=1e-5)
+        assert mask.all() and all(m is not None for m in metas)
+    st = store.stats()
+    assert st['rows_live'] == 10 and st['rows_dead'] == 0
+    # shard files are on disk, each within the row bound
+    files = sorted((tmp_path / 'idx' / 'shards').rglob('shard_*.npy'))
+    assert len(files) == 3
+
+
+def test_drop_key_tombstones_then_compact_rewrites(tmp_path):
+    store = _store(tmp_path, shard_rows=4)
+    store.add_rows('resnet', _rows(6, seed=1), _metas(6, 'k1'))
+    store.add_rows('resnet', _rows(5, seed=2), _metas(5, 'k2'))
+    assert store.drop_key('k1') == 6
+    assert store.drop_key('k1') == 0          # idempotent
+    st = store.stats()
+    assert st['rows_live'] == 5 and st['rows_dead'] == 6
+    # dead rows are masked out of every served view
+    for _, mask, metas in store.shard_views(store.group_for('resnet')):
+        assert all((m is not None) == bool(b)
+                   for m, b in zip(metas, mask))
+    rep = store.compact()
+    assert rep['rows_dropped'] == 6
+    st = store.stats()
+    assert st['rows_live'] == 5 and st['rows_dead'] == 0
+    # a cold replay of the compacted dir agrees (and holds only k2)
+    reloaded = IndexStore(store.index_dir, shard_rows=4)
+    assert reloaded.stats()['rows_live'] == 5
+    assert reloaded.keys() == ['k2']
+
+
+def test_manifest_replay_skips_torn_and_foreign_lines(tmp_path):
+    store = _store(tmp_path, shard_rows=4)
+    store.add_rows('resnet', _rows(4, seed=3), _metas(4, 'k1'))
+    store.set_cursor(CURSOR_SOURCE, 123)
+    with open(store.manifest_path, 'ab') as f:
+        f.write(b'{"op": "nonsense"}\n')
+        f.write(b'{"op": "add", "family": "re')   # torn tail, no newline
+    reloaded = IndexStore(store.index_dir, shard_rows=4)
+    assert reloaded.stats()['rows_live'] == 4
+    # the persisted ingest cursor replayed too
+    assert reloaded.cursor(CURSOR_SOURCE) == 123
+
+
+# -- ingest: fold_manifest cursor + dedupe + reset ---------------------------
+
+
+def test_fold_manifest_folds_once_and_resumes_by_cursor(tmp_path):
+    cache = FeatureCache(str(tmp_path / 'cache'))
+    store = _store(tmp_path, shard_rows=4)
+    _put_framewise(cache, tmp_path, 'k1', n=3, seed=4)
+    _put_framewise(cache, tmp_path, 'k2', n=2, seed=5)
+    rep = fold_manifest(store, cache)
+    assert rep['rows_added'] == 5 and rep['bytes_folded'] > 0
+    # second pass: cursor is past everything — nothing re-folds
+    rep2 = fold_manifest(store, cache)
+    assert rep2['rows_added'] == 0 and rep2['bytes_folded'] == 0
+    # new put folds incrementally from the cursor
+    _put_framewise(cache, tmp_path, 'k3', n=1, seed=6)
+    assert fold_manifest(store, cache)['rows_added'] == 1
+    assert sorted(store.keys()) == ['k1', 'k2', 'k3']
+
+
+def test_fold_manifest_reset_on_shrink_is_idempotent(tmp_path):
+    """A cache-manifest compaction shrinks the file under the ingest
+    cursor; the fold replays from zero and key-dedupe makes the replay
+    add nothing twice."""
+    cache = FeatureCache(str(tmp_path / 'cache'))
+    store = _store(tmp_path, shard_rows=4)
+    for i in range(3):
+        _put_framewise(cache, tmp_path, f'k{i}', n=2, seed=10 + i)
+    assert fold_manifest(store, cache)['rows_added'] == 6
+    # compact the cache manifest (offline gc's rewrite): file shrinks
+    cache.gc(compact=True)
+    assert os.path.getsize(cache.manifest_path) \
+        < store.cursor(CURSOR_SOURCE)
+    rep = fold_manifest(store, cache)
+    assert rep['rows_added'] == 0           # replayed, deduped
+    assert store.stats()['rows_live'] == 6
+
+
+def test_fold_manifest_waits_out_torn_tail(tmp_path):
+    cache = FeatureCache(str(tmp_path / 'cache'))
+    store = _store(tmp_path, shard_rows=4)
+    _put_framewise(cache, tmp_path, 'k1', n=2, seed=20)
+    whole = open(cache.manifest_path, 'rb').read()
+    # a writer mid-append: the last line has no newline yet
+    torn_extra = b'{"op": "put", "key": "k2", "fi'
+    with open(cache.manifest_path, 'ab') as f:
+        f.write(torn_extra)
+    rep = fold_manifest(store, cache)
+    assert rep['rows_added'] == 2
+    # the cursor stopped at the last COMPLETE line, not EOF
+    assert store.cursor(CURSOR_SOURCE) == len(whole)
+
+
+def test_fold_put_skips_non_framewise_entries(tmp_path):
+    cache = FeatureCache(str(tmp_path / 'cache'))
+    store = _store(tmp_path, shard_rows=4)
+    src = tmp_path / 'solo.npy'
+    np.save(src, _rows(2, seed=30))
+    # no timestamps object, no feature_type meta: skipped, not an error
+    cache.put('packed1', {'flow': (str(src), '.npy')})
+    rep = fold_manifest(store, cache)
+    assert rep == {'rows_added': 0, 'rows_dropped': 0,
+                   'objects_skipped': 1,
+                   'bytes_folded': rep['bytes_folded']}
+    assert store.stats()['rows_live'] == 0
+    # dedupe seam: a key already indexed folds to (0, 0)
+    store.add_rows('resnet', _rows(1, seed=31), _metas(1, 'packed1'))
+    assert fold_put(store, cache, 'packed1', {}) == (0, 0)
+
+
+# -- coherence: eviction is never a search hit -------------------------------
+
+
+def test_evicted_object_never_a_search_hit(tmp_path):
+    """The regression the on_evict seam exists for: index a cache
+    object, LRU-evict it, and its rows must be gone BEFORE the next
+    query — tombstoned via the live hook, and the del-record replay
+    keeps an offline rebuild coherent too."""
+    from video_features_tpu.index.search import QueryEngine
+    cache = FeatureCache(str(tmp_path / 'cache'), max_bytes=400)
+    store = _store(tmp_path, shard_rows=4)
+    # the serve-side subscription, minus the server
+    cache.on_evict.append(lambda key, corrupt: store.drop_key(key))
+    _put_framewise(cache, tmp_path, 'kold', n=2, seed=40)
+    fold_manifest(store, cache)
+    engine = QueryEngine(store, aot_store=None, query_block=2, k_max=4)
+    probe = store.shard_views(store.group_for('resnet'))[0][0][0]
+    hits, _ = engine.search('resnet', probe, k=4)
+    assert hits[0][0]['key'] == 'kold'
+    assert hits[0][0]['score'] == pytest.approx(1.0, abs=1e-5)
+    # publish enough new bytes to LRU-evict kold inline
+    for i in range(4):
+        _put_framewise(cache, tmp_path, f'knew{i}', n=2, seed=41 + i)
+    assert not cache.contains('kold')
+    hits, _ = engine.search('resnet', probe, k=4)
+    assert all(h['key'] != 'kold' for h in hits[0]), hits[0]
+    # an offline rebuild from the same cache manifest agrees: the del
+    # records fold as tombstones
+    rebuilt = _store(tmp_path, name='rebuilt', shard_rows=4)
+    fold_manifest(rebuilt, cache)
+    assert 'kold' not in rebuilt.keys()
+
+
+def test_orphan_sweep_drops_unbacked_rows(tmp_path):
+    store = _store(tmp_path, shard_rows=4)
+    store.add_rows('resnet', _rows(3, seed=50), _metas(3, 'gone'))
+    store.add_rows('resnet', _rows(2, seed=51), _metas(2, 'kept'))
+    dropped = store.orphan_sweep(lambda key: key == 'kept')
+    assert dropped == 3
+    assert store.keys() == ['kept']
+    # a probing failure keeps the row (safe side; next sweep retries)
+    assert store.orphan_sweep(
+        lambda key: (_ for _ in ()).throw(OSError('nope'))) == 0
+    assert store.keys() == ['kept']
+
+
+def test_index_gc_exit_codes(tmp_path):
+    """The 0/1/2 contract shared with cache_gc/aot_gc: 2 usage, 1
+    orphans found (and dropped), 0 clean."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    tool = str(REPO_ROOT / 'tools' / 'index_gc.py')
+
+    def run(*args):
+        return subprocess.run([sys.executable, tool, *args],
+                              capture_output=True, text=True, env=env,
+                              cwd=str(REPO_ROOT), timeout=120)
+    # 2: not a directory
+    assert run('--cache-dir', str(tmp_path / 'nope')).returncode == 2
+    # seed an index whose rows point at keys an EMPTY cache denies
+    cache_dir = tmp_path / 'cache'
+    cache_dir.mkdir()
+    store = IndexStore(str(cache_dir / 'index'), shard_rows=4)
+    store.add_rows('resnet', _rows(5, seed=60), _metas(5, 'orphaned'))
+    # 1: the orphan sweep found (and dropped) rows
+    proc = run('--cache-dir', str(cache_dir), '--orphan-sweep')
+    assert proc.returncode == 1, proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep['orphans_dropped'] == 5 and rep['rows_live'] == 0
+    assert rep['compact']['rows_dropped'] == 5
+    # 0: clean on the second pass
+    proc = run('--cache-dir', str(cache_dir), '--orphan-sweep')
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)['orphans_dropped'] == 0
+
+
+# -- offline CLI --------------------------------------------------------------
+
+
+def test_index_cli_ingest_query_status(tmp_path, capsys):
+    """One in-process CLI pass composing ingest → query → status: the
+    offline surface answers the same exact top-k as the served one,
+    reporting on stdout as ONE machine-parseable JSON line."""
+    from video_features_tpu.index.cli import index_main
+    cache = FeatureCache(str(tmp_path / 'cache'))
+    _put_framewise(cache, tmp_path, 'kcli', n=3, seed=70)
+    q = tmp_path / 'q.npy'
+    np.save(q, np.load(tmp_path / 'src_kcli' / 'feat.npy')[1])
+    rc = index_main(['--cache-dir', str(tmp_path / 'cache'),
+                     '--shard-rows', '4', '--ingest',
+                     '--query', str(q), '--k', '2', '--status'])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1                     # exactly one report line
+    rep = json.loads(out[0])
+    assert rep['ingest']['rows_added'] == 3
+    assert rep['index']['rows_live'] == 3
+    # family auto-picked (the index holds exactly one); the query row
+    # retrieves itself at rank 1 with cosine 1.0
+    top = rep['query']['hits'][0]
+    assert rep['query']['family'] == 'resnet'
+    assert top['key'] == 'kcli' and top['t_ms'] == 1000
+    assert top['score'] == pytest.approx(1.0, abs=1e-5)
+    # a failed query reports ok=false and exits 1
+    rc = index_main(['--cache-dir', str(tmp_path / 'cache'),
+                     '--query', str(q), '--family', 'nosuch'])
+    assert rc == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep['ok'] is False and 'nosuch' in rep['error']
+
+
+# -- locks: the pinned index surface -----------------------------------------
+
+
+def test_locks_pin_the_index_surface():
+    """Re-pin coverage: the wire lock carries the v1.3 additive surface
+    and the programs lock pins the ``index`` pseudo-family at BOTH mesh
+    widths with the canonical geometry (the deep drift/rule gates live
+    in test_wire.py / test_programs.py — this names the index rows)."""
+    from video_features_tpu.index.search import (
+        INDEX_DIM, INDEX_K, INDEX_QUERIES, INDEX_ROWS,
+    )
+    wire = json.loads((REPO_ROOT / 'WIRE.lock.json').read_text())
+    assert wire['version'] == '1.3'
+    assert 'search' in wire['commands'] and 'index_status' in wire['commands']
+    assert 'POST /v1/search' in wire['routes']
+    assert wire['routes']['POST /v1/search']['auth']
+
+    lock = json.loads((REPO_ROOT / 'PROGRAMS.lock.json').read_text())
+    idx = lock['families']['index']
+    assert set(idx) == {'mesh1', 'mesh2'}
+    for mesh in ('mesh1', 'mesh2'):
+        topk = idx[mesh]['programs']['topk']
+        assert topk['batch']['shape'] == [INDEX_ROWS, INDEX_DIM]
+        assert [o['shape'] for o in topk['out']] == \
+            [[INDEX_QUERIES, INDEX_K]] * 2
+        assert [o['dtype'] for o in topk['out']] == ['float32', 'int32']
+
+
+# -- slow lane: the served index end-to-end (+ zero cold start) ---------------
+
+
+@pytest.mark.slow
+def test_serve_search_e2e_two_boots_compile_free(tmp_path):
+    """Acceptance run: fused extract publishes both families, ingest
+    reaches lag 0, query-by-video answers the source video's own
+    windows as top hits — byte-identical across two boots against one
+    executable store, with the SECOND boot's index program loaded, not
+    compiled (``serve_prewarm: [index]`` + ``aot_enabled``)."""
+    import time
+
+    from tools.make_sample_video import write_noise_clip
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+    clip = str(write_noise_clip(tmp_path / 'e2e.mp4', 9, seed=0))
+    base = {
+        'device': 'cpu', 'model_name': 'resnet18', 'batch_size': 4,
+        'allow_random_weights': True, 'on_extraction': 'save_numpy',
+        'tmp_path': str(tmp_path / 'tmp'),
+        'output_path': str(tmp_path / 'out'),
+        'cache_enabled': True, 'cache_dir': str(tmp_path / 'cache'),
+        'index_enabled': True,
+        'aot_enabled': True, 'aot_dir': str(tmp_path / 'aot'),
+    }
+
+    def boot_and_search(submit_first):
+        srv = ExtractionServer(base_overrides=dict(base), queue_depth=16,
+                               pool_size=2).start()
+        try:
+            rep = srv.prewarm(['index'])
+            assert not rep['errors'], rep
+            client = ServeClient(port=srv.port)
+            if submit_first:
+                rid = client.submit(None, [clip],
+                                    features=['resnet', 'clip'],
+                                    overrides={'clip.model_name':
+                                               'ViT-B/32'})
+                assert client.wait(rid, timeout_s=600)['state'] == 'done'
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                idx = client.index_status()
+                if idx['rows_live'] > 0 and idx['ingest_lag_bytes'] == 0 \
+                        and set(idx['families']) == {'resnet', 'clip'}:
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError(f'ingest never converged: {idx}')
+            res = client.search(video_path=clip,
+                                features=['resnet', 'clip'], k=3)
+            return res, idx
+        finally:
+            srv.drain(wait=True, grace_s=120)
+    first, idx1 = boot_and_search(submit_first=True)
+    second, idx2 = boot_and_search(submit_first=False)
+    for res, idx in ((first, idx1), (second, idx2)):
+        assert res['ok'] and not res.get('errors')
+        for fam in ('resnet', 'clip'):
+            top = res['results'][fam][0]
+            assert top['video_sha256'] == res['video_sha256'], fam
+            assert top['score'] > 0.999, (fam, top)
+    # byte-identical answers across boots (drop the timing field)
+    def canon(res):
+        res = dict(res)
+        res.pop('wall_s', None)        # timing, and the per-boot request
+        res.pop('request_id', None)    # counter; hits are pure identity
+        return json.dumps(res, sort_keys=True)
+    assert canon(first) == canon(second)
+    # zero cold start for the query program: boot 1 compiled + published
+    # it, boot 2 loaded it
+    assert idx1['programs_compiled'] >= 1
+    assert idx2['programs_loaded'] >= 1 and idx2['programs_compiled'] == 0

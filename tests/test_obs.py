@@ -558,6 +558,10 @@ METRICS_DOC_KEYS = {'uptime_s', 'queue', 'warm_pool', 'cache', 'farm',
                     # the zero-cold-start audit pair (all-zero without
                     # aot_enabled)
                     'aot',
+                    # sharded feature index (index/): rows/shards/
+                    # ingest-lag + query counters, {'enabled': False}
+                    # without index_enabled
+                    'index',
                     # network front door (ingress/): per-tenant view,
                     # {'enabled': False, ...} on loopback-only servers
                     'ingress',
@@ -571,7 +575,7 @@ TRACE_EVENT_KEYS = {'name', 'ph', 'ts', 'dur', 'pid', 'tid', 'args', 's'}
 MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
                  'config', 'fingerprints', 'videos', 'outcomes', 'stages',
                  'compile', 'executables', 'farm', 'mesh', 'ingress',
-                 'programs_lock', 'aot'}
+                 'programs_lock', 'aot', 'index'}
 
 
 CANONICAL_STAGES = {'decode', 'decode+preprocess', 'audio_dsp',

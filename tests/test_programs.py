@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from video_features_tpu.analysis.core import EXIT_CLEAN, EXIT_FINDINGS
 from video_features_tpu.analysis.programs import (
-    FAMILIES, ProgramSpec, build_family, check_program, collect,
+    ALL_PINNED, FAMILIES, ProgramSpec, build_family, check_program, collect,
     default_lock_path, diff_lock, family_lock_hashes, lane_families,
     load_lock, main, mesh_key, parse_mesh_key, program_signature,
     write_lock,
@@ -293,12 +293,14 @@ def test_cli_exit_0_clean_and_2_on_drift(tmp_path, capsys):
 # -- the live-tree gate vs the SHIPPED lock ----------------------------------
 
 def test_shipped_lock_covers_all_families_at_both_widths():
-    """Every family pins both mesh widths on the float32 lane, and every
-    bf16-accepting family (registry.BF16_FEATURES) ADDITIONALLY pins
-    both widths of its mesh<n>@bfloat16 fast-lane variants — a refusing
-    family (i3d, raft) must have none."""
+    """Every pinned family — the model families plus the extra shipped
+    programs (the feature index's query program) — pins both mesh widths
+    on the float32 lane, and every bf16-accepting family
+    (registry.BF16_FEATURES) ADDITIONALLY pins both widths of its
+    mesh<n>@bfloat16 fast-lane variants — a refusing family (i3d, raft)
+    must have none."""
     doc = load_lock(default_lock_path())
-    assert set(doc['families']) == set(FAMILIES)
+    assert set(doc['families']) == set(ALL_PINNED)
     for family, entry in doc['families'].items():
         want = {'mesh1', 'mesh2'}
         if family in BF16_FEATURES:

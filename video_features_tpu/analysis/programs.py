@@ -64,6 +64,12 @@ DEFAULT_LOCK = 'PROGRAMS.lock.json'           # repo-root, committed
 # (config.py), not a second hand-synced list: a new family is a lock
 # gap (and a checker 'coverage' finding) the day it lands
 FAMILIES = tuple(KNOWN_FEATURE_TYPES)
+# non-extractor program providers the lock ALSO pins: the feature
+# index's query program is a shipped compiled program like any step
+# function, so it rides the same gate (float32 lane only — it is not a
+# feature family and never joins registry.BF16_FEATURES)
+EXTRA_PROGRAMS = ('index',)
+ALL_PINNED = FAMILIES + EXTRA_PROGRAMS
 MESH_WIDTHS = (1, 2)
 
 # compute_dtype lanes the lock pins per family: 'float32' entries keep
@@ -143,6 +149,12 @@ def build_family(feature_type: str, compute_dtype: str = 'float32'):
     lane's extractor: bf16 params from the transplant cast, bf16
     activations — whose lowering the mesh<n>@bfloat16 lock variants
     pin)."""
+    if feature_type == 'index':
+        # the feature index's query program: no extractor, no weights —
+        # the provider lowers the SAME jitted callable the serve query
+        # path dispatches, at the canonical lock geometry
+        from video_features_tpu.index.search import IndexPrograms
+        return IndexPrograms()
     from video_features_tpu.config import load_config
     from video_features_tpu.registry import create_extractor
     overrides = dict(_BASE_OVERRIDES)
@@ -495,7 +507,7 @@ def write_lock(path, live: Dict[str, Any], *,
     doc = load_lock(path)
     families = dict(doc.get('families', {}))
     if prune_families:
-        families = {k: v for k, v in families.items() if k in FAMILIES}
+        families = {k: v for k, v in families.items() if k in ALL_PINNED}
     for family, fam_doc in live.items():
         if replace_widths:
             families[family] = {k: fam_doc[k] for k in sorted(fam_doc)}
@@ -564,7 +576,7 @@ def diff_lock(live: Dict[str, Any], lock: Dict[str, Any],
         return {mesh_key(w, lane) for w in widths for lane in lanes
                 if family in lane_families(lane, (family,))}
     for family in sorted(locked):
-        if family not in FAMILIES:
+        if family not in ALL_PINNED:
             findings.append(Finding(
                 'lock-drift', family, 0, '-',
                 f'lock names unknown family {family!r} — stale entry '
@@ -622,7 +634,7 @@ def main(argv=None) -> int:
         description='abstract-interpretation contract checker over every '
                     'compiled JAX program (docs/static_analysis.md)')
     parser.add_argument('--families', help='comma-separated subset '
-                        f'(default: all — {",".join(FAMILIES)})')
+                        f'(default: all — {",".join(ALL_PINNED)})')
     parser.add_argument('--mesh-widths', default='1,2',
                         help='comma-separated mesh widths to pin '
                         '(default: 1,2 — width 2 needs '
@@ -645,11 +657,11 @@ def main(argv=None) -> int:
         return EXIT_CLEAN
 
     families = tuple(args.families.split(',')) if args.families \
-        else FAMILIES
-    unknown = [f for f in families if f not in FAMILIES]
+        else ALL_PINNED
+    unknown = [f for f in families if f not in ALL_PINNED]
     if unknown:
         print(f'vft-programs: unknown families {unknown} '
-              f'(known: {", ".join(FAMILIES)})', file=sys.stderr)
+              f'(known: {", ".join(ALL_PINNED)})', file=sys.stderr)
         return EXIT_ERROR
     widths = tuple(int(w) for w in args.mesh_widths.split(','))
     lanes = tuple(args.lanes.split(','))
@@ -680,7 +692,7 @@ def main(argv=None) -> int:
 
     if args.write_lock:
         write_lock(lock_path, live,
-                   prune_families=set(families) == set(FAMILIES),
+                   prune_families=set(families) == set(ALL_PINNED),
                    replace_widths=(set(widths) == set(MESH_WIDTHS)
                                    and set(lanes) == set(LANES)))
         n = sum(len(e.get('programs', {}))

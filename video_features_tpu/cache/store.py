@@ -42,7 +42,7 @@ import threading
 import time
 from hashlib import sha256
 from pathlib import Path
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from video_features_tpu.utils.output import (
     atomic_write, make_path, write_fingerprint,
@@ -109,6 +109,14 @@ class FeatureCache:
         self.evictions = 0
         self.corrupt_evicted = 0
         self.bytes_saved = 0
+        # eviction subscribers: ``fn(key, corrupt)`` fires for EVERY
+        # entry leaving the store (LRU pressure, corrupt eviction,
+        # offline GC) — the seam the feature index uses to tombstone
+        # rows whose backing object is gone. Callbacks run under the
+        # store lock (the del record and the notification must be one
+        # atomic fact), so they must stay cheap and must not call back
+        # into this cache.
+        self.on_evict: List[Callable[[str, bool], None]] = []
         os.makedirs(os.path.join(self.cache_dir, OBJECTS), exist_ok=True)
         self._load_manifest()
 
@@ -303,6 +311,11 @@ class FeatureCache:
             self.corrupt_evicted += 1
         else:
             self.evictions += 1
+        for fn in list(self.on_evict):
+            try:
+                fn(key, bool(corrupt))
+            except Exception:
+                log_cache_error(f'on_evict callback for {key}')
         return entry['bytes']
 
     # -- garbage collection --------------------------------------------------

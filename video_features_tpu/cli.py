@@ -24,11 +24,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         # requests arrive over a local socket and pack into shared batches
         from video_features_tpu.serve.server import serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == 'index':
+        # offline feature-index surface (index/): fold the cache
+        # manifest and run exact top-k queries without a resident server
+        from video_features_tpu.index.cli import index_main
+        return index_main(argv[1:])
     cli_args = parse_dotlist(argv)
     if 'feature_type' not in cli_args and 'features' not in cli_args:
         print('Usage: python -m video_features_tpu feature_type=<name> [key=value ...]\n'
               '       python -m video_features_tpu features=[f1,f2,...] [key=value ...]\n'
-              '       python -m video_features_tpu serve [serve_port=N ...]')
+              '       python -m video_features_tpu serve [serve_port=N ...]\n'
+              '       python -m video_features_tpu index --cache-dir DIR '
+              '[--ingest] [--query vec.npy --family f]')
         return 2
     # single source of truth: multihost must come from the CLI because the
     # runtime must initialize before anything probes jax devices

@@ -124,6 +124,35 @@ AOT_DEFAULTS: Dict[str, Any] = {
     'aot_max_bytes': None,
 }
 
+# -- feature index (index/; docs/feature_index.md) ---------------------------
+# Same injection policy as CACHE_DEFAULTS: one source of truth, older
+# user YAMLs pick the knobs up automatically, CLI dotlist wins.
+INDEX_DEFAULTS: Dict[str, Any] = {
+    # serve-side feature index: an ingest worker tails the cache
+    # manifest and folds every published framewise feature object into
+    # searchable embedding shards (POST /v1/search, loopback 'search').
+    # Requires cache_enabled. Off by default — today's behavior exactly.
+    'index_enabled': False,
+    # where shards + row manifest live; null = <cache_dir>/index (beside
+    # the objects the rows point into, outside objects/ so cache GC's
+    # orphan sweep never touches it)
+    'index_dir': None,
+    # shard-file row bound: every shard pads to exactly this many rows
+    # at query time, so the AOT store holds ONE query executable per
+    # embedding dim regardless of corpus size
+    'index_shard_rows': 1024,
+    # ingest-poll cadence (seconds) when the cursor has caught up with
+    # the cache manifest; behind, the worker re-polls immediately
+    'index_poll_s': 0.5,
+    # query-batch quantization: query vectors pad to multiples of this,
+    # bounding executable geometries on the query side like
+    # index_shard_rows does on the shard side
+    'index_query_block': 8,
+    # the STATIC k the query program compiles with (lax.top_k); requests
+    # asking for less get a slice, more is clamped
+    'index_k_max': 10,
+}
+
 # -- flight recorder (obs/; docs/observability.md) ---------------------------
 # Same injection policy as CACHE_DEFAULTS: one source of truth, older
 # user YAMLs pick the knobs up automatically, CLI dotlist wins.
@@ -275,6 +304,17 @@ KNOB_CLASSIFICATION: Dict[str, str] = {
     'aot_enabled': 'pool_only',
     'aot_dir': 'pool_only',
     'aot_max_bytes': 'pool_only',
+    # feature index (index/): a serving-side consumer of ALREADY
+    # published cache objects — ingest and query never touch what an
+    # extractor computes, and no worker binds to these knobs at build
+    # time (the IndexService reads them once at boot), so they fragment
+    # neither the cache key space nor the warm pool
+    'index_enabled': 'neither',
+    'index_dir': 'neither',
+    'index_shard_rows': 'neither',
+    'index_poll_s': 'neither',
+    'index_query_block': 'neither',
+    'index_k_max': 'neither',
     # covered by the weights fingerprint (checkpoint CONTENT is hashed)
     'allow_random_weights': 'pool_only',
     # serve-side per-request plumbing
@@ -381,6 +421,8 @@ def load_config(
     for key, value in CACHE_DEFAULTS.items():
         args.setdefault(key, value)
     for key, value in AOT_DEFAULTS.items():
+        args.setdefault(key, value)
+    for key, value in INDEX_DEFAULTS.items():
         args.setdefault(key, value)
     for key, value in OBS_DEFAULTS.items():
         args.setdefault(key, value)
@@ -589,6 +631,30 @@ def sanity_check(args: Config) -> None:
         if args['aot_max_bytes'] < 0:
             raise ValueError('aot_max_bytes must be >= 0 or null; '
                              f'got {args["aot_max_bytes"]}')
+
+    # feature-index knobs (index/): the ingest worker tails the CACHE
+    # manifest, so the index requires the cache; geometry knobs must be
+    # positive ints (they size compiled programs). ValueError, not
+    # assert — survives `python -O`.
+    if args.get('index_enabled'):
+        if not args.get('cache_enabled'):
+            raise ValueError('index_enabled=true requires '
+                             'cache_enabled=true — the index ingests '
+                             'published cache objects '
+                             '(see docs/feature_index.md)')
+    if args.get('index_dir') is not None:
+        args['index_dir'] = str(args['index_dir'])
+    for key in ('index_shard_rows', 'index_query_block', 'index_k_max'):
+        if args.get(key) is not None:
+            args[key] = int(args[key])
+            if args[key] < 1:
+                raise ValueError(f'{key} must be >= 1; got {args[key]}')
+    if args.get('index_poll_s') is not None:
+        args['index_poll_s'] = float(args['index_poll_s'])
+        if args['index_poll_s'] <= 0:
+            raise ValueError('index_poll_s must be > 0 (seconds between '
+                             'ingest polls when caught up); got '
+                             f'{args["index_poll_s"]}')
 
     # device-loop pipelining: the in-flight depth must be a positive int
     # (1 = synchronous; each extra unit pins one more output batch on
@@ -840,10 +906,14 @@ def split_serve_config(cli_args: Dict[str, Any]) -> Tuple[Config, Config]:
         from video_features_tpu.registry import PACKED_FEATURES
         for spec in specs:
             family = spec.split('@', 1)[0]
+            # 'index' is the one non-extractor spec: it warms the
+            # feature index's query program instead of a pool entry
+            if family == 'index':
+                continue
             if family not in PACKED_FEATURES:
                 raise ValueError(
                     f'serve_prewarm names unknown or unserveable family '
-                    f'{family!r} (serveable: '
+                    f'{family!r} (serveable: index, '
                     f'{", ".join(sorted(PACKED_FEATURES))})')
         serve['serve_prewarm'] = specs
     serve['serve_batch_shed_fraction'] = \

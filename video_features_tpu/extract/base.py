@@ -807,12 +807,17 @@ class BaseExtractor:
                  for key in self._saved_feat_keys()}
         if not all(os.path.exists(src) for src, _ in files.values()):
             return                       # partial save (failed video): skip
-        from video_features_tpu.cache import log_cache_error
+        from video_features_tpu.cache import hash_file, log_cache_error
         try:
+            # the video CONTENT hash (memoized — the cache key derivation
+            # already paid for it) rides in the meta so downstream
+            # consumers (the feature index) can group rows by source
+            # video without re-reading it
             self.cache.put(self._video_cache_key(video_path, segment),
                            files,
                            meta={'video': Path(name).name,
-                                 'feature_type': self.feature_type})
+                                 'feature_type': self.feature_type,
+                                 'video_sha256': hash_file(video_path)})
         except Exception:
             log_cache_error(f'publish for {video_path}')
 

@@ -1,0 +1,17 @@
+"""Feature index: sharded exact nearest-neighbor search over every
+extracted embedding.
+
+The cache (``cache/``) makes extraction idempotent; the index makes it
+*searchable*. An ingest worker tails the cache's append-only manifest
+and folds every published framewise feature object into per-(family,
+dim) embedding shards (:mod:`.shards` — bounded, atomically rewritten,
+delete-on-evict coherent with cache GC via the store's ``on_evict``
+seam). Queries run the one packed top-k program in :mod:`.search`
+(batched matmul + ``lax.top_k`` over data-sharded shards, pinned in
+``PROGRAMS.lock.json`` and served from the persistent executable store
+so a warm boot answers its first query compile-free). :mod:`.service`
+is the serving surface behind the loopback ``search``/``index_status``
+commands and ``POST /v1/search``; ``tools/index_gc.py`` is the offline
+maintenance surface and docs/feature_index.md the operator guide.
+"""
+from video_features_tpu.index.shards import IndexStore  # noqa: F401

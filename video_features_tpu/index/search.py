@@ -1,0 +1,257 @@
+"""The exact top-k query program + its runtime dispatch.
+
+One program serves every query: ``topk(shard, queries, row_mask)`` —
+a batched matmul of L2-normalized queries against one data-sharded
+shard block, tombstones masked to ``-inf``, then ``lax.top_k``. Exact
+search, by construction: recall@k is 1.0 and the bench rung that
+reports it is a self-check, not a tuning knob.
+
+The program is a first-class citizen of both contract gates:
+
+  * ``analysis/programs.py`` pins it in PROGRAMS.lock.json under the
+    pseudo-family ``index`` at the CANONICAL geometry below, checked at
+    mesh widths {1, 2} like every extractor program (no f64, leading
+    batch axis divisible by the mesh, const budget);
+  * the serve runtime reaches it only through ``aot.ensure_program``,
+    so a warm boot loads the persisted executable and answers its
+    first query compile-free (``serve_prewarm: [index]``).
+
+Runtime geometries are quantized so the executable store stays small:
+every shard is padded to ``shard_rows`` rows (mask 0 on padding) and
+queries to ``query_block`` — one executable per (shard_rows, dim,
+query_block, k), regardless of corpus size. ``k`` is static (the lock
+pins ``K``); callers asking for less get a slice of the top-K.
+
+jax is imported lazily — ``index.shards`` and the offline GC tool must
+import without it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# canonical lock geometry: what PROGRAMS.lock.json pins and what
+# serve_prewarm warms. 1024 x 512 is one full shard of clip-sized
+# embeddings; 8 queries is the query_block default; K=10 feeds the
+# recall@10 bench rung.
+INDEX_ROWS = 1024
+INDEX_DIM = 512
+INDEX_QUERIES = 8
+INDEX_K = 10
+
+_jit_lock = threading.Lock()
+_jitted = None
+
+
+def _topk_impl(shard, queries, row_mask, *, k: int):
+    import jax
+    import jax.numpy as jnp
+    # scores are cosine similarities (both sides L2-normalized at
+    # ingest/query time); dead + padding rows drop to -inf so they can
+    # never crack the top-k
+    scores = queries @ shard.T
+    scores = jnp.where(row_mask[None, :] > 0, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def topk_jitted():
+    """The one jitted query callable (``k`` static) — the SAME object
+    feeds the lock check, the AOT store, and the jit fallback, so the
+    pinned StableHLO is the lowering of the real dispatch target."""
+    global _jitted
+    with _jit_lock:
+        if _jitted is None:
+            import jax
+            _jitted = jax.jit(_topk_impl, static_argnames=('k',))
+        return _jitted
+
+
+class IndexPrograms:
+    """``program_specs`` provider for the ``index`` pseudo-family —
+    the same shape ``analysis/programs.py`` collects from extractors."""
+
+    feature_type = 'index'
+
+    def __init__(self, rows: int = INDEX_ROWS, dim: int = INDEX_DIM,
+                 queries: int = INDEX_QUERIES, k: int = INDEX_K) -> None:
+        self.rows, self.dim = int(rows), int(dim)
+        self.queries, self.k = int(queries), int(k)
+
+    def abstract_args(self, mesh=None) -> Tuple[Any, Any, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        from video_features_tpu.parallel.mesh import (
+            batch_sharding, replicated,
+        )
+        batch = batch_sharding(mesh) if mesh is not None else None
+        repl = replicated(mesh) if mesh is not None else None
+        shard = jax.ShapeDtypeStruct((self.rows, self.dim), jnp.float32,
+                                     sharding=batch)
+        queries = jax.ShapeDtypeStruct((self.queries, self.dim),
+                                       jnp.float32, sharding=repl)
+        mask = jax.ShapeDtypeStruct((self.rows,), jnp.float32,
+                                    sharding=batch)
+        return shard, queries, mask
+
+    def program_specs(self, mesh=None) -> List[Any]:
+        from video_features_tpu.analysis.programs import ProgramSpec
+        return [ProgramSpec('topk', topk_jitted(),
+                            self.abstract_args(mesh=mesh),
+                            kwargs=dict(k=self.k), batch_argnum=0)]
+
+
+class QueryEngine:
+    """Runtime dispatch: pad to the quantized geometry, run the program
+    (AOT-resident when a store is given, jit otherwise), merge per-query
+    hits across shards on the host."""
+
+    def __init__(self, store, aot_store=None,
+                 query_block: int = INDEX_QUERIES,
+                 k_max: int = INDEX_K) -> None:
+        self.store = store                      # IndexStore
+        self.aot_store = aot_store              # aot.store.ExecStore | None
+        self.query_block = max(1, int(query_block))
+        self.k_max = max(1, int(k_max))
+        self._lock = threading.Lock()
+        self._programs: Dict[Tuple[int, int, int, int], Any] = {}
+        self.programs_loaded = 0
+        self.programs_compiled = 0
+        self.queries_total = 0
+
+    # -- program residency ---------------------------------------------------
+
+    def _program(self, rows: int, dim: int, k: int):
+        """The resident callable for one (rows, dim, query_block, k)
+        geometry; None means 'call the jitted fallback'."""
+        if self.aot_store is None:
+            return None
+        geom = (rows, dim, self.query_block, k)
+        with self._lock:
+            prog = self._programs.get(geom)
+        if prog is not None:
+            return prog
+        import jax.numpy as jnp
+
+        import jax
+
+        from video_features_tpu.aot.runtime import ensure_program
+        args = (jax.ShapeDtypeStruct((rows, dim), jnp.float32),
+                jax.ShapeDtypeStruct((self.query_block, dim), jnp.float32),
+                jax.ShapeDtypeStruct((rows,), jnp.float32))
+        prog, path = ensure_program(
+            self.aot_store, f'topk_{rows}x{dim}q{self.query_block}'
+            f'k{k}', topk_jitted(), args,
+            statics={'k': k}, lane='float32',
+            feature_type='index')
+        with self._lock:
+            self._programs[geom] = prog
+            if path == 'loaded':
+                self.programs_loaded += 1
+            else:
+                self.programs_compiled += 1
+        return prog
+
+    def prewarm(self, rows: int = INDEX_ROWS, dim: int = INDEX_DIM) -> str:
+        """Make the canonical-geometry executable resident (load or
+        compile+publish); returns 'loaded' | 'compiled' | 'jit'."""
+        if self.aot_store is None:
+            topk_jitted()                        # at least build the jit
+            return 'jit'
+        before = self.programs_loaded
+        self._program(rows, dim, self.k_max)
+        return 'loaded' if self.programs_loaded > before else 'compiled'
+
+    # -- queries -------------------------------------------------------------
+
+    def _run(self, shard: np.ndarray, queries: np.ndarray,
+             mask: np.ndarray, k: int):
+        # k is clamped by the caller to the padded row count — top_k
+        # cannot ask for more rows than the shard block holds
+        prog = self._program(shard.shape[0], shard.shape[1], k)
+        if prog is not None:
+            values, idx = prog(shard, queries, mask)
+        else:
+            values, idx = topk_jitted()(shard, queries, mask, k=k)
+        return np.asarray(values), np.asarray(idx)
+
+    def search(self, family: str, queries: np.ndarray, k: int,
+               dim: Optional[int] = None,
+               ) -> Tuple[List[List[Dict[str, Any]]], float]:
+        """Exact top-k for each query vector against one family's
+        shards. Returns (per-query hit lists, wall seconds); each hit
+        is ``{score, video, video_sha256, t_ms, key, family}``. Raises
+        ValueError when the family has no (unambiguous) shard group or
+        the query dim doesn't match."""
+        t0 = time.perf_counter()
+        queries = np.asarray(queries, dtype=np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.ndim != 2 or not queries.shape[0]:
+            raise ValueError(f'expected (n, dim) queries, '
+                             f'got shape {queries.shape}')
+        gkey = self.store.group_for(family, dim=dim)
+        if gkey is None:
+            dims = sorted(g[1] for g in getattr(self.store, '_groups', {})
+                          if g[0] == family)
+            raise ValueError(
+                f'no indexed shards for family {family!r}'
+                + (f' (ambiguous dims {dims}; pass dim=)' if len(dims) > 1
+                   else ''))
+        if queries.shape[1] != gkey[1]:
+            raise ValueError(f'query dim {queries.shape[1]} != indexed '
+                             f'dim {gkey[1]} for family {family!r}')
+        k = max(1, min(int(k), self.k_max))
+        rows_pad = max(self.store.shard_rows, 1)
+        k_run = min(self.k_max, rows_pad)
+        # normalize queries so scores are cosine similarities
+        norms = np.linalg.norm(queries, axis=1, keepdims=True)
+        queries = queries / np.maximum(norms, 1e-12)
+
+        n_real = queries.shape[0]
+        hits: List[List[Dict[str, Any]]] = [[] for _ in range(n_real)]
+        views = self.store.shard_views(gkey)
+        for q0 in range(0, n_real, self.query_block):
+            qblock = queries[q0:q0 + self.query_block]
+            q_pad = np.zeros((self.query_block, gkey[1]), dtype=np.float32)
+            q_pad[:qblock.shape[0]] = qblock
+            for arr, mask, metas in views:
+                if arr.shape[0] == 0:
+                    continue
+                shard_pad = np.zeros((rows_pad, gkey[1]), dtype=np.float32)
+                shard_pad[:arr.shape[0]] = arr
+                mask_pad = np.zeros((rows_pad,), dtype=np.float32)
+                mask_pad[:mask.shape[0]] = mask
+                values, idx = self._run(shard_pad, q_pad, mask_pad, k_run)
+                for qi in range(qblock.shape[0]):
+                    for score, row_j in zip(values[qi], idx[qi]):
+                        if not np.isfinite(score):
+                            continue
+                        meta = metas[row_j] if row_j < len(metas) else None
+                        if meta is None:
+                            continue
+                        hits[q0 + qi].append(
+                            {'score': float(score), 'family': family,
+                             **meta})
+        for lst in hits:
+            lst.sort(key=lambda h: -h['score'])
+            del lst[k:]
+        self.queries_total += n_real
+        return hits, time.perf_counter() - t0
+
+    @staticmethod
+    def merge_hits(per_query: List[List[Dict[str, Any]]],
+                   k: int) -> List[Dict[str, Any]]:
+        """Fold per-query hit lists into one ranking: max score per
+        distinct (key, t_ms) row — the query-by-video response shape."""
+        best: Dict[Tuple[Any, Any], Dict[str, Any]] = {}
+        for lst in per_query:
+            for h in lst:
+                ident = (h.get('key'), h.get('t_ms'))
+                if ident not in best or h['score'] > best[ident]['score']:
+                    best[ident] = h
+        out = sorted(best.values(), key=lambda h: -h['score'])
+        return out[:max(1, int(k))]

@@ -16,6 +16,13 @@ Endpoints (all JSON unless noted; auth via ``Authorization: Bearer
     plus a per-family ``requests`` id map (and ``errors`` for families
     rejected mid-fan-out); status on the umbrella id nests per-family
     ``videos``. One quota unit per fused request, not per family.
+  * ``POST /v1/search``          — query the feature index (wire v1.3;
+    requires ``index_enabled``). By vector: ``{family, vector: [..],
+    k?}`` → ``{hits: [..]}``; by video: ``{video_path, features: [..],
+    k?, timeout_s?}`` (extracts through the fused path, waits for
+    ingest, queries with the video's own windows) → ``{results:
+    {family: [hits]}}``. Quota-gated like extract; the query holds its
+    tenant's concurrency unit only while it runs.
   * ``GET  /v1/requests/<id>``   — request status (tenant-scoped)
   * ``GET  /v1/requests/<id>/trace`` — the request's assembled span
     timeline (tenant-scoped: ANOTHER tenant's id answers 403 — the
@@ -75,6 +82,8 @@ _EXTRACT_FIELDS = frozenset({'feature_type', 'video_paths', 'overrides',
                              'timeout_s', 'range', 'priority', 'features'})
 _LIVE_FIELDS = frozenset({'feature_type', 'fps', 'overrides', 'timeout_s',
                           'priority'})
+_SEARCH_FIELDS = frozenset({'family', 'vector', 'video_path', 'features',
+                            'k', 'timeout_s', 'priority'})
 
 # W3C Trace Context request header (lowercased by the header parser)
 _TRACEPARENT_HEADER = 'traceparent'
@@ -349,7 +358,8 @@ class IngressGateway:
         whose series are never evicted, so an unauthenticated port sweep
         over arbitrary paths must not mint a series per path."""
         p = req.path
-        if p in ('/healthz', '/metrics', '/v1/metrics', '/v1/extract'):
+        if p in ('/healthz', '/metrics', '/v1/metrics', '/v1/extract',
+                 '/v1/search'):
             return p
         if p.startswith('/v1/requests/'):
             return ('/v1/requests/trace' if p.endswith('/trace')
@@ -373,6 +383,8 @@ class IngressGateway:
             return OK, None
         if path == '/v1/extract' and method == 'POST':
             return self._handle_extract(req, resp, tenant)
+        if path == '/v1/search' and method == 'POST':
+            return self._handle_search(req, resp, tenant)
         if path.startswith('/v1/requests/') and path.endswith('/trace') \
                 and method == 'GET':
             return self._handle_trace(req, resp, tenant)
@@ -469,6 +481,53 @@ class IngressGateway:
             if k in result:
                 out[k] = result[k]
         resp.send_json(OK, out)
+        return OK, rid
+
+    def _handle_search(self, req: HttpRequest, resp: ResponseWriter,
+                       tenant: Tenant) -> Tuple[int, Optional[str]]:
+        """``POST /v1/search`` — the feature-index query surface.
+        Same admission layering as extract (auth happened upstream;
+        priority cap, then quota) but the concurrency unit is held only
+        for the synchronous query, released in ``finally`` — there is
+        no completion listener to wait on."""
+        body = req.json_body(self.max_body_bytes)
+        unknown = set(body) - _SEARCH_FIELDS
+        if unknown:
+            raise HttpError(BAD_REQUEST, 'bad_request',
+                            f'unknown fields: {sorted(unknown)}')
+        svc = self.server.index_service
+        if svc is None:
+            # shed before admission: a disabled index never spends a
+            # quota unit
+            raise HttpError(SERVICE_UNAVAILABLE, 'index_disabled',
+                            'the feature index is not enabled on this '
+                            'server (index_enabled=true)',
+                            tenant=tenant.name)
+        priority = self._resolve_priority(body, tenant)
+        self._check_quota(tenant, priority)
+        try:
+            if body.get('video_path') is not None:
+                result = svc.search_by_video(
+                    body['video_path'], features=body.get('features'),
+                    k=int(body.get('k', 10)),
+                    timeout_s=body.get('timeout_s'), priority=priority,
+                    traceparent=req.headers.get(_TRACEPARENT_HEADER))
+            else:
+                result = svc.search_vector(
+                    body.get('family'), body.get('vector'),
+                    k=int(body.get('k', 10)))
+        except (TypeError, ValueError, KeyError) as e:
+            raise HttpError(BAD_REQUEST, 'bad_request',
+                            f'search failed: {e}', tenant=tenant.name)
+        finally:
+            self.quota.release(tenant.name)
+        rid = result.get('request_id')
+        if not result.get('ok'):
+            raise HttpError(BAD_REQUEST, 'search_failed',
+                            str(result.get('error', 'search failed')),
+                            tenant=tenant.name, request_id=rid)
+        result.pop('ok', None)
+        resp.send_json(OK, {'ok': True, 'tenant': tenant.name, **result})
         return OK, rid
 
     def _handle_trace(self, req: HttpRequest, resp: ResponseWriter,

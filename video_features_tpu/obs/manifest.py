@@ -127,6 +127,7 @@ class RunManifest:
         self.ingress: Dict[str, Any] = {}
         self.programs_lock: Dict[str, Any] = {}
         self.aot: Dict[str, Any] = {}
+        self.index: Dict[str, Any] = {}
         self._compile0 = _compile_snapshot()
         _install_compile_listener()
 
@@ -219,6 +220,16 @@ class RunManifest:
         with self._lock:
             self.aot.update({k: _jsonable(v) for k, v in info.items()})
 
+    def note_index(self, info: Dict[str, Any]) -> None:
+        """Record the feature-index view of a run (``IndexService.stats``
+        / ``IndexStore.stats``: rows, shards, ingest lag, query-program
+        path) — written by runs that build or query the sharded
+        embedding index (the offline ``index`` CLI, the index smoke);
+        the section stays ``{}`` otherwise. Later notes merge over
+        earlier ones."""
+        with self._lock:
+            self.index.update({k: _jsonable(v) for k, v in info.items()})
+
     def note_mesh(self, info: Dict[str, Any]) -> None:
         """Record the device mesh a mesh-sharded packed run executed on
         (``mesh_devices``, the (data, time) shape, per-device labels,
@@ -248,6 +259,7 @@ class RunManifest:
             ingress = dict(self.ingress)
             programs_lock = dict(self.programs_lock)
             aot = dict(self.aot)
+            index = dict(self.index)
         outcomes: Dict[str, int] = {}
         for v in videos.values():
             outcomes[v['outcome']] = outcomes.get(v['outcome'], 0) + 1
@@ -281,6 +293,10 @@ class RunManifest:
             # program took (loaded vs compiled) + its StableHLO
             # identity, {} without aot_enabled
             'aot': aot,
+            # sharded feature index (index/): rows/shards/ingest-lag +
+            # query-program path for runs that build or query it, {}
+            # otherwise
+            'index': index,
         }
 
     def write(self, path: str) -> str:

@@ -160,6 +160,36 @@ class ServeClient:
                         f'{timeout_s}s: {st}')
                 time.sleep(poll_s)
 
+    def search(self, family: Optional[str] = None,
+               vector: Optional[List[float]] = None,
+               video_path: Optional[str] = None,
+               features: Optional[List[str]] = None,
+               k: int = 10,
+               timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Query the feature index (v1.3; requires ``index_enabled``).
+        By vector: pass ``family`` + ``vector`` → ``{hits: [...]}``. By
+        video: pass ``video_path`` + ``features`` → the server extracts
+        through the fused path, waits for ingest, and answers
+        ``{results: {family: [hits]}}``; each hit is ``{score, video,
+        video_sha256, t_ms, key, family}``."""
+        msg: Dict[str, Any] = {'cmd': protocol.CMD_SEARCH, 'k': int(k)}
+        if family is not None:
+            msg['family'] = str(family)
+        if vector is not None:
+            msg['vector'] = list(vector)
+        if video_path is not None:
+            msg['video_path'] = str(video_path)
+        if features is not None:
+            msg['features'] = list(features)
+        if timeout_s is not None:
+            msg['timeout_s'] = float(timeout_s)
+        return self._call(msg)
+
+    def index_status(self) -> Dict[str, Any]:
+        """The index section of the metrics document (rows, shards,
+        ingest lag, query-program residency) — v1.3."""
+        return self._call({'cmd': protocol.CMD_INDEX_STATUS})['index']
+
     def metrics(self) -> Dict[str, Any]:
         return self._call({'cmd': protocol.CMD_METRICS})['metrics']
 

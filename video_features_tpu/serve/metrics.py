@@ -111,6 +111,7 @@ def build_metrics(started_at: float,
                   trace_stats: Optional[Dict[str, Any]] = None,
                   watchdog_stats: Optional[Dict[str, Any]] = None,
                   aot_stats: Optional[Dict[str, Any]] = None,
+                  index_stats: Optional[Dict[str, Any]] = None,
                   ) -> Dict[str, Any]:
     """Assemble the one metrics document. ``stage_reports`` maps a
     human-readable pool-entry label → that entry's ``Tracer.report()``;
@@ -156,6 +157,15 @@ def build_metrics(started_at: float,
     # live-session + connection gauges (ingress/gateway.stats()) —
     # always present, {'enabled': False} on a loopback-only server, so
     # scrapers see one stable schema
+    # feature-index view (index/): rows/shards/ingest-lag from the
+    # serve-side ingest worker plus query counters — always present,
+    # {'enabled': False} without index_enabled, so scrapers see one
+    # stable schema; ingest_lag_bytes == 0 means the index has folded
+    # in every published cache object
+    doc['index'] = (index_stats if index_stats is not None
+                    else {'enabled': False, 'rows_live': 0, 'rows_dead': 0,
+                          'shards': 0, 'rows_indexed': 0, 'rows_dropped': 0,
+                          'ingest_lag_bytes': 0, 'queries': 0})
     doc['ingress'] = (ingress_stats if ingress_stats is not None
                       else {'enabled': False, 'requests_total': 0,
                             'shed_total': 0, 'live_sessions': 0,
@@ -238,6 +248,14 @@ def prometheus_text(doc: Dict[str, Any],
             g(f'vft_aot_{key}',
               'persistent executable store accounting (merged across '
               'warm workers)').set(value)
+    for key, value in (doc.get('index') or {}).items():
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            # point-in-time mirrors; the registered vft_index_*_total
+            # counters and latency histogram render off the registry
+            # directly (IndexService registers them at construction)
+            g(f'vft_index_{key}',
+              'sharded feature-index accounting (ingest worker + '
+              'query engine)').set(value)
     # monotonic mirrors (counter semantics, hence _total names): the
     # document carries lifetime totals; the registry counter advances by
     # the delta so repeated renders never double-count and a recorder

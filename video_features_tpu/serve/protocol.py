@@ -43,6 +43,14 @@ Commands (the ``cmd`` field):
     (``docs/serving.md`` schema).
   * ``metrics_prom`` — ``{cmd}`` → ``{ok, text}``: the same state as
     Prometheus text exposition format 0.0.4 (``docs/observability.md``).
+  * ``search`` — (v1.3) query the feature index. By vector:
+    ``{cmd, family, vector: [..], k}``; by video: ``{cmd, video_path,
+    features: [..], k, timeout_s}`` (extracts through the fused submit
+    path, waits for ingest, queries with the video's own windows) →
+    ``{ok, hits | results}`` with per-hit ``{score, video,
+    video_sha256, t_ms, key, family}``. Requires ``index_enabled``.
+  * ``index_status`` — (v1.3) ``{cmd}`` → the index section of the
+    metrics document (rows, shards, ingest lag, program residency).
   * ``drain``   — stop admitting, finish everything queued, shut down.
   * ``ping``    — liveness probe.
 """
@@ -61,11 +69,14 @@ CMD_STATUS = 'status'
 CMD_TRACE = 'trace'
 CMD_METRICS = 'metrics'
 CMD_METRICS_PROM = 'metrics_prom'
+CMD_SEARCH = 'search'
+CMD_INDEX_STATUS = 'index_status'
 CMD_DRAIN = 'drain'
 CMD_PING = 'ping'
 
 COMMANDS = (CMD_SUBMIT, CMD_STATUS, CMD_TRACE, CMD_METRICS,
-            CMD_METRICS_PROM, CMD_DRAIN, CMD_PING)
+            CMD_METRICS_PROM, CMD_SEARCH, CMD_INDEX_STATUS, CMD_DRAIN,
+            CMD_PING)
 
 # wire protocol version this build speaks; MAJOR is the compatibility
 # gate (minor bumps are additive-fields-only and never rejected).
@@ -75,8 +86,11 @@ COMMANDS = (CMD_SUBMIT, CMD_STATUS, CMD_TRACE, CMD_METRICS,
 # landed without a bump — exactly the drift WIRE.lock.json now catches;
 # 1.2 adds the optional `features` submit field (fused multi-family
 # requests: one request id, per-family children, `requests`/`errors`
-# in the response and nested per-family `videos` in status).
-VERSION = '1.2'
+# in the response and nested per-family `videos` in status);
+# 1.3 adds the feature-index surface: the `search` / `index_status`
+# commands and the ingress `POST /v1/search` route (query-by-vector
+# and query-by-video over the sharded embedding index).
+VERSION = '1.3'
 MAJOR = 1
 
 # submit() fields copied verbatim into the request (everything else in the

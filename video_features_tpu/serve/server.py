@@ -515,6 +515,14 @@ class ExtractionServer:
                 float(self.base_overrides['watchdog_stall_s']),
                 on_stall=self._on_stall,
                 registry=self.registry).start()
+        # feature index (index_enabled base override): ingest worker +
+        # query engine behind the search/index_status commands and the
+        # ingress /v1/search route. Created AFTER the watchdog so its
+        # ingest row can register; its thread starts with the server.
+        self.index_service = None
+        if self.base_overrides.get('index_enabled'):
+            from video_features_tpu.index.service import IndexService
+            self.index_service = IndexService(self, self.base_overrides)
         self._draining = False
         self._drained = threading.Event()
         self._sock: Optional[socket.socket] = None
@@ -535,6 +543,8 @@ class ExtractionServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name='serve-accept', daemon=True)
         self._accept_thread.start()
+        if self.index_service is not None:
+            self.index_service.start()
         return self
 
     def install_signal_handlers(self) -> None:
@@ -613,6 +623,12 @@ class ExtractionServer:
                 except Exception:
                     event(logging.WARNING, 'ingress finish_drain failed',
                           subsystem='serve', exc_info=True)
+            if self.index_service is not None:
+                # stop the ingest worker before the watchdog goes down
+                # (its ledger row is forgotten here) and before the
+                # final metrics/trace exports, so they carry the index's
+                # terminal state
+                self.index_service.stop()
             if self.watchdog is not None:
                 # stop BEFORE the final exports: a drain-quiesced worker
                 # with close-sentinel queue state must not read as a
@@ -1258,6 +1274,20 @@ class ExtractionServer:
                   pool_size=self.pool.capacity)
         for spec in specs:
             family, _, lane = str(spec).partition('@')
+            if family == 'index':
+                # the index query program is not a warm-pool entry — it
+                # pre-warms through the index service's own executable
+                # store path (loaded from PROGRAMS.lock-pinned AOT state
+                # when unchanged, compiled otherwise)
+                if self.index_service is None:
+                    report['errors'].append(
+                        f'{spec}: index_enabled is false')
+                    continue
+                outcome = self.index_service.prewarm()
+                report['entries'] += 1
+                report['programs_loaded'] += int(outcome == 'loaded')
+                report['programs_compiled'] += int(outcome == 'compiled')
+                continue
             try:
                 # a virtual '.live'-style pseudo path: config validation
                 # needs a non-empty worklist, and nothing should warn
@@ -1494,7 +1524,9 @@ class ExtractionServer:
             ingress_stats=ingress_stats,
             trace_stats=trace_stats,
             watchdog_stats=watchdog_stats,
-            aot_stats=aot_stats)
+            aot_stats=aot_stats,
+            index_stats=(self.index_service.stats()
+                         if self.index_service is not None else None))
 
     # -- completion callbacks (worker threads) -------------------------------
 
@@ -1652,6 +1684,30 @@ class ExtractionServer:
         if cmd == protocol.CMD_METRICS_PROM:
             # Prometheus text exposition 0.0.4 of the same state
             return protocol.ok(text=self._prometheus(self.metrics()))
+        if cmd == protocol.CMD_SEARCH:
+            if self.index_service is None:
+                return protocol.error(
+                    'index is not enabled on this server '
+                    '(start with index_enabled=true)')
+            try:
+                if msg.get('video_path') is not None:
+                    return protocol.ok(**self.index_service.search_by_video(
+                        msg['video_path'],
+                        features=msg.get('features'),
+                        k=msg.get('k', 10),
+                        timeout_s=msg.get('timeout_s')))
+                return protocol.ok(**self.index_service.search_vector(
+                    msg.get('family'), msg.get('vector'),
+                    k=msg.get('k', 10)))
+            except (TypeError, ValueError, KeyError) as e:
+                # malformed query (missing vector, unknown family, bad
+                # dim): the CLIENT's error, answered structurally — a
+                # bad search must never take down the handler thread
+                return protocol.error(f'search failed: {e}')
+        if cmd == protocol.CMD_INDEX_STATUS:
+            if self.index_service is None:
+                return protocol.ok(index={'enabled': False})
+            return protocol.ok(index=self.index_service.stats())
         if cmd == protocol.CMD_DRAIN:
             self.drain(wait=False)
             return protocol.ok(draining=True)
