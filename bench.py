@@ -68,6 +68,16 @@ store — the warm boot loads serialized executables instead of compiling
 (``builds_compiled == 0`` asserted). ``BENCH_AOT=0/1`` overrides the
 accelerator-only default.
 
+The fleet rung (``fleet_warm_clips_per_sec`` / ``fleet_cache_hit_rate``
+/ ``fleet_cold_host_first_feature_s``): two daemons sharing an L2
+feature tier and an AOT artifact tier behind the content-hash router
+(fleet/) — host A extracts cold and publishes; host B boots with empty
+local stores, pre-warms compile-free off the artifact tier
+(``builds_compiled == 0`` asserted), and serves A's features from the
+shared L2 without decoding; the warm rate re-serves the worklist
+through the router across both hosts. ``BENCH_FLEET=0/1`` overrides
+the accelerator-only default.
+
 Default precision is 'mixed' (ops/precision.py): ambient 3-pass bf16 with
 the drift-tolerant sub-graphs on 1-pass — measured ≤1e-3 feature drift vs
 float32 on the fused path (tools/precision_study.py), i.e. the fastest
@@ -452,6 +462,117 @@ def bench_index(tmp_dir: str, platform: str, wl_paths: list) -> dict:
         }
     finally:
         server.drain(wait=True, grace_s=120)
+
+
+def bench_fleet(tmp_dir: str, platform: str, wl_paths: list) -> dict:
+    """The fleet rung (fleet/): two daemons sharing an L2 feature tier
+    and an AOT artifact tier behind the content-hash router
+    (fleet/router.py). Host A extracts the worklist cold — compiling
+    and publishing executables to the artifact tier and features to
+    the L2. Host B then boots with EMPTY local stores: its pre-warm
+    must be compile-free (``builds_compiled == 0`` asserted — every
+    program pulls from the artifact tier) and its first feature is the
+    peer's L2 publish, served without decoding (admission-time
+    ``cached`` status asserted). The warm number is the fleet-wide
+    re-serve rate through the router, one submit per video so the ring
+    spreads them across both hosts — every video must come back
+    ``cached`` or the rung is mislabeled."""
+    from video_features_tpu.fleet.router import FleetRouter
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+    from video_features_tpu.utils.output import make_path
+
+    shared = os.path.join(tmp_dir, 'fleet_shared')
+
+    def host_overrides(tag):
+        return {
+            'device': platform, 'model_name': 'resnet18', 'batch_size': 8,
+            'allow_random_weights': True, 'on_extraction': 'save_numpy',
+            'tmp_path': os.path.join(tmp_dir, f'fleet_tmp_{tag}'),
+            'cache_enabled': True,
+            'cache_dir': os.path.join(tmp_dir, f'fleet_l1_{tag}'),
+            'cache_l2_dir': os.path.join(shared, 'features'),
+            'aot_enabled': True,
+            'aot_dir': os.path.join(tmp_dir, f'fleet_aot_{tag}'),
+            'aot_l2_dir': os.path.join(shared, 'artifacts'),
+        }
+
+    host_a = ExtractionServer(base_overrides=host_overrides('a'),
+                              queue_depth=64).start()
+    host_b = None
+    router = None
+    try:
+        # cold pass: A owns the whole worklist, compiles, publishes
+        ca = ServeClient(port=host_a.port)
+        rid = ca.submit('resnet', wl_paths, overrides={
+            'output_path': os.path.join(tmp_dir, 'fleet_out_cold')})
+        st = ca.wait(rid, timeout_s=900)
+        assert st['state'] == 'done', f'fleet cold pass: {st}'
+
+        # cold-host boot-to-first-feature: B joins with empty local
+        # stores, pulls A's executables (zero compiles) and serves A's
+        # first video from the shared L2 with zero decode
+        t0 = time.perf_counter()
+        host_b = ExtractionServer(base_overrides=host_overrides('b'),
+                                  queue_depth=64).start()
+        report = host_b.prewarm(['resnet'])
+        assert report['errors'] == [], f'fleet cold-host prewarm: {report}'
+        cb = ServeClient(port=host_b.port)
+        rid_b = cb.submit('resnet', wl_paths[:1], overrides={
+            'output_path': os.path.join(tmp_dir, 'fleet_out_boot')})
+        st_b = cb.wait(rid_b, timeout_s=300)
+        cold_host_s = time.perf_counter() - t0
+        assert st_b['state'] == 'done', f'fleet cold host: {st_b}'
+        assert st_b['videos'][wl_paths[0]] == 'cached', \
+            f'cold host decoded instead of serving the peer L2: {st_b}'
+        wm = host_b.metrics()['warm_pool']
+        assert wm['builds_compiled'] == 0, \
+            f'cold host compiled — artifact tier missed: {wm}'
+
+        # warm fleet pass: one submit per video through the router, so
+        # the ring spreads the worklist across both hosts
+        router = FleetRouter(
+            [f'127.0.0.1:{host_a.port}', f'127.0.0.1:{host_b.port}'],
+            port=0, probe_interval_s=30.0).start()
+        cr = ServeClient(port=router.port)
+        warm_out = os.path.join(tmp_dir, 'fleet_out_warm')
+        t0 = time.perf_counter()
+        rids = [cr.submit('resnet', [p],
+                          overrides={'output_path': warm_out})
+                for p in wl_paths]
+        for p, r in zip(wl_paths, rids):
+            st = cr.wait(r, timeout_s=300)
+            assert st['state'] == 'done', f'fleet warm pass: {st}'
+            assert st['videos'][p] == 'cached', \
+                f'warm pass missed the shared tier — rung mislabeled: {st}'
+        warm_s = time.perf_counter() - t0
+
+        clips = 0
+        for p in wl_paths:
+            arr = np.load(make_path(
+                os.path.join(warm_out, 'resnet', 'resnet18'),
+                p, 'resnet', '.npy'))
+            clips += arr.shape[0]
+        assert clips > 0, 'fleet warm pass produced no clips'
+        hits = misses = 0
+        for srv in (host_a, host_b):
+            cst = srv.metrics()['cache']
+            hits += cst['hits']
+            misses += cst['misses']
+        return {
+            'fleet_warm_clips_per_sec': round(clips / warm_s, 3),
+            'fleet_cache_hit_rate': round(hits / max(1, hits + misses), 4),
+            'fleet_cold_host_first_feature_s': round(cold_host_s, 3),
+        }
+    finally:
+        if router is not None:
+            router.stop()
+        for srv in (host_a, host_b):
+            if srv is not None:
+                try:
+                    srv.drain(wait=True, grace_s=120)
+                except Exception:
+                    pass
 
 
 def bench_cache(precision: str, batch: int, stack: int, tmp_dir: str,
@@ -1198,6 +1319,21 @@ def run() -> dict:
                     rungs.update(bench_index(tmp_dir, platform, wl_paths))
                 except Exception as e:
                     rungs['index_error'] = f'{type(e).__name__}: {e}'
+            # The fleet rung (fleet/): two daemons sharing an L2 feature
+            # tier + AOT artifact tier behind the content-hash router —
+            # compile-free cold-host boot, peer-published warm serves.
+            # BENCH_FLEET=0/1 overrides the accelerator-only default.
+            if os.environ.get('BENCH_FLEET',
+                              '1' if on_accel else '0') == '1':
+                try:
+                    if wl_paths is None:
+                        from tools.worklist_bench import make_worklist
+                        wl_paths = make_worklist(
+                            tmp_dir, 4 if on_accel else 2,
+                            10 if on_accel else 2)
+                    rungs.update(bench_fleet(tmp_dir, platform, wl_paths))
+                except Exception as e:
+                    rungs['fleet_error'] = f'{type(e).__name__}: {e}'
             # The serve-warm bf16 rung: fp32 and bf16 entries resident
             # side by side in ONE daemon (distinct pool keys), warm
             # rates + measured error. BENCH_BF16_SERVE=0/1 overrides.

@@ -839,8 +839,8 @@ def test_knob_registry_is_behavior_preserving():
         'compilation_cache_dir', 'profile', 'profile_dir', 'show_pred',
         'trace_out', 'trace_capacity', 'manifest_out',
         'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s',
-        'cache_enabled', 'cache_dir', 'cache_max_bytes',
-        'aot_enabled', 'aot_dir', 'aot_max_bytes',
+        'cache_enabled', 'cache_dir', 'cache_max_bytes', 'cache_l2_dir',
+        'aot_enabled', 'aot_dir', 'aot_max_bytes', 'aot_l2_dir',
         'index_enabled', 'index_dir', 'index_shard_rows',
         'index_poll_s', 'index_query_block', 'index_k_max',
         'allow_random_weights', 'timeout_s', 'config', 'features'}

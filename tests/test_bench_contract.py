@@ -212,6 +212,35 @@ def test_bench_index_rung_emits_keys():
     assert rungs['index_rows_live'] > 0
 
 
+def test_bench_fleet_rung_emits_keys():
+    """BENCH_FLEET=1 drives the fleet rung (fleet/): two daemons share
+    an L2 feature tier and an AOT artifact tier behind the content-hash
+    router. The record must carry the fleet-wide warm re-serve rate,
+    the shared-store hit rate, and the cold host's boot-to-first-
+    feature wall — the rung itself asserts the cold host never compiles
+    (artifact-tier pull) and never decodes (peer L2 serve), so an
+    ``fleet_error``-free record IS the acceptance evidence."""
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_WORKLIST': '1', 'BENCH_SERVE': '0',
+                      'BENCH_CACHE': '0', 'BENCH_FUSED': '0',
+                      'BENCH_BF16': '0', 'BENCH_INGRESS': '0',
+                      'BENCH_INDEX': '0',
+                      'BENCH_WORKLIST_FEATURE': 'resnet',
+                      'BENCH_FLEET': '1'})
+    rungs = rec['rungs']
+    assert 'fleet_error' not in rungs, rungs.get('fleet_error')
+    assert rungs['fleet_warm_clips_per_sec'] > 0
+    assert 0.0 < rungs['fleet_cache_hit_rate'] <= 1.0
+    assert rungs['fleet_cold_host_first_feature_s'] > 0
+    # direction-awareness downstream: the boot wall is a latency, the
+    # rates gate like throughputs
+    import tools.bench_diff as bd
+    assert bd.lower_is_better('fleet_cold_host_first_feature_s')
+    assert not bd.lower_is_better('fleet_warm_clips_per_sec')
+    assert not bd.lower_is_better('fleet_cache_hit_rate')
+
+
 def test_bench_diff_error_rungs_flagged_never_gated(tmp_path):
     """tools/bench_diff.py direction-awareness for the *_error* fields:
     a measured-error rung that RISES shows as WORSE (lower-is-better)

@@ -152,6 +152,28 @@ def test_inline_eviction_on_publish_over_max_bytes(tmp_path):
     assert cache.contains('key0004')            # the newest always survives
 
 
+def test_on_evict_callback_may_reenter_the_cache(tmp_path):
+    """Eviction subscribers fire OUTSIDE the store lock: a callback that
+    calls back into the cache (the index ingest thread does exactly
+    this) must neither deadlock nor see a stale index."""
+    cache = _fill_store(tmp_path, 4, file_bytes=1000)
+    seen = []
+
+    def reentrant(key, corrupt):
+        # re-enter through the locked public surface — a lock held
+        # across the callback would deadlock right here
+        seen.append((key, corrupt, cache.contains(key)))
+        cache.stats()
+
+    cache.on_evict.append(reentrant)
+    report = cache.gc(target_bytes=2000)
+    assert report['lru_evicted'] == 2
+    assert len(seen) == 2
+    # by notification time the entry is already gone from the index
+    assert all(not present for _, _, present in seen)
+    assert all(not corrupt for _, corrupt, _ in seen)
+
+
 def test_corrupt_entry_evicted_not_served(tmp_path):
     cache = _fill_store(tmp_path, 2)
     edir = Path(cache.cache_dir) / 'objects' / 'ke' / 'key0000'

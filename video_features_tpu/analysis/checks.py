@@ -145,10 +145,11 @@ def check_recipe_picklable(package: Package) -> List[Finding]:
 
 KNOB_CLASS_VALUES = ('neither', 'pool_only', 'fingerprint_only', 'both')
 _DEFAULTS_RE = re.compile(r'^[A-Z][A-Z_]*_DEFAULTS$')
-# server-level namespace: validated wholesale by split_serve_config's
-# unknown-key rejection and never merged into per-request configs, so
-# fingerprint/pool-key classification does not apply
-_EXEMPT_DEFAULTS = ('SERVE_DEFAULTS',)
+# server-level namespaces: validated wholesale by split_serve_config's /
+# split_fleet_config's unknown-key rejection and never merged into
+# per-request configs, so fingerprint/pool-key classification does not
+# apply
+_EXEMPT_DEFAULTS = ('SERVE_DEFAULTS', 'FLEET_DEFAULTS')
 
 
 def _defaults_dicts(mod: Module) -> Dict[str, ast.AST]:

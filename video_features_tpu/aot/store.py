@@ -167,6 +167,18 @@ class ExecStore:
         with self._lock:
             return digest in self._index
 
+    def meta_for(self, digest: str) -> Optional[Dict[str, Any]]:
+        """The recorded ``meta`` of one entry (None when absent) — the
+        fleet artifact tier (``fleet/artifacts.py``) re-publishes a
+        peer-compiled payload locally under the SAME meta so
+        environment-drift diagnostics stay truthful on the pulling
+        host."""
+        with self._lock:
+            entry = self._index.get(digest)
+            if entry is None:
+                return None
+            return dict(entry['meta'])
+
     def metas_for(self, program_sha: str) -> list:
         """The recorded ``meta`` of every entry publishing
         ``program_sha`` — the runtime's environment-drift diagnostics
@@ -421,11 +433,14 @@ def merge_exec_stats(stats: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     merged: Dict[str, Any] = {
         'stores': 0, 'entries': 0, 'bytes': 0, 'hits': 0, 'misses': 0,
         'puts': 0, 'evictions': 0, 'corrupt_evicted': 0,
+        # fleet artifact-tier counters (fleet/artifacts.py): zero on
+        # plain stores — always present so vft_aot_* keeps one schema
+        'pulled': 0, 'published': 0,
     }
     for s in stats:
         merged['stores'] += 1
         for k in ('entries', 'bytes', 'hits', 'misses', 'puts',
-                  'evictions', 'corrupt_evicted'):
+                  'evictions', 'corrupt_evicted', 'pulled', 'published'):
             merged[k] += s.get(k, 0)
     total = merged['hits'] + merged['misses']
     merged['hit_rate'] = (merged['hits'] / total) if total else 0.0

@@ -112,11 +112,18 @@ class FeatureCache:
         # eviction subscribers: ``fn(key, corrupt)`` fires for EVERY
         # entry leaving the store (LRU pressure, corrupt eviction,
         # offline GC) — the seam the feature index uses to tombstone
-        # rows whose backing object is gone. Callbacks run under the
-        # store lock (the del record and the notification must be one
-        # atomic fact), so they must stay cheap and must not call back
-        # into this cache.
+        # rows whose backing object is gone. Callbacks fire AFTER the
+        # store lock is released (queued by ``_evict_locked``, drained
+        # by ``_notify_evictions``): the index ingest thread re-enters
+        # the store from its callback, and firing under ``self._lock``
+        # would order cache-lock → subscriber-lock against the ingest
+        # thread's subscriber-lock → cache-lock — a deadlock once a
+        # second lock (the L2 tier's) joins the graph. The del record
+        # still lands before the notice, so a subscriber observing the
+        # evict always sees the manifest already agreeing.
         self.on_evict: List[Callable[[str, bool], None]] = []
+        # (key, corrupt) notices queued under the lock, fired outside it
+        self._pending_evict_notices: List[Tuple[str, bool]] = []
         os.makedirs(os.path.join(self.cache_dir, OBJECTS), exist_ok=True)
         self._load_manifest()
 
@@ -184,6 +191,17 @@ class FeatureCache:
         with self._lock:
             return key in self._index
 
+    def entry_exts(self, key: str) -> Optional[Dict[str, str]]:
+        """Output key → file extension for a stored entry (None when
+        absent) — the fleet tier (``fleet/tier.py``) uses this to
+        re-publish a peer-served L2 entry into the local L1 without
+        knowing anything about the family that produced it."""
+        with self._lock:
+            entry = self._index.get(key)
+            if entry is None:
+                return None
+            return {okey: f['ext'] for okey, f in entry['files'].items()}
+
     def fetch_to(self, key: str, out_root: str, video_path: str,
                  fingerprint: Optional[str] = None) -> bool:
         """Materialize entry ``key`` as ``video_path``'s output files
@@ -234,6 +252,7 @@ class FeatureCache:
                 if current is not None and current['files'] == files:
                     self._evict_locked(key, corrupt=True)
                 self.misses += 1
+            self._notify_evictions()
             return False
         if fingerprint is not None:
             write_fingerprint(out_root, video_path, fingerprint)
@@ -298,6 +317,7 @@ class FeatureCache:
                     and self._total_bytes > self.max_bytes:
                 self._gc_locked(self.max_bytes, verify=False,
                                 compact=False, orphan_sweep=False)
+        self._notify_evictions()
 
     def _evict_locked(self, key: str, corrupt: bool = False) -> int:
         entry = self._index.pop(key, None)
@@ -311,12 +331,29 @@ class FeatureCache:
             self.corrupt_evicted += 1
         else:
             self.evictions += 1
-        for fn in list(self.on_evict):
-            try:
-                fn(key, bool(corrupt))
-            except Exception:
-                log_cache_error(f'on_evict callback for {key}')
+        # queue, don't fire: subscribers run outside the lock (see the
+        # on_evict declaration) — every public entry point that can
+        # reach here drains via _notify_evictions after unlocking
+        self._pending_evict_notices.append((key, bool(corrupt)))
         return entry['bytes']
+
+    def _notify_evictions(self) -> None:
+        """Drain queued eviction notices and fire the subscribers with
+        NO store lock held — a callback may freely call back into this
+        cache (the index ingest thread does). Looped because a callback
+        re-entering the store can itself queue further evictions."""
+        while True:
+            with self._lock:
+                if not self._pending_evict_notices:
+                    return
+                notices = self._pending_evict_notices
+                self._pending_evict_notices = []
+            for key, corrupt in notices:
+                for fn in list(self.on_evict):
+                    try:
+                        fn(key, corrupt)
+                    except Exception:
+                        log_cache_error(f'on_evict callback for {key}')
 
     # -- garbage collection --------------------------------------------------
 
@@ -341,9 +378,11 @@ class FeatureCache:
         """
         with self._lock:
             self._reload_locked()
-            return self._gc_locked(
+            report = self._gc_locked(
                 self.max_bytes if target_bytes is None else target_bytes,
                 verify=verify, compact=compact, orphan_sweep=True)
+        self._notify_evictions()
+        return report
 
     def _reload_locked(self) -> None:
         """Re-replay the manifest from disk (puts/touches/dels appended
@@ -445,11 +484,15 @@ def merge_cache_stats(stats: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     merged: Dict[str, Any] = {
         'caches': 0, 'entries': 0, 'bytes': 0, 'hits': 0, 'misses': 0,
         'puts': 0, 'evictions': 0, 'corrupt_evicted': 0, 'bytes_saved': 0,
+        # fleet tier counters (fleet/tier.py): zero on plain caches —
+        # always present so the metrics document keeps one schema
+        'peer_hits': 0, 'l2_publishes': 0,
     }
     for s in stats:
         merged['caches'] += 1
         for k in ('entries', 'bytes', 'hits', 'misses', 'puts',
-                  'evictions', 'corrupt_evicted', 'bytes_saved'):
+                  'evictions', 'corrupt_evicted', 'bytes_saved',
+                  'peer_hits', 'l2_publishes'):
             merged[k] += s.get(k, 0)
     total = merged['hits'] + merged['misses']
     merged['hit_rate'] = (merged['hits'] / total) if total else 0.0

@@ -29,13 +29,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         # manifest and run exact top-k queries without a resident server
         from video_features_tpu.index.cli import index_main
         return index_main(argv[1:])
+    if argv and argv[0] == 'fleet':
+        # multi-host front door (fleet/): consistent-hash routing over
+        # N serve daemons — jax-free, so importing it never probes
+        # devices in the router process
+        from video_features_tpu.fleet.router import fleet_main
+        return fleet_main(argv[1:])
     cli_args = parse_dotlist(argv)
     if 'feature_type' not in cli_args and 'features' not in cli_args:
         print('Usage: python -m video_features_tpu feature_type=<name> [key=value ...]\n'
               '       python -m video_features_tpu features=[f1,f2,...] [key=value ...]\n'
               '       python -m video_features_tpu serve [serve_port=N ...]\n'
               '       python -m video_features_tpu index --cache-dir DIR '
-              '[--ingest] [--query vec.npy --family f]')
+              '[--ingest] [--query vec.npy --family f]\n'
+              '       python -m video_features_tpu fleet '
+              'fleet_hosts=[h1:p1,h2:p2] [fleet_port=N ...]')
         return 2
     # single source of truth: multihost must come from the CLI because the
     # runtime must initialize before anything probes jax devices

@@ -39,6 +39,12 @@ CACHE_DEFAULTS: Dict[str, Any] = {
     # LRU size bound in bytes (null = unbounded); enforced inline on
     # publish and offline via tools/cache_gc.py
     'cache_max_bytes': None,
+    # fleet shared tier (fleet/tier.py; docs/fleet.md): a directory
+    # every fleet host mounts. When set, cache_dir becomes the local L1
+    # and this the L2 — puts replicate here, an L1 miss a peer already
+    # extracted serves from here byte-identically (no decode) and
+    # promotes into L1. null = single-host behavior exactly.
+    'cache_l2_dir': None,
 }
 
 # -- device-loop pipelining (parallel/packing.py; docs/benchmarks.md) --------
@@ -122,6 +128,13 @@ AOT_DEFAULTS: Dict[str, Any] = {
     # LRU size bound in bytes (null = unbounded); enforced inline on
     # publish and offline via tools/aot_gc.py
     'aot_max_bytes': None,
+    # fleet shared artifact tier (fleet/artifacts.py; docs/fleet.md):
+    # when set, aot_dir becomes the local L1 and this a shared
+    # publish-on-compile / pull-on-miss tier — a freshly provisioned
+    # host loads executables a peer compiled and boots compile-free.
+    # Same ISA/trust caveats as a network-shared aot_dir (above).
+    # null = single-host behavior exactly.
+    'aot_l2_dir': None,
 }
 
 # -- feature index (index/; docs/feature_index.md) ---------------------------
@@ -294,6 +307,10 @@ KNOB_CLASSIFICATION: Dict[str, str] = {
     'cache_enabled': 'pool_only',
     'cache_dir': 'pool_only',
     'cache_max_bytes': 'pool_only',
+    # the L2 is part of WHICH store the worker publishes/consults —
+    # same pool-key reasoning as cache_dir; and like cache_dir it can
+    # never change the bytes an extractor computes
+    'cache_l2_dir': 'pool_only',
     # executable store (aot/): where compiled programs are LOADED from
     # can never change the bytes they compute (loaded executables are
     # byte-identical to fresh compiles — tests/test_aot.py pins it), so
@@ -304,6 +321,9 @@ KNOB_CLASSIFICATION: Dict[str, str] = {
     'aot_enabled': 'pool_only',
     'aot_dir': 'pool_only',
     'aot_max_bytes': 'pool_only',
+    # same reasoning as aot_dir: names WHERE executables come from,
+    # never what they compute
+    'aot_l2_dir': 'pool_only',
     # feature index (index/): a serving-side consumer of ALREADY
     # published cache objects — ingest and query never touch what an
     # extractor computes, and no worker binds to these knobs at build
@@ -616,6 +636,13 @@ def sanity_check(args: Config) -> None:
             warnings.warn('cache_enabled has no effect with '
                           'on_extraction=print — disabling the cache')
             args['cache_enabled'] = False
+    if args.get('cache_l2_dir') is not None:
+        # the shared tier rides on the cache: without a local L1 store
+        # there is nothing to tier
+        args['cache_l2_dir'] = str(args['cache_l2_dir'])
+        if not args.get('cache_enabled'):
+            raise ValueError('cache_l2_dir requires cache_enabled=true '
+                             '(see docs/fleet.md)')
 
     # executable-store knobs (aot/): the dir coerces to str, the size
     # bound must be a non-negative int. ValueError, not assert —
@@ -631,6 +658,11 @@ def sanity_check(args: Config) -> None:
         if args['aot_max_bytes'] < 0:
             raise ValueError('aot_max_bytes must be >= 0 or null; '
                              f'got {args["aot_max_bytes"]}')
+    if args.get('aot_l2_dir') is not None:
+        args['aot_l2_dir'] = str(args['aot_l2_dir'])
+        if not args.get('aot_enabled'):
+            raise ValueError('aot_l2_dir requires aot_enabled=true '
+                             '(see docs/fleet.md)')
 
     # feature-index knobs (index/): the ingest worker tails the CACHE
     # manifest, so the index requires the cache; geometry knobs must be
@@ -934,6 +966,89 @@ def split_serve_config(cli_args: Dict[str, Any]) -> Tuple[Config, Config]:
         if serve[key] < 1:
             raise ValueError(f'{key} must be >= 1; got {serve[key]}')
     return serve, base
+
+
+# -- fleet router (fleet/; docs/fleet.md) ------------------------------------
+# Router-process knobs, NOT extraction config: the `fleet` command takes
+# ONLY these (backends own their extraction/serve config), so unlike the
+# *_DEFAULTS families above they never merge into per-feature args and
+# carry no fingerprint/pool-key classification.
+FLEET_DEFAULTS: Dict[str, Any] = {
+    # static backend membership: a list of host:port serve daemons
+    # (bare ports mean loopback — the simulation/test form). LIVENESS
+    # is probed, not configured: unhealthy or draining hosts leave the
+    # eligible set without a config change.
+    'fleet_hosts': None,
+    # the router's own loopback JSON-lines listener (0 = ephemeral)
+    'fleet_port': 9310,
+    'fleet_host': '127.0.0.1',
+    # optional HTTP front door (ingress transport); null = loopback only
+    'fleet_http_port': None,
+    'fleet_http_host': '127.0.0.1',
+    # API-key file for the HTTP front door (required when it's on —
+    # same no-anonymous-mode policy as serve_ingress_auth_file)
+    'fleet_auth_file': None,
+    # health-probe cadence; the probe also reads each backend's
+    # `draining` flag for drain-aware membership
+    'fleet_probe_interval_s': 2.0,
+    # failover bound: how many ring hosts one request may try
+    'fleet_max_attempts': 3,
+    # backoff between ring hosts (doubles per attempt, capped)
+    'fleet_backoff_base_s': 0.05,
+    # per-backend connect deadline on the request path
+    'fleet_connect_timeout_s': 2.0,
+    # virtual nodes per host on the consistent-hash ring
+    'fleet_ring_replicas': 64,
+}
+
+
+def split_fleet_config(cli_args: Dict[str, Any]) -> Tuple[Config, Config]:
+    """Split a fleet-command dotlist into (router knobs, leftovers).
+
+    Same typo discipline as :func:`split_serve_config`; leftovers are
+    returned (not merged anywhere) so ``fleet_main`` can refuse them —
+    the router forwards requests, it does not own extraction config.
+    """
+    fleet, extra = Config(FLEET_DEFAULTS), Config()
+    for key, value in dict(cli_args).items():
+        if key.startswith('fleet_'):
+            if key not in FLEET_DEFAULTS:
+                raise ValueError(
+                    f'Unknown fleet option {key!r}. '
+                    f'Known: {", ".join(sorted(FLEET_DEFAULTS))}')
+            fleet[key] = value
+        else:
+            extra[key] = value
+    if fleet['fleet_hosts'] is not None:
+        hosts = fleet['fleet_hosts']
+        if isinstance(hosts, (str, int)):
+            hosts = [hosts]
+        if not isinstance(hosts, (list, tuple)) or not hosts:
+            raise ValueError(
+                'fleet_hosts must be a host:port (or bare-port) list, '
+                f'e.g. [127.0.0.1:9301,127.0.0.1:9302]; got '
+                f'{fleet["fleet_hosts"]!r}')
+        fleet['fleet_hosts'] = [str(h) for h in hosts]
+    for key in ('fleet_port', 'fleet_max_attempts', 'fleet_ring_replicas'):
+        fleet[key] = int(fleet[key])
+    if fleet['fleet_port'] < 0:
+        raise ValueError(f'fleet_port must be >= 0; got {fleet["fleet_port"]}')
+    for key in ('fleet_max_attempts', 'fleet_ring_replicas'):
+        if fleet[key] < 1:
+            raise ValueError(f'{key} must be >= 1; got {fleet[key]}')
+    for key in ('fleet_probe_interval_s', 'fleet_backoff_base_s',
+                'fleet_connect_timeout_s'):
+        fleet[key] = float(fleet[key])
+        if fleet[key] <= 0:
+            raise ValueError(f'{key} must be > 0; got {fleet[key]}')
+    if fleet['fleet_http_port'] is not None:
+        fleet['fleet_http_port'] = int(fleet['fleet_http_port'])
+        if not fleet['fleet_auth_file']:
+            raise ValueError(
+                'fleet_http_port requires fleet_auth_file (an API-key '
+                'file; see docs/ingress.md) — the fleet front door has '
+                'no anonymous mode either')
+    return fleet, extra
 
 
 def form_list_from_user_input(

@@ -379,8 +379,18 @@ class BaseExtractor:
             return
         if args.get('cache_enabled') and self.on_extraction in ACTION_TO_EXT:
             try:
-                self.cache = FeatureCache.get(args.get('cache_dir'),
-                                              args.get('cache_max_bytes'))
+                l2 = args.get('cache_l2_dir')
+                if l2:
+                    # fleet shared tier: local L1 + shared L2
+                    from video_features_tpu.fleet.tier import (
+                        TieredFeatureCache,
+                    )
+                    self.cache = TieredFeatureCache.get_pair(
+                        args.get('cache_dir'), l2,
+                        args.get('cache_max_bytes'))
+                else:
+                    self.cache = FeatureCache.get(
+                        args.get('cache_dir'), args.get('cache_max_bytes'))
             except Exception:
                 log_cache_error(f'open ({args.get("cache_dir")})')
                 self.cache = None
@@ -401,8 +411,18 @@ class BaseExtractor:
 
         from video_features_tpu.aot import ExecStore, log_aot_error
         try:
-            self._aot_store = ExecStore.get(args.get('aot_dir'),
-                                            args.get('aot_max_bytes'))
+            l2 = args.get('aot_l2_dir')
+            if l2:
+                # fleet shared artifact tier: publish-on-compile,
+                # pull-on-miss (fleet/artifacts.py)
+                from video_features_tpu.fleet.artifacts import (
+                    TieredExecStore,
+                )
+                self._aot_store = TieredExecStore.get_pair(
+                    args.get('aot_dir'), l2, args.get('aot_max_bytes'))
+            else:
+                self._aot_store = ExecStore.get(args.get('aot_dir'),
+                                                args.get('aot_max_bytes'))
             self._aot_lock = threading.Lock()
         except Exception:
             log_aot_error(f'open ({args.get("aot_dir")})')

@@ -1,0 +1,31 @@
+"""Fleet-scale serving: the multi-host tier over the one-host daemon.
+
+One :mod:`serve` daemon scales a host's chips; this package scales
+hosts. Three pieces, each federating a seam the single-host tree
+already exposes:
+
+  * :mod:`fleet.ring` — the consistent-hash ring. Requests route by
+    VIDEO CONTENT HASH (the same sha256 the content-addressed cache
+    keys on), so each shard's feature cache and warm pools stay hot for
+    the videos it owns, and removing a host moves only ~1/N of the key
+    space (the ring property the rebalance test pins).
+  * :mod:`fleet.router` — the front door: a stdlib-only router speaking
+    both the loopback JSON-lines protocol and the ingress HTTP surface,
+    with per-backend health probes, drain-aware membership, and
+    bounded retry-with-backoff failover to the ring's next host on
+    connect failure or shed (driven by the wire-1.4 structured error
+    ``code``, never by message text).
+  * :mod:`fleet.tier` / :mod:`fleet.artifacts` — the shared tiers: the
+    feature cache promoted to local-L1 + shared-directory-L2 (a miss on
+    host A that host B already extracted materializes byte-identically
+    without decode), and the AOT executable store as the fleet's shared
+    artifact tier (a freshly provisioned host pulls executables a peer
+    compiled and serves its first request compile-free).
+
+Everything here is deliberately importable without jax: the router and
+both tiers move bytes and JSON; what the bytes mean lives in the
+subsystems they federate.
+"""
+from video_features_tpu.fleet.ring import HashRing
+
+__all__ = ['HashRing']

@@ -1,0 +1,144 @@
+"""The AOT executable store as the fleet's shared artifact tier.
+
+``aot_l2_dir`` turns every ``ExecStore`` open (serve workers, packed
+CLI runs, the index service's query program) into a
+:class:`TieredExecStore`: the host's own ``aot_dir`` stays the L1 —
+this class IS an ``ExecStore`` over it — and a shared directory every
+fleet host mounts becomes the artifact tier behind it.
+
+Why this is safe with zero coordination: ``exec_digest`` already keys
+on the program's StableHLO sha256 (the identity PROGRAMS.lock.json
+pins) plus the lane, jax version, backend platform, device kind, and
+host ISA. Two hosts with matching environments compute the SAME digest
+for the same program, so:
+
+  * **publish-on-compile** — a compile anywhere in the fleet lands the
+    serialized executable in the shared tier (local put, then shared
+    put, both atomic-replace idempotent);
+  * **pull-on-miss** — a freshly provisioned host's first ``fetch``
+    misses its empty L1, hits the shared tier, re-publishes the payload
+    locally (so the next boot is a local load), and serves its first
+    request compile-free — ``builds_compiled == 0``;
+  * **silent recompile on drift** — a host whose environment differs
+    (jax upgrade, different device kind or ISA) simply computes a
+    digest nothing published: the miss is structural, the runtime
+    compiles as it always did, and ``metas_for`` still surfaces the
+    near-miss for the drift diagnostics.
+
+Counters fold into the existing ``vft_aot_*`` families: the tier's
+stats are the L1 stats plus ``pulled`` / ``published`` and an ``l2``
+sub-document; ``merge_exec_stats`` sums what it knows and ignores the
+rest. Integrity at both levels is the store's own size-check /
+evict-corrupt path; a payload that fails to DESERIALIZE after a pull
+is evicted from BOTH tiers (identical bytes — a poisoned shared entry
+must not re-poison every cold host). The shared tier carries no inline
+eviction pressure (``max_bytes=None``); bounding it is
+``tools/aot_gc.py`` against the shared directory.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from video_features_tpu.aot.store import ExecStore, log_aot_error
+
+
+class TieredExecStore(ExecStore):
+    """Local-L1 ``ExecStore`` with a shared artifact tier behind it."""
+
+    _pair_instances: Dict[Tuple[str, str], 'TieredExecStore'] = {}
+    _pair_lock = threading.Lock()
+
+    @classmethod
+    def get_pair(cls, aot_dir: str, l2_dir: str,
+                 max_bytes: Optional[int] = None) -> 'TieredExecStore':
+        """The process-wide tier for an (L1, shared) directory pair —
+        same sharing policy as :meth:`ExecStore.get`."""
+        key = (os.path.abspath(os.path.expanduser(str(aot_dir))),
+               os.path.abspath(os.path.expanduser(str(l2_dir))))
+        with cls._pair_lock:
+            inst = cls._pair_instances.get(key)
+            if inst is None:
+                inst = cls._pair_instances[key] = cls(
+                    key[0], key[1], max_bytes=max_bytes)
+            elif max_bytes is not None:
+                inst.max_bytes = int(max_bytes)
+            return inst
+
+    def __init__(self, aot_dir: str, l2_dir: str,
+                 max_bytes: Optional[int] = None) -> None:
+        super().__init__(aot_dir, max_bytes=max_bytes)
+        self.l2 = ExecStore.get(l2_dir)
+        self.pulled = 0           # L1 miss served from the shared tier
+        self.published = 0        # local puts replicated into it
+
+    # -- core operations -----------------------------------------------------
+
+    def contains(self, digest: str) -> bool:
+        return super().contains(digest) or self.l2.contains(digest)
+
+    def metas_for(self, program_sha: str) -> list:
+        """Union of both tiers (deduplicated) — a cold host's drift
+        diagnostics must see what the FLEET holds for the program, not
+        its own empty L1."""
+        seen = []
+        for meta in super().metas_for(program_sha) \
+                + self.l2.metas_for(program_sha):
+            if meta not in seen:
+                seen.append(meta)
+        return seen
+
+    def fetch(self, digest: str) -> Optional[bytes]:
+        """L1 first; on miss, pull from the shared tier and re-publish
+        locally under the peer's recorded meta (pull-on-miss). A failed
+        local re-publish degrades to serving the pulled bytes — the
+        next boot pulls again."""
+        payload = super().fetch(digest)
+        if payload is not None:
+            return payload
+        payload = self.l2.fetch(digest)
+        if payload is None:
+            return None
+        with self._lock:
+            self.pulled += 1
+        try:
+            super().put(digest, payload, meta=self.l2.meta_for(digest))
+        except Exception:
+            log_aot_error(f'local re-publish of pulled {digest[:12]}')
+        return payload
+
+    def put(self, digest: str, payload: bytes,
+            meta: Optional[Dict[str, Any]] = None) -> None:
+        """Publish locally, then into the shared tier
+        (publish-on-compile). A shared publish failure degrades to
+        local-only and is reported — it must never fail the build that
+        produced the executable."""
+        super().put(digest, payload, meta)
+        try:
+            self.l2.put(digest, payload, meta)
+            with self._lock:
+                self.published += 1
+        except Exception:
+            log_aot_error(f'shared publish of {digest[:12]} '
+                          f'({self.l2.aot_dir})')
+
+    def evict_corrupt(self, digest: str) -> None:
+        """Purge BOTH tiers: a payload that failed to deserialize was
+        byte-identical in each, and leaving the shared copy would
+        re-poison every cold host that pulls it."""
+        super().evict_corrupt(digest)
+        try:
+            self.l2.evict_corrupt(digest)
+        except Exception:
+            log_aot_error(f'shared corrupt-evict of {digest[:12]}')
+
+    # -- accounting ----------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        with self._lock:
+            out['pulled'] = self.pulled
+            out['published'] = self.published
+        out['l2'] = self.l2.stats()
+        return out

@@ -1,0 +1,642 @@
+"""The fleet front door: consistent-hash routing over N serve daemons.
+
+One router process speaks BOTH surfaces the single-host daemon does:
+
+  * the loopback JSON-lines protocol (``serve/protocol.py``) — so a
+    ``ServeClient`` pointed at the router is indistinguishable from one
+    pointed at a daemon (submit/status/trace/search/metrics/ping), and
+    the CLI/tests drive the fleet with zero new client code;
+  * the ingress HTTP surface (``ingress/http.py`` transport +
+    ``ingress/auth.py`` API keys + ``ingress/quota.py`` tenant gates)
+    when ``fleet_http_port`` is set — ``POST /v1/extract``,
+    ``POST /v1/search``, ``GET /v1/requests/<id>``, ``GET /v1/metrics``,
+    and an unauthenticated ``GET /healthz`` carrying the per-backend
+    health table.
+
+Routing: requests key on the first video's CONTENT hash (the same
+sha256 the content-addressed cache keys on — ``cache/key.hash_file``),
+so every video's repeat traffic lands on the shard whose L1 cache and
+warm pools already hold it. Vector searches key on the family.
+
+Failover (the wire-1.4 contract): a backend failure is classified by
+its structured error ``code`` — ``shed`` / ``connect_refused`` /
+``deadline`` walk to the hash ring's NEXT host with bounded
+exponential backoff (at most ``fleet_max_attempts`` hosts); everything
+else (``invalid``, ``unsupported``, ``not_found``, ``internal``)
+propagates to the caller, because a request the whole fleet would
+reject identically must not be retried N times. Message text never
+drives the decision.
+
+Membership: ``fleet_hosts`` is static config; LIVENESS is probed — a
+background thread pings every backend each ``fleet_probe_interval_s``,
+and the ping response's ``draining`` flag (wire 1.1+) removes a
+draining host from the eligible set before its listener closes
+(drain-aware membership). A connect failure on the REQUEST path marks
+the backend unhealthy immediately — the next submit skips it without
+waiting for the probe cycle. Unhealthy hosts stay ON the ring
+(eligibility is a filter, not a rebuild), so when one returns, exactly
+its own keys come home.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from video_features_tpu.fleet.ring import DEFAULT_REPLICAS, HashRing
+from video_features_tpu.serve import protocol
+from video_features_tpu.serve.client import ServeClient, ServeError
+
+# request_id → backend retention for status/trace routing; same bound
+# as the daemons' own request history
+ROUTE_HISTORY = 4096
+
+
+def _log_fleet_error(what: str) -> None:
+    """Router-path failures degrade to failover or a structured error,
+    never to a dropped request — but silently eating them would hide a
+    dead backend forever. Same reporting seam as cache/aot."""
+    import logging
+
+    from video_features_tpu.obs.events import event
+    event(logging.WARNING, f'fleet router {what} failed (continuing)',
+          subsystem='fleet', exc_info=True)
+
+
+class Backend:
+    """One configured backend host and its probed liveness."""
+
+    def __init__(self, addr: str) -> None:
+        self.addr = addr
+        host, _, port = addr.rpartition(':')
+        self.host = host or '127.0.0.1'
+        self.port = int(port)
+        self.healthy = False
+        self.draining = False
+        self.last_probe_t = 0.0
+        self.last_error: Optional[str] = None
+        self.consecutive_failures = 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {'healthy': self.healthy, 'draining': self.draining,
+                'last_probe_t': self.last_probe_t,
+                'last_error': self.last_error,
+                'consecutive_failures': self.consecutive_failures}
+
+
+class FleetRouter:
+    """Content-hash router over a static backend list."""
+
+    # failover backoff between ring hosts: same shape as ServeClient's
+    # connect backoff — short, doubling, jitter-free (the per-host
+    # connect path already jitters)
+    _BACKOFF_CAP_S = 0.5
+
+    def __init__(self, hosts: List[str], host: str = '127.0.0.1',
+                 port: int = 0,
+                 http_host: str = '127.0.0.1',
+                 http_port: Optional[int] = None,
+                 auth_file: Optional[str] = None,
+                 auth: Optional[Any] = None,
+                 probe_interval_s: float = 2.0,
+                 max_attempts: int = 3,
+                 backoff_base_s: float = 0.05,
+                 connect_timeout_s: float = 2.0,
+                 ring_replicas: int = DEFAULT_REPLICAS,
+                 max_connections: int = 64) -> None:
+        addrs = []
+        for h in hosts:
+            addr = str(h)
+            if ':' not in addr:
+                addr = f'127.0.0.1:{addr}'   # bare port = loopback sim
+            addrs.append(addr)
+        if not addrs:
+            raise ValueError('fleet_hosts must name at least one backend')
+        self.ring = HashRing(addrs, replicas=ring_replicas)
+        self._backends = {a: Backend(a) for a in self.ring.hosts}
+        self.host, self._port_req = host, int(port)
+        self.probe_interval_s = float(probe_interval_s)
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_base_s = float(backoff_base_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._lock = threading.Lock()
+        self._draining = False
+        self._started_at = time.monotonic()
+        # request_id → backend addr (status/trace routing), bounded
+        self._routes: Dict[str, str] = {}
+        self._route_order: 'deque[str]' = deque()
+        # counters (under _lock)
+        self._routed: Dict[str, int] = {a: 0 for a in self.ring.hosts}
+        self._failovers = 0
+        self._rejected = 0
+        self._sock = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._probe_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # optional HTTP front door (reuses the ingress transport/auth)
+        self.http = None
+        self._http_auth = auth
+        self._http_host, self._http_port = http_host, http_port
+        self._http_auth_file = auth_file
+        self._quota = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        assert self._sock is not None, 'router not started'
+        return self._sock.getsockname()[1]
+
+    def start(self) -> 'FleetRouter':
+        import socket
+        # one synchronous probe sweep BEFORE accepting traffic, so the
+        # first request sees real membership, not all-unhealthy
+        self.probe()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self._port_req))
+        self._sock.listen(64)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name='fleet-accept', daemon=True)
+        self._accept_thread.start()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name='fleet-probe', daemon=True)
+        self._probe_thread.start()
+        if self._http_port is not None:
+            from video_features_tpu.ingress.auth import ApiKeyAuth
+            from video_features_tpu.ingress.http import HttpServer
+            from video_features_tpu.ingress.quota import QuotaManager
+            if self._http_auth is None:
+                if not self._http_auth_file:
+                    raise ValueError('the fleet HTTP front door requires '
+                                     'an API-key file (fleet_auth_file)')
+                self._http_auth = ApiKeyAuth.from_file(self._http_auth_file)
+            self._quota = QuotaManager()
+            self.http = HttpServer(self._handle_http,
+                                   host=self._http_host,
+                                   port=int(self._http_port)).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._draining = True
+        if self.http is not None:
+            self.http.begin_drain()
+            self.http.finish_drain(grace_s=1.0)
+        if self._sock is not None:
+            import socket
+            try:
+                # shutdown BEFORE close: a bare close leaves the
+                # listener half-alive while the accept thread is blocked
+                # on it, and one more connection would sneak through
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # -- membership ----------------------------------------------------------
+
+    def _probe_call(self, b: Backend) -> Dict[str, Any]:
+        """One raw ping with a HARD read deadline — ServeClient leaves
+        reads unbounded (extraction can take a while), but a wedged
+        backend that accepts and never answers must cost the probe
+        thread half a second, not its liveness."""
+        import socket
+        timeout = min(0.5, self.connect_timeout_s)
+        with socket.create_connection((b.host, b.port),
+                                      timeout=timeout) as conn:
+            conn.settimeout(timeout)
+            conn.sendall(protocol.encode({'cmd': protocol.CMD_PING,
+                                          'v': protocol.VERSION}))
+            with conn.makefile('rb') as rfile:
+                line = rfile.readline()
+        if not line:
+            raise ConnectionError('backend closed the probe connection')
+        return protocol.decode(line)
+
+    def probe(self) -> Dict[str, Dict[str, Any]]:
+        """One synchronous health sweep; returns the per-backend table.
+        ``ping`` (wire 1.1+) answers ``draining`` — a draining host is
+        alive but leaves the eligible set."""
+        for b in self._backends.values():
+            try:
+                resp = self._probe_call(b)
+                with self._lock:
+                    b.healthy = bool(resp.get('ok'))
+                    b.draining = bool(resp.get('draining'))
+                    b.last_error = None
+                    b.consecutive_failures = 0
+            except (ServeError, OSError, ValueError) as e:
+                with self._lock:
+                    b.healthy = False
+                    b.last_error = f'{type(e).__name__}: {e}'
+                    b.consecutive_failures += 1
+            finally:
+                with self._lock:
+                    b.last_probe_t = time.time()
+        with self._lock:
+            return {a: b.snapshot() for a, b in self._backends.items()}
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self.probe()
+            except Exception:
+                _log_fleet_error('probe sweep')
+
+    def eligible(self) -> List[str]:
+        """Backends the ring may route to: healthy and not draining."""
+        with self._lock:
+            return [a for a, b in self._backends.items()
+                    if b.healthy and not b.draining]
+
+    # -- routing core --------------------------------------------------------
+
+    @staticmethod
+    def route_key(msg: Dict[str, Any]) -> str:
+        """The consistent-hash key for one request: the first video's
+        CONTENT hash (cache-key identity — repeat traffic for a video
+        lands where its features are cached), the path itself when the
+        file isn't readable yet (the backend will answer the error),
+        or the family for vector searches."""
+        paths = msg.get('video_paths') or []
+        video = msg.get('video_path')
+        if video is not None and not paths:
+            paths = [video]
+        if paths:
+            from video_features_tpu.cache.key import hash_file
+            try:
+                return hash_file(str(paths[0]))
+            except OSError:
+                return str(paths[0])
+        return f"family:{msg.get('family')}"
+
+    def _remember_route(self, request_id: str, addr: str) -> None:
+        with self._lock:
+            self._routes[request_id] = addr
+            self._route_order.append(request_id)
+            while len(self._route_order) > ROUTE_HISTORY:
+                self._routes.pop(self._route_order.popleft(), None)
+
+    def _backend_call(self, addr: str,
+                      msg: Dict[str, Any]) -> Dict[str, Any]:
+        b = self._backends[addr]
+        client = ServeClient(b.port, host=b.host,
+                             connect_timeout_s=self.connect_timeout_s)
+        return client._call(dict(msg))
+
+    def _route(self, key: str, msg: Dict[str, Any],
+               on_success: Optional[Callable[[Dict[str, Any], str],
+                                             None]] = None,
+               ) -> Dict[str, Any]:
+        """Walk the ring's failover order for ``key``, forwarding
+        ``msg``; classify each failure by its structured code and
+        either walk on (shed / connect_refused / deadline) or
+        propagate. Returns the successful backend response, or the
+        LAST failure as a structured error."""
+        hosts = self.ring.hosts_for(key, eligible=self.eligible())
+        if not hosts:
+            with self._lock:
+                self._rejected += 1
+            return protocol.error('no eligible fleet backend '
+                                  '(all unhealthy or draining)',
+                                  code=protocol.ERR_SHED)
+        delay = self.backoff_base_s
+        last: Optional[ServeError] = None
+        for i, addr in enumerate(hosts[:self.max_attempts]):
+            if i > 0:
+                with self._lock:
+                    self._failovers += 1
+                time.sleep(delay)
+                delay = min(delay * 2, self._BACKOFF_CAP_S)
+            try:
+                resp = self._backend_call(addr, msg)
+            except ServeError as e:
+                last = e
+                if e.code == protocol.ERR_CONNECT_REFUSED:
+                    # fast member removal: don't wait for the probe
+                    with self._lock:
+                        b = self._backends[addr]
+                        b.healthy = False
+                        b.last_error = str(e)
+                        b.consecutive_failures += 1
+                if e.retryable:
+                    continue
+                break
+            except (OSError, ValueError) as e:
+                # transport surprise outside the classified set (reset
+                # mid-read, undecodable response): treat as shed —
+                # another host may serve it — but remember the text
+                last = ServeError(f'{type(e).__name__}: {e}',
+                                  code=protocol.ERR_SHED)
+                continue
+            with self._lock:
+                self._routed[addr] = self._routed.get(addr, 0) + 1
+            if on_success is not None:
+                on_success(resp, addr)
+            return resp
+        with self._lock:
+            self._rejected += 1
+        assert last is not None
+        return protocol.error(str(last),
+                              code=last.code or protocol.ERR_INTERNAL,
+                              **{k: v for k, v in last.extra.items()
+                                 if k not in ('ok', 'error', 'code')})
+
+    # -- command handlers ----------------------------------------------------
+
+    def submit(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            if self._draining:
+                self._rejected += 1
+                return protocol.error('draining',
+                                      code=protocol.ERR_SHED)
+
+        def _remember(resp: Dict[str, Any], addr: str) -> None:
+            rid = resp.get('request_id')
+            if rid:
+                self._remember_route(rid, addr)
+            # fused children route with the umbrella
+            for child in (resp.get('requests') or {}).values():
+                self._remember_route(child, addr)
+            resp['backend'] = addr
+
+        return self._route(self.route_key(msg), msg,
+                           on_success=_remember)
+
+    def request_scoped(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """status/trace: route by the remembered request_id → backend
+        binding (content hash is not recoverable from an id)."""
+        rid = msg.get('request_id')
+        with self._lock:
+            addr = self._routes.get(rid)
+        if addr is None:
+            return protocol.error(f'unknown request_id {rid!r}',
+                                  code=protocol.ERR_NOT_FOUND)
+        try:
+            return self._backend_call(addr, msg)
+        except ServeError as e:
+            return protocol.error(str(e),
+                                  code=e.code or protocol.ERR_INTERNAL)
+
+    def search(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        return self._route(self.route_key(msg), msg)
+
+    def forward_any(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Commands with no content affinity (index_status): any
+        eligible backend, ring-ordered on a constant key for
+        stability."""
+        return self._route('fleet:any', msg)
+
+    def metrics(self) -> Dict[str, Any]:
+        """The fleet metrics document: router counters + the
+        per-backend table (health, queue depth, cache hit rate — the
+        ``tools/fleet_status.py`` surface). Backend metrics are
+        fetched live from healthy hosts; a host that fails the fetch
+        degrades to its probe row."""
+        with self._lock:
+            backends = {a: b.snapshot()
+                        for a, b in self._backends.items()}
+            doc: Dict[str, Any] = {
+                'uptime_s': round(time.monotonic() - self._started_at, 3),
+                'draining': self._draining,
+                'hosts': list(self.ring.hosts),
+                'routed': dict(self._routed),
+                'failovers': self._failovers,
+                'rejected': self._rejected,
+            }
+        for addr, row in backends.items():
+            if not row['healthy']:
+                continue
+            try:
+                m = self._backend_call(addr,
+                                       {'cmd': protocol.CMD_METRICS})
+                bm = m.get('metrics') or {}
+                row['queue_depth'] = (bm.get('queue') or {}).get('depth')
+                row['cache_hit_rate'] = \
+                    (bm.get('cache') or {}).get('hit_rate')
+                row['builds_compiled'] = \
+                    (bm.get('warm_pool') or {}).get('builds_compiled')
+                row['builds_loaded'] = \
+                    (bm.get('warm_pool') or {}).get('builds_loaded')
+            except (ServeError, OSError, ValueError):
+                _log_fleet_error(f'metrics fetch from {addr}')
+        doc['eligible'] = [a for a, r in backends.items()
+                           if r['healthy'] and not r['draining']]
+        doc['backends'] = backends
+        return {'fleet': doc}
+
+    # -- loopback listener ---------------------------------------------------
+
+    def _dispatch(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        rejection = protocol.check_version(msg)
+        if rejection is not None:
+            return rejection
+        cmd = msg.get('cmd')
+        if cmd == protocol.CMD_PING:
+            with self._lock:
+                draining = self._draining
+            return protocol.ok(draining=draining, v=protocol.VERSION,
+                               fleet_hosts=len(self.ring))
+        if cmd == protocol.CMD_SUBMIT:
+            return self.submit(msg)
+        if cmd in (protocol.CMD_STATUS, protocol.CMD_TRACE):
+            return self.request_scoped(msg)
+        if cmd == protocol.CMD_SEARCH:
+            return self.search(msg)
+        if cmd == protocol.CMD_INDEX_STATUS:
+            return self.forward_any(msg)
+        if cmd == protocol.CMD_METRICS:
+            return protocol.ok(metrics=self.metrics())
+        if cmd == protocol.CMD_METRICS_PROM:
+            # per-host exposition belongs to each backend's own scrape
+            # target; aggregating text format here would double-count
+            return protocol.error(
+                'metrics_prom is per-backend — scrape the daemons',
+                code=protocol.ERR_UNSUPPORTED)
+        if cmd == protocol.CMD_DRAIN:
+            with self._lock:
+                self._draining = True
+            return protocol.ok(draining=True)
+        return protocol.error(
+            f'unknown cmd {cmd!r}; known: {", ".join(protocol.COMMANDS)}',
+            code=protocol.ERR_INVALID)
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return                     # socket closed: stopping
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             name='fleet-conn', daemon=True).start()
+
+    def _handle_conn(self, conn) -> None:
+        try:
+            with conn:
+                rfile = conn.makefile('rb')
+                wfile = conn.makefile('wb')
+                for line in rfile:
+                    try:
+                        msg = protocol.decode(line)
+                        resp = self._dispatch(msg)
+                    except Exception as e:
+                        resp = protocol.error(f'{type(e).__name__}: {e}',
+                                              code=protocol.ERR_INTERNAL)
+                    try:
+                        wfile.write(protocol.encode(resp))
+                        wfile.flush()
+                    except (OSError, ValueError):
+                        return             # client went away mid-reply
+        except OSError:
+            pass                           # torn connection: next client
+
+    # -- HTTP front door -----------------------------------------------------
+
+    # structured code → HTTP status for propagated backend errors
+    _CODE_STATUS: Dict[str, int] = {}
+
+    @classmethod
+    def _code_to_status(cls, code: Optional[str]) -> int:
+        from video_features_tpu.ingress import http as h
+        if not cls._CODE_STATUS:
+            cls._CODE_STATUS.update({
+                protocol.ERR_SHED: h.SERVICE_UNAVAILABLE,
+                protocol.ERR_CONNECT_REFUSED: h.SERVICE_UNAVAILABLE,
+                protocol.ERR_DEADLINE: h.SERVICE_UNAVAILABLE,
+                protocol.ERR_INVALID: h.BAD_REQUEST,
+                protocol.ERR_UNSUPPORTED: h.BAD_REQUEST,
+                protocol.ERR_NOT_FOUND: h.NOT_FOUND,
+                protocol.ERR_INTERNAL: h.INTERNAL_ERROR,
+            })
+        return cls._CODE_STATUS.get(code or '', h.INTERNAL_ERROR)
+
+    def _handle_http(self, req, resp, conn) -> None:
+        from video_features_tpu.ingress import http as h
+        try:
+            if req.method == 'GET' and req.path == '/healthz':
+                # NO auth: load balancers probe this
+                with self._lock:
+                    table = {a: {'healthy': b.healthy,
+                                 'draining': b.draining}
+                             for a, b in self._backends.items()}
+                    draining = self._draining
+                resp.send_json(h.OK, {'ok': True, 'draining': draining,
+                                      'fleet': True, 'backends': table})
+                return
+            tenant = self._http_auth.authenticate(req.headers)
+            if tenant is None:
+                resp.send_json(h.UNAUTHORIZED, {
+                    'ok': False, 'error': 'unauthorized',
+                    'message': 'missing or unknown API key '
+                               '(Authorization: Bearer <key>)'})
+                return
+            if req.method == 'GET' and req.path == '/v1/metrics':
+                resp.send_json(h.OK, {'ok': True,
+                                      'metrics': self.metrics()})
+                return
+            if req.method == 'GET' \
+                    and req.path.startswith('/v1/requests/'):
+                rid = req.path[len('/v1/requests/'):].strip('/')
+                out = self.request_scoped(
+                    {'cmd': protocol.CMD_STATUS, 'request_id': rid})
+                status = h.OK if out.get('ok') \
+                    else self._code_to_status(out.get('code'))
+                resp.send_json(status, out)
+                return
+            if req.method == 'POST' \
+                    and req.path in ('/v1/extract', '/v1/search'):
+                body = req.json_body(16 * (1 << 20))
+                acquired, reason = self._quota.acquire(tenant)
+                if not acquired:
+                    resp.send_json(
+                        h.TOO_MANY_REQUESTS,
+                        {'ok': False, 'error': reason,
+                         'tenant': tenant.name})
+                    return
+                try:
+                    if req.path == '/v1/extract':
+                        msg = {'cmd': protocol.CMD_SUBMIT}
+                        for k in protocol.SUBMIT_FIELDS:
+                            if k in body:
+                                msg[k] = body[k]
+                        tp = req.headers.get('traceparent')
+                        if tp and 'traceparent' not in msg:
+                            msg['traceparent'] = tp
+                        out = self.submit(msg)
+                    else:
+                        msg = dict(body)
+                        msg['cmd'] = protocol.CMD_SEARCH
+                        out = self.search(msg)
+                finally:
+                    # the router holds the concurrency unit only for
+                    # the forward itself: completion lives on the
+                    # backend, and its own ingress (when enabled)
+                    # owns per-request lifetime quota
+                    self._quota.release(tenant.name)
+                status = h.OK if out.get('ok') \
+                    else self._code_to_status(out.get('code'))
+                resp.send_json(status, out)
+                return
+            raise h.HttpError(h.NOT_FOUND, 'not_found',
+                              f'no fleet route {req.method} {req.path}')
+        except h.HttpError as e:
+            resp.send_json(e.status, e.body())
+
+
+def fleet_main(argv: List[str]) -> int:
+    """``python -m video_features_tpu fleet`` entry point."""
+    import os
+    import signal
+
+    from video_features_tpu.config import parse_dotlist, split_fleet_config
+    cli = parse_dotlist(argv)
+    fleet_cfg, extra = split_fleet_config(cli)
+    if extra:
+        raise ValueError(
+            f'unknown fleet keys: {sorted(extra)} — the router takes '
+            f'only fleet_* knobs (backends own extraction config)')
+    hosts = fleet_cfg['fleet_hosts']
+    if not hosts:
+        raise ValueError('fleet_hosts is required, e.g. '
+                         'fleet_hosts=[127.0.0.1:9301,127.0.0.1:9302]')
+    router = FleetRouter(
+        hosts,
+        host=fleet_cfg['fleet_host'],
+        port=fleet_cfg['fleet_port'],
+        http_host=fleet_cfg['fleet_http_host'],
+        http_port=fleet_cfg['fleet_http_port'],
+        auth_file=fleet_cfg['fleet_auth_file'],
+        probe_interval_s=fleet_cfg['fleet_probe_interval_s'],
+        max_attempts=fleet_cfg['fleet_max_attempts'],
+        backoff_base_s=fleet_cfg['fleet_backoff_base_s'],
+        connect_timeout_s=fleet_cfg['fleet_connect_timeout_s'],
+        ring_replicas=fleet_cfg['fleet_ring_replicas'],
+    ).start()
+    done = threading.Event()
+
+    def _graceful(signum, frame):
+        router.stop()
+        done.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    # machine-greppable endpoint line (tests and tooling scrape it,
+    # same contract as the serve daemon's startup line)
+    # vft-lint: ok=stdout-purity — documented startup line (fleet)
+    print(f'fleet router on {router.host}:{router.port} '
+          f'(pid {os.getpid()}; backends={",".join(router.ring.hosts)}, '
+          f'eligible={len(router.eligible())})', flush=True)
+    if router.http is not None:
+        # vft-lint: ok=stdout-purity — documented startup line (fleet)
+        print(f'fleet ingress on {router.http.host}:{router.http.port}',
+              flush=True)
+    done.wait()
+    # vft-lint: ok=stdout-purity — shutdown line of the same contract
+    print('fleet: stopped, exiting', flush=True)
+    return 0

@@ -155,14 +155,32 @@ class IndexService:
             resolve_index_dir(overrides),
             shard_rows=int(overrides.get('index_shard_rows', 1024)))
         from video_features_tpu.cache.store import FeatureCache
-        self.cache = FeatureCache.get(overrides.get('cache_dir'),
-                                      overrides.get('cache_max_bytes'))
+        cache_l2 = overrides.get('cache_l2_dir')
+        if cache_l2:
+            # fleet tier: ingest tails the LOCAL manifest as before, but
+            # fetches of rows a peer published resolve through the L2
+            from video_features_tpu.fleet.tier import TieredFeatureCache
+            self.cache = TieredFeatureCache.get_pair(
+                overrides.get('cache_dir'), cache_l2,
+                overrides.get('cache_max_bytes'))
+        else:
+            self.cache = FeatureCache.get(overrides.get('cache_dir'),
+                                          overrides.get('cache_max_bytes'))
         aot_store = None
         if overrides.get('aot_enabled'):
             from video_features_tpu.aot import ExecStore, log_aot_error
+            aot_l2 = overrides.get('aot_l2_dir')
             try:
-                aot_store = ExecStore.get(overrides.get('aot_dir'),
-                                          overrides.get('aot_max_bytes'))
+                if aot_l2:
+                    from video_features_tpu.fleet.artifacts import (
+                        TieredExecStore,
+                    )
+                    aot_store = TieredExecStore.get_pair(
+                        overrides.get('aot_dir'), aot_l2,
+                        overrides.get('aot_max_bytes'))
+                else:
+                    aot_store = ExecStore.get(overrides.get('aot_dir'),
+                                              overrides.get('aot_max_bytes'))
             except Exception:
                 log_aot_error(f'open ({overrides.get("aot_dir")})')
         self.engine = QueryEngine(
@@ -198,8 +216,10 @@ class IndexService:
             from video_features_tpu.obs.spans import SpanRecorder
             self._recorder = SpanRecorder()
             server._persistent_recorders.append(self._recorder)
-        # delete-on-evict coherence: fires under the cache lock, so it
-        # must stay cheap (tombstone + one manifest line)
+        # delete-on-evict coherence: fires AFTER the cache lock is
+        # released (store queues notices, drains outside the lock), so
+        # the callback may safely re-enter the cache — but it still
+        # stays cheap (tombstone + one manifest line)
         self.cache.on_evict.append(self._on_cache_evict)
 
     # -- lifecycle -----------------------------------------------------------

@@ -17,7 +17,47 @@ from video_features_tpu.serve import protocol
 
 
 class ServeError(RuntimeError):
-    """The server answered ``ok: false`` (the message is the reason)."""
+    """The server answered ``ok: false`` (the message is the reason).
+
+    ``code`` (wire 1.4) is the STRUCTURED failure class — one of the
+    ``protocol.ERR_*`` constants, or None from a pre-1.4 server. The
+    fleet router's failover switch keys on it exclusively: ``shed``,
+    ``connect_refused``, and ``deadline`` are retry-next-host;
+    everything else propagates. ``extra`` carries the response's other
+    fields (``depth``/``capacity`` on queue_full, …) verbatim."""
+
+    def __init__(self, message: str, code: Optional[str] = None,
+                 extra: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__(message)
+        self.code = code
+        self.extra = dict(extra) if extra else {}
+
+    @property
+    def retryable(self) -> bool:
+        """True when a DIFFERENT backend could plausibly accept this
+        request (this host shed it, refused the connect, or sat on it
+        past the deadline) — the one bit the router's failover needs."""
+        return self.code in (protocol.ERR_SHED,
+                             protocol.ERR_CONNECT_REFUSED,
+                             protocol.ERR_DEADLINE)
+
+
+class ServeConnectError(ServeError, ConnectionRefusedError):
+    """No listener answered within ``connect_timeout_s`` (code
+    ``connect_refused``). Also a :class:`ConnectionRefusedError` so
+    pre-1.4 callers catching the OS exception keep working."""
+
+    def __init__(self, message: str) -> None:
+        ServeError.__init__(self, message,
+                            code=protocol.ERR_CONNECT_REFUSED)
+
+
+class ServeDeadlineError(ServeError, TimeoutError):
+    """The request outlived the caller's wait deadline (code
+    ``deadline``). Also a :class:`TimeoutError` for pre-1.4 callers."""
+
+    def __init__(self, message: str) -> None:
+        ServeError.__init__(self, message, code=protocol.ERR_DEADLINE)
 
 
 class ServeClient:
@@ -59,7 +99,9 @@ class ServeClient:
                 return conn
             except ConnectionRefusedError:
                 if time.monotonic() + delay >= deadline:
-                    raise
+                    raise ServeConnectError(
+                        f'connect to {self.host}:{self.port} refused for '
+                        f'{self.connect_timeout_s}s') from None
                 # clamp the jittered sleep to the remaining budget so
                 # the deadline is honored even at the jitter's top end
                 time.sleep(max(0.0, min(delay * random.uniform(0.5, 1.5),
@@ -70,10 +112,16 @@ class ServeClient:
     def _read_response(rfile) -> Dict[str, Any]:
         line = rfile.readline()
         if not line:
-            raise ServeError('server closed the connection')
+            # a mid-request connection loss looks exactly like a shed to
+            # the caller's retry logic: another host may well accept it
+            raise ServeError('server closed the connection',
+                             code=protocol.ERR_SHED)
         resp = protocol.decode(line)
         if not resp.get('ok'):
-            raise ServeError(resp.get('error', 'unknown server error'))
+            raise ServeError(resp.get('error', 'unknown server error'),
+                             code=resp.get('code'),
+                             extra={k: v for k, v in resp.items()
+                                    if k not in ('ok', 'error', 'code')})
         return resp
 
     def _call(self, msg: Dict[str, Any]) -> Dict[str, Any]:
@@ -155,7 +203,7 @@ class ServeClient:
                 if st['state'] != 'running':
                     return st
                 if time.monotonic() >= deadline:
-                    raise TimeoutError(
+                    raise ServeDeadlineError(
                         f'request {request_id} still {st["state"]} after '
                         f'{timeout_s}s: {st}')
                 time.sleep(poll_s)

@@ -89,8 +89,12 @@ COMMANDS = (CMD_SUBMIT, CMD_STATUS, CMD_TRACE, CMD_METRICS,
 # in the response and nested per-family `videos` in status);
 # 1.3 adds the feature-index surface: the `search` / `index_status`
 # commands and the ingress `POST /v1/search` route (query-by-vector
-# and query-by-video over the sharded embedding index).
-VERSION = '1.3'
+# and query-by-video over the sharded embedding index);
+# 1.4 adds the additive `code` field on error responses (the ERR_*
+# constants below): the fleet router's failover decision — retry the
+# hash ring's next host vs propagate to the caller — keys on the code,
+# never on the human-readable message text.
+VERSION = '1.4'
 MAJOR = 1
 
 # submit() fields copied verbatim into the request (everything else in the
@@ -100,6 +104,21 @@ SUBMIT_FIELDS = ('cmd', 'v', 'feature_type', 'video_paths', 'overrides',
                  'features')
 
 PRIORITIES = ('interactive', 'batch')
+
+# structured error codes (wire 1.4, the additive `code` response field).
+# Server-side rejections carry one of the first group; the CLIENT mints
+# the second group for failures that never reached a server response, so
+# one switch in the router covers both. Failover semantics
+# (fleet/router.py): `shed`, `connect_refused`, and `deadline` are
+# retry-next-host; everything else propagates to the caller — a request
+# the whole fleet would reject identically must not be retried N times.
+ERR_SHED = 'shed'                      # queue_full / draining admission
+ERR_INVALID = 'invalid'                # malformed or unknown-field request
+ERR_UNSUPPORTED = 'unsupported'        # version skew / disabled subsystem
+ERR_NOT_FOUND = 'not_found'            # unknown request_id
+ERR_INTERNAL = 'internal'              # handler raised
+ERR_CONNECT_REFUSED = 'connect_refused'  # client-minted: no listener
+ERR_DEADLINE = 'deadline'              # client-minted: timed out waiting
 
 
 def encode(msg: Dict[str, Any]) -> bytes:
@@ -133,11 +152,13 @@ def check_version(msg: Dict[str, Any]) -> 'Dict[str, Any] | None':
     except (TypeError, ValueError):
         return error(f'malformed protocol version {v!r} '
                      f'(server speaks {VERSION})',
-                     v=VERSION, request_id=msg.get('request_id'))
+                     code=ERR_UNSUPPORTED, v=VERSION,
+                     request_id=msg.get('request_id'))
     if major != MAJOR:
         return error(f'unsupported protocol major version {v!r}; '
                      f'server speaks {VERSION}',
-                     v=VERSION, request_id=msg.get('request_id'))
+                     code=ERR_UNSUPPORTED, v=VERSION,
+                     request_id=msg.get('request_id'))
     return None
 
 

@@ -793,7 +793,8 @@ class ExtractionServer:
             req = self._requests.get(request_id)
         recorders = self._all_recorders()
         if req is None:
-            return protocol.error(f'unknown request_id {request_id!r}')
+            return protocol.error(f'unknown request_id {request_id!r}',
+                                  code=protocol.ERR_NOT_FOUND)
         ctx = req.trace
         trace_id = ctx.trace_id if ctx is not None else None
         events: List[Dict[str, Any]] = []
@@ -871,19 +872,22 @@ class ExtractionServer:
         trace_ctx = accept_traceparent(traceparent)
         if not isinstance(video_paths, (list, tuple)) or not video_paths:
             self.stats.bump('rejected')
-            return protocol.error('video_paths must be a non-empty list')
+            return protocol.error('video_paths must be a non-empty list',
+                                  code=protocol.ERR_INVALID)
         if priority is None:
             priority = 'interactive'
         if priority not in protocol.PRIORITIES:
             self.stats.bump('rejected')
             return protocol.error(
                 f'unknown priority {priority!r}; known: '
-                f'{", ".join(protocol.PRIORITIES)}')
+                f'{", ".join(protocol.PRIORITIES)}',
+                code=protocol.ERR_INVALID)
         try:
             segment = self._check_range(range_s)
         except (TypeError, ValueError) as e:
             self.stats.bump('rejected')
-            return protocol.error(f'invalid range: {e}')
+            return protocol.error(f'invalid range: {e}',
+                                  code=protocol.ERR_INVALID)
         paths = [str(p) for p in video_paths]
         if len(set(paths)) != len(paths):
             # Request.videos is keyed by path: a duplicate would collapse
@@ -891,17 +895,20 @@ class ExtractionServer:
             # unique-stem assert also catches this, but asserts vanish
             # under `python -O` — this check must not.)
             self.stats.bump('rejected')
-            return protocol.error('duplicate video_paths in one request')
+            return protocol.error('duplicate video_paths in one request',
+                                  code=protocol.ERR_INVALID)
         if feature_type not in PACKED_FEATURES:
             self.stats.bump('rejected')
             return protocol.error(
                 f'feature_type {feature_type!r} has no packed/serving '
-                f'support; serveable: {", ".join(sorted(PACKED_FEATURES))}')
+                f'support; serveable: {", ".join(sorted(PACKED_FEATURES))}',
+                code=protocol.ERR_UNSUPPORTED)
         if _live_session is not None and feature_type not in LIVE_FEATURES:
             self.stats.bump('rejected')
             return protocol.error(
                 f'feature_type {feature_type!r} has no live-session '
-                f'support; live-capable: {", ".join(sorted(LIVE_FEATURES))}')
+                f'support; live-capable: {", ".join(sorted(LIVE_FEATURES))}',
+                code=protocol.ERR_UNSUPPORTED)
         # config resolution is LOCK-FREE: the YAML read + sanity_check
         # must not stall completion callbacks or status/metrics — the
         # admission lock guards only server state (the block below)
@@ -910,7 +917,8 @@ class ExtractionServer:
                                                    overrides)
         except Exception as e:
             self.stats.bump('rejected')
-            return protocol.error(f'invalid request: {e}')
+            return protocol.error(f'invalid request: {e}',
+                                  code=protocol.ERR_INVALID)
 
         # -- content-addressed cache: answer hits BEFORE admission -------
         # A hit is an O(read) file copy — it must not occupy a queue slot
@@ -948,12 +956,13 @@ class ExtractionServer:
         with self._lock:
             if self._draining:
                 self.stats.bump('rejected')
-                return protocol.error('draining')
+                return protocol.error('draining', code=protocol.ERR_SHED)
             capacity = self._admission_capacity(priority)
             if self._inflight_videos + len(miss_paths) > capacity:
                 self.stats.bump('rejected')
                 return protocol.error(
-                    'queue_full', depth=self._inflight_videos,
+                    'queue_full', code=protocol.ERR_SHED,
+                    depth=self._inflight_videos,
                     capacity=capacity, priority=priority)
             worker = self.pool.get(key)
             build_lock = self._build_locks.setdefault(
@@ -984,7 +993,8 @@ class ExtractionServer:
                         except Exception as e:
                             self.stats.bump('rejected')
                             return protocol.error(
-                                f'extractor build failed: {e}')
+                                f'extractor build failed: {e}',
+                                code=protocol.ERR_INTERNAL)
 
             with self._lock:
                 if self._draining:
@@ -994,7 +1004,8 @@ class ExtractionServer:
                     # close never drops already-enqueued work)
                     worker.close()
                     self.stats.bump('rejected')
-                    return protocol.error('draining')
+                    return protocol.error('draining',
+                                          code=protocol.ERR_SHED)
                 if self._inflight_videos + len(miss_paths) > \
                         self._admission_capacity(priority):
                     # re-check after the lockless build window; the
@@ -1002,7 +1013,8 @@ class ExtractionServer:
                     # caller's retry
                     self.stats.bump('rejected')
                     return protocol.error(
-                        'queue_full', depth=self._inflight_videos,
+                        'queue_full', code=protocol.ERR_SHED,
+                        depth=self._inflight_videos,
                         capacity=self._admission_capacity(priority),
                         priority=priority)
                 if worker.closed or worker.crashed:
@@ -1045,7 +1057,8 @@ class ExtractionServer:
             return protocol.ok(request_id=req.id,
                                trace_id=trace_ctx.trace_id)
         self.stats.bump('rejected')
-        return protocol.error('worker churn outpaced admission; retry')
+        return protocol.error('worker churn outpaced admission; retry',
+                              code=protocol.ERR_SHED)
 
     def _submit_fused(self, features, video_paths,
                       overrides: Optional[Dict[str, Any]] = None,
@@ -1069,16 +1082,19 @@ class ExtractionServer:
             fams = resolve_fused_features(features)
         except (TypeError, ValueError) as e:
             self.stats.bump('rejected')
-            return protocol.error(f'invalid features: {e}')
+            return protocol.error(f'invalid features: {e}',
+                                  code=protocol.ERR_INVALID)
         bad = [f for f in fams if f not in PACKED_FEATURES]
         if bad:
             self.stats.bump('rejected')
             return protocol.error(
                 f'features {bad} have no packed/serving support; '
-                f'serveable: {", ".join(sorted(PACKED_FEATURES))}')
+                f'serveable: {", ".join(sorted(PACKED_FEATURES))}',
+                code=protocol.ERR_UNSUPPORTED)
         if not isinstance(video_paths, (list, tuple)) or not video_paths:
             self.stats.bump('rejected')
-            return protocol.error('video_paths must be a non-empty list')
+            return protocol.error('video_paths must be a non-empty list',
+                                  code=protocol.ERR_INVALID)
         paths = [str(p) for p in video_paths]
         trace_ctx = accept_traceparent(traceparent)
         # family-scoped overrides ('<family>.<knob>') peel off to their
@@ -1093,12 +1109,13 @@ class ExtractionServer:
                 self._resolve_entry_config(fam, paths, o)
             except Exception as e:
                 self.stats.bump('rejected')
-                return protocol.error(f'invalid request for {fam!r}: {e}')
+                return protocol.error(f'invalid request for {fam!r}: {e}',
+                                      code=protocol.ERR_INVALID)
 
         with self._lock:
             if self._draining:
                 self.stats.bump('rejected')
-                return protocol.error('draining')
+                return protocol.error('draining', code=protocol.ERR_SHED)
             self._next_id += 1
             parent = FusedRequest(f'r{self._next_id:06d}', fams, paths,
                                   priority=priority, trace=trace_ctx)
@@ -1134,7 +1151,8 @@ class ExtractionServer:
                 self._requests.pop(parent.id, None)
             return protocol.error(
                 'fused submit admitted no family: '
-                + '; '.join(f'{f}: {e}' for f, e in errors.items()))
+                + '; '.join(f'{f}: {e}' for f, e in errors.items()),
+                code=protocol.ERR_INTERNAL)
 
         with self._lock:
             parent.children = children
@@ -1387,8 +1405,17 @@ class ExtractionServer:
         from video_features_tpu.parallel.packing import segment_name
         hits: List[str] = []
         try:
-            cache = FeatureCache.get(args.get('cache_dir'),
-                                     args.get('cache_max_bytes'))
+            l2 = args.get('cache_l2_dir')
+            if l2:
+                # fleet tier: an admission-time hit may be served from
+                # the shared L2 a PEER host published — the request goes
+                # terminal without ever decoding here (docs/fleet.md)
+                from video_features_tpu.fleet.tier import TieredFeatureCache
+                cache = TieredFeatureCache.get_pair(
+                    args.get('cache_dir'), l2, args.get('cache_max_bytes'))
+            else:
+                cache = FeatureCache.get(args.get('cache_dir'),
+                                         args.get('cache_max_bytes'))
             with self._lock:
                 self._caches[cache.cache_dir] = cache
             fp = run_fingerprint(args)
@@ -1412,7 +1439,8 @@ class ExtractionServer:
         with self._lock:
             req = self._requests.get(request_id)
             if req is None:
-                return protocol.error(f'unknown request_id {request_id!r}')
+                return protocol.error(f'unknown request_id {request_id!r}',
+                                      code=protocol.ERR_NOT_FOUND)
             return protocol.ok(**req.snapshot())
 
     def _fold_retired_locked(self, report: Dict[str, Dict]) -> None:
@@ -1645,7 +1673,8 @@ class ExtractionServer:
                     msg = protocol.decode(line)
                     resp = self._dispatch(msg)
                 except Exception as e:
-                    resp = protocol.error(f'{type(e).__name__}: {e}')
+                    resp = protocol.error(f'{type(e).__name__}: {e}',
+                                          code=protocol.ERR_INTERNAL)
                 try:
                     wfile.write(protocol.encode(resp))
                     wfile.flush()
@@ -1666,7 +1695,8 @@ class ExtractionServer:
             unknown = set(msg) - set(protocol.SUBMIT_FIELDS)
             if unknown:
                 return protocol.error(
-                    f'unknown submit fields: {sorted(unknown)}')
+                    f'unknown submit fields: {sorted(unknown)}',
+                    code=protocol.ERR_INVALID)
             return self.submit(msg.get('feature_type'),
                                msg.get('video_paths'),
                                overrides=msg.get('overrides'),
@@ -1688,7 +1718,8 @@ class ExtractionServer:
             if self.index_service is None:
                 return protocol.error(
                     'index is not enabled on this server '
-                    '(start with index_enabled=true)')
+                    '(start with index_enabled=true)',
+                    code=protocol.ERR_UNSUPPORTED)
             try:
                 if msg.get('video_path') is not None:
                     return protocol.ok(**self.index_service.search_by_video(
@@ -1703,7 +1734,8 @@ class ExtractionServer:
                 # malformed query (missing vector, unknown family, bad
                 # dim): the CLIENT's error, answered structurally — a
                 # bad search must never take down the handler thread
-                return protocol.error(f'search failed: {e}')
+                return protocol.error(f'search failed: {e}',
+                                      code=protocol.ERR_INVALID)
         if cmd == protocol.CMD_INDEX_STATUS:
             if self.index_service is None:
                 return protocol.ok(index={'enabled': False})
@@ -1712,7 +1744,8 @@ class ExtractionServer:
             self.drain(wait=False)
             return protocol.ok(draining=True)
         return protocol.error(
-            f'unknown cmd {cmd!r}; known: {", ".join(protocol.COMMANDS)}')
+            f'unknown cmd {cmd!r}; known: {", ".join(protocol.COMMANDS)}',
+            code=protocol.ERR_INVALID)
 
 
 def serve_main(argv: List[str]) -> int:
