@@ -69,14 +69,16 @@ store — the warm boot loads serialized executables instead of compiling
 accelerator-only default.
 
 The fleet rung (``fleet_warm_clips_per_sec`` / ``fleet_cache_hit_rate``
-/ ``fleet_cold_host_first_feature_s``): two daemons sharing an L2
-feature tier and an AOT artifact tier behind the content-hash router
-(fleet/) — host A extracts cold and publishes; host B boots with empty
-local stores, pre-warms compile-free off the artifact tier
-(``builds_compiled == 0`` asserted), and serves A's features from the
-shared L2 without decoding; the warm rate re-serves the worklist
-through the router across both hosts. ``BENCH_FLEET=0/1`` overrides
-the accelerator-only default.
+/ ``fleet_cold_host_first_feature_s`` / ``fleet_metrics_scrape_ms``):
+two daemons sharing an L2 feature tier and an AOT artifact tier behind
+the content-hash router (fleet/) — host A extracts cold and publishes;
+host B boots with empty local stores, pre-warms compile-free off the
+artifact tier (``builds_compiled == 0`` asserted), and serves A's
+features from the shared L2 without decoding; the warm rate re-serves
+the worklist through the router across both hosts, and the scrape
+rung times the router's fleet-aggregated ``metrics_prom`` (vft-scope —
+the cost of the one-scrape-target design). ``BENCH_FLEET=0/1``
+overrides the accelerator-only default.
 
 Default precision is 'mixed' (ops/precision.py): ambient 3-pass bf16 with
 the drift-tolerant sub-graphs on 1-pass — measured ≤1e-3 feature drift vs
@@ -547,6 +549,17 @@ def bench_fleet(tmp_dir: str, platform: str, wl_paths: list) -> dict:
                 f'warm pass missed the shared tier — rung mislabeled: {st}'
         warm_s = time.perf_counter() - t0
 
+        # vft-scope: the aggregated scrape is the fleet's one metrics
+        # hop — time it end-to-end (scrape both backends under the
+        # probe deadline, relabel host=, merge, SLO tick)
+        t0 = time.perf_counter()
+        prom = cr.metrics_prom()
+        scrape_ms = (time.perf_counter() - t0) * 1000.0
+        assert 'vft_fleet_routed_total{host=' in prom, \
+            'aggregated exposition missing fleet families'
+        assert 'vft_slo_latency_burn_rate{window="5m"}' in prom, \
+            'aggregated exposition missing SLO gauges'
+
         clips = 0
         for p in wl_paths:
             arr = np.load(make_path(
@@ -563,6 +576,7 @@ def bench_fleet(tmp_dir: str, platform: str, wl_paths: list) -> dict:
             'fleet_warm_clips_per_sec': round(clips / warm_s, 3),
             'fleet_cache_hit_rate': round(hits / max(1, hits + misses), 4),
             'fleet_cold_host_first_feature_s': round(cold_host_s, 3),
+            'fleet_metrics_scrape_ms': round(scrape_ms, 2),
         }
     finally:
         if router is not None:

@@ -828,7 +828,8 @@ def test_knob_registry_is_behavior_preserving():
     and the vft-index knobs, 'neither' like the cache knobs the index
     derives from — the index stores nothing the cache does not, so its
     presence can never change what bytes a run produces or which warm
-    entry serves it)."""
+    entry serves it; and the vft-scope SLO knobs, 'neither' — burn-rate
+    evaluation only reads metrics the serving path already records)."""
     from video_features_tpu.config import knob_exclude
     assert knob_exclude('fingerprint') == {
         'video_paths', 'file_with_video_paths', 'output_path', 'tmp_path',
@@ -839,6 +840,7 @@ def test_knob_registry_is_behavior_preserving():
         'compilation_cache_dir', 'profile', 'profile_dir', 'show_pred',
         'trace_out', 'trace_capacity', 'manifest_out',
         'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s',
+        'slo_latency_p99_s', 'slo_availability',
         'cache_enabled', 'cache_dir', 'cache_max_bytes', 'cache_l2_dir',
         'aot_enabled', 'aot_dir', 'aot_max_bytes', 'aot_l2_dir',
         'index_enabled', 'index_dir', 'index_shard_rows',
@@ -850,6 +852,7 @@ def test_knob_registry_is_behavior_preserving():
         'manifest_out', 'inflight', 'decode_workers',
         'decode_farm_ring_mb',
         'postmortem_dir', 'postmortem_max_bytes', 'watchdog_stall_s',
+        'slo_latency_p99_s', 'slo_availability',
         'index_enabled', 'index_dir', 'index_shard_rows',
         'index_poll_s', 'index_query_block', 'index_k_max',
         'features'}

@@ -415,6 +415,124 @@ def test_router_drain_aware_membership():
         ok.kill()
 
 
+def test_router_failover_yields_one_merged_trace():
+    """Acceptance pin (vft-scope): a submit that fails over mid-walk
+    yields ONE trace — the router's route/failover spans plus spans
+    from BOTH attempted backends, merged ts-sorted under a single
+    trace_id, every event stamped with its contributing host."""
+    import re
+
+    def traced(tag, captured, shed_submit=False):
+        def respond(msg):
+            if msg['cmd'] == protocol.CMD_PING:
+                return protocol.ok(draining=False)
+            if msg['cmd'] == protocol.CMD_SUBMIT:
+                captured[tag] = msg.get('traceparent')
+                if shed_submit:
+                    return protocol.error('queue full (64/64)',
+                                          code=protocol.ERR_SHED)
+                return protocol.ok(request_id='r-trace')
+            if msg['cmd'] == protocol.CMD_TRACE:
+                tid = captured[tag].split('-')[1]
+                return protocol.ok(
+                    request_id=msg.get('request_id'), trace_id=tid,
+                    state='done',
+                    events=[{'name': f'{tag}_admission', 'ph': 'X',
+                             'ts': 10.0 if shed_submit else 20.0,
+                             'dur': 5.0, 'pid': 1, 'tid': 1,
+                             'args': {'trace_id': tid}}])
+            return protocol.error('unknown', code=protocol.ERR_INVALID)
+        return respond
+
+    captured = {}
+    shed = _FakeBackend(traced('shed', captured, shed_submit=True))
+    ok = _FakeBackend(traced('ok', captured))
+    router = _router([shed.addr, ok.addr])
+    try:
+        from video_features_tpu.fleet.router import FleetRouter
+        from video_features_tpu.serve.client import ServeClient
+        # pick a key the SHEDDING backend owns, so the ring walk
+        # attempts it first and fails over to the healthy one
+        path = next(
+            p for p in (f'/v/trace{i}.mp4' for i in range(200))
+            if router.ring.host_for(FleetRouter.route_key(
+                {'video_paths': [p]})) == shed.addr)
+        client = ServeClient(router.port)
+        resp = client._call({'cmd': 'submit', 'video_paths': [path]})
+        assert resp['ok'] and resp['backend'] == ok.addr
+        rid = resp['request_id']
+
+        # the router minted ONE W3C traceparent and forwarded it to
+        # BOTH attempted backends — same trace_id on each wire
+        w3c = re.compile(r'^00-[0-9a-f]{32}-[0-9a-f]{16}-[0-9a-f]{2}$')
+        assert w3c.match(captured['shed']), captured
+        assert w3c.match(captured['ok']), captured
+        tid = captured['ok'].split('-')[1]
+        assert captured['shed'].split('-')[1] == tid
+
+        trace = client.trace(rid)
+        assert trace['trace_id'] == tid
+        assert sorted(trace['hosts']) == sorted(
+            ['router', shed.addr, ok.addr])
+        spans = [e for e in trace['events'] if e.get('ph') != 'M']
+        by_host = {}
+        for e in spans:
+            by_host.setdefault(e['args']['host'], []).append(e['name'])
+        assert 'shed_admission' in by_host[shed.addr]
+        assert 'ok_admission' in by_host[ok.addr]
+        assert 'failover' in by_host['router']
+        assert 'route' in by_host['router']
+        assert by_host['router'].count('backend_call') == 2
+        # merged presentation order: ts-sorted across all hosts
+        ts = [e['ts'] for e in spans]
+        assert ts == sorted(ts)
+        assert client.metrics()['fleet']['failovers'] >= 1
+    finally:
+        router.stop()
+        shed.kill()
+        ok.kill()
+
+
+def test_router_metrics_prom_aggregates_host_labeled_families():
+    """The router's exposition is the FLEET's: every backend's families
+    relabeled ``host=``, family headers emitted once, plus the router's
+    own ``vft_fleet_*`` and ``vft_slo_*`` series."""
+    def with_prom(msg):
+        if msg['cmd'] == protocol.CMD_METRICS_PROM:
+            return protocol.ok(text='# HELP vft_up liveness\n'
+                                    '# TYPE vft_up gauge\n'
+                                    'vft_up 1\n')
+        return _healthy(msg)
+    b1, b2 = _FakeBackend(with_prom), _FakeBackend(with_prom)
+    router = _router([b1.addr, b2.addr])
+    try:
+        from video_features_tpu.serve.client import ServeClient
+        client = ServeClient(router.port)
+        resp = client._call({'cmd': 'submit', 'video_paths': ['/v/a.mp4']})
+        assert resp['ok']
+        text = client.metrics_prom()
+        for addr in (b1.addr, b2.addr):
+            assert f'vft_up{{host="{addr}"}} 1' in text, text
+            assert f'vft_fleet_backend_up{{host="{addr}"}} 1' in text
+            assert f'vft_fleet_probe_age_seconds{{host="{addr}"}}' in text
+        # one merged family header despite two contributing hosts
+        assert text.count('# TYPE vft_up gauge') == 1
+        assert 'vft_fleet_routed_total{host=' in text
+        assert 'vft_fleet_requests_total{outcome="completed"} 1' in text
+        assert 'vft_slo_latency_burn_rate{window="5m"}' in text
+        assert 'vft_slo_availability_burn_rate{window="1h"}' in text
+        # a dead backend contributes nothing but stays visible as down
+        b2.kill()
+        router.probe()
+        text = router.metrics_prom()
+        assert f'vft_up{{host="{b2.addr}"}}' not in text
+        assert f'vft_fleet_backend_up{{host="{b2.addr}"}} 0' in text
+    finally:
+        router.stop()
+        b1.kill()
+        b2.kill()
+
+
 # -- real two-backend integration (the acceptance scenario) ------------------
 
 

@@ -307,7 +307,7 @@ def test_index_cli_ingest_query_status(tmp_path, capsys):
 
 
 def test_locks_pin_the_index_surface():
-    """Re-pin coverage: the wire lock carries the v1.4 additive surface
+    """Re-pin coverage: the wire lock carries the v1.5 additive surface
     and the programs lock pins the ``index`` pseudo-family at BOTH mesh
     widths with the canonical geometry (the deep drift/rule gates live
     in test_wire.py / test_programs.py — this names the index rows)."""
@@ -315,7 +315,7 @@ def test_locks_pin_the_index_surface():
         INDEX_DIM, INDEX_K, INDEX_QUERIES, INDEX_ROWS,
     )
     wire = json.loads((REPO_ROOT / 'WIRE.lock.json').read_text())
-    assert wire['version'] == '1.4'
+    assert wire['version'] == '1.5'
     assert 'search' in wire['commands'] and 'index_status' in wire['commands']
     assert 'POST /v1/search' in wire['routes']
     assert wire['routes']['POST /v1/search']['auth']

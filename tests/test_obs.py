@@ -132,9 +132,10 @@ def test_trace_view_accepts_b_e_pairs(tmp_path):
 
 # -- metrics registry + Prometheus exposition --------------------------------
 
+_LABEL_VALUE = r'"(?:[^"\\]|\\.)*"'   # escaped \" \\ \n allowed inside
 _SAMPLE_RE = re.compile(
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
-    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*=' + _LABEL_VALUE +
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*=' + _LABEL_VALUE + r')*\})? '
     r'(NaN|[+-]?Inf|[-+0-9.eE]+)$')
 
 
@@ -190,6 +191,27 @@ def test_registry_rejects_type_conflicts_and_negative_inc():
         reg.counter('y_total').inc(-1)
 
 
+def test_prometheus_escaping_label_values_and_help():
+    """Exposition-format escaping: label values escape backslash,
+    double-quote, and newline; HELP text escapes backslash and newline
+    (but NOT quotes — the 0.0.4 rules differ). Host labels injected by
+    the fleet aggregator carry arbitrary operator strings, so a hostile
+    value must not tear the line grammar."""
+    reg = MetricsRegistry()
+    reg.gauge('vft_up', 'backend "up"\nby host (C:\\fleet)',
+              labels={'host': 'bad"host\\with\nnewline'}).set(1)
+    text = reg.render()
+    assert ('vft_up{host="bad\\"host\\\\with\\nnewline"} 1'
+            in text.splitlines())
+    # HELP: backslash and newline escaped, the quote left alone
+    assert ('# HELP vft_up backend "up"\\nby host (C:\\\\fleet)'
+            in text.splitlines())
+    # no raw newline survived into the body of any line
+    for line in text.splitlines():
+        assert '\n' not in line
+    assert_valid_prometheus(text)
+
+
 def test_histogram_default_buckets_cover_latency_range():
     h = Histogram()
     assert h.buckets == tuple(sorted(DEFAULT_BUCKETS))
@@ -233,6 +255,98 @@ def test_prometheus_from_serve_doc():
                    'vft_stage_seconds{stage="model"} 2',
                    'vft_stage_occupancy{stage="model"} 0.75'):
         assert needle in text, f'{needle!r} missing from:\n{text}'
+
+
+# -- SLO burn-rate evaluation (obs/slo.py) -----------------------------------
+
+def test_slo_burn_rate_trips_on_latency_spike():
+    """Satellite/acceptance pin: an injected latency spike drives the
+    burn rate over the 14.4x threshold in BOTH windows, fires the
+    alert (gauges + alerts_total + WARNING event), and a recovery
+    phase resolves it WITHOUT another FIRING transition."""
+    from video_features_tpu.obs.events import event_counts
+    from video_features_tpu.obs.slo import SloEvaluator
+
+    clock = {'t': 1000.0}
+    reg = MetricsRegistry()
+    slo = SloEvaluator(reg, latency_p99_s=1.0,
+                       clock=lambda: clock['t'])
+    h = reg.histogram('vft_serve_request_latency_seconds')
+    warn0 = event_counts().get(('WARNING', 'slo'), 0)
+
+    slo.tick()                               # baseline sample
+    for _ in range(100):
+        h.observe(0.01)                      # clean traffic
+    clock['t'] += 30
+    doc = slo.tick()
+    assert doc['enabled'] is True
+    assert doc['alerts'] == {'latency_p99': False}
+    assert all(v == 0.0 for v in doc['burn_rates']['latency'].values())
+
+    for _ in range(50):
+        h.observe(5.0)                       # the spike: 50 over 1.0s
+    clock['t'] += 30
+    doc = slo.tick()
+    # 50/150 over threshold → frac 1/3 → burn ~33x against the 1%
+    # budget, in both windows (both baselines predate the spike)
+    assert doc['alerts'] == {'latency_p99': True}
+    assert doc['alerts_firing'] == 1
+    assert doc['alerts_total'] == 1
+    for burn in doc['burn_rates']['latency'].values():
+        assert burn > 14.4
+    assert event_counts().get(('WARNING', 'slo'), 0) == warn0 + 1
+    text = reg.render()
+    assert_valid_prometheus(text)
+    assert 'vft_slo_latency_burn_rate{window="5m"}' in text
+    assert 'vft_slo_alert{slo="latency_p99"} 1' in text
+    assert 'vft_slo_latency_threshold_seconds 1' in text
+
+    # recovery: enough clean traffic that the 5m window's baseline
+    # moves past the spike → short-window burn drops → alert resolves
+    for _ in range(2000):
+        h.observe(0.01)
+    clock['t'] += 400
+    doc = slo.tick()
+    assert doc['alerts'] == {'latency_p99': False}
+    assert doc['alerts_firing'] == 0
+    assert doc['alerts_total'] == 1          # FIRING transitions only
+    assert 'vft_slo_alert{slo="latency_p99"} 0' in reg.render()
+
+
+def test_slo_availability_burn_rate():
+    """The availability objective burns on the failed-request fraction:
+    10% failures against a 99.9% target is a 100x burn."""
+    from video_features_tpu.obs.slo import SloEvaluator
+
+    clock = {'t': 0.0}
+    reg = MetricsRegistry()
+    slo = SloEvaluator(reg, availability=0.999,
+                       clock=lambda: clock['t'])
+    slo.tick()
+    reg.counter('vft_serve_requests_total',
+                labels={'outcome': 'completed'}).inc(90)
+    reg.counter('vft_serve_requests_total',
+                labels={'outcome': 'failed'}).inc(10)
+    clock['t'] += 60
+    doc = slo.tick()
+    for burn in doc['burn_rates']['availability'].values():
+        assert burn == pytest.approx(100.0)
+    assert doc['alerts'] == {'availability': True}
+    assert 'vft_slo_availability_burn_rate{window="1h"}' in reg.render()
+
+
+def test_slo_evaluator_rejects_bad_objectives():
+    from video_features_tpu.obs.slo import SloEvaluator, disabled_stats
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        SloEvaluator(reg)                    # no objective at all
+    with pytest.raises(ValueError):
+        SloEvaluator(reg, latency_p99_s=0.0)
+    with pytest.raises(ValueError):
+        SloEvaluator(reg, availability=1.5)
+    # the disabled shape carries the same keys as a live evaluation
+    live = SloEvaluator(reg, latency_p99_s=1.0, clock=lambda: 0.0).tick()
+    assert set(disabled_stats()) <= set(live)
 
 
 # -- structured event log ----------------------------------------------------
@@ -570,12 +684,15 @@ METRICS_DOC_KEYS = {'uptime_s', 'queue', 'warm_pool', 'cache', 'farm',
                     # (recorders + events_dropped), and the stall
                     # watchdog's progress ledger ({'enabled': False}
                     # without watchdog_stall_s)
-                    'events', 'trace', 'watchdog'}
+                    'events', 'trace', 'watchdog',
+                    # vft-scope: SLO burn-rate evaluation (obs/slo.py),
+                    # {'enabled': False, ...} without slo_* knobs
+                    'slo'}
 TRACE_EVENT_KEYS = {'name', 'ph', 'ts', 'dur', 'pid', 'tid', 'args', 's'}
 MANIFEST_KEYS = {'schema', 'version', 'started_at_unix_s', 'wall_s',
                  'config', 'fingerprints', 'videos', 'outcomes', 'stages',
                  'compile', 'executables', 'farm', 'mesh', 'ingress',
-                 'programs_lock', 'aot', 'index'}
+                 'programs_lock', 'aot', 'index', 'slo'}
 
 
 CANONICAL_STAGES = {'decode', 'decode+preprocess', 'audio_dsp',

@@ -53,16 +53,19 @@ RESNET_KEYS = ('resnet', 'fps', 'timestamps_ms')
 
 def test_check_version_minor_skew_accepted_major_rejected():
     """The MAJOR/MINOR compatibility contract behind WIRE.lock.json's
-    bump semantics: VERSION is now '1.4' (1.1 covered PR 8's versioning
+    bump semantics: VERSION is now '1.5' (1.1 covered PR 8's versioning
     + PR 11's trace surface; 1.2 added the additive `features` fused
     submit field; 1.3 adds the `search`/`index_status` feature-index
     surface; 1.4 adds the additive `code` error field the fleet
-    router's failover keys on), and a client speaking ANY unknown 1.x
+    router's failover keys on; 1.5 adds the additive fleet
+    observability surface — scatter-gathered traces with `hosts`,
+    aggregated `/metrics`, `vft_slo_*`), and a client speaking ANY
+    unknown 1.x
     must keep working, while an unknown major gets the structured
     rejection echoing its request_id."""
     from video_features_tpu.serve import protocol
 
-    assert protocol.VERSION == '1.4'
+    assert protocol.VERSION == '1.5'
     assert protocol.MAJOR == 1
     # minor skew is additive-fields-only by contract: never rejected,
     # future minors included

@@ -579,7 +579,7 @@ def test_live_lock_covers_the_whole_surface():
     lock = load_lock(default_lock_path())
     from video_features_tpu.serve import protocol
     assert set(lock['commands']) == set(protocol.COMMANDS)
-    assert protocol.VERSION == lock['version'] == '1.4'
+    assert protocol.VERSION == lock['version'] == '1.5'
     paths = {k.split(' ', 1)[1] for k in lock['routes']}
     assert {'/healthz', '/v1/extract', '/v1/requests/<id>',
             '/v1/requests/<id>/trace', '/v1/live/<id>', '/v1/metrics',

@@ -3,10 +3,12 @@
 
 Connects to a fleet router's loopback port (``fleet`` command), asks for
 its metrics document, and prints one row per configured backend —
-health, drain state, live queue depth, cache hit rate, and warm-pool
-build counters — plus the router's own routing/failover counters. The
-same document backs the router's HTTP ``GET /v1/metrics``; this tool is
-the no-auth operator surface for the loopback deployment shape.
+health, PROBE FRESHNESS (``age_s``: seconds since the last probe, so a
+stale last-good row is distinguishable from a live healthy backend),
+drain state, live queue depth, cache hit rate, and warm-pool build
+counters — plus the router's own routing/failover counters. The same
+document backs the router's HTTP ``GET /v1/metrics``; this tool is the
+no-auth operator surface for the loopback deployment shape.
 
 Usage:
     python tools/fleet_status.py [--host 127.0.0.1] --port 9310 [--json]
@@ -74,14 +76,16 @@ def main(argv=None) -> int:
               f"draining={fleet.get('draining')}  "
               f"failovers={fleet.get('failovers')}  "
               f"rejected={fleet.get('rejected')}")
-        header = (f"{'backend':24} {'health':>9} {'drain':>5} "
-                  f"{'queue':>5} {'hit%':>6} {'compiled':>8} "
-                  f"{'loaded':>6} {'routed':>7}  last_error")
+        header = (f"{'backend':24} {'health':>9} {'age_s':>6} "
+                  f"{'drain':>5} {'queue':>5} {'hit%':>6} "
+                  f"{'compiled':>8} {'loaded':>6} {'routed':>7}  "
+                  f"last_error")
         print(header)
         for addr, row in sorted((fleet.get('backends') or {}).items()):
             hit = row.get('cache_hit_rate')
             print(f"{addr:24} "
                   f"{'healthy' if row.get('healthy') else 'DOWN':>9} "
+                  f"{_fmt(row.get('probe_age_s'), 6)} "
                   f"{'yes' if row.get('draining') else 'no':>5} "
                   f"{_fmt(row.get('queue_depth'), 5)} "
                   f"{_fmt(None if hit is None else 100 * hit, 6)} "

@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""SLO burn-rate report: objectives, per-window burn, alert states.
+
+Connects to a serve daemon OR a fleet router loopback port, reads the
+``slo`` section of its metrics document (``obs/slo.py`` — a daemon
+carries it when ``slo_latency_p99_s=`` / ``slo_availability=`` are
+set; a router always does, over its routed-request families), and
+renders one line per (objective, window) with the alert verdict.
+
+A burn rate of 1.0 means the error budget is being spent exactly at
+the sustainable pace; the alert fires when EVERY window burns above
+the threshold (default 14.4x — a 30-day budget gone in ~2 days).
+
+Usage:
+    python tools/slo_report.py [--host 127.0.0.1] --port 9310 [--json]
+
+Exit codes (monitorable — cron/CI can alert on them):
+    0  SLO evaluation enabled, no alert firing
+    1  at least one burn-rate alert is FIRING
+    2  the target is unreachable, or answers a metrics document with
+       SLO evaluation disabled (nothing to report)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--host', default='127.0.0.1',
+                    help='the daemon/router host (default: loopback)')
+    ap.add_argument('--port', type=int, required=True,
+                    help='a serve daemon or fleet router loopback port')
+    ap.add_argument('--timeout-s', type=float, default=5.0,
+                    help='connect deadline for reaching the target')
+    ap.add_argument('--json', action='store_true',
+                    help='print the raw slo section instead of the '
+                         'report')
+    ns = ap.parse_args(argv)
+
+    from video_features_tpu.serve.client import ServeClient, ServeError
+    try:
+        doc = ServeClient(ns.port, host=ns.host,
+                          connect_timeout_s=ns.timeout_s).metrics()
+    except (ServeError, OSError) as e:
+        print(f'error: {ns.host}:{ns.port} unreachable: {e}',
+              file=sys.stderr)
+        return 2
+    # a router nests its document under 'fleet'; a daemon is flat
+    slo = (doc.get('fleet') or doc).get('slo')
+    if not isinstance(slo, dict) or not slo.get('enabled'):
+        print(f'error: {ns.host}:{ns.port} has SLO evaluation disabled '
+              '(set slo_latency_p99_s= / slo_availability= on the '
+              'daemon; the fleet router always evaluates)',
+              file=sys.stderr)
+        return 2
+
+    if ns.json:
+        print(json.dumps(slo, sort_keys=True))
+    else:
+        objectives = slo.get('objectives') or {}
+        alerts = slo.get('alerts') or {}
+        threshold = slo.get('burn_alert_threshold')
+        print(f"slo report {ns.host}:{ns.port}  "
+              f"objectives={json.dumps(objectives, sort_keys=True)}  "
+              f"alert_threshold={threshold}x")
+        burn = slo.get('burn_rates') or {}
+        for objective in sorted(burn):
+            windows = burn[objective] or {}
+            rendered = '  '.join(f'{w}={windows[w]:.2f}x'
+                                 for w in sorted(windows))
+            key = 'latency_p99' if objective == 'latency' else objective
+            verdict = 'FIRING' if alerts.get(key) else 'ok'
+            print(f'  {objective:<14} {rendered}  [{verdict}]')
+        print(f"alerts firing: {slo.get('alerts_firing', 0)}  "
+              f"(lifetime transitions: {slo.get('alerts_total', 0)})")
+
+    return 1 if slo.get('alerts_firing') else 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -29,8 +29,18 @@ removes.
 
 Exit codes: 0 valid · 1 invalid (details on stderr) · 2 usage/IO error.
 
+Multiple files merge into ONE timeline before validation and the
+critical-path summaries: metadata events first, then every timeline
+event ts-sorted, with per-file ``events_dropped`` summed — the
+fleet-debugging workflow, where the router export and each backend's
+export land in separate files but share trace_ids (vft-scope forwards
+one traceparent across hosts, so grouping by trace_id stitches the
+request back together). A SINGLE file is still checked as-written —
+no re-sort — so a torn export keeps failing the monotonicity check.
+
 Usage:
-    python tools/trace_view.py TRACE.json [--quiet] [--trace-id ID]
+    python tools/trace_view.py TRACE.json [MORE.json ...]
+                               [--quiet] [--trace-id ID]
 """
 from __future__ import annotations
 
@@ -207,7 +217,10 @@ def summarize(events: List[Dict[str, Any]]) -> str:
 
 def main(argv: List[str] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument('trace', help='Chrome trace-event JSON file')
+    ap.add_argument('trace', nargs='+',
+                    help='Chrome trace-event JSON file(s); several merge '
+                         'into one ts-sorted timeline (events sharing a '
+                         'trace_id group across files)')
     ap.add_argument('--quiet', action='store_true',
                     help='validate only; no summary table')
     ap.add_argument('--trace-id', default=None, metavar='ID',
@@ -215,19 +228,39 @@ def main(argv: List[str] = None) -> int:
                          'trace (vft-flight trace_id)')
     args = ap.parse_args(argv)
 
-    try:
-        with open(args.trace) as f:
-            doc = json.load(f)
-    except (OSError, json.JSONDecodeError) as e:
-        print(f'trace_view: cannot read {args.trace}: {e}', file=sys.stderr)
-        return 2
-    if not isinstance(doc, dict) or \
-            not isinstance(doc.get('traceEvents'), list):
-        print('trace_view: not a trace-event document (expected an '
-              'object with a traceEvents list)', file=sys.stderr)
-        return 1
+    docs = []
+    for path in args.trace:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f'trace_view: cannot read {path}: {e}', file=sys.stderr)
+            return 2
+        if not isinstance(doc, dict) or \
+                not isinstance(doc.get('traceEvents'), list):
+            print(f'trace_view: {path}: not a trace-event document '
+                  '(expected an object with a traceEvents list)',
+                  file=sys.stderr)
+            return 1
+        docs.append(doc)
 
-    events = doc['traceEvents']
+    if len(docs) == 1:
+        # single file: check as-written (a torn export must keep failing
+        # the monotonicity check), exactly the pre-merge behavior
+        events = docs[0]['traceEvents']
+        dropped = (docs[0].get('otherData') or {}).get('events_dropped', 0)
+    else:
+        merged = [ev for doc in docs for ev in doc['traceEvents']]
+        # metadata first, then the joint ts-sorted timeline (stable, so
+        # equal timestamps keep per-file order) — the same ordering the
+        # recorders' own merge uses
+        events = sorted(merged,
+                        key=lambda e: (isinstance(e, dict)
+                                       and e.get('ph') not in META_PHASES,
+                                       (e.get('ts', 0)
+                                        if isinstance(e, dict) else 0)))
+        dropped = sum((doc.get('otherData') or {}).get('events_dropped', 0)
+                      for doc in docs)
     errors = validate_events(events)
     if errors:
         for err in errors[:50]:
@@ -235,7 +268,6 @@ def main(argv: List[str] = None) -> int:
         print(f'trace_view: INVALID — {len(errors)} violation(s) in '
               f'{len(events)} events', file=sys.stderr)
         return 1
-    dropped = (doc.get('otherData') or {}).get('events_dropped', 0)
     if args.trace_id is not None:
         selected = [e for e in events
                     if args.trace_id in event_trace_ids(e)]

@@ -194,6 +194,14 @@ OBS_DEFAULTS: Dict[str, Any] = {
     # seconds without a single stage advance trips a structured event +
     # vft_watchdog_stalls_total{stage} + a black-box dump. null = off.
     'watchdog_stall_s': None,
+    # -- vft-scope SLOs (obs/slo.py) -------------------------------------
+    # declarative objectives; setting either turns on multi-window 5m/1h
+    # burn-rate evaluation over the serve request families, vft_slo_*
+    # gauges, and structured obs/events alerts. null = off.
+    # "99% of requests complete within this many seconds":
+    'slo_latency_p99_s': None,
+    # request success-rate objective in (0, 1), e.g. 0.999:
+    'slo_availability': None,
 }
 
 
@@ -300,6 +308,11 @@ KNOB_CLASSIFICATION: Dict[str, str] = {
     'postmortem_dir': 'neither',
     'postmortem_max_bytes': 'neither',
     'watchdog_stall_s': 'neither',
+    # vft-scope SLOs: burn-rate evaluation reads metrics the serving
+    # path already records — an objective can't change extracted bytes
+    # or executable identity
+    'slo_latency_p99_s': 'neither',
+    'slo_availability': 'neither',
     # the cache's own namespace must not fragment its key space; pool-key
     # RELEVANT: a worker's extractor publishes/consults the cache
     # configured at build time, so requests with different cache
@@ -759,6 +772,22 @@ def sanity_check(args: Config) -> None:
                              'without a stage advance before a stall '
                              f'trips); got {args["watchdog_stall_s"]}')
 
+    # vft-scope SLO knobs (obs/slo.py): a latency objective is a positive
+    # deadline; availability is a success-rate target strictly inside
+    # (0, 1) — 1.0 means a zero error budget and every failure divides
+    # by it
+    if args.get('slo_latency_p99_s') is not None:
+        args['slo_latency_p99_s'] = float(args['slo_latency_p99_s'])
+        if args['slo_latency_p99_s'] <= 0:
+            raise ValueError('slo_latency_p99_s must be > 0 (the p99 '
+                             'latency objective in seconds); got '
+                             f'{args["slo_latency_p99_s"]}')
+    if args.get('slo_availability') is not None:
+        args['slo_availability'] = float(args['slo_availability'])
+        if not 0 < args['slo_availability'] < 1:
+            raise ValueError('slo_availability must be in (0, 1), e.g. '
+                             f'0.999; got {args["slo_availability"]}')
+
     assert args.get('file_with_video_paths') or args.get('video_paths'), \
         '`video_paths` or `file_with_video_paths` must be specified'
     filenames = [Path(p).stem for p in form_list_from_user_input(
@@ -999,6 +1028,13 @@ FLEET_DEFAULTS: Dict[str, Any] = {
     'fleet_connect_timeout_s': 2.0,
     # virtual nodes per host on the consistent-hash ring
     'fleet_ring_replicas': 64,
+    # fleet-level SLOs (obs/slo.py evaluated over the router's routed-
+    # request families): always on at the router — /metrics is one
+    # scrape target for the whole fleet, so the vft_slo_* gauges must
+    # always render. Defaults are generous (video extraction is
+    # minutes-scale); tighten per deployment.
+    'fleet_slo_latency_p99_s': 30.0,
+    'fleet_slo_availability': 0.999,
 }
 
 
@@ -1037,10 +1073,14 @@ def split_fleet_config(cli_args: Dict[str, Any]) -> Tuple[Config, Config]:
         if fleet[key] < 1:
             raise ValueError(f'{key} must be >= 1; got {fleet[key]}')
     for key in ('fleet_probe_interval_s', 'fleet_backoff_base_s',
-                'fleet_connect_timeout_s'):
+                'fleet_connect_timeout_s', 'fleet_slo_latency_p99_s'):
         fleet[key] = float(fleet[key])
         if fleet[key] <= 0:
             raise ValueError(f'{key} must be > 0; got {fleet[key]}')
+    fleet['fleet_slo_availability'] = float(fleet['fleet_slo_availability'])
+    if not 0 < fleet['fleet_slo_availability'] < 1:
+        raise ValueError('fleet_slo_availability must be in (0, 1), '
+                         f'e.g. 0.999; got {fleet["fleet_slo_availability"]}')
     if fleet['fleet_http_port'] is not None:
         fleet['fleet_http_port'] = int(fleet['fleet_http_port'])
         if not fleet['fleet_auth_file']:

@@ -9,9 +9,11 @@ One router process speaks BOTH surfaces the single-host daemon does:
   * the ingress HTTP surface (``ingress/http.py`` transport +
     ``ingress/auth.py`` API keys + ``ingress/quota.py`` tenant gates)
     when ``fleet_http_port`` is set — ``POST /v1/extract``,
-    ``POST /v1/search``, ``GET /v1/requests/<id>``, ``GET /v1/metrics``,
-    and an unauthenticated ``GET /healthz`` carrying the per-backend
-    health table.
+    ``POST /v1/search``, ``GET /v1/requests/<id>``,
+    ``GET /v1/requests/<id>/trace`` (cross-host assembled trace),
+    ``GET /v1/metrics``, ``GET /metrics`` (fleet-aggregated Prometheus
+    text), and an unauthenticated ``GET /healthz`` carrying the
+    per-backend health table.
 
 Routing: requests key on the first video's CONTENT hash (the same
 sha256 the content-addressed cache keys on — ``cache/key.hash_file``),
@@ -36,6 +38,22 @@ the backend unhealthy immediately — the next submit skips it without
 waiting for the probe cycle. Unhealthy hosts stay ON the ring
 (eligibility is a filter, not a rebuild), so when one returns, exactly
 its own keys come home.
+
+Observability (vft-scope): the router is the one hop every production
+request crosses, so it records its own ``route`` / ``backend_call`` /
+``failover`` / ``probe`` spans on a ``SpanRecorder``, mints or adopts
+a W3C traceparent per submit and forwards it on every loopback hop —
+one trace_id spans the whole fleet. The ``trace`` command (and
+``GET /v1/requests/<id>/trace``) scatter-gathers: every backend the
+request ATTEMPTED is asked for its spans (failover history included),
+each event is stamped ``host=``, and the merge is ts-sorted under the
+one trace_id. Per-host clocks are not aligned — the ts-sort is a
+presentation order; the ``host`` attr is the ground truth for "where
+did this span run". ``metrics_prom`` / ``GET /metrics`` aggregate
+every backend's exposition (``fleet/aggregate.py``: host-relabel +
+merge) with the router's own ``vft_fleet_*`` families and the always-on
+fleet SLO burn-rate gauges (``obs/slo.py`` over the router's
+routed-request families) — one scrape target for the whole fleet.
 """
 from __future__ import annotations
 
@@ -44,13 +62,22 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from video_features_tpu.fleet import aggregate
 from video_features_tpu.fleet.ring import DEFAULT_REPLICAS, HashRing
+from video_features_tpu.obs.context import TraceContext, accept_traceparent
+from video_features_tpu.obs.metrics import MetricsRegistry
+from video_features_tpu.obs.slo import SloEvaluator
+from video_features_tpu.obs.spans import CLOCK, SpanRecorder
 from video_features_tpu.serve import protocol
 from video_features_tpu.serve.client import ServeClient, ServeError
 
 # request_id → backend retention for status/trace routing; same bound
 # as the daemons' own request history
 ROUTE_HISTORY = 4096
+
+# the router's span ring: routing spans are tiny (4 per routed request
+# worst-case) so a fraction of the daemons' 200K default covers hours
+ROUTER_TRACE_CAPACITY = 50_000
 
 
 def _log_fleet_error(what: str) -> None:
@@ -79,8 +106,14 @@ class Backend:
         self.consecutive_failures = 0
 
     def snapshot(self) -> Dict[str, Any]:
+        # probe_age_s makes freshness explicit: `healthy` alone can't
+        # distinguish a live backend from one whose last GOOD probe is
+        # a probe-loop stall ago (None = never probed)
+        age = (round(time.time() - self.last_probe_t, 3)
+               if self.last_probe_t else None)
         return {'healthy': self.healthy, 'draining': self.draining,
                 'last_probe_t': self.last_probe_t,
+                'probe_age_s': age,
                 'last_error': self.last_error,
                 'consecutive_failures': self.consecutive_failures}
 
@@ -104,7 +137,9 @@ class FleetRouter:
                  backoff_base_s: float = 0.05,
                  connect_timeout_s: float = 2.0,
                  ring_replicas: int = DEFAULT_REPLICAS,
-                 max_connections: int = 64) -> None:
+                 max_connections: int = 64,
+                 slo_latency_p99_s: float = 30.0,
+                 slo_availability: float = 0.999) -> None:
         addrs = []
         for h in hosts:
             addr = str(h)
@@ -123,13 +158,38 @@ class FleetRouter:
         self._lock = threading.Lock()
         self._draining = False
         self._started_at = time.monotonic()
-        # request_id → backend addr (status/trace routing), bounded
-        self._routes: Dict[str, str] = {}
+        # request_id → (owner addr, trace_id, attempted addrs) — the
+        # owner routes status; the full attempt history (failovers
+        # included) routes the scatter-gather trace assembly
+        self._routes: Dict[str, Tuple[str, Optional[str],
+                                      Tuple[str, ...]]] = {}
         self._route_order: 'deque[str]' = deque()
         # counters (under _lock)
         self._routed: Dict[str, int] = {a: 0 for a in self.ring.hosts}
         self._failovers = 0
         self._rejected = 0
+        # vft-scope: the router's own observability plane — routing
+        # spans, vft_fleet_* families, and the always-on fleet SLO
+        # (its /metrics is the fleet's one scrape target, so the
+        # vft_slo_* gauges must always render)
+        self.recorder = SpanRecorder(capacity=ROUTER_TRACE_CAPACITY)
+        self.registry = MetricsRegistry()
+        self._latency_hist = self.registry.histogram(
+            'vft_fleet_request_latency_seconds',
+            'router-observed latency of routed requests (failover '
+            'walk included)')
+        self._req_completed = self.registry.counter(
+            'vft_fleet_requests_total', 'routed requests by outcome',
+            labels={'outcome': 'completed'})
+        self._req_failed = self.registry.counter(
+            'vft_fleet_requests_total', 'routed requests by outcome',
+            labels={'outcome': 'failed'})
+        self.slo = SloEvaluator(
+            self.registry,
+            latency_p99_s=slo_latency_p99_s,
+            availability=slo_availability,
+            latency_family='vft_fleet_request_latency_seconds',
+            outcome_family='vft_fleet_requests_total')
         self._sock = None
         self._accept_thread: Optional[threading.Thread] = None
         self._probe_thread: Optional[threading.Thread] = None
@@ -224,6 +284,7 @@ class FleetRouter:
         ``ping`` (wire 1.1+) answers ``draining`` — a draining host is
         alive but leaves the eligible set."""
         for b in self._backends.values():
+            t0 = CLOCK()
             try:
                 resp = self._probe_call(b)
                 with self._lock:
@@ -239,6 +300,9 @@ class FleetRouter:
             finally:
                 with self._lock:
                     b.last_probe_t = time.time()
+                    healthy, draining = b.healthy, b.draining
+                self.recorder.span('probe', t0, CLOCK(), host=b.addr,
+                                   healthy=healthy, draining=draining)
         with self._lock:
             return {a: b.snapshot() for a, b in self._backends.items()}
 
@@ -276,9 +340,12 @@ class FleetRouter:
                 return str(paths[0])
         return f"family:{msg.get('family')}"
 
-    def _remember_route(self, request_id: str, addr: str) -> None:
+    def _remember_route(self, request_id: str, addr: str,
+                        trace_id: Optional[str] = None,
+                        attempted: Tuple[str, ...] = ()) -> None:
         with self._lock:
-            self._routes[request_id] = addr
+            self._routes[request_id] = (addr, trace_id,
+                                        attempted or (addr,))
             self._route_order.append(request_id)
             while len(self._route_order) > ROUTE_HISTORY:
                 self._routes.pop(self._route_order.popleft(), None)
@@ -290,33 +357,64 @@ class FleetRouter:
                              connect_timeout_s=self.connect_timeout_s)
         return client._call(dict(msg))
 
+    @staticmethod
+    def _span_ids(ctx: Optional[TraceContext]) -> Dict[str, str]:
+        """trace_id + a FRESH span_id for one router span (the pairing
+        contract: every trace-scoped event names its own span)."""
+        return ctx.child().attrs() if ctx is not None else {}
+
+    def _observe_routed(self, t0: float, ok: bool) -> None:
+        """Feed the router's SLO families: one latency observation and
+        one outcome per routed request (failover walk included — the
+        caller experienced the whole walk)."""
+        self._latency_hist.observe(CLOCK() - t0)
+        (self._req_completed if ok else self._req_failed).inc()
+
     def _route(self, key: str, msg: Dict[str, Any],
-               on_success: Optional[Callable[[Dict[str, Any], str],
-                                             None]] = None,
+               on_success: Optional[Callable[[Dict[str, Any], str,
+                                              Tuple[str, ...]], None]]
+               = None,
+               ctx: Optional[TraceContext] = None,
                ) -> Dict[str, Any]:
         """Walk the ring's failover order for ``key``, forwarding
         ``msg``; classify each failure by its structured code and
         either walk on (shed / connect_refused / deadline) or
         propagate. Returns the successful backend response, or the
-        LAST failure as a structured error."""
+        LAST failure as a structured error. ``on_success`` receives
+        the response, the serving backend, and every backend the walk
+        ATTEMPTED (trace assembly follows the same history)."""
+        t_route = CLOCK()
         hosts = self.ring.hosts_for(key, eligible=self.eligible())
         if not hosts:
             with self._lock:
                 self._rejected += 1
+            self._observe_routed(t_route, ok=False)
             return protocol.error('no eligible fleet backend '
                                   '(all unhealthy or draining)',
                                   code=protocol.ERR_SHED)
         delay = self.backoff_base_s
         last: Optional[ServeError] = None
+        attempted: List[str] = []
         for i, addr in enumerate(hosts[:self.max_attempts]):
             if i > 0:
                 with self._lock:
                     self._failovers += 1
+                t_f = CLOCK()
                 time.sleep(delay)
+                self.recorder.span('failover', t_f, CLOCK(),
+                                   from_backend=attempted[-1],
+                                   to_backend=addr, attempt=i,
+                                   **self._span_ids(ctx))
                 delay = min(delay * 2, self._BACKOFF_CAP_S)
+            attempted.append(addr)
+            t_call = CLOCK()
             try:
                 resp = self._backend_call(addr, msg)
             except ServeError as e:
+                self.recorder.span('backend_call', t_call, CLOCK(),
+                                   backend=addr, attempt=i,
+                                   error_code=e.code,
+                                   **self._span_ids(ctx))
                 last = e
                 if e.code == protocol.ERR_CONNECT_REFUSED:
                     # fast member removal: don't wait for the probe
@@ -332,17 +430,35 @@ class FleetRouter:
                 # transport surprise outside the classified set (reset
                 # mid-read, undecodable response): treat as shed —
                 # another host may serve it — but remember the text
+                self.recorder.span('backend_call', t_call, CLOCK(),
+                                   backend=addr, attempt=i,
+                                   error_code=protocol.ERR_SHED,
+                                   **self._span_ids(ctx))
                 last = ServeError(f'{type(e).__name__}: {e}',
                                   code=protocol.ERR_SHED)
                 continue
+            self.recorder.span('backend_call', t_call, CLOCK(),
+                               backend=addr, attempt=i,
+                               **self._span_ids(ctx))
             with self._lock:
                 self._routed[addr] = self._routed.get(addr, 0) + 1
             if on_success is not None:
-                on_success(resp, addr)
+                on_success(resp, addr, tuple(attempted))
+            rid = resp.get('request_id')
+            self.recorder.span('route', t_route, CLOCK(), backend=addr,
+                               attempts=i + 1,
+                               **({'request_id': rid} if rid else {}),
+                               **self._span_ids(ctx))
+            self._observe_routed(t_route, ok=True)
             return resp
         with self._lock:
             self._rejected += 1
         assert last is not None
+        self.recorder.span('route', t_route, CLOCK(),
+                           attempts=len(attempted),
+                           error_code=last.code or protocol.ERR_INTERNAL,
+                           **self._span_ids(ctx))
+        self._observe_routed(t_route, ok=False)
         return protocol.error(str(last),
                               code=last.code or protocol.ERR_INTERNAL,
                               **{k: v for k, v in last.extra.items()
@@ -356,33 +472,102 @@ class FleetRouter:
                 self._rejected += 1
                 return protocol.error('draining',
                                       code=protocol.ERR_SHED)
+        # adopt the caller's traceparent or mint one, and forward it on
+        # EVERY loopback hop: the backend joins the same trace, so one
+        # trace_id spans router + every attempted backend
+        ctx = accept_traceparent(msg.get('traceparent'))
+        msg = dict(msg)
+        msg['traceparent'] = ctx.traceparent()
 
-        def _remember(resp: Dict[str, Any], addr: str) -> None:
+        def _remember(resp: Dict[str, Any], addr: str,
+                      attempted: Tuple[str, ...]) -> None:
             rid = resp.get('request_id')
             if rid:
-                self._remember_route(rid, addr)
+                self._remember_route(rid, addr, ctx.trace_id, attempted)
             # fused children route with the umbrella
             for child in (resp.get('requests') or {}).values():
-                self._remember_route(child, addr)
+                self._remember_route(child, addr, ctx.trace_id,
+                                     attempted)
             resp['backend'] = addr
 
         return self._route(self.route_key(msg), msg,
-                           on_success=_remember)
+                           on_success=_remember, ctx=ctx)
 
     def request_scoped(self, msg: Dict[str, Any]) -> Dict[str, Any]:
-        """status/trace: route by the remembered request_id → backend
+        """status: route by the remembered request_id → owner backend
         binding (content hash is not recoverable from an id)."""
         rid = msg.get('request_id')
         with self._lock:
-            addr = self._routes.get(rid)
-        if addr is None:
+            entry = self._routes.get(rid)
+        if entry is None:
             return protocol.error(f'unknown request_id {rid!r}',
                                   code=protocol.ERR_NOT_FOUND)
         try:
-            return self._backend_call(addr, msg)
+            return self._backend_call(entry[0], msg)
         except ServeError as e:
             return protocol.error(str(e),
                                   code=e.code or protocol.ERR_INTERNAL)
+
+    def assemble_trace(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Scatter-gather trace assembly: ask EVERY backend the request
+        attempted (failover history, not just the owner) for its spans,
+        stamp each event ``host=``, merge with the router's own spans
+        for the trace, and return one ts-sorted timeline under the one
+        trace_id. Per-host clocks are not aligned, so the sort is a
+        presentation order — the ``host`` attr says where a span ran."""
+        rid = msg.get('request_id')
+        with self._lock:
+            entry = self._routes.get(rid)
+        if entry is None:
+            return protocol.error(f'unknown request_id {rid!r}',
+                                  code=protocol.ERR_NOT_FOUND)
+        owner, trace_id, attempted = entry
+        events: List[Dict[str, Any]] = []
+        hosts: List[str] = []
+        state = None
+        for addr in attempted:
+            try:
+                resp = self._backend_call(
+                    addr, {'cmd': protocol.CMD_TRACE,
+                           'request_id': rid})
+            except (ServeError, OSError, ValueError):
+                # a backend that SHED the submit never admitted the
+                # request — its not_found is expected, and even the
+                # owner going down must degrade the trace to the spans
+                # we can still reach, not fail the assembly
+                if addr == owner:
+                    _log_fleet_error(f'trace fetch from owner {addr}')
+                continue
+            hosts.append(addr)
+            if addr == owner:
+                state = resp.get('state')
+                trace_id = resp.get('trace_id') or trace_id
+            for ev in resp.get('events') or ():
+                ev = dict(ev)
+                args = dict(ev.get('args') or {})
+                args['host'] = addr
+                ev['args'] = args
+                events.append(ev)
+        for ev in self.recorder.snapshot():
+            if ev.get('ph') == 'M':
+                continue                  # router thread metas: noise
+            args = ev.get('args') or {}
+            if not ((trace_id and args.get('trace_id') == trace_id)
+                    or args.get('request_id') == rid):
+                continue
+            ev = dict(ev)
+            args = dict(args)
+            args['host'] = 'router'
+            ev['args'] = args
+            events.append(ev)
+        # metas first, then the joint timeline — cross-host ts are a
+        # presentation order (same contract as tools/trace_view.py's
+        # multi-file merge)
+        events.sort(key=lambda e: (e.get('ph') != 'M',
+                                   e.get('ts', 0)))
+        return protocol.ok(request_id=rid, trace_id=trace_id,
+                           state=state, events=events,
+                           hosts=['router'] + hosts)
 
     def search(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         return self._route(self.route_key(msg), msg)
@@ -429,7 +614,69 @@ class FleetRouter:
         doc['eligible'] = [a for a, r in backends.items()
                            if r['healthy'] and not r['draining']]
         doc['backends'] = backends
+        # fleet-level SLO burn rates: every metrics assembly is an
+        # evaluator tick (scrape-driven sampling, no extra thread)
+        doc['slo'] = self.slo.tick()
         return {'fleet': doc}
+
+    def metrics_prom(self) -> str:
+        """The fleet's ONE Prometheus scrape: every backend's own
+        exposition host-relabeled and merged (``fleet/aggregate.py``)
+        plus the router's ``vft_fleet_*`` / ``vft_slo_*`` families. A
+        backend that fails its scrape contributes no samples — its
+        absence shows as ``vft_fleet_backend_up 0`` with an explicit
+        ``vft_fleet_probe_age_seconds``, never as silently stale
+        values."""
+        deadline = min(0.5, self.connect_timeout_s)
+        with self._lock:
+            backends = list(self._backends.values())
+        texts: Dict[str, Optional[str]] = {}
+        for b in backends:
+            if not b.healthy:
+                texts[b.addr] = None
+                continue
+            try:
+                texts[b.addr] = aggregate.scrape_prom(
+                    b.host, b.port, deadline)
+            except (ServeError, OSError, ValueError):
+                _log_fleet_error(f'metrics scrape from {b.addr}')
+                texts[b.addr] = None
+        with self._lock:
+            routed = dict(self._routed)
+            failovers, rejected = self._failovers, self._rejected
+            snaps = {a: b.snapshot() for a, b in self._backends.items()}
+        # mirror the router's plain-int counters into registry series
+        # by DELTA (counters only go up; the ints are the truth)
+        for name, help_text, total, labels in (
+                [('vft_fleet_failovers_total',
+                  'failover walks to a next ring host', failovers, None),
+                 ('vft_fleet_rejected_total',
+                  'requests the router answered with a structured '
+                  'error', rejected, None)]
+                + [('vft_fleet_routed_total',
+                    'requests routed per backend', n, {'host': a})
+                   for a, n in routed.items()]):
+            c = self.registry.counter(name, help_text, labels=labels)
+            if total > c.value:
+                c.inc(total - c.value)
+        for addr, snap in snaps.items():
+            self.registry.gauge(
+                'vft_fleet_backend_up',
+                '1 if the last probe of this backend succeeded',
+                labels={'host': addr}).set(1 if snap['healthy'] else 0)
+            self.registry.gauge(
+                'vft_fleet_backend_draining',
+                '1 if the backend reported draining on its last probe',
+                labels={'host': addr}).set(1 if snap['draining'] else 0)
+            if snap['probe_age_s'] is not None:
+                self.registry.gauge(
+                    'vft_fleet_probe_age_seconds',
+                    'seconds since this backend was last probed '
+                    '(staleness of its health row and of a missing '
+                    'scrape)',
+                    labels={'host': addr}).set(snap['probe_age_s'])
+        self.slo.tick()
+        return aggregate.merge_expositions(texts) + self.registry.render()
 
     # -- loopback listener ---------------------------------------------------
 
@@ -445,8 +692,10 @@ class FleetRouter:
                                fleet_hosts=len(self.ring))
         if cmd == protocol.CMD_SUBMIT:
             return self.submit(msg)
-        if cmd in (protocol.CMD_STATUS, protocol.CMD_TRACE):
+        if cmd == protocol.CMD_STATUS:
             return self.request_scoped(msg)
+        if cmd == protocol.CMD_TRACE:
+            return self.assemble_trace(msg)
         if cmd == protocol.CMD_SEARCH:
             return self.search(msg)
         if cmd == protocol.CMD_INDEX_STATUS:
@@ -454,11 +703,7 @@ class FleetRouter:
         if cmd == protocol.CMD_METRICS:
             return protocol.ok(metrics=self.metrics())
         if cmd == protocol.CMD_METRICS_PROM:
-            # per-host exposition belongs to each backend's own scrape
-            # target; aggregating text format here would double-count
-            return protocol.error(
-                'metrics_prom is per-backend — scrape the daemons',
-                code=protocol.ERR_UNSUPPORTED)
+            return protocol.ok(text=self.metrics_prom())
         if cmd == protocol.CMD_DRAIN:
             with self._lock:
                 self._draining = True
@@ -540,11 +785,22 @@ class FleetRouter:
                 resp.send_json(h.OK, {'ok': True,
                                       'metrics': self.metrics()})
                 return
+            if req.method == 'GET' and req.path == '/metrics':
+                # the fleet's one Prometheus scrape target (same
+                # content type as the daemons' ingress /metrics)
+                resp.send(h.OK, self.metrics_prom().encode('utf-8'),
+                          content_type='text/plain; version=0.0.4')
+                return
             if req.method == 'GET' \
                     and req.path.startswith('/v1/requests/'):
                 rid = req.path[len('/v1/requests/'):].strip('/')
-                out = self.request_scoped(
-                    {'cmd': protocol.CMD_STATUS, 'request_id': rid})
+                if rid.endswith('/trace'):
+                    out = self.assemble_trace(
+                        {'cmd': protocol.CMD_TRACE,
+                         'request_id': rid[:-len('/trace')].strip('/')})
+                else:
+                    out = self.request_scoped(
+                        {'cmd': protocol.CMD_STATUS, 'request_id': rid})
                 status = h.OK if out.get('ok') \
                     else self._code_to_status(out.get('code'))
                 resp.send_json(status, out)
@@ -617,6 +873,8 @@ def fleet_main(argv: List[str]) -> int:
         backoff_base_s=fleet_cfg['fleet_backoff_base_s'],
         connect_timeout_s=fleet_cfg['fleet_connect_timeout_s'],
         ring_replicas=fleet_cfg['fleet_ring_replicas'],
+        slo_latency_p99_s=fleet_cfg['fleet_slo_latency_p99_s'],
+        slo_availability=fleet_cfg['fleet_slo_availability'],
     ).start()
     done = threading.Event()
 

@@ -6,6 +6,9 @@ Endpoints (all JSON unless noted; auth via ``Authorization: Bearer
   * ``GET  /healthz``            — liveness (NO auth: load balancers)
   * ``GET  /v1/metrics``         — the serve metrics document
   * ``GET  /metrics``            — Prometheus text exposition 0.0.4
+    (the fleet router's HTTP front door serves the same route with the
+    FLEET-aggregated exposition — host-relabeled backend families +
+    ``vft_fleet_*``/``vft_slo_*``; see docs/fleet.md)
   * ``POST /v1/extract``         — submit an extraction request
     (``{feature_type, video_paths, overrides?, timeout_s?,
     range?: [start_s, end_s], priority?, features?: [..]}``) →

@@ -128,6 +128,7 @@ class RunManifest:
         self.programs_lock: Dict[str, Any] = {}
         self.aot: Dict[str, Any] = {}
         self.index: Dict[str, Any] = {}
+        self.slo: Dict[str, Any] = {}
         self._compile0 = _compile_snapshot()
         _install_compile_listener()
 
@@ -230,6 +231,15 @@ class RunManifest:
         with self._lock:
             self.index.update({k: _jsonable(v) for k, v in info.items()})
 
+    def note_slo(self, info: Dict[str, Any]) -> None:
+        """Record the SLO evaluation view (``SloEvaluator.stats()``:
+        objectives, per-window burn rates, alert states) — written by
+        servers running with ``slo_latency_p99_s=`` /
+        ``slo_availability=``; the section stays ``{}`` otherwise.
+        Later notes merge over earlier ones."""
+        with self._lock:
+            self.slo.update({k: _jsonable(v) for k, v in info.items()})
+
     def note_mesh(self, info: Dict[str, Any]) -> None:
         """Record the device mesh a mesh-sharded packed run executed on
         (``mesh_devices``, the (data, time) shape, per-device labels,
@@ -260,6 +270,7 @@ class RunManifest:
             programs_lock = dict(self.programs_lock)
             aot = dict(self.aot)
             index = dict(self.index)
+            slo = dict(self.slo)
         outcomes: Dict[str, int] = {}
         for v in videos.values():
             outcomes[v['outcome']] = outcomes.get(v['outcome'], 0) + 1
@@ -297,6 +308,9 @@ class RunManifest:
             # query-program path for runs that build or query it, {}
             # otherwise
             'index': index,
+            # SLO burn-rate evaluation (obs/slo): objectives + alert
+            # states for runs with slo_* knobs, {} otherwise
+            'slo': slo,
         }
 
     def write(self, path: str) -> str:

@@ -61,6 +61,12 @@ def _escape(v: str) -> str:
     return str(v).replace('\\', r'\\').replace('"', r'\"').replace('\n', r'\n')
 
 
+def _escape_help(v: str) -> str:
+    # HELP lines escape backslash and newline but NOT double quotes —
+    # the exposition format 0.0.4 rule differs from label values
+    return str(v).replace('\\', r'\\').replace('\n', r'\n')
+
+
 class Counter:
     """Monotonic float counter."""
 
@@ -236,7 +242,7 @@ class MetricsRegistry:
         lines: List[str] = []
         for name, mtype, help_text, series in families:
             lines.append(f'# HELP {name} '
-                         f'{help_text or name.replace("_", " ")}')
+                         f'{_escape_help(help_text or name.replace("_", " "))}')
             lines.append(f'# TYPE {name} {mtype}')
             for pairs, metric in series:
                 lines.extend(metric._samples(name, pairs))

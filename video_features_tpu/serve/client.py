@@ -182,7 +182,11 @@ class ServeClient:
         trace_id, state, events}`` — every recorded span/instant across
         the server's live recorders carrying the request's trace id
         (requires the server to run with a ``trace_out`` base override;
-        empty otherwise)."""
+        empty otherwise). Against the fleet router (v1.5) the assembly
+        is scatter-gather: router spans plus every attempted backend's
+        spans, ts-sorted under one trace_id, each event stamped with a
+        ``host`` attr and the additive ``hosts`` field listing the
+        contributors."""
         return self._call({'cmd': protocol.CMD_TRACE,
                            'request_id': request_id})
 
@@ -242,7 +246,10 @@ class ServeClient:
         return self._call({'cmd': protocol.CMD_METRICS})['metrics']
 
     def metrics_prom(self) -> str:
-        """The same state as Prometheus text exposition format 0.0.4."""
+        """The same state as Prometheus text exposition format 0.0.4.
+        Against the fleet router (v1.5): the fleet-aggregated exposition
+        — every backend's families relabeled ``host=`` plus the
+        router's own ``vft_fleet_*`` / ``vft_slo_*`` families."""
         return self._call({'cmd': protocol.CMD_METRICS_PROM})['text']
 
     def drain(self) -> None:

@@ -112,6 +112,7 @@ def build_metrics(started_at: float,
                   watchdog_stats: Optional[Dict[str, Any]] = None,
                   aot_stats: Optional[Dict[str, Any]] = None,
                   index_stats: Optional[Dict[str, Any]] = None,
+                  slo_stats: Optional[Dict[str, Any]] = None,
                   ) -> Dict[str, Any]:
     """Assemble the one metrics document. ``stage_reports`` maps a
     human-readable pool-entry label → that entry's ``Tracer.report()``;
@@ -187,6 +188,13 @@ def build_metrics(started_at: float,
     doc['watchdog'] = (watchdog_stats if watchdog_stats is not None
                        else {'enabled': False, 'stalls_total': 0,
                              'workers': {}})
+    # SLO burn rates (obs/slo): objectives + per-window burn + alert
+    # states, or the stable disabled shape without slo_* knobs
+    if slo_stats is not None:
+        doc['slo'] = slo_stats
+    else:
+        from video_features_tpu.obs.slo import disabled_stats
+        doc['slo'] = disabled_stats()
     doc.update(request_stats.snapshot())
     doc['stages'] = {label: rep for label, rep in stage_reports.items()}
     doc['stages_merged'] = merge_reports(stage_reports.values())

@@ -39,10 +39,17 @@ Commands (the ``cmd`` field):
   * ``trace``   — ``{cmd, request_id}`` → ``{ok, request_id, trace_id,
     events}``: the request's assembled span timeline, filtered from the
     live recorders (``serve.server.ExtractionServer.request_trace``).
+    Against the FLEET ROUTER (v1.5) the assembly is scatter-gather —
+    router spans plus every attempted backend's spans merged ts-sorted
+    under one trace_id, with per-event ``host`` attrs and an additive
+    ``hosts`` response field listing the contributors.
   * ``metrics`` — ``{cmd}`` → the live metrics document
-    (``docs/serving.md`` schema).
+    (``docs/serving.md`` schema; v1.5 adds the ``slo`` section).
   * ``metrics_prom`` — ``{cmd}`` → ``{ok, text}``: the same state as
     Prometheus text exposition format 0.0.4 (``docs/observability.md``).
+    Against the FLEET ROUTER (v1.5): the fleet-aggregated exposition —
+    every backend's families relabeled ``host=`` and merged with the
+    router's ``vft_fleet_*`` / ``vft_slo_*`` families.
   * ``search`` — (v1.3) query the feature index. By vector:
     ``{cmd, family, vector: [..], k}``; by video: ``{cmd, video_path,
     features: [..], k, timeout_s}`` (extracts through the fused submit
@@ -93,8 +100,14 @@ COMMANDS = (CMD_SUBMIT, CMD_STATUS, CMD_TRACE, CMD_METRICS,
 # 1.4 adds the additive `code` field on error responses (the ERR_*
 # constants below): the fleet router's failover decision — retry the
 # hash ring's next host vs propagate to the caller — keys on the code,
-# never on the human-readable message text.
-VERSION = '1.4'
+# never on the human-readable message text;
+# 1.5 (vft-scope) adds the fleet observability plane, all additive:
+# the router answers `metrics_prom` with the fleet-aggregated
+# exposition (host-relabeled backend families + vft_fleet_*/vft_slo_*),
+# its `trace` response gains `hosts` and per-event `host` attrs
+# (cross-host scatter-gather assembly), and the metrics document gains
+# the `slo` section (burn-rate objectives, obs/slo.py).
+VERSION = '1.5'
 MAJOR = 1
 
 # submit() fields copied verbatim into the request (everything else in the

@@ -515,6 +515,20 @@ class ExtractionServer:
                 float(self.base_overrides['watchdog_stall_s']),
                 on_stall=self._on_stall,
                 registry=self.registry).start()
+        # vft-scope: SLO burn-rate evaluation over this server's own
+        # request families (slo_latency_p99_s / slo_availability base
+        # overrides). Ticks ride metrics assembly — no extra thread.
+        self.slo = None
+        if self.base_overrides.get('slo_latency_p99_s') is not None \
+                or self.base_overrides.get('slo_availability') is not None:
+            from video_features_tpu.obs.slo import SloEvaluator
+            _lat = self.base_overrides.get('slo_latency_p99_s')
+            _avail = self.base_overrides.get('slo_availability')
+            self.slo = SloEvaluator(
+                self.registry,
+                latency_p99_s=(float(_lat) if _lat is not None else None),
+                availability=(float(_avail) if _avail is not None
+                              else None))
         # feature index (index_enabled base override): ingest worker +
         # query engine behind the search/index_status commands and the
         # ingress /v1/search route. Created AFTER the watchdog so its
@@ -1554,7 +1568,9 @@ class ExtractionServer:
             watchdog_stats=watchdog_stats,
             aot_stats=aot_stats,
             index_stats=(self.index_service.stats()
-                         if self.index_service is not None else None))
+                         if self.index_service is not None else None),
+            slo_stats=(self.slo.stats()
+                       if self.slo is not None else None))
 
     # -- completion callbacks (worker threads) -------------------------------
 
