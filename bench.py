@@ -80,6 +80,18 @@ rung times the router's fleet-aggregated ``metrics_prom`` (vft-scope —
 the cost of the one-scrape-target design). ``BENCH_FLEET=0/1``
 overrides the accelerator-only default.
 
+The precision-ladder rungs (``*_bf16_*`` / ``*_int8_*``): the bf16 fast
+lane and the int8 weight lane each get a framewise in-graph rung, a
+packed-worklist rung and a serve-warm rung vs their fp32 sibling at
+otherwise identical knobs — and EVERY ladder rung records its measured
+``*_max_abs_error`` / ``*_rel_l2_error`` beside the speedup (never a
+speedup without its cost; the rel-L2 numbers are checkable against the
+pinned ``BF16_REL_L2_BOUNDS`` / ``INT8_REL_L2_BOUNDS``). The int8 serve
+rung additionally parks the WHOLE ladder — fp32, bf16 and int8 warm
+entries — in one daemon (pool size asserted ≥ 3). ``BENCH_BF16`` /
+``BENCH_BF16_SERVE`` / ``BENCH_INT8`` / ``BENCH_INT8_SERVE`` override
+the accelerator-only defaults.
+
 Default precision is 'mixed' (ops/precision.py): ambient 3-pass bf16 with
 the drift-tolerant sub-graphs on 1-pass — measured ≤1e-3 feature drift vs
 float32 on the fused path (tools/precision_study.py), i.e. the fastest
@@ -736,6 +748,76 @@ def bench_bf16_framewise(jax, device, iters: int, on_accel: bool) -> dict:
     }
 
 
+def bench_int8_framewise(jax, device, iters: int, on_accel: bool) -> dict:
+    """The framewise in-graph int8 rung: the SAME resnet step timed fp32
+    vs the int8 weight lane on device-resident uint8 batches — int8
+    params from transplant-time quantization (a QUARTER of the fp32 HBM
+    and H2D bytes; ops/quant.py), fp32 activations after the in-graph
+    dequant — plus the measured error of one batch, recorded beside the
+    speedup so a committed int8 number is checkable against
+    ``INT8_REL_L2_BOUNDS``. Weight-only quantization pays in residency
+    and transfer, not FLOPs, so the honest expectation on a compute-rich
+    chip is speedup ~1.0 with quarter-size params — the error columns
+    are the rung's real payload."""
+    from functools import partial
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from video_features_tpu.extract.resnet import ExtractResNet
+    from video_features_tpu.models import resnet as resnet_model
+    from video_features_tpu.ops.precision import param_np_dtype, rel_l2
+
+    from video_features_tpu.transplant.torch2jax import transplant
+
+    arch = 'resnet50' if on_accel else 'resnet18'
+    size = 224 if on_accel else 64
+    batch = 32 if on_accel else 2
+    sd = resnet_model.init_state_dict(arch=arch)
+    rng = np.random.RandomState(0)
+    frames = jax.device_put(
+        rng.randint(0, 255, (iters, batch, size, size, 3))
+        .astype(np.uint8), device)
+    one = jax.device_put(
+        rng.randint(0, 255, (batch, size, size, 3)).astype(np.uint8),
+        device)
+
+    rates, outs = {}, {}
+    for lane in ('float32', 'int8'):
+        params = jax.device_put(
+            transplant(sd, dtype=param_np_dtype(lane)), device)
+        # int8 lane activates in float32 (compute_jnp_dtype): the only
+        # delta vs the fp32 lane is quantized weights + in-graph dequant
+        step = partial(ExtractResNet._forward, arch=arch,
+                       dtype=jnp.float32)
+
+        def chained(p, xs):
+            def body(acc, x):
+                return acc + step(p, x).sum(), None
+            acc, _ = lax.scan(body, jnp.float32(0), xs)
+            return acc
+
+        jitted = jax.jit(chained)
+        assert np.isfinite(float(jitted(params, frames)))  # compile+guard
+        t0 = time.perf_counter()
+        checksum = float(jitted(params, frames))
+        rates[lane] = batch * iters / (time.perf_counter() - t0)
+        assert np.isfinite(checksum)
+        outs[lane] = np.asarray(jax.jit(step)(params, one))
+
+    err = float(np.max(np.abs(outs['float32'] - outs['int8'])))
+    return {
+        'resnet_ingraph_int8_frames_per_sec': round(rates['int8'], 3),
+        'resnet_ingraph_int8_fp32_frames_per_sec': round(
+            rates['float32'], 3),
+        'resnet_ingraph_int8_speedup': round(
+            rates['int8'] / rates['float32'], 3),
+        'resnet_ingraph_int8_max_abs_error': round(err, 6),
+        'resnet_ingraph_int8_rel_l2_error': round(
+            rel_l2(outs['float32'], outs['int8']), 6),
+    }
+
+
 def bench_serve_bf16(precision: str, tmp_dir: str, platform: str,
                      wl_paths: list) -> dict:
     """The serve-warm bf16 rung: the same worklist served twice per lane
@@ -795,6 +877,75 @@ def bench_serve_bf16(precision: str, tmp_dir: str, platform: str,
             'serve_bf16_speedup': round(f32_s / bf16_s, 3),
             'serve_bf16_max_abs_error': errs['max_abs_error'],
             'serve_bf16_rel_l2_error': errs['rel_l2_error'],
+        }
+    finally:
+        server.drain(wait=True, grace_s=120)
+
+
+def bench_serve_int8(precision: str, tmp_dir: str, platform: str,
+                     wl_paths: list) -> dict:
+    """The serve-warm int8 rung, and the full precision ladder resident
+    in ONE daemon: fp32, bf16 and int8 requests for the same family
+    build THREE distinct warm pool entries (compute_dtype is pool-key
+    relevant on every rung of the ladder; asserted via the pool size),
+    the int8 warm-pass rate gives the steady-state throughput a resident
+    quarter-size entry delivers, and the measured error of the int8 warm
+    outputs vs the fp32 warm outputs rides beside it."""
+    from video_features_tpu.serve.client import ServeClient
+    from video_features_tpu.serve.server import ExtractionServer
+    from video_features_tpu.utils.output import make_path
+
+    base = {
+        'device': platform, 'precision': precision,
+        'model_name': 'resnet18', 'batch_size': 8,
+        'allow_random_weights': True, 'on_extraction': 'save_numpy',
+        'tmp_path': os.path.join(tmp_dir, 'si8_tmp'),
+        'serve_warm_pool_size': 4,      # three lanes must fit warm
+    }
+    server = ExtractionServer(
+        base_overrides=base,
+        queue_depth=max(64, 4 * len(wl_paths))).start()
+    try:
+        client = ServeClient(port=server.port)
+
+        def one_pass(tag, lane):
+            out_root = os.path.join(tmp_dir, f'si8_out_{tag}')
+            t0 = time.perf_counter()
+            rids = [client.submit('resnet', [p], overrides={
+                        'output_path': out_root,
+                        'compute_dtype': lane})
+                    for p in wl_paths]
+            for rid in rids:
+                st = client.wait(rid, timeout_s=900)
+                assert st['state'] == 'done', f'serve int8 {tag}: {st}'
+            return out_root, time.perf_counter() - t0
+
+        one_pass('f32_cold', 'float32')
+        f32_root, f32_s = one_pass('f32_warm', 'float32')
+        one_pass('bf16_cold', 'bfloat16')           # third ladder rung
+        one_pass('int8_cold', 'int8')
+        int8_root, int8_s = one_pass('int8_warm', 'int8')
+
+        clips = 0
+        for p in wl_paths:
+            arr = np.load(make_path(os.path.join(int8_root, 'resnet',
+                                                 'resnet18'),
+                                    p, 'resnet', '.npy'))
+            clips += arr.shape[0]
+        assert clips > 0, 'serve int8 warm pass produced no clips'
+        m = client.metrics()
+        # the WHOLE ladder resident at once: three distinct warm entries
+        # for one family, one per compute_dtype — the pool-key split
+        # extended down to int8 (never a shared program across lanes)
+        assert m['warm_pool']['size'] >= 3, m['warm_pool']
+        errs = _feature_file_errors(f32_root, int8_root)
+        return {
+            'serve_int8_clips_per_sec': round(clips / int8_s, 3),
+            'serve_int8_fp32_clips_per_sec': round(clips / f32_s, 3),
+            'serve_int8_speedup': round(f32_s / int8_s, 3),
+            'serve_int8_max_abs_error': errs['max_abs_error'],
+            'serve_int8_rel_l2_error': errs['rel_l2_error'],
+            'serve_int8_warm_pool_size': m['warm_pool']['size'],
         }
     finally:
         server.drain(wait=True, grace_s=120)
@@ -982,6 +1133,19 @@ def run() -> dict:
                                               on_accel))
         except Exception as e:
             rungs['bf16_ingraph_error'] = f'{type(e).__name__}: {e}'
+
+    # the int8 weight lane (compute_dtype=int8, ops/quant.py): same
+    # shape as the bf16 rung — speedup AND measured error, always
+    # together, checkable against INT8_REL_L2_BOUNDS. BENCH_INT8=0/1
+    # overrides the accelerator-only default.
+    run_int8 = os.environ.get('BENCH_INT8',
+                              '1' if on_accel else '0') == '1'
+    if run_int8:
+        try:
+            rungs.update(bench_int8_framewise(jax, device, iters,
+                                              on_accel))
+        except Exception as e:
+            rungs['int8_ingraph_error'] = f'{type(e).__name__}: {e}'
 
     # per-rung Tracer stage reports (decode/h2d/model/save split) ride
     # along in the record so tools/bench_diff.py users can see WHERE a
@@ -1197,6 +1361,51 @@ def run() -> dict:
                     except Exception as e:
                         rungs['worklist_bf16_error'] = \
                             f'{type(e).__name__}: {e}'
+                # The int8 weight-lane rung (compute_dtype=int8): the
+                # same packed worklist, one fp32 sibling pass + one int8
+                # pass at OTHERWISE IDENTICAL knobs, so the delta is the
+                # lane alone — quarter-size params + in-graph dequant —
+                # with the measured output error recorded next to the
+                # speedup (never a speedup without its cost).
+                if wl_paths is not None and run_int8:
+                    try:
+                        i8_feature = os.environ.get('BENCH_INT8_FEATURE',
+                                                    'resnet')
+                        wrec_f32 = run_worklist(
+                            i8_feature, wl_paths,
+                            os.path.join(tmp_dir, 'int8_f32'),
+                            tmp_dir, platform, batch_size=min(batch, 8),
+                            stack=stack, precision=precision,
+                            packed=True, inflight=1, decode_workers=1,
+                            compute_dtype='float32')
+                        wrec_i8 = run_worklist(
+                            i8_feature, wl_paths,
+                            os.path.join(tmp_dir, 'int8'),
+                            tmp_dir, platform, batch_size=min(batch, 8),
+                            stack=stack, precision=precision,
+                            packed=True, inflight=1, decode_workers=1,
+                            compute_dtype='int8')
+                        errs = _feature_file_errors(
+                            os.path.join(tmp_dir, 'int8_f32', 'out'),
+                            os.path.join(tmp_dir, 'int8', 'out'))
+                        rungs['worklist_packed_int8_clips_per_sec'] = \
+                            wrec_i8['clips_per_sec']
+                        rungs['worklist_packed_int8_fp32_clips_per_sec'] \
+                            = wrec_f32['clips_per_sec']
+                        rungs['worklist_packed_int8_speedup'] = round(
+                            wrec_i8['clips_per_sec']
+                            / max(wrec_f32['clips_per_sec'], 1e-9), 3)
+                        rungs['worklist_packed_int8_max_abs_error'] = \
+                            errs['max_abs_error']
+                        rungs['worklist_packed_int8_rel_l2_error'] = \
+                            errs['rel_l2_error']
+                        rungs['worklist_int8_compute_dtype'] = \
+                            wrec_i8['compute_dtype']
+                        stage_reports['worklist_packed_int8'] = \
+                            wrec_i8['stages']
+                    except Exception as e:
+                        rungs['worklist_int8_error'] = \
+                            f'{type(e).__name__}: {e}'
                 # The fused multi-family rung (features=[...]): ONE
                 # decode + ONE sha256 pass per video feeding N families
                 # (run_packed_fused) vs N sequential per-family passes —
@@ -1363,6 +1572,21 @@ def run() -> dict:
                                                   platform, wl_paths))
                 except Exception as e:
                     rungs['serve_bf16_error'] = f'{type(e).__name__}: {e}'
+            # The serve-warm int8 rung + the full ladder in one daemon:
+            # fp32/bf16/int8 as three resident pool entries, int8 warm
+            # rate + measured error. BENCH_INT8_SERVE=0/1 overrides.
+            if os.environ.get('BENCH_INT8_SERVE',
+                              '1' if on_accel else '0') == '1':
+                try:
+                    if wl_paths is None:
+                        from tools.worklist_bench import make_worklist
+                        wl_paths = make_worklist(
+                            tmp_dir, 4 if on_accel else 2,
+                            10 if on_accel else 2)
+                    rungs.update(bench_serve_int8(precision, tmp_dir,
+                                                  platform, wl_paths))
+                except Exception as e:
+                    rungs['serve_int8_error'] = f'{type(e).__name__}: {e}'
     if mode == 'e2e' and f'e2e_{precision}' in rungs:
         headline_key = f'e2e_{precision}'
 

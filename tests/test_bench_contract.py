@@ -154,6 +154,43 @@ def test_bench_bf16_rungs_emit_keys():
                for k in rungs)
 
 
+def test_bench_int8_rungs_emit_keys():
+    """BENCH_INT8=1 drives the int8 weight-lane rungs: the in-graph
+    framewise pair (fp32 vs int8 on the SAME resnet step — quantized
+    params, in-graph dequant, fp32 activations) and the packed worklist
+    pair — every speedup recorded WITH its measured error, and the error
+    under the family's pinned ``INT8_REL_L2_BOUNDS`` entry. fp32 rung
+    keys are untouched."""
+    from video_features_tpu.ops.precision import INT8_REL_L2_BOUNDS
+    rec = _run_bench({'BENCH_MODE': 'both', 'BENCH_E2E_RUNS': '1',
+                      'BENCH_VIDEO': 'synthetic', 'BENCH_E2E_SECONDS': '1',
+                      'BENCH_WORKLIST': '1', 'BENCH_SERVE': '0',
+                      'BENCH_CACHE': '0', 'BENCH_INT8': '1',
+                      'BENCH_INT8_SERVE': '0', 'BENCH_BF16': '0',
+                      'BENCH_WORKLIST_FEATURE': 'resnet'})
+    rungs = rec['rungs']
+    for err in ('int8_ingraph_error', 'worklist_int8_error'):
+        assert err not in rungs, rungs.get(err)
+    # in-graph framewise pair: speedup + error always recorded together
+    assert rungs['resnet_ingraph_int8_frames_per_sec'] > 0
+    assert rungs['resnet_ingraph_int8_fp32_frames_per_sec'] > 0
+    assert rungs['resnet_ingraph_int8_speedup'] > 0
+    assert rungs['resnet_ingraph_int8_max_abs_error'] > 0
+    assert 0 < rungs['resnet_ingraph_int8_rel_l2_error'] \
+        <= INT8_REL_L2_BOUNDS['resnet']
+    # packed worklist pair: real files, fp32 sibling rung beside it
+    assert rungs['worklist_packed_int8_clips_per_sec'] > 0
+    assert rungs['worklist_packed_int8_fp32_clips_per_sec'] > 0
+    assert rungs['worklist_packed_int8_speedup'] > 0
+    assert rungs['worklist_packed_int8_max_abs_error'] > 0
+    assert 0 < rungs['worklist_packed_int8_rel_l2_error'] \
+        <= INT8_REL_L2_BOUNDS['resnet']
+    assert rungs['worklist_int8_compute_dtype'] == 'int8'
+    # fp32 rungs keep their historical keys
+    assert any(k.startswith('worklist_packed_clips_per_sec')
+               for k in rungs)
+
+
 def test_bench_fused_rung_emits_keys():
     """BENCH_FUSED=1 drives the fused multi-family rung: one
     ``features=[...]`` pass (decode + sha256 once per video, N families

@@ -1,16 +1,18 @@
 """ops/precision pins + the precision='mixed' extraction mode + the
-compute_dtype=bfloat16 fast lane's pinned parity bounds (PARITY.md-style:
-the bounds table lives in ops/precision.BF16_REL_L2_BOUNDS; this module
-asserts the measured drift of every accepting family's REAL jitted step
-stays under it — the cheapest family in tier-1, the full six-family
-ladder in the slow lane)."""
+compute_dtype fast lanes' pinned parity bounds (PARITY.md-style: the
+bounds tables live in ops/precision.BF16_REL_L2_BOUNDS /
+INT8_REL_L2_BOUNDS; this module asserts the measured drift of every
+accepting family's REAL jitted step stays under them — build-free
+numeric gates in tier-1, the full real-build ladders in the slow lane,
+with ONE module-scoped fp32 reference build per family shared across
+both fast-lane ladders)."""
 import numpy as np
 import pytest
 
 from video_features_tpu.ops.precision import (
-    BF16_REL_L2_BOUNDS, COMPUTE_DTYPES, ComputeDtypeError, MIXED_PINS,
-    check_compute_dtype, normalize_pins, param_np_dtype, pin_scope,
-    rel_l2,
+    BF16_REL_L2_BOUNDS, COMPUTE_DTYPES, ComputeDtypeError,
+    INT8_REL_L2_BOUNDS, MIXED_PINS, check_compute_dtype, normalize_pins,
+    param_np_dtype, pin_scope, rel_l2,
 )
 
 
@@ -84,15 +86,18 @@ def test_mixed_mode_extractor_runs_and_matches_on_cpu(tmp_path):
         np.testing.assert_array_equal(outs['mixed'][k], outs['highest'][k])
 
 
-# -- the bf16 fast lane (compute_dtype=bfloat16) ------------------------------
+# -- the compute_dtype fast lanes (bfloat16 / int8) ---------------------------
 #
 # One extractor per (family, lane) serves ALL of a family's assertions
 # (parity, census, output dtype — the PR 11 reuse pattern: builds are
-# the expensive part); the fp32 reference and the bf16 candidate see
-# IDENTICAL uint8 inputs, so every diff is the lane's. The builds live
-# in the SLOW lane (tier-1's 870 s budget has no room for six extractor
-# pairs); tier-1 keeps the build-free numerics + identity gates below
-# plus the lock-census gate in test_programs.
+# the expensive part); the fp32 reference and the fast-lane candidate
+# see IDENTICAL uint8 inputs, so every diff is the lane's. The builds
+# live in the SLOW lane (tier-1's 870 s budget has no room for the
+# extractor pairs), and the fp32 REFERENCE build+run is module-scoped
+# (`_f32_reference`) so the bf16 and int8 ladders share it instead of
+# each paying a second fp32 build per family; tier-1 keeps the
+# build-free numerics + identity gates below plus the lock-census gate
+# in test_programs.
 
 # family → (config overrides, input batch builder). Geometries are the
 # smallest each family compiles quickly at on CPU; the bound is rel-L2,
@@ -132,83 +137,133 @@ def _build_lane(ft, compute_dtype, tmp_root):
     return create_extractor(load_config(ft, overrides=overrides))
 
 
-def _lane_outputs(ft, tmp_root):
-    """(fp32 features, bf16-lane features, bf16 extractor) on identical
-    inputs — the step functions the hot paths dispatch, not re-wraps."""
+def _run_step(ex, ft, batch):
+    """One device step on the REAL jitted callable the hot path
+    dispatches (not a re-wrap), family quirks included."""
     import jax
-    batch = _BF16_CASES[ft][1]()
-    outs = {}
-    ex_b = None
-    for lane in ('float32', 'bfloat16'):
-        ex = _build_lane(ft, lane, tmp_root)
-        if lane == 'bfloat16':
-            ex_b = ex
-        x = batch
-        if ft == 'vggish' and lane == 'bfloat16':
-            x = x.astype(ex.param_dtype)       # the _run_batched edge cast
-        if ft == 's3d':
-            step, _, _ = ex._geometry_step(*batch.shape[2:4])
-            out = step(ex.params, jax.device_put(x))
-        else:
-            out = ex._step(ex.params, jax.device_put(x))
-        outs[lane] = np.asarray(out)
-    return outs['float32'], outs['bfloat16'], ex_b
+    x = batch
+    if ft == 'vggish' and ex.compute_dtype == 'bfloat16':
+        x = x.astype(ex.param_dtype)       # the _run_batched edge cast
+    if ft == 's3d':
+        step, _, _ = ex._geometry_step(*batch.shape[2:4])
+        return np.asarray(step(ex.params, jax.device_put(x)))
+    return np.asarray(ex._step(ex.params, jax.device_put(x)))
 
 
-def _assert_lane_contract(ft, tmp_root):
+@pytest.fixture(scope='module')
+def _f32_reference(tmp_path_factory):
+    """Per-family fp32 reference features, built ONCE per module run and
+    shared by the bf16 AND int8 slow ladders (the input builders are
+    seeded, so every lane sees byte-identical batches). Builds are the
+    expensive part — this keeps the two-ladder suite at one fp32 build
+    per family instead of two."""
+    cache = {}
+
+    def get(ft):
+        if ft not in cache:
+            root = str(tmp_path_factory.mktemp(f'ref_{ft}'))
+            ex = _build_lane(ft, 'float32', root)
+            cache[ft] = _run_step(ex, ft, _BF16_CASES[ft][1]())
+        return cache[ft]
+    return get
+
+
+def _assert_lane_contract(ft, lane, tmp_root, ref):
     import jax
-    ref, fast, ex_b = _lane_outputs(ft, tmp_root)
+    bounds = (BF16_REL_L2_BOUNDS if lane == 'bfloat16'
+              else INT8_REL_L2_BOUNDS)
+    ex = _build_lane(ft, lane, tmp_root)
+    fast = _run_step(ex, ft, _BF16_CASES[ft][1]())
     # the lane actually computed differently...
     assert np.abs(ref - fast).max() > 0, f'{ft}: lanes identical?'
     # ...features still leave the device as float32 (on-disk contract)...
     assert fast.dtype == np.float32
     # ...within the family's pinned parity bound...
     err = rel_l2(ref, fast)
-    assert err <= BF16_REL_L2_BOUNDS[ft], (
-        f'{ft}: bf16 lane rel-L2 {err:.3e} over the pinned bound '
-        f'{BF16_REL_L2_BOUNDS[ft]:.1e}')
-    # ...and the cast reached EVERY param: bf16 in HBM, zero fp32
-    # survivors (the PROGRAMS.lock census holds the same line)
-    dtypes = {str(leaf.dtype)
-              for leaf in jax.tree_util.tree_leaves(ex_b.params)
-              if hasattr(leaf, 'dtype')}
-    assert dtypes == {'bfloat16'}, (ft, dtypes)
+    assert err <= bounds[ft], (
+        f'{ft}: {lane} lane rel-L2 {err:.3e} over the pinned bound '
+        f'{bounds[ft]:.1e}')
+    # ...and the storage transform reached the params (the PROGRAMS.lock
+    # census holds the same line per lane)
+    by_dtype = {}
+    for leaf in jax.tree_util.tree_leaves(ex.params):
+        if hasattr(leaf, 'dtype'):
+            by_dtype[str(leaf.dtype)] = (by_dtype.get(str(leaf.dtype), 0)
+                                         + leaf.nbytes)
+    if lane == 'bfloat16':
+        # the cast reached EVERY param: zero fp32 survivors
+        assert set(by_dtype) == {'bfloat16'}, (ft, by_dtype)
+    else:
+        # int8 weight payloads dominate; fp32 is the declared minority
+        # (per-channel scales, biases, norm params, embedding tables)
+        assert 'int8' in by_dtype, (ft, by_dtype)
+        assert by_dtype.get('float32', 0) < by_dtype['int8'], (ft, by_dtype)
 
 
-def test_bf16_bounds_table_is_pinned():
-    """PARITY.md-style pin: the bounds (and who accepts the lane) are an
+def test_bounds_tables_are_pinned():
+    """PARITY.md-style pin: the bounds (and who accepts each lane) are an
     intentional, test-visible contract — moving one is a review event,
     not a drive-by edit."""
-    from video_features_tpu.registry import BF16_FEATURES
+    from video_features_tpu.registry import BF16_FEATURES, INT8_FEATURES
     assert BF16_REL_L2_BOUNDS == {
         'r21d': 1.5e-2, 's3d': 2e-2, 'resnet': 2e-2,
         'clip': 3e-2, 'timm': 5e-2, 'vggish': 2.5e-2,
     }
     assert set(BF16_REL_L2_BOUNDS) == BF16_FEATURES
-    assert COMPUTE_DTYPES == ('float32', 'bfloat16')
+    assert INT8_REL_L2_BOUNDS == {
+        'resnet': 5e-2, 'clip': 3.5e-2, 'timm': 7.5e-2,
+    }
+    assert set(INT8_REL_L2_BOUNDS) == INT8_FEATURES
+    # int8 accepts a strict subset of bf16's families: every int8 lane
+    # rung sits below an existing bf16 rung on the ladder
+    assert INT8_FEATURES < BF16_FEATURES
+    assert COMPUTE_DTYPES == ('float32', 'bfloat16', 'int8')
 
 
-def test_bf16_refusal_is_structured_and_names_the_bound():
-    for ft in ('i3d', 'raft'):
-        with pytest.raises(ComputeDtypeError) as e:
-            check_compute_dtype(ft, 'bfloat16')
-        msg = str(e.value)
-        assert ft in msg and '1e-3' in msg and 'precision=mixed' in msg
+def test_refusal_is_structured_and_echoes_the_requested_dtype():
+    """Refusals name the family, the parity bound, the remediation — and
+    the REQUESTED dtype (the pre-int8 message hardcoded
+    'compute_dtype=bfloat16' whatever was asked)."""
+    for lane in ('bfloat16', 'int8'):
+        for ft in ('i3d', 'raft'):
+            with pytest.raises(ComputeDtypeError) as e:
+                check_compute_dtype(ft, lane)
+            msg = str(e.value)
+            assert f'compute_dtype={lane} is refused' in msg
+            assert ft in msg and '1e-3' in msg and 'precision=mixed' in msg
+    # families with a bf16 bound but NO int8 bound refuse int8 with the
+    # generic opt-in message naming the right registry set
+    with pytest.raises(ComputeDtypeError) as e:
+        check_compute_dtype('vggish', 'int8')
+    assert 'compute_dtype=int8 is refused' in str(e.value)
+    assert 'INT8_FEATURES' in str(e.value)
     with pytest.raises(ComputeDtypeError):
         check_compute_dtype('resnet', 'float16')    # unknown value
+    # fp8: structured not-yet naming backend support as the gate
+    with pytest.raises(ComputeDtypeError) as e:
+        check_compute_dtype('resnet', 'float8_e4m3fn')
+    assert 'backend' in str(e.value) and 'int8' in str(e.value)
     assert check_compute_dtype('i3d', 'float32') == 'float32'
     assert check_compute_dtype('resnet', 'bfloat16') == 'bfloat16'
+    assert check_compute_dtype('resnet', 'int8') == 'int8'
+    assert check_compute_dtype('vggish', 'bfloat16') == 'bfloat16'
 
 
 def test_param_np_dtype():
     import ml_dtypes
     assert param_np_dtype('float32') == np.dtype(np.float32)
     assert param_np_dtype('bfloat16') == np.dtype(ml_dtypes.bfloat16)
+    assert param_np_dtype('int8') == np.dtype(np.int8)
+    # exhaustive dispatch: an unrecognized lane raises instead of the
+    # old silent float32 fall-through
+    for bad in ('float16', 'int4', 'fp8', ''):
+        with pytest.raises(ComputeDtypeError):
+            param_np_dtype(bad)
 
 
 def test_compute_dtype_is_identity_on_both_axes():
     """The KNOB_CLASSIFICATION 'both' contract, pinned via the two REAL
-    consumers: fp32 and bf16 runs of the same video must produce
+    consumers: runs of the same video on any two lanes must produce
     distinct cache fingerprints (never share a cache entry) and
     distinct serve pool keys (never share a warm program)."""
     from video_features_tpu.cache.key import config_fingerprint
@@ -218,10 +273,11 @@ def test_compute_dtype_is_identity_on_both_axes():
     base = dict(feature_type='resnet', model_name='resnet18',
                 batch_size=8, device='cpu', output_path='/o',
                 tmp_path='/t')
-    f32 = Config(base, compute_dtype='float32')
-    bf16 = Config(base, compute_dtype='bfloat16')
-    assert config_fingerprint(f32) != config_fingerprint(bf16)
-    assert pool_key(f32) != pool_key(bf16)
+    cfgs = [Config(base, compute_dtype=lane) for lane in COMPUTE_DTYPES]
+    fps = [config_fingerprint(c) for c in cfgs]
+    keys = [pool_key(c) for c in cfgs]
+    assert len(set(fps)) == len(COMPUTE_DTYPES)
+    assert len(set(keys)) == len(COMPUTE_DTYPES)
 
 
 def test_bf16_islands_and_epilogue_cast_tier1():
@@ -260,13 +316,150 @@ def test_bf16_islands_and_epilogue_cast_tier1():
     assert features_to_f32(xb).dtype == jnp.float32
 
 
+def test_int8_quant_dequant_numerics_tier1():
+    """Build-free tier-1 slice of the int8 lane's numerics: the
+    quantizer's per-channel scales, symmetric clip, zero-guard and the
+    in-graph dequant roundtrip — plus the load-bearing structural
+    identity (dequantize_tree on a PLAIN tree adds zero graph ops, which
+    is what keeps the fp32 lane's StableHLO byte-identical with the call
+    compiled into every accepting family's forward). The full
+    per-family error ladder — real builds, measured drift vs the pinned
+    bounds — lives in the slow lane below; tier-1's STRUCTURAL int8
+    gate is the lock census in test_programs."""
+    import jax
+
+    from video_features_tpu.ops.quant import (
+        QMAX, QuantizedTensor, dequantize_tree, quantize_array,
+        quantize_flat, tree_is_quantized,
+    )
+
+    rng = np.random.RandomState(0)
+    # per-channel: each output channel's amax maps exactly to +/-127
+    w = (rng.randn(3, 3, 8, 16) * np.linspace(0.1, 4.0, 16)).astype(
+        np.float32)
+    qt = quantize_array(w)
+    assert qt.q.dtype == np.int8 and qt.q.shape == w.shape
+    assert qt.scale.dtype == np.float32
+    assert qt.scale.shape == (1, 1, 1, 16)
+    assert int(np.abs(qt.q).max()) == QMAX
+    np.testing.assert_allclose(
+        qt.scale.ravel(), np.abs(w).max(axis=(0, 1, 2)) / QMAX)
+    # roundtrip error bounded by scale/2 per element (round-to-nearest)
+    deq = np.asarray(qt.dequantize())
+    assert np.abs(deq - w).max() <= float(qt.scale.max()) / 2 + 1e-7
+    # axis-0 channel layout (CLIP's torch-layout in_proj_weight)
+    qt0 = quantize_array(rng.randn(24, 8).astype(np.float32), axis=0)
+    assert qt0.scale.shape == (24, 1)
+    # all-zero channel: scale guards to 1.0, payload is zeros
+    wz = np.zeros((4, 3), np.float32)
+    wz[:, 0] = 5.0
+    qz = quantize_array(wz)
+    assert np.all(np.asarray(qz.scale).ravel()[1:] == 1.0)
+    assert np.all(qz.q[:, 1:] == 0)
+    assert np.isfinite(np.asarray(qz.dequantize())).all()
+    # eligibility (the transplant re-layout rule): weights quantize,
+    # biases/norm params stay fp32, embedding tables and the skip set
+    # stay fp32, in_proj_weight rides the axis-0 path
+    flat = {
+        'conv1.weight': rng.randn(3, 3, 3, 8).astype(np.float32),
+        'fc.weight': rng.randn(16, 10).astype(np.float32),
+        'fc.bias': rng.randn(10).astype(np.float32),
+        'bn.weight': rng.randn(8).astype(np.float32),
+        'token_embedding.weight': rng.randn(50, 16).astype(np.float32),
+        'attn.in_proj_weight': rng.randn(48, 16).astype(np.float32),
+        'skipme.weight': rng.randn(4, 4).astype(np.float32),
+    }
+    q = quantize_flat(flat, skip={'skipme.weight'})
+    assert isinstance(q['conv1.weight'], QuantizedTensor)
+    assert isinstance(q['fc.weight'], QuantizedTensor)
+    assert isinstance(q['attn.in_proj_weight'], QuantizedTensor)
+    assert q['attn.in_proj_weight'].scale.shape == (48, 1)
+    for kept in ('fc.bias', 'bn.weight', 'token_embedding.weight',
+                 'skipme.weight'):
+        assert q[kept].dtype == np.float32, kept
+    # dequantize_tree: expands quantized leaves, identity on plain trees
+    tree = {'a': {'w': quantize_array(w)}, 'b': flat['fc.bias']}
+    assert tree_is_quantized(tree) and not tree_is_quantized(flat)
+    out = dequantize_tree(tree)
+    assert out['a']['w'].dtype == jax.numpy.float32
+    assert out['b'] is tree['b']          # untouched leaf, same object
+    # the structural-identity proof: on a plain tree the compiled
+    # program contains NO convert/multiply from the dequant seam
+    plain = {'w': flat['fc.weight'], 'b': flat['fc.bias']}
+
+    def fwd(p, x):
+        p = dequantize_tree(p)
+        return x @ p['w'] + p['b']
+
+    x = rng.randn(2, 16).astype(np.float32)
+    jx = jax.make_jaxpr(fwd)(plain, x)
+    assert 'convert' not in str(jx)
+    # and on a quantized tree the SAME forward computes the dequantized
+    # matmul
+    qplain = {'w': quantize_array(flat['fc.weight']), 'b': plain['b']}
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(fwd)(qplain, x)),
+        x @ np.asarray(qplain['w'].dequantize()) + plain['b'], rtol=1e-5)
+
+
+def test_int8_scale_table_roundtrip(tmp_path):
+    """The checkpoint-adjacent calibration store: derived scales pin to
+    <ckpt>.int8-scales.npz, load back bit-identical, and
+    load_torch_checkpoint consumes a pinned table automatically on the
+    int8 lane (same quantized bytes as the derived path — the table is
+    the derived scales made explicit)."""
+    from video_features_tpu.ops.quant import (
+        derive_scales, load_scale_table, save_scale_table,
+        scale_table_path,
+    )
+    from video_features_tpu.transplant.torch2jax import (
+        load_torch_checkpoint, save_transplanted,
+    )
+    rng = np.random.RandomState(1)
+    params = {'conv': {'weight': rng.randn(3, 3, 4, 8).astype(np.float32),
+                       'bias': rng.randn(8).astype(np.float32)}}
+    ckpt = str(tmp_path / 'model.npz')
+    save_transplanted(params, ckpt)
+    flat = {'conv.weight': params['conv']['weight'],
+            'conv.bias': params['conv']['bias']}
+    scales = derive_scales(flat)
+    assert set(scales) == {'conv.weight'}
+    table = scale_table_path(ckpt)
+    assert table == f'{ckpt}.int8-scales.npz'
+    save_scale_table(table, scales, meta={'measured_rel_l2': '1e-2'})
+    loaded = load_scale_table(table)
+    np.testing.assert_array_equal(loaded['conv.weight'],
+                                  scales['conv.weight'])
+    assert load_scale_table(str(tmp_path / 'absent.npz')) == {}
+    # the int8 load path consumes the pinned table
+    from video_features_tpu.ops.quant import QuantizedTensor
+    loaded_params = load_torch_checkpoint(ckpt, dtype=np.int8)
+    qt = loaded_params['conv']['weight']
+    assert isinstance(qt, QuantizedTensor)
+    np.testing.assert_array_equal(np.asarray(qt.scale).ravel(),
+                                  scales['conv.weight'].ravel())
+    assert loaded_params['conv']['bias'].dtype == np.float32
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize('ft', sorted(_BF16_CASES))
-def test_bf16_lane_parity_all_families(ft, tmp_path):
-    """The full lane gate, one family per case: real extractor builds on
-    both lanes, identical inputs, measured rel-L2 under the pinned
-    bound, all-bf16 params census, float32 feature outputs."""
-    _assert_lane_contract(ft, str(tmp_path))
+def test_bf16_lane_parity_all_families(ft, tmp_path, _f32_reference):
+    """The full bf16 lane gate, one family per case: real extractor
+    builds (fp32 reference shared module-wide), identical inputs,
+    measured rel-L2 under the pinned bound, all-bf16 params census,
+    float32 feature outputs."""
+    _assert_lane_contract(ft, 'bfloat16', str(tmp_path),
+                          _f32_reference(ft))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('ft', sorted(INT8_REL_L2_BOUNDS))
+def test_int8_lane_parity_all_families(ft, tmp_path, _f32_reference):
+    """The full int8 lane gate for every accepting family: real builds
+    (fp32 reference shared with the bf16 ladder above), identical
+    inputs, measured rel-L2 under the pinned INT8_REL_L2_BOUNDS entry,
+    int8-majority params census, float32 feature outputs."""
+    _assert_lane_contract(ft, 'int8', str(tmp_path), _f32_reference(ft))
 
 
 def test_iter_early_pin_structurally_sound():

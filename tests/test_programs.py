@@ -33,7 +33,7 @@ from video_features_tpu.analysis.programs import (
     write_lock,
 )
 from video_features_tpu.parallel.mesh import make_mesh
-from video_features_tpu.registry import BF16_FEATURES
+from video_features_tpu.registry import BF16_FEATURES, INT8_FEATURES
 
 
 def sig_and_findings(spec, family='toy', width=1, mesh=None):
@@ -305,6 +305,8 @@ def test_shipped_lock_covers_all_families_at_both_widths():
         want = {'mesh1', 'mesh2'}
         if family in BF16_FEATURES:
             want |= {'mesh1@bfloat16', 'mesh2@bfloat16'}
+        if family in INT8_FEATURES:
+            want |= {'mesh1@int8', 'mesh2@int8'}
         assert set(entry) == want, family
         for mesh in entry.values():
             assert mesh['programs'], family
@@ -330,13 +332,42 @@ def test_shipped_bf16_variants_census_is_pure_bf16():
     assert checked >= 2 * len(BF16_FEATURES)   # both widths per family
 
 
+def test_shipped_int8_variants_census_is_int8_majority():
+    """The int8 lane's load-bearing acceptance against the committed
+    lock: every compute_dtype=int8 variant carries int8 params and its
+    DECLARED fp32 minority (biases, norm params, per-channel scales)
+    stays strictly under the int8 payload bytes — proof the per-channel
+    weight quantization reached the conv/linear bulk of every accepting
+    family (CLIP's fused in_proj_weight included, which alone would
+    flip the byte majority if missed)."""
+    doc = load_lock(default_lock_path())
+    checked = 0
+    for family in sorted(INT8_FEATURES):
+        for key, entry in doc['families'][family].items():
+            if '@int8' not in key:
+                continue
+            for name, sig in entry['programs'].items():
+                census = sig['params']
+                assert 'int8' in census, (family, key, name, census)
+                assert census['int8']['arrays'] > 0
+                assert 'float64' not in census, (family, key, name)
+                f32 = census.get('float32', {}).get('bytes', 0)
+                assert f32 < census['int8']['bytes'], (family, key, name,
+                                                       census)
+                checked += 1
+    assert checked >= 2 * len(INT8_FEATURES)   # both widths per family
+
+
 def test_lane_helpers_roundtrip():
     assert mesh_key(1, 'float32') == 'mesh1'          # pre-lane keys hold
     assert mesh_key(2, 'bfloat16') == 'mesh2@bfloat16'
+    assert mesh_key(2, 'int8') == 'mesh2@int8'
     assert parse_mesh_key('mesh1') == (1, 'float32')
     assert parse_mesh_key('mesh2@bfloat16') == (2, 'bfloat16')
+    assert parse_mesh_key('mesh1@int8') == (1, 'int8')
     assert lane_families('float32', FAMILIES) == FAMILIES
     assert set(lane_families('bfloat16', FAMILIES)) == BF16_FEATURES
+    assert set(lane_families('int8', FAMILIES)) == INT8_FEATURES
 
 
 def test_bf16_census_rule_catches_fp32_survivor():
@@ -354,6 +385,31 @@ def test_bf16_census_rule_catches_fp32_survivor():
     assert '@bfloat16' in bf16_findings[0].render()
     assert check_program(spec, sig, 'toy', 1, None,
                          lane='float32') == []
+
+
+def test_int8_census_rule_catches_unquantized_params():
+    """An int8-lane program must carry int8 params OUTWEIGHING its fp32
+    minority: a plain-fp32 toy trips 'int8-census' (nothing quantized),
+    a quantized toy with a small fp32 scale rides clean — and the same
+    fp32 signature on the float32 lane must not fire (fp32 params are
+    that lane's contract)."""
+    w8 = jax.ShapeDtypeStruct((64, 8), np.int8)     # 512 int8 bytes
+    sc = jax.ShapeDtypeStruct((1, 8), np.float32)   # 32 fp32 bytes
+    fq = jax.jit(lambda q, s, b: (b.astype(np.float32)
+                                  @ (q.astype(np.float32) * s)))
+    b64 = jax.ShapeDtypeStruct((4, 64), np.uint8)
+    spec_ok = ProgramSpec('step', fq, (w8, sc, b64))
+    sig_ok = program_signature(spec_ok)
+    assert check_program(spec_ok, sig_ok, 'toy', 1, None,
+                         lane='int8') == []
+    # unquantized: fp32-only params on the int8 lane
+    f = jax.jit(lambda p, b: b.astype(np.float32).sum(axis=1) * p)
+    spec = ProgramSpec('step', f, (P, B4))
+    sig = program_signature(spec)
+    findings = check_program(spec, sig, 'toy', 1, None, lane='int8')
+    assert rules_of(findings) == {'int8-census'}
+    assert '@int8' in findings[0].render()
+    assert check_program(spec, sig, 'toy', 1, None, lane='float32') == []
 
 
 def test_bf16_lane_collect_and_lock_roundtrip(tmp_path):

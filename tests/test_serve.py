@@ -236,6 +236,46 @@ def test_tracer_merge_reports():
     assert m['decode']['count'] == 1
 
 
+def test_device_placer_stacks_int8_quarter_size_entries():
+    """The precision ladder's serve payoff, pinned with NO placer code
+    change: int8 entries are ~quarter the fp32 params bytes, so the
+    byte-first ranking stacks TWO int8 entries plus a bf16 entry on one
+    chip before a second fp32 copy lands there — and the
+    ``vft_device_resident_bytes`` gauges read the QUANTIZED residency,
+    not a per-entry count."""
+    import jax
+
+    from video_features_tpu.serve.pool import DevicePlacer
+
+    devices = jax.devices()[:2]
+    placer = DevicePlacer()
+    FP32, BF16, INT8 = 4000, 2000, 1000     # the ladder's byte ratios
+    fp32_a = placer.assign(devices, 1, nbytes=FP32)
+    int8_a = placer.assign(devices, 1, nbytes=INT8)
+    int8_b = placer.assign(devices, 1, nbytes=INT8)
+    bf16_a = placer.assign(devices, 1, nbytes=BF16)
+    # the small-lane chip absorbs both int8 entries AND the bf16 entry
+    # (1000+1000+2000 = 4000 bytes) before the fp32 chip takes anything
+    # else — byte ranking, where entry-count ranking would have
+    # alternated chips after the first int8 landed
+    assert int8_a[0].id != fp32_a[0].id
+    assert int8_b[0].id == int8_a[0].id
+    assert bf16_a[0].id == int8_a[0].id
+    by_bytes = placer.snapshot_bytes()
+    assert by_bytes[f'd{fp32_a[0].id}'] == FP32
+    assert by_bytes[f'd{int8_a[0].id}'] == 2 * INT8 + BF16
+    # now the ledger is level (4000 vs 4000): the NEXT fp32 copy ties on
+    # bytes, ties on nothing else but entry count (1 vs 3) — it lands on
+    # the fp32 chip, keeping the quantized stack intact
+    fp32_b = placer.assign(devices, 1, nbytes=FP32)
+    assert fp32_b[0].id == fp32_a[0].id
+    for entry, size in ((fp32_a, FP32), (fp32_b, FP32), (bf16_a, BF16),
+                        (int8_a, INT8), (int8_b, INT8)):
+        placer.release(entry, nbytes=size)
+    assert set(placer.snapshot_bytes().values()) == {0}
+    assert set(placer.snapshot().values()) == {0}
+
+
 # -- the live server ---------------------------------------------------------
 
 def test_serve_lifecycle_warm_parity_fault_sigterm_resume(

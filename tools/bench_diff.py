@@ -14,7 +14,8 @@ Direction-aware: throughput-like rungs (``*clips_per_sec*``,
 families, → N when fusion works) regress when they DROP;
 latency/duration-like rungs (``*latency*``, ``*_s`` suffixed) regress
 when they RISE. Numeric MEASURED-ERROR rungs (``*_error*`` fields the
-bf16 lane records: ``*_max_abs_error`` / ``*_rel_l2_error``) are
+precision-ladder lanes record — bf16 and int8 alike:
+``*_max_abs_error`` / ``*_rel_l2_error``) are
 lower-is-better for display but FLAGGED-NEVER-GATED like config
 metadata — drift there is bounded by tests/test_precision.py's pinned
 per-family bounds, not by a cross-round percentage (random-weight
@@ -58,8 +59,9 @@ def is_config_metadata(name: str) -> bool:
 
 
 def is_error_rung(name: str) -> bool:
-    """Numeric measured-error rungs (the bf16 lane's ``*_max_abs_error``
-    / ``*_rel_l2_error`` fields). Lower is better, but NEVER gated:
+    """Numeric measured-error rungs (the precision ladder's
+    ``*_max_abs_error`` / ``*_rel_l2_error`` fields — every bf16 and
+    int8 rung records them). Lower is better, but NEVER gated:
     their absolute bound lives in tests/test_precision.py — a
     percentage diff across rounds (different weights, geometry,
     platform) is noise, not signal. Suffix-matched exactly: a future

@@ -9,11 +9,13 @@ dropped donation, or a weight tensor accidentally captured by closure
 (baked into the HLO as a constant) ships invisibly. This module
 AOT-lowers every family's *actual* jitted step — the same callable the
 hot paths dispatch — at a canonical abstract geometry, on CPU, at mesh
-widths {1, 2} (forced host devices) and, for families that accept the
-bf16 fast lane (``registry.BF16_FEATURES``), on BOTH compute_dtype
-lanes (``mesh<n>`` = float32 as always; ``mesh<n>@bfloat16`` = the fast
-lane, whose parameter dtype census proves the transplant cast left no
-fp32 param behind — the ``bf16-census`` rule), and
+widths {1, 2} (forced host devices) and, for families that accept a
+compute_dtype fast lane, on EVERY lane they accept (``mesh<n>`` =
+float32 as always; ``mesh<n>@bfloat16`` for ``registry.BF16_FEATURES``,
+whose parameter dtype census proves the transplant cast left no fp32
+param behind — the ``bf16-census`` rule; ``mesh<n>@int8`` for
+``registry.INT8_FEATURES``, whose census proves the weight quantization
+ran and fp32 is the declared minority — the ``int8-census`` rule), and
 
   * extracts an **abstract signature** per program: batch/output avals
     (weak types included), the full parameter dtype census, the declared
@@ -74,25 +76,30 @@ MESH_WIDTHS = (1, 2)
 
 # compute_dtype lanes the lock pins per family: 'float32' entries keep
 # their historical mesh<n> keys byte-for-byte (the default path must
-# never drift when a lane is added), 'bfloat16' variants land under
-# mesh<n>@bfloat16 for every family in registry.BF16_FEATURES — their
-# parameter dtype census is the proof that the transplant-time cast
-# left NO fp32 param behind (the bf16-census rule below).
-LANES = ('float32', 'bfloat16')
+# never drift when a lane is added), fast-lane variants land under
+# mesh<n>@<lane> for every family in the lane's registry opt-in set —
+# their parameter dtype census is the per-lane proof the storage
+# transform actually happened: 'bfloat16' (registry.BF16_FEATURES) must
+# carry ZERO fp32 params (the bf16-census rule below), 'int8'
+# (registry.INT8_FEATURES) must carry int8 weight payloads with float32
+# reduced to the DECLARED minority — scales, biases, norm params
+# (the int8-census rule below).
+LANES = ('float32', 'bfloat16', 'int8')
 
 RULES = ('no-f64', 'no-weak-type', 'no-host-callback', 'donation',
-         'shardable', 'const-budget', 'bf16-census')
+         'shardable', 'const-budget', 'bf16-census', 'int8-census')
 
 
 def lane_families(lane: str, families: Iterable[str]) -> tuple:
     """The subset of ``families`` that builds on ``lane`` — every family
-    for float32; only the opted-in ``registry.BF16_FEATURES`` for the
-    bf16 fast lane (the rest REFUSE the knob at config time, which is
-    itself contract-tested — not a lock gap)."""
+    for float32; only the lane's registry opt-in set for the fast lanes
+    (``BF16_FEATURES`` / ``INT8_FEATURES`` — the rest REFUSE the knob at
+    config time, which is itself contract-tested, not a lock gap)."""
     if lane == 'float32':
         return tuple(families)
-    from video_features_tpu.registry import BF16_FEATURES
-    return tuple(f for f in families if f in BF16_FEATURES)
+    from video_features_tpu.registry import BF16_FEATURES, INT8_FEATURES
+    accepted = BF16_FEATURES if lane == 'bfloat16' else INT8_FEATURES
+    return tuple(f for f in families if f in accepted)
 
 
 def mesh_key(width: int, lane: str) -> str:
@@ -423,6 +430,34 @@ def check_program(spec: ProgramSpec, sig: Dict[str, Any], family: str,
                    f'{detail} in its parameter census — the '
                    f'transplant-time cast (torch2jax dtype seam) missed '
                    f'them; bf16 params must be bf16 in HBM')
+    if lane == 'int8':
+        # the int8 lane's proof, same shape as bf16's but with a
+        # DECLARED fp32 minority: weights dominate a model's bytes, so
+        # after quantization (ops/quant.py) the census must show int8
+        # payloads outweighing the fp32 leftovers (per-channel scales,
+        # biases, norm params, embedding tables). fp32 bytes >= int8
+        # bytes means the quantizer missed the weights — full-size HBM
+        # residency under an "int8" label.
+        census = sig['params']
+        if 'float64' in census:
+            report('int8-census',
+                   'compute_dtype=int8 program carries float64 params — '
+                   'no lane stores f64')
+        if 'int8' not in census:
+            report('int8-census',
+                   'compute_dtype=int8 program has NO int8 params in '
+                   'its census — the transplant-time quantization '
+                   '(ops/quant.py via the torch2jax dtype seam) never '
+                   'ran')
+        else:
+            f32 = census.get('float32', {}).get('bytes', 0)
+            i8 = census['int8']['bytes']
+            if f32 >= i8:
+                report('int8-census',
+                       f'compute_dtype=int8 program carries more float32 '
+                       f'param bytes ({f32}) than int8 ({i8}) — float32 '
+                       f'must be the declared minority (scales/biases/'
+                       f'norm params); the quantizer missed the weights')
     return findings
 
 
@@ -641,8 +676,9 @@ def main(argv=None) -> int:
                         '--xla_force_host_platform_device_count=2)')
     parser.add_argument('--lanes', default=','.join(LANES),
                         help='comma-separated compute_dtype lanes to '
-                        'check/pin (default: float32,bfloat16 — the '
-                        'bf16 lane covers registry.BF16_FEATURES only)')
+                        'check/pin (default: float32,bfloat16,int8 — '
+                        'each fast lane covers only its registry opt-in '
+                        'set, BF16_FEATURES / INT8_FEATURES)')
     parser.add_argument('--lock', help='lock file path (default: '
                         f'<repo>/{DEFAULT_LOCK})')
     parser.add_argument('--write-lock', action='store_true',

@@ -68,17 +68,20 @@ PIPELINE_DEFAULTS: Dict[str, Any] = {
     # knob only drives the PACKED paths (pack_across_videos / serve) —
     # the per-video loop keeps data_parallel for in-graph DP.
     'mesh_devices': 1,
-    # the bf16 fast lane (ops/precision.py, docs/benchmarks.md "bf16
-    # fast lane"): 'float32' (default) is exactly today's numerics;
-    # 'bfloat16' casts params to bf16 at transplant time (half the HBM
-    # residency + H2D bytes) and runs bf16 activations with fp32
-    # accumulation islands, under a measured per-family max-abs error
+    # the precision ladder (ops/precision.py, docs/benchmarks.md
+    # "precision ladder"): 'float32' (default) is exactly today's
+    # numerics; 'bfloat16' casts params to bf16 at transplant time (half
+    # the HBM residency + H2D bytes) and runs bf16 activations with fp32
+    # accumulation islands; 'int8' quantizes conv/linear weights
+    # per-output-channel symmetric int8 at transplant time (a QUARTER of
+    # the fp32 param bytes, ops/quant.py) with in-graph dequant and fp32
+    # activations. Each lane sits under a measured per-family rel-L2
     # bound (tests/test_precision.py). Orthogonal to the matmul
-    # `precision=` knob. Families without a pinned bound REFUSE it with
-    # a structured build-time error (registry.BF16_FEATURES); outputs
-    # are NOT byte-identical across lanes, so the knob is classified
-    # 'both' — fp32 and bf16 artifacts never share a cache entry or a
-    # warm serve program.
+    # `precision=` knob. Families without a pinned bound REFUSE a lane
+    # with a structured build-time error (registry.BF16_FEATURES /
+    # registry.INT8_FEATURES); outputs are NOT byte-identical across
+    # lanes, so the knob is classified 'both' — artifacts from different
+    # lanes never share a cache entry or a warm serve program.
     'compute_dtype': 'float32',
 }
 

@@ -84,13 +84,16 @@ class BaseExtractor:
         self.device = device
         self.concat_rgb_flow = concat_rgb_flow
         self.precision = precision
-        # bf16 fast lane (ops/precision.py): the STORAGE + activation
-        # dtype of the device step — 'float32' is byte-for-byte today's
-        # graph; 'bfloat16' halves params HBM/H2D and runs bf16
-        # activations with fp32 accumulation islands, under the family's
-        # pinned parity bound. sanity_check already refused unknown
-        # values and non-accepting families at config time; extractors
-        # constructed directly get the same guard here.
+        # compute_dtype fast lanes (ops/precision.py): the STORAGE (+
+        # activation) dtype of the device step — 'float32' is
+        # byte-for-byte today's graph; 'bfloat16' halves params HBM/H2D
+        # and runs bf16 activations with fp32 accumulation islands;
+        # 'int8' quarter-sizes params via per-output-channel weight
+        # quantization (ops/quant.py) with in-graph dequant and fp32
+        # activations — each under the family's pinned parity bound.
+        # sanity_check already refused unknown values and non-accepting
+        # families at config time; extractors constructed directly get
+        # the same guard here.
         from video_features_tpu.ops.precision import COMPUTE_DTYPES
         if compute_dtype not in COMPUTE_DTYPES:
             raise ValueError(f'compute_dtype must be one of '
@@ -177,9 +180,11 @@ class BaseExtractor:
     @property
     def param_dtype(self):
         """Numpy STORAGE dtype for transplanted params on this lane
-        (``ml_dtypes.bfloat16`` for the bf16 fast lane, else float32) —
-        what ``load_params`` hands the transplant layer's ``dtype=``
-        seam, so a bf16 entry's params are bf16 in HBM from build."""
+        (``ml_dtypes.bfloat16`` for the bf16 fast lane, ``int8`` for the
+        weight-quantized lane, else float32) — what ``load_params``
+        hands the transplant layer's ``dtype=`` seam, so a fast-lane
+        entry's params are reduced-size in HBM from build (int8 selects
+        the quantize-eligible-weights path, not a blanket cast)."""
         from video_features_tpu.ops.precision import param_np_dtype
         return param_np_dtype(self.compute_dtype)
 
@@ -188,7 +193,9 @@ class BaseExtractor:
         """The jnp activation dtype the device step casts its uint8
         input to — threaded into each family's jitted forward as a
         trace-time constant, so the float32 lane's program is
-        byte-identical to the pre-knob graph."""
+        byte-identical to the pre-knob graph. The int8 lane ACTIVATES in
+        float32 (only weight storage is quantized; the in-graph dequant
+        lands in the fp32 compute path)."""
         import jax.numpy as jnp
         return jnp.bfloat16 if self.compute_dtype == 'bfloat16' \
             else jnp.float32

@@ -80,8 +80,9 @@ class ExtractCLIP(BaseFrameWiseExtractor):
         if ckpt and str(ckpt).endswith('.npz'):
             # via load_torch_checkpoint for the same float32 upcast the
             # .pt path (and every other extractor) applies — or the bf16
-            # storage cast when the fast lane is on. args because this
-            # runs before super().__init__ sets self.compute_dtype.
+            # storage cast / int8 weight quantization when a fast lane is
+            # on. args because this runs before super().__init__ sets
+            # self.compute_dtype.
             from video_features_tpu.ops.precision import param_np_dtype
             from video_features_tpu.transplant.torch2jax import (
                 load_torch_checkpoint,
@@ -102,6 +103,10 @@ class ExtractCLIP(BaseFrameWiseExtractor):
     @staticmethod
     def _forward(params, batch, arch, dtype=None):
         from video_features_tpu.ops.precision import features_to_f32
+        from video_features_tpu.ops.quant import dequantize_tree
+        # int8 lane: expand QuantizedTensor weights in-graph; structural
+        # identity (same StableHLO) on the fp32/bf16 lanes' plain trees
+        params = dequantize_tree(params, dtype)
         x = to_float_zero_one(batch, dtype)
         x = normalize(x, clip_model.MEAN, clip_model.STD)
         return features_to_f32(clip_model.encode_image(params, x, arch))
@@ -139,8 +144,11 @@ class ExtractCLIP(BaseFrameWiseExtractor):
                 return None, None
             self._classes = [f'a photo of {label}' for label in labels]
         tokens = tokenize(self._classes)
+        # one-shot narration path: dequantize eagerly for the int8 lane
+        # (identity otherwise) — the text tower reads raw weight arrays
+        from video_features_tpu.ops.quant import dequantize_tree
         feats = jax.jit(partial(clip_model.encode_text, model_name=self.arch))(
-            self.params, tokens)
+            dequantize_tree(self.params), tokens)
         self._text_feats = feats
         return self._text_feats, self._classes
 

@@ -52,6 +52,11 @@ class ExtractResNet(BaseFrameWiseExtractor):
     @staticmethod
     def _forward(params, batch, arch, dtype=None):
         from video_features_tpu.ops.precision import features_to_f32
+        from video_features_tpu.ops.quant import dequantize_tree
+        # int8 lane: expand QuantizedTensor weights in-graph (one
+        # convert+multiply each); structural identity — zero ops, same
+        # StableHLO — on the fp32/bf16 lanes' plain trees
+        params = dequantize_tree(params, dtype)
         x = to_float_zero_one(batch, dtype)
         x = normalize(x, resnet_model.MEAN, resnet_model.STD)
         return features_to_f32(
@@ -74,7 +79,9 @@ class ExtractResNet(BaseFrameWiseExtractor):
 
     def maybe_show_pred(self, feats: np.ndarray) -> None:
         from video_features_tpu.ops.nn import linear
+        from video_features_tpu.ops.quant import dequantize_tree
         from video_features_tpu.utils.preds import show_predictions_on_dataset
         import jax.numpy as jnp
-        logits = np.asarray(linear(jnp.asarray(feats), self.params['fc']))
+        logits = np.asarray(linear(jnp.asarray(feats),
+                                   dequantize_tree(self.params['fc'])))
         show_predictions_on_dataset(logits, 'imagenet1k')

@@ -283,6 +283,10 @@ class ExtractTIMM(BaseFrameWiseExtractor):
     @staticmethod
     def _forward(params, batch, family, arch, mean, std, dtype=None):
         from video_features_tpu.ops.precision import features_to_f32
+        from video_features_tpu.ops.quant import dequantize_tree
+        # int8 lane: expand QuantizedTensor weights in-graph; structural
+        # identity (same StableHLO) on the fp32/bf16 lanes' plain trees
+        params = dequantize_tree(params, dtype)
         x = to_float_zero_one(batch, dtype)
         x = normalize(x, mean, std)
         return features_to_f32(
@@ -326,6 +330,8 @@ class ExtractTIMM(BaseFrameWiseExtractor):
             return
         import jax.numpy as jnp
         from video_features_tpu.ops.nn import linear
+        from video_features_tpu.ops.quant import dequantize_tree
         from video_features_tpu.utils.preds import show_predictions_on_dataset
-        logits = np.asarray(linear(jnp.asarray(feats), head))
+        logits = np.asarray(linear(jnp.asarray(feats),
+                                   dequantize_tree(head)))
         show_predictions_on_dataset(logits, 'imagenet1k')

@@ -98,11 +98,15 @@ def load_or_init(args: Any, key: str, init_fn: Callable[[], Dict[str, Any]],
 
     ``load`` overrides the default :func:`load_torch_checkpoint` for
     families with special checkpoint handling. ``dtype`` is the STORAGE
-    dtype floating params are cast to at transplant time (the bf16 fast
-    lane's seam — ``compute_dtype=bfloat16`` extractors pass
+    dtype floating params are cast to at transplant time (the fast
+    lanes' seam — ``compute_dtype=bfloat16`` extractors pass
     ``ml_dtypes.bfloat16`` here so params are bf16 in HBM from the first
-    ``device_put``, never cast per-step); None keeps the historical
-    float32 default.
+    ``device_put``, never cast per-step; ``compute_dtype=int8``
+    extractors pass ``np.int8``, which the transplant layer treats as
+    "quantize eligible conv/linear weights per-output-channel, float32
+    for the rest" — ops/quant.py — consuming any pinned
+    ``<ckpt>.int8-scales.npz`` calibration table automatically); None
+    keeps the historical float32 default.
     """
     from video_features_tpu.transplant.torch2jax import (
         load_torch_checkpoint, transplant,

@@ -1,0 +1,262 @@
+"""Post-training int8 weight quantization (the ``compute_dtype=int8`` lane).
+
+The precision ladder's bottom rung: conv/linear weights are quantized ONCE
+at transplant time — per-output-channel symmetric int8, the standard
+post-training weight-only scheme — and dequantized IN-GRAPH at use, so
+
+  * params are int8 in HBM from the first ``device_put`` (a quarter of the
+    fp32 residency and H2D bytes; the byte-ranked serve ``DevicePlacer``
+    stacks ~4x the warm entries per chip),
+  * activations stay in the fp32 compute path (``compute_jnp_dtype`` is
+    float32 for this lane — the dequant emits one convert+multiply per
+    weight, then the math is the float32 graph), and
+  * the float32/bf16 lanes are untouched: :func:`dequantize_tree` is a
+    structural identity on trees with no :class:`QuantizedTensor` in them,
+    so their StableHLO stays byte-identical (PROGRAMS.lock.json pins it).
+
+Layout contract: quantization runs AFTER the transplant re-layout
+(torch2jax), where the output channel is always the LAST axis — conv
+(*spatial, I, O), linear (I, O) — so the per-channel ``scale`` is a flat
+``(O,)`` float32 vector broadcasting over the last axis in both the
+quantizer and the in-graph dequant. Eligibility mirrors the transplant's
+own re-layout rule (``convert_tensor``): '.weight' tensors of ndim >= 2,
+minus the ``no_transpose`` embedding tables; biases, norm scales/stats and
+every other 1-D param stay float32 — the lane's DECLARED fp32 minority,
+which the vft-programs ``int8-census`` rule bounds (fp32 bytes < int8
+bytes per program).
+
+Scales are weight-derived and deterministic (amax/127 per channel), so a
+rebuild from the same checkpoint always lands the same int8 bytes. The
+calibration tool (``tools/calibrate_int8.py``) additionally PINS the
+per-tensor scale table into a checkpoint-adjacent ``.int8-scales.npz``
+(:func:`scale_table_path`) and measures the family's feature rel-L2 drift
+— a pinned table is consumed verbatim at build (:func:`load_scale_table`),
+making the quantization reproducible even across checkpoint re-exports
+that perturb weight bytes.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+import numpy as np
+
+# symmetric int8: the scale maps amax -> 127 and values clip to +/-127
+# (never -128 — symmetry keeps the dequant a single multiply, no zero
+# point anywhere in the graph)
+QMAX = 127
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """An int8-quantized weight: ``q`` (int8, transplanted layout) and the
+    per-output-channel ``scale`` (float32, broadcast shape — ``O`` on the
+    channel axis, 1 elsewhere). Registered as a pytree NODE so the whole
+    params machinery
+    (device_put, jit flattening, ``params_nbytes``, the vft-programs
+    parameter census, abstract ShapeDtypeStruct mapping) sees exactly two
+    leaves — the int8 payload and the fp32 scale — with no special cases.
+
+    Deliberately NOT array-duck-typed: models access weights as raw
+    arrays (``x @ p['weight']``, ``lax.conv_general_dilated``), and a
+    half-faithful wrapper would fail deep inside XLA instead of at the
+    seam. The one legal consumer is :func:`dequantize_tree` at the top of
+    an accepting family's forward — anything else touching a quantized
+    leaf raises immediately.
+    """
+
+    __slots__ = ('q', 'scale')
+
+    def __init__(self, q, scale) -> None:
+        self.q = q
+        self.scale = scale
+
+    def dequantize(self, dtype=None):
+        """``q * scale`` in ``dtype`` (float32 default) — the in-graph
+        use-site expansion: one convert + one broadcast multiply per
+        weight, then the downstream math is the ordinary float graph."""
+        import jax.numpy as jnp
+        dtype = dtype or jnp.float32
+        return jnp.asarray(self.q).astype(dtype) * jnp.asarray(
+            self.scale).astype(dtype)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        # no validation: unflatten must accept abstract leaves
+        # (ShapeDtypeStruct / tracers) for AOT lowering and tree_map
+        del aux
+        return cls(*children)
+
+    def __repr__(self) -> str:
+        return (f'QuantizedTensor(q={getattr(self.q, "shape", self.q)}, '
+                f'scale={getattr(self.scale, "shape", self.scale)})')
+
+
+def _derive_scale(a: np.ndarray, axis: int) -> np.ndarray:
+    """amax/127 per channel along ``axis``, in BROADCAST shape (1s on
+    every other axis) so the dequant is a plain multiply whatever the
+    channel axis is. All-zero channels get scale 1.0 (their int8 payload
+    is all zeros either way — the guard only keeps the dequant multiply
+    finite)."""
+    amax = np.max(np.abs(a), axis=tuple(
+        ax for ax in range(a.ndim) if ax != axis % a.ndim), keepdims=True)
+    scale = (amax / float(QMAX)).astype(np.float32)
+    return np.where(scale > 0, scale, np.float32(1.0)).astype(np.float32)
+
+
+def quantize_array(arr: np.ndarray,
+                   scale: Optional[np.ndarray] = None,
+                   axis: int = -1) -> QuantizedTensor:
+    """Per-output-channel symmetric int8 quantization of one transplanted
+    weight. ``axis`` is the output-channel axis — LAST for everything the
+    transplant re-laid-out (conv (*spatial, I, O), linear (I, O)), axis 0
+    for CLIP's torch-layout ``in_proj_weight`` (3E, E). ``scale``
+    overrides the derived amax/127 per-channel scales — the
+    calibration-table consumption path; any shape broadcastable against
+    ``arr`` with ``O`` channel entries."""
+    a = np.asarray(arr, dtype=np.float32)
+    if a.ndim < 2:
+        raise ValueError(f'per-channel quantization needs ndim >= 2; '
+                         f'got shape {a.shape}')
+    if scale is None:
+        scale = _derive_scale(a, axis)
+    else:
+        scale = np.asarray(scale, dtype=np.float32)
+        if scale.ndim != a.ndim:     # flat (O,) table entry → broadcast shape
+            shape = [1] * a.ndim
+            shape[axis % a.ndim] = scale.size
+            scale = scale.reshape(shape)
+        scale = np.where(scale > 0, scale,
+                         np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.rint(a / scale), -QMAX, QMAX).astype(np.int8)
+    return QuantizedTensor(q, scale)
+
+
+def _channel_axis(name: str, arr: Any,
+                  skip: Optional[set]) -> Optional[int]:
+    """Output-channel axis for one flat (dot-named, transplanted-layout)
+    entry, or None when it must stay float32. Mirrors the transplant
+    re-layout rule (torch2jax.convert_tensor): '.weight' tensors of
+    ndim >= 2 had their output channel moved LAST (axis -1) — minus the
+    ``no_transpose`` embedding/gather tables, which keep torch layout
+    and stay float32; embedding tables are ALSO excluded by name
+    ('...embedding.weight') because pre-transplanted .npz archives no
+    longer carry the conversion-time no_transpose set, and a gather
+    table has no output-channel axis to quantize along. CLIP's fused
+    attention ``in_proj_weight`` (torch layout (3E, E), transposed at
+    use) quantizes along axis 0."""
+    if skip and name in skip:
+        return None
+    arr = np.asarray(arr)
+    if arr.ndim < 2 or not np.issubdtype(arr.dtype, np.floating):
+        return None
+    if name.endswith('in_proj_weight'):
+        return 0
+    if not (name.endswith('.weight') or name == 'weight'):
+        return None
+    parts = name.split('.')
+    if len(parts) >= 2 and 'embedding' in parts[-2]:
+        return None
+    return -1
+
+
+def quantize_flat(flat: Mapping[str, np.ndarray], *,
+                  skip: Optional[set] = None,
+                  scales: Optional[Mapping[str, np.ndarray]] = None,
+                  ) -> Dict[str, Any]:
+    """int8-quantize every eligible weight of a FLAT (dot-named,
+    transplanted-layout) param dict; everything else is cast to float32 —
+    the lane's declared fp32 minority (biases, norm params, the scales
+    themselves). ``scales`` is a pinned per-tensor scale table
+    (:func:`load_scale_table`); absent entries fall back to the derived
+    weight amax scales, which are bit-identical for the same weight
+    bytes."""
+    out: Dict[str, Any] = {}
+    for name, arr in flat.items():
+        axis = _channel_axis(name, arr, skip)
+        if axis is not None:
+            out[name] = quantize_array(
+                arr, scale=scales.get(name) if scales else None,
+                axis=axis)
+        elif np.issubdtype(np.asarray(arr).dtype, np.floating):
+            out[name] = np.asarray(arr, dtype=np.float32)
+        else:
+            out[name] = arr
+    return out
+
+
+def dequantize_tree(params: Any, dtype=None) -> Any:
+    """Expand every :class:`QuantizedTensor` in ``params`` to its float
+    array (float32 default); a STRUCTURAL IDENTITY — same leaves, zero
+    graph ops — on trees that carry none, which is what keeps the
+    float32/bf16 lanes' StableHLO byte-identical with the call compiled
+    into every accepting family's forward."""
+    return jax.tree_util.tree_map(
+        lambda leaf: (leaf.dequantize(dtype)
+                      if isinstance(leaf, QuantizedTensor) else leaf),
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+
+
+def tree_is_quantized(params: Any) -> bool:
+    """True when any leaf of ``params`` is a :class:`QuantizedTensor`."""
+    found = False
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
+        if isinstance(leaf, QuantizedTensor):
+            found = True
+            break
+    return found
+
+
+# -- the checkpoint-adjacent scale table -------------------------------------
+
+def scale_table_path(checkpoint_path: str) -> str:
+    """The ONE naming convention for a checkpoint's pinned int8 scale
+    table: ``<ckpt>.int8-scales.npz`` right next to the checkpoint, so
+    the table travels with the weights it calibrates and a build resolves
+    it with no extra config knob."""
+    return f'{checkpoint_path}.int8-scales.npz'
+
+
+def save_scale_table(path: str, scales: Mapping[str, np.ndarray],
+                     meta: Optional[Mapping[str, str]] = None) -> None:
+    """Write a per-tensor scale table (flat dot-named keys -> float32
+    ``(O,)`` vectors). ``meta`` string entries ride along under
+    ``__meta_<key>`` (the calibration tool records the measured rel-L2
+    and the corpus it measured on)."""
+    payload = {k: np.asarray(v, np.float32) for k, v in scales.items()}
+    for k, v in (meta or {}).items():
+        payload[f'__meta_{k}'] = np.asarray(str(v))
+    np.savez(path, **payload)
+
+
+def load_scale_table(path: str) -> Dict[str, np.ndarray]:
+    """Read a :func:`save_scale_table` table back (meta entries dropped);
+    ``{}`` when the file does not exist — absent table means derived
+    scales, never an error."""
+    import os
+    if not os.path.exists(path):
+        return {}
+    with np.load(path) as data:
+        return {k: data[k] for k in data.files
+                if not k.startswith('__meta_')}
+
+
+def derive_scales(flat: Mapping[str, np.ndarray], *,
+                  skip: Optional[set] = None) -> Dict[str, np.ndarray]:
+    """The derived per-channel scales for every eligible weight of a flat
+    transplanted dict — what :func:`quantize_flat` would use; the
+    calibration tool pins exactly these into the table."""
+    out: Dict[str, np.ndarray] = {}
+    for name, arr in flat.items():
+        axis = _channel_axis(name, arr, skip)
+        if axis is not None:
+            out[name] = _derive_scale(np.asarray(arr, np.float32), axis)
+    return out

@@ -52,6 +52,19 @@ PACKED_FEATURES = frozenset(
 BF16_FEATURES = frozenset(
     {'r21d', 's3d', 'resnet', 'clip', 'timm', 'vggish'})
 
+# feature types whose extractor accepts the int8 weight lane
+# (compute_dtype=int8 — conv/linear weights quantized per-output-channel
+# symmetric int8 at transplant time, dequantized in-graph at use, fp32
+# activations; ops/quant.py). Same deliberate-literal policy as
+# BF16_FEATURES: a family joins ONLY once its rel-L2 drift vs the fp32
+# lane is measured and pinned (ops/precision.INT8_REL_L2_BOUNDS,
+# asserted by tests/test_precision.py). The set is the bandwidth-bound
+# framewise backbones the lane exists for; i3d/raft refuse by
+# measurement (ops/precision.INT8_REFUSALS — the same error amplifiers
+# that disqualify bf16), the video families (r21d/s3d/vggish) refuse by
+# the generic no-measured-bound rule until someone pins them.
+INT8_FEATURES = frozenset({'resnet', 'clip', 'timm'})
+
 # feature types whose extractor can consume a LIVE session (ingress/):
 # raw network frames windowed to the family's packed geometry
 # (BaseExtractor.live_window_spec). Same deliberate-literal policy: a

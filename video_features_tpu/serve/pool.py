@@ -157,7 +157,9 @@ class DevicePlacer:
     byte-first (entries, then device id, break ties): entries are not
     interchangeable HBM units — a bf16 fast-lane entry
     (``compute_dtype=bfloat16``) is ~half the params bytes of its fp32
-    sibling, so two bf16 entries should stack on one chip before a
+    sibling and an int8 weight-lane entry (``compute_dtype=int8``,
+    ops/quant.py) ~a quarter, so two bf16 entries — or four int8 ones —
+    should stack on one chip before a
     second fp32 copy does. Callers that don't know their size pass 0 and
     the ranking degrades to the historical entry-count ordering. Release
     on entry retirement (eviction reap, crash) returns the chips AND the

@@ -72,7 +72,9 @@ def nest(flat: Mapping[str, np.ndarray]) -> Dict[str, Any]:
 
 def transplant(state_dict: Mapping[str, Any],
                no_transpose: Optional[set] = None,
-               dtype: Optional[np.dtype] = None) -> Dict[str, Any]:
+               dtype: Optional[np.dtype] = None,
+               scales: Optional[Mapping[str, np.ndarray]] = None,
+               ) -> Dict[str, Any]:
     """Full pipeline: strip DP prefixes, convert layouts, nest, cast.
 
     Args:
@@ -80,16 +82,32 @@ def transplant(state_dict: Mapping[str, Any],
         no_transpose: names whose 2-D '.weight' must keep torch layout
             (embedding tables; see :func:`convert_tensor`).
         dtype: optional cast (e.g. np.float32 for CLIP's fp16 checkpoints).
+            ``np.int8`` selects the int8 WEIGHT-QUANTIZATION path instead
+            of a blanket astype: eligible conv/linear weights become
+            :class:`~video_features_tpu.ops.quant.QuantizedTensor` leaves
+            (per-output-channel symmetric, post-re-layout so the channel
+            axis is last), everything else stays float32 — the lane's
+            declared fp32 minority (ops/quant.py).
+        scales: pinned per-tensor int8 scale table (dot-named, from
+            ``tools/calibrate_int8.py`` via
+            :func:`~video_features_tpu.ops.quant.load_scale_table`);
+            int8 dtype only. Absent entries use the derived weight-amax
+            scales — deterministic either way.
     """
     no_transpose = set(no_transpose or ())
+    quantize = dtype is not None and np.dtype(dtype) == np.int8
     flat = {}
     for name, value in strip_dataparallel(state_dict).items():
         if name.endswith('num_batches_tracked'):
             continue  # torch BN bookkeeping, meaningless at inference
         arr = convert_tensor(name, value, no_transpose)
-        if dtype is not None and np.issubdtype(arr.dtype, np.floating):
+        if (not quantize and dtype is not None
+                and np.issubdtype(arr.dtype, np.floating)):
             arr = arr.astype(dtype)
         flat[name] = arr
+    if quantize:
+        from video_features_tpu.ops.quant import quantize_flat
+        flat = quantize_flat(flat, skip=no_transpose, scales=scales)
     return nest(flat)
 
 
@@ -104,13 +122,28 @@ def load_torch_checkpoint(path: str, dtype: Optional[np.dtype] = np.float32,
     them needs NO torch at all, which is how production TPU hosts deploy.
     ``key`` selects a sub-dict for torch checkpoints that wrap the
     state_dict (e.g. {'state_dict': ...} or {'model': ...}).
+
+    ``dtype=np.int8`` quantizes eligible weights instead of casting
+    (see :func:`transplant`); a pinned scale table sitting next to the
+    checkpoint (``<ckpt>.int8-scales.npz``, written by
+    tools/calibrate_int8.py) is consumed automatically.
     """
+    quantize = dtype is not None and np.dtype(dtype) == np.int8
+    scales = None
+    if quantize:
+        from video_features_tpu.ops.quant import (
+            load_scale_table, scale_table_path,
+        )
+        scales = load_scale_table(scale_table_path(str(path))) or None
     if str(path).endswith('.npz'):
         if key is not None or no_transpose is not None:
             raise ValueError(
                 '.npz archives are already transplanted: key/no_transpose '
                 'were applied at conversion time and cannot be re-applied')
         params = load_transplanted(path)
+        if quantize:
+            from video_features_tpu.ops.quant import quantize_flat
+            return nest(quantize_flat(_flatten(params), scales=scales))
         if dtype is not None:
             def cast(tree):
                 return {k: (cast(v) if isinstance(v, dict) else
@@ -127,7 +160,8 @@ def load_torch_checkpoint(path: str, dtype: Optional[np.dtype] = np.float32,
         ckpt = ckpt[key]
     elif isinstance(ckpt, dict) and 'state_dict' in ckpt:
         ckpt = ckpt['state_dict']
-    return transplant(ckpt, dtype=dtype, no_transpose=no_transpose)
+    return transplant(ckpt, dtype=dtype, no_transpose=no_transpose,
+                      scales=scales)
 
 
 def _flatten(tree: Mapping[str, Any], prefix: str = '') -> Dict[str, np.ndarray]:
